@@ -1,0 +1,69 @@
+"""Debug driver: step the 2-host UDP ping window by window, printing
+queue/state summaries. Used to diagnose engine/netstack issues."""
+
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import time
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from shadow_tpu.apps import pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import EngineStats, step_window
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.net.step import make_step_fn
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), "..", "tests"))
+from test_udp_ping import TWO_VERTEX, PORT
+
+
+def main():
+    cfg = NetConfig(num_hosts=2, end_time=10 * simtime.ONE_SECOND)
+    hosts = [
+        HostSpec(name="client", type="client", proc_start_time=simtime.ONE_SECOND),
+        HostSpec(name="server", type="server"),
+    ]
+    b = build(cfg, TWO_VERTEX, hosts)
+    client = jnp.asarray(np.arange(2) == b.host_of("client"))
+    server = jnp.asarray(np.arange(2) == b.host_of("server"))
+    sim = pingpong.setup(b.sim, client_mask=client, server_mask=server,
+                         server_ip=b.ip_of("server"), server_port=PORT,
+                         count=3, size=64)
+    step = make_step_fn(cfg, (pingpong.handler,))
+    stats = EngineStats.create()
+
+    t0 = time.perf_counter()
+    stepper = jax.jit(
+        lambda s, st, wend: step_window(s, st, step, wend, cfg.emit_capacity)
+    )
+    print(f"build done {time.perf_counter()-t0:.1f}s; min_jump={b.min_jump}")
+
+    wstart = int(jnp.min(sim.events.min_time()))
+    for i in range(40):
+        wend = min(wstart + b.min_jump, cfg.end_time + 1)
+        t0 = time.perf_counter()
+        sim, stats, next_min = stepper(sim, stats, wend)
+        next_min = int(next_min)
+        dt = time.perf_counter() - t0
+        app = sim.app
+        print(
+            f"w{i}: [{wstart/1e6:.1f},{wend/1e6:.1f})ms {dt:.2f}s "
+            f"ev={int(stats.events_processed)} us={int(stats.micro_steps)} "
+            f"sent={list(np.asarray(app.sent))} rcvd={list(np.asarray(app.rcvd))} "
+            f"qfill={list(np.asarray(sim.events.fill_count()))} "
+            f"next={next_min/1e6 if next_min < simtime.MAX else -1:.1f}ms"
+        )
+        if next_min > cfg.end_time:
+            print("done")
+            break
+        wstart = next_min
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,31 @@
+"""shadow_tpu — a TPU-native parallel discrete-event network simulator.
+
+A ground-up reimplementation of the capabilities of Shadow 1.x
+(reference: whzhe51/shadow) as JAX/XLA device programs:
+
+- Simulated time is int64 nanoseconds (ref: definitions.h:18).
+- Events live in per-host fixed-capacity device tensors instead of
+  locked heaps (ref: priority_queue.c, scheduler_policy_host_single.c);
+  the deterministic total order (time, dstHost, srcHost, seq)
+  (ref: event.c:110-153) is preserved exactly.
+- The conservative window barrier (ref: master.c:450-480,
+  scheduler.c:359-414) becomes a min-reduction over queue heads; on a
+  multi-chip mesh it is a cross-shard pmin.
+- Routing is a precomputed dense latency/reliability matrix
+  (ref: topology.c lazy Dijkstra cache) — a pure gather at send time.
+- Protocol state (TCP/UDP/NIC/router) is struct-of-arrays, updated by
+  masked vectorized handlers (ref: src/main/host/descriptor/*).
+
+Applications run against an explicit virtual-process API (coroutines on
+the host CPU, or compiled state machines on device) instead of Shadow's
+elf-loader/LD_PRELOAD native-binary interposition, which cannot exist on
+a TPU (ref: SURVEY.md §7.1).
+"""
+
+import jax
+
+# Simulated time is 64-bit nanoseconds throughout (ref: definitions.h:18).
+# This must be set before any jax computation in this process.
+jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

@@ -1,0 +1,134 @@
+"""On-device UDP ping/echo application — the 2-host tgen ping analog
+(BASELINE.json config #1; the reference runs tgen client/server
+binaries under interposition, SURVEY.md §7.1 replaces those with
+explicit app models).
+
+Client: at PROC_START, sends a `size`-byte datagram to the server;
+each reply triggers the next ping until `count` pings are done,
+accumulating round-trip times. Server: echoes every datagram back to
+its source.
+
+This app also documents the device-app pattern: socket setup happens
+at build time (outside jit); runtime logic is a masked batch handler
+appended after the netstack handlers, reacting to PROC_START and to
+data readiness on the app's socket.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net import nic, udp
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.net.rings import gather_hs
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+ROLE_NONE = 0
+ROLE_CLIENT = 1
+ROLE_SERVER = 2
+
+
+@struct.dataclass
+class PingPongApp:
+    role: jax.Array        # [H] i32
+    sock: jax.Array        # [H] i32 socket slot
+    server_ip: jax.Array   # [H] i64 (client: where to ping)
+    server_port: jax.Array  # [H] i32
+    size: jax.Array        # [H] i32 datagram payload bytes
+    remaining: jax.Array   # [H] i32 pings left to send
+    sent: jax.Array        # [H] i32
+    rcvd: jax.Array        # [H] i32 (client: replies; server: pings)
+    last_send: jax.Array   # [H] i64
+    rtt_sum: jax.Array     # [H] i64
+
+
+def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
+          count: int = 10, size: int = 64):
+    """Create + bind sockets and the app state (build time, host side)."""
+    H = sim.net.host_ip.shape[0]
+    either = client_mask | server_mask
+    net, slot = sk_create(sim.net, either, SocketType.UDP)
+    # server binds the known port; client takes an ephemeral port
+    net, _ = sk_bind(net, server_mask, slot, 0, server_port)
+    net, _ = sk_bind(net, client_mask, slot, 0, 0)
+    app = PingPongApp(
+        role=jnp.where(client_mask, ROLE_CLIENT,
+                       jnp.where(server_mask, ROLE_SERVER, ROLE_NONE)),
+        sock=slot,
+        server_ip=jnp.broadcast_to(jnp.asarray(server_ip, I64), (H,)),
+        server_port=jnp.full((H,), server_port, I32),
+        size=jnp.full((H,), size, I32),
+        remaining=jnp.where(client_mask, count, 0).astype(I32),
+        sent=jnp.zeros((H,), I32),
+        rcvd=jnp.zeros((H,), I32),
+        last_send=jnp.zeros((H,), I64),
+        rtt_sum=jnp.zeros((H,), I64),
+    )
+    return sim.replace(net=net, app=app)
+
+
+def _client_send(sim, buf, mask, now):
+    app = sim.app
+    net, ok = udp.udp_enqueue_send(
+        sim.net, mask, app.sock, app.server_ip, app.server_port,
+        app.size, -1,
+    )
+    sim = sim.replace(net=net)
+    app = app.replace(
+        remaining=app.remaining - ok.astype(I32),
+        sent=app.sent + ok.astype(I32),
+        last_send=jnp.where(ok, now, app.last_send),
+    )
+    sim = sim.replace(app=app)
+    return nic.notify_wants_send(sim, buf, ok, now)
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+
+    # process start: client fires the first ping
+    is_start = popped.valid & (popped.kind == EventKind.PROC_START)
+    start_client = is_start & (app.role == ROLE_CLIENT) & (app.remaining > 0)
+    sim, buf = _client_send(sim, buf, start_client, now)
+
+    # drain the socket whenever an event may have delivered data (the
+    # epoll-notify -> process_continue analog, ref: epoll.c:638-680).
+    # one datagram per micro-step; more data re-enters via the next
+    # delivery or this host's chained events.
+    app = sim.app
+    may_have_data = popped.valid & (
+        (popped.kind == EventKind.NIC_RECV)
+        | (popped.kind == EventKind.PACKET_LOCAL)
+    ) & (app.role != ROLE_NONE)
+    readable = gather_hs(sim.net.in_count, app.sock) > 0
+    net, got, src_ip, src_port, length, _ = udp.udp_recv(
+        sim.net, may_have_data & readable, app.sock
+    )
+    sim = sim.replace(net=net)
+
+    # server echoes to the datagram's source
+    echo = got & (app.role == ROLE_SERVER)
+    net, ok = udp.udp_enqueue_send(
+        sim.net, echo, app.sock, src_ip, src_port, length, -1
+    )
+    sim = sim.replace(net=net)
+    sim, buf = nic.notify_wants_send(sim, buf, ok, now)
+
+    # client accounts RTT and sends the next ping
+    app = sim.app
+    reply = got & (app.role == ROLE_CLIENT)
+    app = app.replace(
+        rcvd=app.rcvd + got.astype(I32),
+        rtt_sum=app.rtt_sum + jnp.where(reply, now - app.last_send, 0),
+    )
+    sim = sim.replace(app=app)
+    nxt = reply & (app.remaining > 0)
+    sim, buf = _client_send(sim, buf, nxt, now)
+    return sim, buf

@@ -1,0 +1,59 @@
+"""Minimal PHOLD-style ring model used by tests, examples, and smoke
+benchmarks: each event at host h schedules one event at (h+1)%H after a
+fixed cross-host latency (ref: src/test/phold/test_phold.c:36-52 is the
+full weighted-random version; see shadow_tpu.apps.phold)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import (
+    EventKind,
+    EventQueue,
+    Outbox,
+    emit,
+    emit_words,
+    push_rows,
+)
+
+LATENCY = 10 * simtime.ONE_MILLISECOND
+HOP_KIND = EventKind.USER
+
+
+@struct.dataclass
+class RingSim:
+    events: EventQueue
+    outbox: Outbox
+    hops: jax.Array  # [H] i32 — events handled per host
+
+
+def step(sim: RingSim, popped, buf):
+    H = sim.events.num_hosts
+    lane = jnp.arange(H, dtype=jnp.int32)
+    is_hop = popped.valid & (popped.kind == HOP_KIND)
+    buf = emit(buf, is_hop, (lane + 1) % H, popped.time + LATENCY,
+               HOP_KIND, emit_words(0, num_hosts=H))
+    return sim.replace(hops=sim.hops + is_hop.astype(jnp.int32)), buf
+
+
+def make(num_hosts: int, capacity: int = 16, outbox_capacity: int = 16) -> RingSim:
+    q = EventQueue.create(num_hosts, capacity)
+    # host 0 starts the ring at t=0
+    mask = jnp.arange(num_hosts) == 0
+    H = num_hosts
+    q = push_rows(
+        q, mask,
+        jnp.zeros((H,), simtime.DTYPE),
+        jnp.full((H,), HOP_KIND, jnp.int32),
+        jnp.zeros((H,), jnp.int32),
+        jnp.zeros((H,), jnp.int32),
+        emit_words(0, num_hosts=H),
+    )
+    return RingSim(
+        events=q,
+        outbox=Outbox.create(H, outbox_capacity),
+        hops=jnp.zeros((H,), jnp.int32),
+    )

@@ -1,0 +1,2 @@
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EventQueue, Outbox, Popped, EmitBuffer

@@ -1,0 +1,427 @@
+"""Device-resident event queues.
+
+The reference keeps one locked binary min-heap of events per host
+(ref: priority_queue.c:17-40, scheduler_policy_host_single.c:20-33) with
+the deterministic total order key (time, dstHostID, srcHostID,
+perSourceSequence) (ref: event.c:110-153). Here each host owns one row
+of fixed-capacity struct-of-arrays tensors; "pop" is a masked
+lexicographic argmin over the row, so ordering is bit-identical to the
+reference's heap order for any thread/shard count.
+
+Cross-host events never target the current window (every inter-host
+path latency >= the window length, which is the min path latency — ref:
+master.c:450-480, scheduler_policy_host_single.c:171-184), so sends are
+staged per *source* host in an Outbox (collision-free writes) and routed
+to destination rows once per window by a sort-based shuffle. On a
+sharded mesh that shuffle is the all-to-all exchange point.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+
+I32 = jnp.int32
+# Number of generic int32 payload words carried by every event. Wide
+# enough for a simulated TCP header (ref: packet.h:66-86): src/dst
+# ports, seq, ack, flags, window, timestamp, ts-echo, sack range,
+# payload ref+len.
+NWORDS = 12
+
+
+class EventKind:
+    """Builtin event kinds. The reference's Task is an arbitrary C
+    closure (ref: task.c:13-21); on device we enumerate handler ids.
+    Kinds >= USER are claimed by application models."""
+
+    NONE = 0
+    PACKET = 1          # packet arrival at dst host's upstream router
+    PACKET_LOCAL = 2    # loopback delivery (ref: network_interface.c:546-554)
+    TIMER = 3           # timerfd expiration (ref: timer.c)
+    PROC_START = 4      # process start (ref: process.c:1326-1360)
+    PROC_STOP = 5
+    NIC_RECV = 6        # rx token-bucket drain retry (ref: network_interface.c:421-455)
+    NIC_SEND = 7        # tx token-bucket drain retry
+    TCP_RTX_TIMER = 8   # TCP retransmission timeout
+    TCP_CLOSE_TIMER = 9  # TIMEWAIT 60s close timer (ref: tcp.c:604-699)
+    TCP_DACK_TIMER = 10  # delayed-ACK timer
+    HEARTBEAT = 11      # tracker heartbeat (ref: tracker.c:607)
+    USER = 16
+
+
+@struct.dataclass
+class EventQueue:
+    """Per-host event store: row h = host h's pending events.
+
+    time == simtime.INVALID marks an empty slot. `seq` is the
+    per-*source*-host sequence number that makes the total order
+    deterministic (ref: event.c:29-35,110-153)."""
+
+    time: jax.Array   # [H, K] i64
+    kind: jax.Array   # [H, K] i32
+    src: jax.Array    # [H, K] i32
+    seq: jax.Array    # [H, K] i32
+    words: jax.Array  # [H, K, NWORDS] i32
+    # Per-source-host monotonic event id (ref: host_getNewEventID).
+    next_seq: jax.Array   # [H] i32
+    # Sticky count of events dropped because a row was full. The host
+    # side checks this between windows and re-runs with a larger K
+    # (the reference never drops events; neither do we silently).
+    overflow: jax.Array   # [] i32
+
+    @property
+    def num_hosts(self) -> int:
+        return self.time.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.time.shape[1]
+
+    @staticmethod
+    def create(num_hosts: int, capacity: int) -> "EventQueue":
+        return EventQueue(
+            time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
+            kind=jnp.zeros((num_hosts, capacity), I32),
+            src=jnp.zeros((num_hosts, capacity), I32),
+            seq=jnp.zeros((num_hosts, capacity), I32),
+            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            next_seq=jnp.zeros((num_hosts,), I32),
+            overflow=jnp.zeros((), I32),
+        )
+
+    def valid(self) -> jax.Array:
+        return self.time != simtime.INVALID
+
+    def fill_count(self) -> jax.Array:
+        """[H] number of occupied slots per host row."""
+        return jnp.sum(self.valid(), axis=1, dtype=I32)
+
+    def min_time(self) -> jax.Array:
+        """[H] earliest pending event time per host (INVALID if none).
+        The per-shard reduction of this is the conservative barrier's
+        min-next-event-time (ref: scheduler.c:393-398)."""
+        return jnp.min(self.time, axis=1)
+
+
+class Popped(NamedTuple):
+    """One popped event per host lane ([H]-shaped; valid=False lanes
+    hold garbage and must be masked by handlers)."""
+
+    valid: jax.Array  # [H] bool
+    time: jax.Array   # [H] i64
+    kind: jax.Array   # [H] i32
+    src: jax.Array    # [H] i32
+    seq: jax.Array    # [H] i32
+    words: jax.Array  # [H, NWORDS] i32
+
+    def word(self, i: int) -> jax.Array:
+        return self.words[:, i]
+
+
+def _tie_key(src: jax.Array, seq: jax.Array) -> jax.Array:
+    """Pack (srcHost, perSourceSeq) into one sortable i64 — the 3rd and
+    4th keys of the reference's event order (ref: event.c:137-152).
+    (dstHost, the 2nd key, is the row index here.)"""
+    return (src.astype(jnp.int64) << 32) | seq.astype(jnp.uint32).astype(jnp.int64)
+
+
+def pop_earliest(q: EventQueue, horizon) -> tuple[EventQueue, Popped]:
+    """Pop each host's earliest event with time < horizon.
+
+    This is the device analog of one scheduler_pop round across all
+    hosts at once (ref: scheduler.c:359-377): one host's events stay
+    serial (one pop per micro-step), different hosts pop in parallel.
+    """
+    t = q.time  # [H, K]
+    # Lexicographic argmin over (time, src, seq) within each row.
+    tmin = jnp.min(t, axis=1, keepdims=True)              # [H,1]
+    is_tmin = t == tmin
+    tie = jnp.where(is_tmin, _tie_key(q.src, q.seq), jnp.iinfo(jnp.int64).max)
+    idx = jnp.argmin(tie, axis=1)                          # [H]
+    rows = jnp.arange(q.num_hosts)
+    ptime = t[rows, idx]
+    valid = ptime < jnp.asarray(horizon, simtime.DTYPE)
+    popped = Popped(
+        valid=valid,
+        time=ptime,
+        kind=q.kind[rows, idx],
+        src=q.src[rows, idx],
+        seq=q.seq[rows, idx],
+        words=q.words[rows, idx],
+    )
+    # Clear popped slots (only where valid).
+    clear_idx = jnp.where(valid, idx, q.capacity)  # OOB -> drop
+    new_time = q.time.at[rows, clear_idx].set(simtime.INVALID, mode="drop")
+    return q.replace(time=new_time), popped
+
+
+def push_rows(
+    q: EventQueue,
+    mask: jax.Array,   # [H] bool — which rows receive an event
+    time: jax.Array,   # [H] i64
+    kind: jax.Array,   # [H] i32
+    src: jax.Array,    # [H] i32
+    seq: jax.Array,    # [H] i32
+    words: jax.Array,  # [H, NWORDS] i32
+) -> EventQueue:
+    """Insert one event into each masked host row (first free slot)."""
+    free = ~q.valid()                                     # [H, K]
+    has_free = jnp.any(free, axis=1)
+    slot = jnp.argmax(free, axis=1)                       # first free slot
+    ok = mask & has_free
+    rows = jnp.arange(q.num_hosts)
+    slot = jnp.where(ok, slot, q.capacity)                # OOB -> drop
+    q = q.replace(
+        time=q.time.at[rows, slot].set(time, mode="drop"),
+        kind=q.kind.at[rows, slot].set(kind, mode="drop"),
+        src=q.src.at[rows, slot].set(src, mode="drop"),
+        seq=q.seq.at[rows, slot].set(seq, mode="drop"),
+        words=q.words.at[rows, slot, :].set(words, mode="drop"),
+        overflow=q.overflow + jnp.sum(mask & ~has_free, dtype=I32),
+    )
+    return q
+
+
+@struct.dataclass
+class Outbox:
+    """Cross-host events staged per *source* host, so writes are
+    collision-free inside a micro-step. Routed to destination rows once
+    per window by route_outbox() (the shard-exchange point;
+    ref: worker_sendPacket, worker.c:243-304 is the only place events
+    cross hosts)."""
+
+    dst: jax.Array    # [H, M] i32  (-1 = empty)
+    time: jax.Array   # [H, M] i64
+    kind: jax.Array   # [H, M] i32
+    src: jax.Array    # [H, M] i32
+    seq: jax.Array    # [H, M] i32
+    words: jax.Array  # [H, M, NWORDS] i32
+    count: jax.Array  # [H] i32
+    overflow: jax.Array  # [] i32
+
+    @property
+    def num_hosts(self) -> int:
+        return self.dst.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.dst.shape[1]
+
+    @staticmethod
+    def create(num_hosts: int, capacity: int) -> "Outbox":
+        return Outbox(
+            dst=jnp.full((num_hosts, capacity), -1, I32),
+            time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
+            kind=jnp.zeros((num_hosts, capacity), I32),
+            src=jnp.zeros((num_hosts, capacity), I32),
+            seq=jnp.zeros((num_hosts, capacity), I32),
+            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            count=jnp.zeros((num_hosts,), I32),
+            overflow=jnp.zeros((), I32),
+        )
+
+
+def outbox_append(
+    out: Outbox,
+    mask: jax.Array,   # [H] bool
+    dst: jax.Array,    # [H] i32
+    time: jax.Array,   # [H] i64
+    kind: jax.Array,   # [H] i32
+    src: jax.Array,    # [H] i32
+    seq: jax.Array,    # [H] i32
+    words: jax.Array,  # [H, NWORDS] i32
+) -> Outbox:
+    rows = jnp.arange(out.num_hosts)
+    ok = mask & (out.count < out.capacity)
+    slot = jnp.where(ok, out.count, out.capacity)  # OOB -> drop
+    return out.replace(
+        dst=out.dst.at[rows, slot].set(dst, mode="drop"),
+        time=out.time.at[rows, slot].set(time, mode="drop"),
+        kind=out.kind.at[rows, slot].set(kind, mode="drop"),
+        src=out.src.at[rows, slot].set(src, mode="drop"),
+        seq=out.seq.at[rows, slot].set(seq, mode="drop"),
+        words=out.words.at[rows, slot, :].set(words, mode="drop"),
+        count=out.count + ok.astype(I32),
+        overflow=out.overflow + jnp.sum(mask & ~(out.count < out.capacity), dtype=I32),
+    )
+
+
+def compact_rows(q: EventQueue) -> EventQueue:
+    """Stable-partition each row so occupied slots are contiguous at the
+    front. Pop order is argmin-based, so intra-row layout is free; this
+    just makes free slots addressable as fill_count + rank."""
+    empty = ~q.valid()
+    order = jnp.argsort(empty, axis=1, stable=True)       # [H, K]
+    take = lambda a: jnp.take_along_axis(a, order, axis=1)
+    return q.replace(
+        time=take(q.time), kind=take(q.kind), src=take(q.src), seq=take(q.seq),
+        words=jnp.take_along_axis(q.words, order[..., None], axis=1),
+    )
+
+
+def route_outbox(q: EventQueue, out: Outbox) -> tuple[EventQueue, Outbox]:
+    """Deliver all staged cross-host events into destination rows.
+
+    Single-shard version: flatten, sort by destination, compute each
+    event's rank within its destination segment, scatter into the
+    compacted destination row at fill_count[dst] + rank. The multi-chip
+    path runs the same routine after an all-to-all keyed by
+    dst // hosts_per_shard (see shadow_tpu.parallel).
+    """
+    H, M = out.dst.shape
+    n = H * M
+    dst = out.dst.reshape(n)
+    occupied = dst >= 0
+    # A dst outside [0, H) is a routing bug (or an unremapped global id
+    # on the sharded path) — count it, never silently drop.
+    bad_dst = occupied & (dst >= H)
+    valid = occupied & ~bad_dst
+    # Sort by dst (invalid last). Within a segment any order works for
+    # correctness (pop re-sorts); sorting keeps it deterministic.
+    skey = jnp.where(valid, dst, H)
+    order = jnp.argsort(skey, stable=True)
+    dst_s = skey[order]
+    time_s = out.time.reshape(n)[order]
+    kind_s = out.kind.reshape(n)[order]
+    src_s = out.src.reshape(n)[order]
+    seq_s = out.seq.reshape(n)[order]
+    words_s = out.words.reshape(n, NWORDS)[order]
+    valid_s = dst_s < H
+
+    # rank within destination segment
+    pos = jnp.arange(n)
+    is_start = jnp.concatenate([jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]])
+    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
+    rank = pos - seg_start
+
+    q = compact_rows(q)
+    base = q.fill_count()                                  # [H]
+    slot = base[jnp.where(valid_s, dst_s, 0)] + rank       # [n]
+    fits = valid_s & (slot < q.capacity)
+    row = jnp.where(fits, dst_s, H)                        # OOB -> drop
+    slot = jnp.where(fits, slot, q.capacity)
+    q = q.replace(
+        time=q.time.at[row, slot].set(time_s, mode="drop"),
+        kind=q.kind.at[row, slot].set(kind_s, mode="drop"),
+        src=q.src.at[row, slot].set(src_s, mode="drop"),
+        seq=q.seq.at[row, slot].set(seq_s, mode="drop"),
+        words=q.words.at[row, slot, :].set(words_s, mode="drop"),
+        overflow=q.overflow
+        + jnp.sum(valid_s & ~fits, dtype=I32)
+        + jnp.sum(bad_dst, dtype=I32),
+    )
+    out = out.replace(
+        dst=jnp.full((H, M), -1, I32),
+        time=jnp.full((H, M), simtime.INVALID, simtime.DTYPE),
+        count=jnp.zeros((H,), I32),
+    )
+    return q, out
+
+
+@struct.dataclass
+class EmitBuffer:
+    """Per-micro-step emission staging. Handlers run sequentially (one
+    masked batch per kind), each lane (= the host whose event was
+    popped) appending at its private cursor — deterministic and
+    collision-free. apply_emissions() then assigns per-source sequence
+    numbers in slot order and moves local events into the queue and
+    remote events into the Outbox."""
+
+    dst: jax.Array    # [H, E] i32
+    time: jax.Array   # [H, E] i64
+    kind: jax.Array   # [H, E] i32
+    words: jax.Array  # [H, E, NWORDS] i32
+    count: jax.Array  # [H] i32
+    overflow: jax.Array  # [] i32
+
+    @property
+    def num_hosts(self) -> int:
+        return self.dst.shape[0]
+
+    @property
+    def capacity(self) -> int:
+        return self.dst.shape[1]
+
+    @staticmethod
+    def create(num_hosts: int, capacity: int = 4) -> "EmitBuffer":
+        return EmitBuffer(
+            dst=jnp.full((num_hosts, capacity), -1, I32),
+            time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
+            kind=jnp.zeros((num_hosts, capacity), I32),
+            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            count=jnp.zeros((num_hosts,), I32),
+            overflow=jnp.zeros((), I32),
+        )
+
+
+def emit(
+    buf: EmitBuffer,
+    mask: jax.Array,          # [H] bool
+    dst: jax.Array,           # [H] i32 (dst == lane index -> local)
+    time: jax.Array,          # [H] i64
+    kind,                     # [H] i32 or int
+    words: jax.Array,         # [H, NWORDS] i32
+) -> EmitBuffer:
+    H = buf.num_hosts
+    rows = jnp.arange(H)
+    kind = jnp.broadcast_to(jnp.asarray(kind, I32), (H,))
+    ok = mask & (buf.count < buf.capacity)
+    slot = jnp.where(ok, buf.count, buf.capacity)
+    return buf.replace(
+        dst=buf.dst.at[rows, slot].set(dst, mode="drop"),
+        time=buf.time.at[rows, slot].set(time, mode="drop"),
+        kind=buf.kind.at[rows, slot].set(kind, mode="drop"),
+        words=buf.words.at[rows, slot, :].set(words, mode="drop"),
+        count=buf.count + ok.astype(I32),
+        overflow=buf.overflow + jnp.sum(mask & ~(buf.count < buf.capacity), dtype=I32),
+    )
+
+
+def emit_words(*vals, num_hosts: int | None = None) -> jax.Array:
+    """Assemble an [H, NWORDS] word array from [H] (or scalar) columns."""
+    assert len(vals) <= NWORDS, f"{len(vals)} payload words > NWORDS={NWORDS}"
+    cols = []
+    H = num_hosts
+    for v in vals:
+        v = jnp.asarray(v)
+        if v.ndim == 1:
+            H = v.shape[0]
+    assert H is not None
+    for v in vals:
+        v = jnp.asarray(v, I32)
+        cols.append(jnp.broadcast_to(v, (H,)))
+    while len(cols) < NWORDS:
+        cols.append(jnp.zeros((H,), I32))
+    return jnp.stack(cols[:NWORDS], axis=1)
+
+
+def apply_emissions(
+    q: EventQueue, out: Outbox, buf: EmitBuffer
+) -> tuple[EventQueue, Outbox]:
+    """Move staged emissions into the local queue / cross-host outbox,
+    assigning per-source sequence numbers in slot order (matching the
+    reference's per-push host_getNewEventID ordering)."""
+    H, E = buf.dst.shape
+    lane = jnp.arange(H, dtype=I32)
+    nvalid = jnp.zeros((H,), I32)
+    for e in range(E):
+        v = buf.dst[:, e] >= 0
+        seq = q.next_seq + nvalid
+        is_local = v & (buf.dst[:, e] == lane)
+        is_remote = v & (buf.dst[:, e] != lane)
+        q = push_rows(
+            q, is_local, buf.time[:, e], buf.kind[:, e], lane, seq, buf.words[:, e]
+        )
+        out = outbox_append(
+            out, is_remote, buf.dst[:, e], buf.time[:, e], buf.kind[:, e],
+            lane, seq, buf.words[:, e],
+        )
+        nvalid = nvalid + v.astype(I32)
+    q = q.replace(next_seq=q.next_seq + nvalid,
+                  overflow=q.overflow + buf.overflow)
+    return q, out

@@ -1,0 +1,41 @@
+"""Deterministic per-host random streams.
+
+The reference seeds one master Random, which seeds the slave, which
+seeds each host in registration order (ref: master.c:417, slave.c:301,
+random.c:16-60) — determinism flows from the seed hierarchy, not from
+execution order. Here the hierarchy is a counter-based construction:
+draw i of host h from master seed s is threefry(fold(fold(key(s), h),
+counter_h)), which is independent of thread/shard interleaving by
+construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def host_streams(seed: int, num_hosts: int) -> jax.Array:
+    """[H] per-host base keys (batched key array)."""
+    base = jax.random.key(seed)
+    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+        base, jnp.arange(num_hosts, dtype=jnp.uint32)
+    )
+
+
+def uniform(keys: jax.Array, counters: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """One f32 uniform [0,1) draw per host at its current counter;
+    returns (values[H], counters+1)."""
+    ks = jax.vmap(jax.random.fold_in)(keys, counters.astype(jnp.uint32))
+    vals = jax.vmap(lambda k: jax.random.uniform(k, dtype=jnp.float32))(ks)
+    return vals, counters + 1
+
+
+def randint(keys: jax.Array, counters: jax.Array, maxval) -> tuple[jax.Array, jax.Array]:
+    """One i32 uniform draw in [0, maxval) per host (maxval may be [H])."""
+    ks = jax.vmap(jax.random.fold_in)(keys, counters.astype(jnp.uint32))
+    u = jax.vmap(lambda k: jax.random.uniform(k, dtype=jnp.float32))(ks)
+    vals = jnp.minimum((u * maxval).astype(I32), jnp.asarray(maxval, I32) - 1)
+    return vals, counters + 1

@@ -1,0 +1,53 @@
+"""Simulation-time constants and helpers.
+
+Parity with the reference's time model (ref: definitions.h:14-78):
+simulated time is unsigned 64-bit nanoseconds there; here it is *signed*
+int64 nanoseconds (JAX sorts/compares signed types natively), with
+INVALID = int64 max as the "no event" sentinel. int64 range covers
+~292 years of nanoseconds, the same practical range.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+DTYPE = jnp.int64
+
+# Sentinel meaning "no time / empty slot" (ref: definitions.h:28).
+INVALID = np.iinfo(np.int64).max
+MAX = INVALID - 1
+MIN = 0
+
+ONE_NANOSECOND = 1
+ONE_MICROSECOND = 1_000
+ONE_MILLISECOND = 1_000_000
+ONE_SECOND = 1_000_000_000
+ONE_MINUTE = 60 * ONE_SECOND
+ONE_HOUR = 3600 * ONE_SECOND
+
+# Offset added to simulated time so applications observe a wall clock
+# starting at 2000-01-01 00:00:00 UTC (ref: definitions.h:74-78,
+# worker.c:385-390).
+EMULATED_TIME_OFFSET = 946_684_800 * ONE_SECOND
+
+
+def ns(x) -> jnp.ndarray:
+    return jnp.asarray(x, dtype=DTYPE)
+
+
+def from_seconds(s: float) -> int:
+    return int(round(s * ONE_SECOND))
+
+
+def from_millis(ms: float) -> int:
+    return int(round(ms * ONE_MILLISECOND))
+
+
+def to_seconds(t) -> float:
+    return float(t) / ONE_SECOND
+
+
+def emulated(t):
+    """Simulated -> emulated (app-visible) time (ref: worker.c:385-390)."""
+    return t + EMULATED_TIME_OFFSET

@@ -1,0 +1,2 @@
+from shadow_tpu.net.state import NetState, NetConfig, SocketType, SocketFlags
+from shadow_tpu.net.step import make_step_fn

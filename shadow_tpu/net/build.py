@@ -1,0 +1,144 @@
+"""Build a runnable simulation from topology + host specs.
+
+This is the device-era analog of the reference's startup path
+(ref: master.c:161-398 / slave.c:296-336): load + validate topology,
+register every host with DNS, attach hosts to vertices via the hint
+rules, derive the conservative window from the minimum path latency,
+and initialize the struct-of-arrays device state. Process starts are
+seeded as PROC_START events (ref: process.c:1326-1360).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Sequence
+
+import numpy as np
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import run as engine_run
+from shadow_tpu.core.events import EventKind, emit_words, push_rows
+from shadow_tpu.net.state import (
+    NetConfig,
+    NetState,
+    Sim,
+    make_net_state,
+    make_sim,
+)
+from shadow_tpu.net.step import make_step_fn
+from shadow_tpu.routing.dns import DNS
+from shadow_tpu.routing.graphml import parse_graphml
+from shadow_tpu.routing.topology import Topology
+
+
+@dataclass
+class HostSpec:
+    """One virtual host (ref: <host> config element,
+    configuration.h:62-101)."""
+
+    name: str
+    ip: str | None = None            # requested IP hint
+    citycode: str | None = None
+    countrycode: str | None = None
+    geocode: str | None = None
+    type: str | None = None
+    bandwidthdown: int | None = None  # KiB/s override
+    bandwidthup: int | None = None
+    proc_start_time: int | None = None  # PROC_START event time (ns)
+
+    def hints(self) -> dict:
+        out: dict = {}
+        for k in ("ip", "citycode", "countrycode", "geocode", "type"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        for k in ("bandwidthdown", "bandwidthup"):
+            v = getattr(self, k)
+            if v is not None:
+                out[k] = v
+        return out
+
+
+@dataclass
+class SimBundle:
+    cfg: NetConfig
+    sim: Sim
+    topology: Topology
+    dns: DNS
+    min_jump: int
+    host_names: list[str]
+    name_to_index: dict[str, int] = field(default_factory=dict)
+
+    def ip_of(self, name: str) -> int:
+        return self.dns.resolve_name(name).ip
+
+    def host_of(self, name: str) -> int:
+        return self.name_to_index[name]
+
+
+def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
+          app: Any = None) -> SimBundle:
+    if len(hosts) != cfg.num_hosts:
+        raise ValueError(f"cfg.num_hosts={cfg.num_hosts} != {len(hosts)} specs")
+    top = Topology(parse_graphml(graphml_text))
+    dns = DNS()
+    names = []
+    for i, h in enumerate(hosts):
+        dns.register(i, h.name, requested_ip=h.ip)
+        names.append(h.name)
+
+    # attach draws come from the deterministic seed hierarchy
+    # (ref: master.c:417 -> slave.c:301): one uniform per host in
+    # registration order.
+    draws = np.random.default_rng(cfg.seed).random(len(hosts))
+    placement = top.attach_hosts([h.hints() for h in hosts], draws)
+    min_jump = top.min_jump_ns(placement)
+
+    net = make_net_state(
+        cfg,
+        host_ips=dns.host_ips(cfg.num_hosts),
+        bw_up_kibps=placement.bw_up_kibps,
+        bw_down_kibps=placement.bw_down_kibps,
+        vertex_of_host=placement.vertex,
+        latency_ns=top.latency_ns,
+        reliability=top.reliability,
+    )
+    sim = make_sim(cfg, net, app=app)
+
+    # seed PROC_START events (ref: host_boot -> process_schedule)
+    starts = np.full(cfg.num_hosts, -1, dtype=np.int64)
+    for i, h in enumerate(hosts):
+        if h.proc_start_time is not None:
+            starts[i] = h.proc_start_time
+    m = starts >= 0
+    if m.any():
+        H = cfg.num_hosts
+        q = push_rows(
+            sim.events,
+            jnp.asarray(m),
+            jnp.asarray(np.where(m, starts, 0), simtime.DTYPE),
+            jnp.full((H,), EventKind.PROC_START, jnp.int32),
+            jnp.arange(H, dtype=jnp.int32),
+            jnp.zeros((H,), jnp.int32),
+            emit_words(0, num_hosts=H),
+        )
+        q = q.replace(next_seq=q.next_seq + jnp.asarray(m, jnp.int32))
+        sim = sim.replace(events=q)
+
+    return SimBundle(
+        cfg=cfg, sim=sim, topology=top, dns=dns, min_jump=min_jump,
+        host_names=names, name_to_index={n: i for i, n in enumerate(names)},
+    )
+
+
+def run(bundle: SimBundle, app_handlers=(), end_time: int | None = None):
+    """Run the whole simulation on device; returns (sim, stats)."""
+    step = make_step_fn(bundle.cfg, app_handlers)
+    return engine_run(
+        bundle.sim,
+        step,
+        end_time=end_time if end_time is not None else bundle.cfg.end_time,
+        min_jump=bundle.min_jump,
+        emit_capacity=bundle.cfg.emit_capacity,
+    )

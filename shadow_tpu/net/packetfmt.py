@@ -1,0 +1,75 @@
+"""Packet layout inside event payload words.
+
+The reference's Packet object (ref: packet.c:22-37, packet.h:66-86)
+carries protocol headers and a refcounted payload; on device a packet
+in flight is just the event's NWORDS int32 words. Payload bytes are
+never on device — `W_PAYREF` indexes the host-side payload pool
+(mirrors Payload sharing, ref: payload.c:17-30); synthetic traffic
+uses PAYREF_NONE and only lengths are modeled.
+
+The event's `src` field is the source *host index*; source IP is
+derived via the host IP table when needed.
+"""
+
+import jax.numpy as jnp
+
+# word indices
+W_PROTO = 0    # protocol | tcp-flags<<8  (see below)
+W_LEN = 1      # payload length in bytes
+W_PORTS = 2    # src_port | dst_port<<16
+W_PAYREF = 3   # host-side payload pool index, PAYREF_NONE = synthetic
+W_SEQ = 4      # TCP sequence number
+W_ACK = 5      # TCP acknowledgment
+W_WIN = 6      # TCP advertised window
+W_TSVAL = 7    # TCP timestamp value (ms)
+W_TSECHO = 8   # TCP timestamp echo (ms)
+W_SACKL = 9    # TCP selective-ack range left edge
+W_SACKR = 10   # TCP selective-ack range right edge
+W_DSTIP = 11   # destination IP (distinguishes loopback vs eth delivery)
+
+PAYREF_NONE = -1
+
+# protocols (ref: packet.h protocol enum {LOCAL, UDP, TCP})
+PROTO_LOCAL = 0
+PROTO_UDP = 1
+PROTO_TCP = 2
+
+# TCP header flags, stored shifted by 8 in W_PROTO
+TCPF_SYN = 1
+TCPF_ACK = 2
+TCPF_FIN = 4
+TCPF_RST = 8
+
+# Header sizes added to payload length for bandwidth accounting
+# (ref: definitions.h:176-183).
+HDR_UDP = 42
+HDR_TCP = 66
+MTU = 1500  # ref: definitions.h:188
+
+
+def proto_of(words):
+    return words[:, W_PROTO] & 0xFF
+
+
+def tcp_flags_of(words):
+    return (words[:, W_PROTO] >> 8) & 0xFF
+
+
+def pack_proto(proto, flags=0):
+    return proto | (flags << 8)
+
+
+def ports_of(words):
+    w = words[:, W_PORTS]
+    return w & 0xFFFF, (w >> 16) & 0xFFFF
+
+
+def pack_ports(src_port, dst_port):
+    return (src_port & 0xFFFF) | ((dst_port & 0xFFFF) << 16)
+
+
+def wire_length(proto, payload_len):
+    """Total on-wire bytes used for token-bucket accounting
+    (ref: network_interface.c:443,545: payload + header size)."""
+    hdr = jnp.where(proto == PROTO_TCP, HDR_TCP, HDR_UDP)
+    return payload_len + hdr

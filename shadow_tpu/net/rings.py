@@ -1,0 +1,62 @@
+"""Masked per-lane ring-buffer helpers for [H,S,B] socket rings and
+[H,R] router rings. Each micro-step touches at most one (host, slot)
+per lane, so operations are [H]-vectorized scatters/gathers with
+invalid lanes dropped via out-of-bounds indices."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def gather_hs(arr, slot):
+    """arr[H,S] -> [H] value at (lane, slot); slot clipped for safety
+    (callers mask invalid lanes)."""
+    H = arr.shape[0]
+    lane = jnp.arange(H)
+    return arr[lane, jnp.clip(slot, 0, arr.shape[1] - 1)]
+
+
+def set_hs(arr, mask, slot, value):
+    """arr[H,S] masked scatter at (lane, slot)."""
+    H, S = arr.shape[:2]
+    lane = jnp.arange(H)
+    s = jnp.where(mask, slot, S)  # OOB -> drop
+    return arr.at[lane, s].set(value, mode="drop")
+
+
+def ring_push_at(head, count, capacity: int, mask, slot):
+    """Compute the write position for pushing one element into ring
+    (lane, slot). Returns (ok[H], pos[H]) with pos=capacity for
+    dropped lanes (use mode='drop' scatters at [lane, slot, pos])."""
+    c = gather_hs(count, slot)
+    h = gather_hs(head, slot)
+    ok = mask & (c < capacity)
+    pos = jnp.where(ok, (h + c) % capacity, capacity)
+    return ok, pos
+
+
+def ring_advance_push(head, count, mask, slot, ok):
+    """Commit a push: count += 1 where ok."""
+    c = gather_hs(count, slot)
+    return head, set_hs(count, mask & ok, slot, c + 1)
+
+
+def ring_peek_at(head, count, mask, slot, capacity: int):
+    """Position of the ring head element; pos=capacity when empty or
+    masked out."""
+    c = gather_hs(count, slot)
+    h = gather_hs(head, slot)
+    ok = mask & (c > 0)
+    return ok, jnp.where(ok, h % capacity, capacity)
+
+
+def ring_advance_pop(head, count, mask, slot, capacity: int):
+    """Commit a pop: head = (head+1)%capacity, count -= 1."""
+    c = gather_hs(count, slot)
+    h = gather_hs(head, slot)
+    ok = mask & (c > 0)
+    head = set_hs(head, ok, slot, (h + 1) % capacity)
+    count = set_hs(count, ok, slot, c - 1)
+    return head, count

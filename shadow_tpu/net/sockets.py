@@ -1,0 +1,107 @@
+"""Socket table operations.
+
+The reference's descriptor table + per-interface bound-socket hash
+(ref: host.c:696-767, network_interface.c:255-308) become row scans
+over the [H,S] socket arrays: a "bind" writes the (ip,port) columns, a
+delivery "lookup" is a vectorized match over the row, preferring the
+general (peer-less) association first exactly like the reference
+(network_interface.c:388-403).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net.state import NetState, SocketFlags, SocketType
+
+I32 = jnp.int32
+MIN_RANDOM_PORT = 10000  # ref: definitions.h:94
+
+
+def sk_create(net: NetState, mask, stype):
+    """Allocate one socket per masked lane (first free slot). Returns
+    (net, slot[H] — -1 where full/unmasked)."""
+    free = net.sk_type == SocketType.NONE  # [H,S]
+    has = jnp.any(free, axis=1)
+    slot = jnp.argmax(free, axis=1)
+    ok = mask & has
+    slot = jnp.where(ok, slot, -1)
+    stype_b = jnp.broadcast_to(jnp.asarray(stype, I32), mask.shape)
+    net = net.replace(
+        sk_type=set_hs(net.sk_type, ok, slot, stype_b),
+        sk_flags=set_hs(
+            net.sk_flags, ok, slot,
+            jnp.full(mask.shape, SocketFlags.ACTIVE | SocketFlags.WRITABLE, I32),
+        ),
+    )
+    return net, slot
+
+
+def sk_bind(net: NetState, mask, slot, ip, port):
+    """Bind masked lanes' socket `slot` to (ip, port); port 0 draws an
+    ephemeral port (counter-based analog of the reference's random
+    free-port search, host.c:1058-1110 — deterministic per host)."""
+    eph = MIN_RANDOM_PORT + net.port_ctr
+    use_eph = mask & (jnp.asarray(port) == 0)
+    port = jnp.where(use_eph, eph, port)
+    net = net.replace(
+        port_ctr=net.port_ctr + use_eph.astype(I32),
+        sk_bound_ip=set_hs(net.sk_bound_ip, mask, slot,
+                           jnp.asarray(ip, net.sk_bound_ip.dtype)),
+        sk_bound_port=set_hs(net.sk_bound_port, mask, slot,
+                             jnp.asarray(port, I32)),
+    )
+    return net, port
+
+
+def sk_connect_peer(net: NetState, mask, slot, peer_ip, peer_port):
+    """Set the peer association (UDP connect / TCP connect initiation).
+    Auto-binds an ephemeral port if unbound (ref: host.c:1193-1230)."""
+    bport = gather_hs(net.sk_bound_port, slot)
+    net, _ = sk_bind(net, mask & (bport == 0), slot, 0, 0)
+    net = net.replace(
+        sk_peer_ip=set_hs(net.sk_peer_ip, mask, slot,
+                          jnp.asarray(peer_ip, net.sk_peer_ip.dtype)),
+        sk_peer_port=set_hs(net.sk_peer_port, mask, slot,
+                            jnp.asarray(peer_port, I32)),
+    )
+    return net
+
+
+def sk_set_flag(net: NetState, mask, slot, flag: int, on):
+    cur = gather_hs(net.sk_flags, slot)
+    new = jnp.where(on, cur | flag, cur & ~flag)
+    return net.replace(sk_flags=set_hs(net.sk_flags, mask, slot, new))
+
+
+def lookup_socket(net: NetState, mask, proto, dst_ip, dst_port, src_ip, src_port):
+    """Find the receiving socket slot per lane ([H] -> slot or -1).
+
+    Order matches the reference (network_interface.c:388-403): first
+    the general association (bound port, no peer — servers), then the
+    (peer ip, peer port)-specific association."""
+    S = net.sk_type.shape[1]
+    pr = jnp.asarray(proto)[:, None]
+    dip = jnp.asarray(dst_ip)[:, None]
+    dpt = jnp.asarray(dst_port)[:, None]
+    sip = jnp.asarray(src_ip)[:, None]
+    spt = jnp.asarray(src_port)[:, None]
+
+    base = (
+        mask[:, None]
+        & (net.sk_type == pr)
+        & ((net.sk_flags & SocketFlags.CLOSED) == 0)
+        & (net.sk_bound_port == dpt)
+        & ((net.sk_bound_ip == 0) | (net.sk_bound_ip == dip))
+    )
+    general = base & (net.sk_peer_port == 0)
+    specific = base & (net.sk_peer_ip == sip) & (net.sk_peer_port == spt)
+
+    def first_slot(m):
+        has = jnp.any(m, axis=1)
+        return jnp.where(has, jnp.argmax(m, axis=1), -1)
+
+    g = first_slot(general)
+    s = first_slot(specific)
+    return jnp.where(g >= 0, g, s)

@@ -1,0 +1,281 @@
+"""Struct-of-arrays state for the virtual host network stack.
+
+One NetState holds *all* hosts' kernel state as [H]- and [H,S]-shaped
+device arrays (the reference's per-host heap objects — Host,
+NetworkInterface, Router, Socket — ref: host.c:47-105,
+network_interface.c, socket.h:47-78 — become rows). Sockets are laid
+out [H, S] so "this host's sockets" is a row and qdisc selection is a
+vectorized row scan.
+
+Payload bytes are never device-resident; packets carry lengths and a
+host-side pool reference (ref: payload.c).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core import rng, simtime
+from shadow_tpu.core.events import NWORDS, EventQueue, Outbox
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+class SocketType:
+    NONE = 0
+    UDP = 1
+    TCP = 2
+    # intra-host conduits (pipe/socketpair, ref: channel.c) are modeled
+    # as socket pairs with local-only delivery
+    PIPE = 3
+
+
+class SocketFlags:
+    """Descriptor status bits (ref: descriptor.h:19-31)."""
+
+    ACTIVE = 1
+    READABLE = 2
+    WRITABLE = 4
+    CLOSED = 8
+
+
+class QDisc:
+    """Interface queuing discipline (ref: options.h:31-34)."""
+
+    FIFO = 0
+    RR = 1
+
+
+# token-bucket refill interval (ref: network_interface.c:93-95)
+TB_REFILL_INTERVAL = simtime.ONE_MILLISECOND
+
+
+@dataclass(frozen=True)
+class NetConfig:
+    """Static build-time configuration (shapes are compile-time)."""
+
+    num_hosts: int
+    sockets_per_host: int = 4
+    in_ring: int = 16            # per-socket input packet ring slots
+    out_ring: int = 16           # per-socket output packet ring slots
+    router_ring: int = 32       # per-host upstream router queue slots
+    timers_per_host: int = 4
+    event_capacity: int = 32
+    outbox_capacity: int = 32
+    qdisc: int = QDisc.FIFO
+    bootstrap_end: int = 0       # "unlimited bandwidth" period end
+                                 # (ref: master.c:261-268)
+    end_time: int = simtime.ONE_SECOND
+    min_jump: int = 10 * simtime.ONE_MILLISECOND
+    seed: int = 1
+    emit_capacity: int = 6       # max emissions per host per micro-step
+    # default socket buffer byte limits (ref: definitions.h:153-159)
+    sndbuf: int = 131072
+    rcvbuf: int = 174760
+
+
+@struct.dataclass
+class NetState:
+    # --- immutable lookup tables -------------------------------------
+    host_ip: jax.Array           # [H] i64 eth IP per host
+    ip_sorted: jax.Array         # [H] i64 sorted IPs (for ip->host lookup)
+    host_of_ip_sorted: jax.Array  # [H] i32 host index aligned to ip_sorted
+    vertex_of_host: jax.Array    # [H] i32 topology attachment
+    latency_ns: jax.Array        # [V,V] i64
+    reliability: jax.Array       # [V,V] f32
+    # --- per-host RNG (deterministic seed hierarchy) ------------------
+    rng_keys: jax.Array          # [H] key array
+    rng_ctr: jax.Array           # [H] u32 draw counters
+    # --- NIC token buckets (ref: network_interface.c:93-226) ----------
+    tb_send_refill: jax.Array    # [H] i64 bytes per interval
+    tb_recv_refill: jax.Array    # [H] i64
+    tb_send_tokens: jax.Array    # [H] i64
+    tb_recv_tokens: jax.Array    # [H] i64
+    tb_quantum: jax.Array        # [H] i64 last analytic refill quantum
+    nic_send_pending: jax.Array  # [H] bool — a future NIC_SEND exists
+    nic_recv_pending: jax.Array  # [H] bool
+    rr_ptr: jax.Array            # [H] i32 round-robin qdisc cursor
+    port_ctr: jax.Array          # [H] i32 ephemeral port allocator
+                                 # (counter analog of host.c:1058-1110)
+    priority_ctr: jax.Array     # [H] i64 per-host packet priority
+                                 # (ref: host.c packet priority counter)
+    # --- upstream router ring + CoDel (ref: router_queue_codel.c) -----
+    rq_src: jax.Array            # [H,R] i32 source host of queued packet
+    rq_enq_ts: jax.Array         # [H,R] i64 enqueue time (sojourn calc)
+    rq_words: jax.Array          # [H,R,NWORDS] i32 packet words
+    rq_head: jax.Array           # [H] i32 ring head
+    rq_count: jax.Array          # [H] i32 ring occupancy
+    rq_bytes: jax.Array          # [H] i64 queued wire bytes
+    codel_interval_expire: jax.Array  # [H] i64 (0 = good state)
+    codel_next_drop: jax.Array   # [H] i64
+    codel_dropping: jax.Array    # [H] bool drop mode
+    codel_drop_count: jax.Array  # [H] i32
+    codel_drop_count_last: jax.Array  # [H] i32
+    # --- sockets [H,S] ------------------------------------------------
+    sk_type: jax.Array           # [H,S] i32 SocketType
+    sk_flags: jax.Array          # [H,S] i32 SocketFlags bits
+    sk_bound_ip: jax.Array       # [H,S] i64 (0 = INADDR_ANY wildcard)
+    sk_bound_port: jax.Array     # [H,S] i32 (0 = unbound)
+    sk_peer_ip: jax.Array        # [H,S] i64 (0 = unconnected)
+    sk_peer_port: jax.Array      # [H,S] i32
+    sk_sndbuf: jax.Array         # [H,S] i32 byte limits
+    sk_rcvbuf: jax.Array         # [H,S] i32
+    # input ring: packets delivered, waiting for app recv
+    in_src_ip: jax.Array         # [H,S,BI] i64
+    in_src_port: jax.Array       # [H,S,BI] i32
+    in_len: jax.Array            # [H,S,BI] i32
+    in_payref: jax.Array         # [H,S,BI] i32
+    in_head: jax.Array           # [H,S] i32
+    in_count: jax.Array          # [H,S] i32
+    in_bytes: jax.Array          # [H,S] i32
+    # output ring: packetized app data waiting for the NIC
+    out_dst_ip: jax.Array        # [H,S,BO] i64
+    out_dst_port: jax.Array      # [H,S,BO] i32
+    out_len: jax.Array           # [H,S,BO] i32
+    out_payref: jax.Array        # [H,S,BO] i32
+    out_priority: jax.Array      # [H,S,BO] i64
+    out_head: jax.Array          # [H,S] i32
+    out_count: jax.Array         # [H,S] i32
+    out_bytes: jax.Array         # [H,S] i32
+    # --- timers (timerfd analog, ref: timer.c) ------------------------
+    tm_expire: jax.Array         # [H,T] i64 next expiry (INVALID = off)
+    tm_interval: jax.Array       # [H,T] i64 (0 = one-shot)
+    tm_gen: jax.Array            # [H,T] i32 generation (stale-expiry guard)
+    tm_expirations: jax.Array    # [H,T] i64 count since last read
+    # --- counters (tracker-lite; full tracker in utils) ---------------
+    ctr_drop_reliability: jax.Array  # [H] i64 packets dropped by path loss
+    ctr_drop_codel: jax.Array    # [H] i64
+    ctr_drop_nosocket: jax.Array  # [H] i64
+    ctr_drop_bufferfull: jax.Array  # [H] i64
+    ctr_rx_bytes: jax.Array      # [H] i64
+    ctr_tx_bytes: jax.Array      # [H] i64
+    ctr_rx_packets: jax.Array    # [H] i64
+    ctr_tx_packets: jax.Array    # [H] i64
+    rq_overflow: jax.Array       # [] i32 router ring overflow (grow R!)
+
+
+@struct.dataclass
+class Sim:
+    """Top-level simulation state: engine queues + netstack + app."""
+
+    events: EventQueue
+    outbox: Outbox
+    net: NetState
+    app: Any = None
+
+
+def make_net_state(
+    cfg: NetConfig,
+    host_ips: np.ndarray,       # [H] i64
+    bw_up_kibps: np.ndarray,    # [H]
+    bw_down_kibps: np.ndarray,  # [H]
+    vertex_of_host: np.ndarray,  # [H] i32
+    latency_ns: np.ndarray,     # [V,V] i64
+    reliability: np.ndarray,    # [V,V] f32
+) -> NetState:
+    H, S = cfg.num_hosts, cfg.sockets_per_host
+    BI, BO, R, T = cfg.in_ring, cfg.out_ring, cfg.router_ring, cfg.timers_per_host
+
+    # bytes per refill interval (ref: network_interface.c:196-203)
+    tf = simtime.ONE_SECOND // TB_REFILL_INTERVAL
+    send_refill = np.asarray(bw_up_kibps, np.int64) * 1024 // tf
+    recv_refill = np.asarray(bw_down_kibps, np.int64) * 1024 // tf
+    from shadow_tpu.net.packetfmt import MTU
+
+    z_h = jnp.zeros((H,), I64)
+    zi_h = jnp.zeros((H,), I32)
+
+    return NetState(
+        host_ip=jnp.asarray(host_ips, I64),
+        ip_sorted=jnp.asarray(np.sort(host_ips), I64),
+        host_of_ip_sorted=jnp.asarray(np.argsort(host_ips), I32),
+        vertex_of_host=jnp.asarray(vertex_of_host, I32),
+        latency_ns=jnp.asarray(latency_ns, I64),
+        reliability=jnp.asarray(reliability, jnp.float32),
+        rng_keys=rng.host_streams(cfg.seed, H),
+        rng_ctr=jnp.zeros((H,), jnp.uint32),
+        tb_send_refill=jnp.asarray(send_refill),
+        tb_recv_refill=jnp.asarray(recv_refill),
+        # buckets start at capacity = refill + MTU
+        # (ref: network_interface.c:219-226)
+        tb_send_tokens=jnp.asarray(send_refill + MTU),
+        tb_recv_tokens=jnp.asarray(recv_refill + MTU),
+        tb_quantum=z_h,
+        nic_send_pending=jnp.zeros((H,), bool),
+        nic_recv_pending=jnp.zeros((H,), bool),
+        rr_ptr=zi_h,
+        port_ctr=zi_h,
+        priority_ctr=z_h,
+        rq_src=jnp.zeros((H, R), I32),
+        rq_enq_ts=jnp.zeros((H, R), I64),
+        rq_words=jnp.zeros((H, R, NWORDS), I32),
+        rq_head=zi_h,
+        rq_count=zi_h,
+        rq_bytes=z_h,
+        codel_interval_expire=z_h,
+        codel_next_drop=z_h,
+        codel_dropping=jnp.zeros((H,), bool),
+        codel_drop_count=zi_h,
+        codel_drop_count_last=zi_h,
+        sk_type=jnp.zeros((H, S), I32),
+        sk_flags=jnp.zeros((H, S), I32),
+        sk_bound_ip=jnp.zeros((H, S), I64),
+        sk_bound_port=jnp.zeros((H, S), I32),
+        sk_peer_ip=jnp.zeros((H, S), I64),
+        sk_peer_port=jnp.zeros((H, S), I32),
+        sk_sndbuf=jnp.full((H, S), cfg.sndbuf, I32),
+        sk_rcvbuf=jnp.full((H, S), cfg.rcvbuf, I32),
+        in_src_ip=jnp.zeros((H, S, BI), I64),
+        in_src_port=jnp.zeros((H, S, BI), I32),
+        in_len=jnp.zeros((H, S, BI), I32),
+        in_payref=jnp.zeros((H, S, BI), I32),
+        in_head=jnp.zeros((H, S), I32),
+        in_count=jnp.zeros((H, S), I32),
+        in_bytes=jnp.zeros((H, S), I32),
+        out_dst_ip=jnp.zeros((H, S, BO), I64),
+        out_dst_port=jnp.zeros((H, S, BO), I32),
+        out_len=jnp.zeros((H, S, BO), I32),
+        out_payref=jnp.zeros((H, S, BO), I32),
+        out_priority=jnp.zeros((H, S, BO), I64),
+        out_head=jnp.zeros((H, S), I32),
+        out_count=jnp.zeros((H, S), I32),
+        out_bytes=jnp.zeros((H, S), I32),
+        tm_expire=jnp.full((H, T), simtime.INVALID, I64),
+        tm_interval=jnp.zeros((H, T), I64),
+        tm_gen=jnp.zeros((H, T), I32),
+        tm_expirations=jnp.zeros((H, T), I64),
+        ctr_drop_reliability=z_h,
+        ctr_drop_codel=z_h,
+        ctr_drop_nosocket=z_h,
+        ctr_drop_bufferfull=z_h,
+        ctr_rx_bytes=z_h,
+        ctr_tx_bytes=z_h,
+        ctr_rx_packets=z_h,
+        ctr_tx_packets=z_h,
+        rq_overflow=jnp.zeros((), I32),
+    )
+
+
+def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
+    return Sim(
+        events=EventQueue.create(cfg.num_hosts, cfg.event_capacity),
+        outbox=Outbox.create(cfg.num_hosts, cfg.outbox_capacity),
+        net=net,
+        app=app,
+    )
+
+
+def host_of_ip(net: NetState, ip):
+    """Device ip -> host-index lookup ([...] i64 -> [...] i32, -1 when
+    unknown). Replaces worker_resolveIPToAddress (ref: worker.c:255)."""
+    idx = jnp.searchsorted(net.ip_sorted, ip)
+    idx = jnp.clip(idx, 0, net.ip_sorted.shape[0] - 1)
+    hit = net.ip_sorted[idx] == ip
+    return jnp.where(hit, net.host_of_ip_sorted[idx], -1)

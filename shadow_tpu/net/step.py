@@ -1,0 +1,40 @@
+"""Composes the per-micro-step handler pipeline for the engine.
+
+The reference dispatches events through arbitrary Task closures
+(ref: task.c, event.c:65-93); here the dispatch is a fixed sequence of
+masked batch handlers — every handler sees all H popped events and
+acts only on lanes whose kind matches. Handlers touch disjoint state
+per lane (one event per host per micro-step), so composition order
+does not affect results; app handlers run after the netstack so they
+observe updated socket state within the same micro-step.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+from shadow_tpu.net import nic, timers
+from shadow_tpu.net.state import NetConfig
+
+AppHandler = Callable  # (cfg, sim, popped, buf) -> (sim, buf)
+
+_NET_HANDLERS = (
+    nic.handle_packet_arrival,
+    nic.handle_nic_recv,
+    nic.handle_nic_send,
+    nic.handle_packet_local,
+    timers.handle_timer,
+)
+
+
+def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
+    """Build the engine step_fn: netstack handlers then app handlers."""
+
+    def step(sim, popped, buf):
+        for h in _NET_HANDLERS:
+            sim, buf = h(cfg, sim, popped, buf)
+        for h in app_handlers:
+            sim, buf = h(cfg, sim, popped, buf)
+        return sim, buf
+
+    return step

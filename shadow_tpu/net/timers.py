@@ -1,0 +1,99 @@
+"""timerfd-style timers (ref: descriptor/timer.c).
+
+Each host owns T timer slots with absolute next-expiry + interval.
+Setting a timer bumps a generation counter and schedules a TIMER event
+carrying (slot, generation); stale events from earlier settings are
+ignored on fire — the reference's expireID invalidation
+(timer.c:23-42,201-…). Periodic timers reschedule themselves.
+
+Apps observe expirations via tm_expirations (timerfd read semantics)
+and may also register their own handler for EventKind.TIMER.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EventKind, emit
+from shadow_tpu.core.events import NWORDS
+from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net.state import NetConfig, NetState
+
+I32 = jnp.int32
+
+# timer event words
+TW_SLOT = 0
+TW_GEN = 1
+
+
+def timer_set(sim, buf, mask, slot, expire_time, interval=0):
+    """Arm timer `slot` per masked lane to fire at expire_time (abs),
+    then every `interval` ns if nonzero. Returns (sim, buf)."""
+    net = sim.net
+    H = net.tm_expire.shape[0]
+    gen = gather_hs(net.tm_gen, slot) + 1
+    net = net.replace(
+        tm_expire=set_hs(net.tm_expire, mask, slot,
+                         jnp.asarray(expire_time, simtime.DTYPE)),
+        tm_interval=set_hs(net.tm_interval, mask, slot,
+                           jnp.asarray(interval, simtime.DTYPE)),
+        tm_gen=set_hs(net.tm_gen, mask, slot, gen),
+    )
+    words = jnp.zeros((H, NWORDS), I32)
+    words = words.at[:, TW_SLOT].set(jnp.asarray(slot, I32))
+    words = words.at[:, TW_GEN].set(gen)
+    buf = emit(buf, mask, jnp.arange(H, dtype=I32),
+               jnp.asarray(expire_time, simtime.DTYPE), EventKind.TIMER, words)
+    return sim.replace(net=net), buf
+
+
+def timer_disarm(sim, mask, slot):
+    """Disarm: bump generation so in-flight events become stale."""
+    net = sim.net
+    gen = gather_hs(net.tm_gen, slot) + 1
+    net = net.replace(
+        tm_expire=set_hs(net.tm_expire, mask, slot, simtime.INVALID),
+        tm_gen=set_hs(net.tm_gen, mask, slot, gen),
+    )
+    return sim.replace(net=net)
+
+
+def timer_read(sim, mask, slot):
+    """timerfd read(): returns expirations since last read and clears
+    the count. Returns (sim, count[H])."""
+    net = sim.net
+    n = gather_hs(net.tm_expirations, slot)
+    n = jnp.where(mask, n, 0)
+    net = net.replace(
+        tm_expirations=set_hs(net.tm_expirations, mask, slot,
+                              jnp.zeros_like(n)))
+    return sim.replace(net=net), n
+
+
+def handle_timer(cfg: NetConfig, sim, popped, buf):
+    """kind=TIMER: count the expiration if the generation is current;
+    reschedule periodic timers."""
+    net = sim.net
+    H = net.tm_expire.shape[0]
+    mask = popped.valid & (popped.kind == EventKind.TIMER)
+    slot = popped.words[:, TW_SLOT]
+    gen = popped.words[:, TW_GEN]
+    live = mask & (gather_hs(net.tm_gen, slot) == gen)
+
+    exp = gather_hs(net.tm_expirations, slot)
+    net = net.replace(
+        tm_expirations=set_hs(net.tm_expirations, live, slot, exp + 1)
+    )
+    interval = gather_hs(net.tm_interval, slot)
+    periodic = live & (interval > 0)
+    nxt = popped.time + interval
+    net = net.replace(
+        tm_expire=set_hs(
+            net.tm_expire, live, slot,
+            jnp.where(periodic, nxt, simtime.INVALID),
+        )
+    )
+    buf = emit(buf, periodic, jnp.arange(H, dtype=I32), nxt,
+               EventKind.TIMER, popped.words)
+    return sim.replace(net=net), buf

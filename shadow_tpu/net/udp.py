@@ -1,0 +1,124 @@
+"""UDP datagram socket semantics (ref: descriptor/udp.c).
+
+Send wraps app data into packets of at most CONFIG_DATAGRAM_MAX_SIZE
+(ref: udp.c send path, definitions.h:193) queued on the socket's
+output ring for the NIC; receive buffers packets in arrival order in
+the input ring, dropping when the receive buffer is full, and raises
+the READABLE status (ref: udp.c:53-…, descriptor_adjustStatus)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.rings import (
+    gather_hs,
+    ring_advance_pop,
+    ring_advance_push,
+    ring_push_at,
+    ring_peek_at,
+    set_hs,
+)
+from shadow_tpu.net.state import NetState, SocketFlags
+
+I32 = jnp.int32
+DATAGRAM_MAX = 65507  # ref: definitions.h:193
+
+
+def udp_enqueue_send(net: NetState, mask, slot, dst_ip, dst_port, length, payref):
+    """Queue one datagram on (lane, slot)'s output ring. Returns
+    (net, ok[H]) — ok False when the send buffer lacks space, the
+    app-visible EWOULDBLOCK condition (ref: socket buffer accounting,
+    socket.h:47-78)."""
+    H = mask.shape[0]
+    lane = jnp.arange(H)
+    length = jnp.asarray(length, I32)
+    BO = net.out_dst_ip.shape[2]
+
+    space_ok = (gather_hs(net.out_bytes, slot) + length) <= gather_hs(
+        net.sk_sndbuf, slot
+    )
+    ok, pos = ring_push_at(net.out_head, net.out_count, BO, mask & space_ok, slot)
+    s = jnp.where(ok, slot, net.out_dst_ip.shape[1])
+    pri = net.priority_ctr  # per-host app-ordering priority (host.c)
+    net = net.replace(
+        out_dst_ip=net.out_dst_ip.at[lane, s, pos].set(
+            jnp.asarray(dst_ip, net.out_dst_ip.dtype), mode="drop"),
+        out_dst_port=net.out_dst_port.at[lane, s, pos].set(
+            jnp.asarray(dst_port, I32), mode="drop"),
+        out_len=net.out_len.at[lane, s, pos].set(length, mode="drop"),
+        out_payref=net.out_payref.at[lane, s, pos].set(
+            jnp.asarray(payref, I32), mode="drop"),
+        out_priority=net.out_priority.at[lane, s, pos].set(pri, mode="drop"),
+        priority_ctr=net.priority_ctr + ok.astype(net.priority_ctr.dtype),
+    )
+    _, count = ring_advance_push(net.out_head, net.out_count, mask, slot, ok)
+    net = net.replace(out_count=count)
+    ob = gather_hs(net.out_bytes, slot)
+    net = net.replace(out_bytes=set_hs(net.out_bytes, ok, slot, ob + length))
+    return net, ok
+
+
+def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
+    """Push one received datagram into (lane, slot)'s input ring; drop
+    (counted) when the receive buffer is full. Returns net."""
+    H = mask.shape[0]
+    lane = jnp.arange(H)
+    length = jnp.asarray(length, I32)
+    BI = net.in_src_ip.shape[2]
+
+    space_ok = (gather_hs(net.in_bytes, slot) + length) <= gather_hs(
+        net.sk_rcvbuf, slot
+    )
+    ok, pos = ring_push_at(net.in_head, net.in_count, BI, mask & space_ok, slot)
+    s = jnp.where(ok, slot, net.in_src_ip.shape[1])
+    net = net.replace(
+        in_src_ip=net.in_src_ip.at[lane, s, pos].set(
+            jnp.asarray(src_ip, net.in_src_ip.dtype), mode="drop"),
+        in_src_port=net.in_src_port.at[lane, s, pos].set(
+            jnp.asarray(src_port, I32), mode="drop"),
+        in_len=net.in_len.at[lane, s, pos].set(length, mode="drop"),
+        in_payref=net.in_payref.at[lane, s, pos].set(
+            jnp.asarray(payref, I32), mode="drop"),
+    )
+    _, count = ring_advance_push(net.in_head, net.in_count, mask, slot, ok)
+    net = net.replace(in_count=count)
+    ib = gather_hs(net.in_bytes, slot)
+    net = net.replace(in_bytes=set_hs(net.in_bytes, ok, slot, ib + length))
+    # readable on data arrival (ref: descriptor_adjustStatus READABLE)
+    flags = gather_hs(net.sk_flags, slot)
+    net = net.replace(
+        sk_flags=set_hs(net.sk_flags, ok, slot, flags | SocketFlags.READABLE)
+    )
+    dropped = mask & ~space_ok
+    net = net.replace(
+        ctr_drop_bufferfull=net.ctr_drop_bufferfull + dropped.astype(jnp.int64)
+    )
+    return net
+
+
+def udp_recv(net: NetState, mask, slot):
+    """Pop one datagram per masked lane. Returns
+    (net, got[H], src_ip, src_port, length, payref)."""
+    H = mask.shape[0]
+    lane = jnp.arange(H)
+    BI = net.in_src_ip.shape[2]
+    got, pos = ring_peek_at(net.in_head, net.in_count, mask, slot, BI)
+    s = jnp.clip(slot, 0, net.in_src_ip.shape[1] - 1)
+    posc = jnp.clip(pos, 0, BI - 1)
+    src_ip = net.in_src_ip[lane, s, posc]
+    src_port = net.in_src_port[lane, s, posc]
+    length = jnp.where(got, net.in_len[lane, s, posc], 0)
+    payref = net.in_payref[lane, s, posc]
+    head, count = ring_advance_pop(net.in_head, net.in_count, got, slot, BI)
+    net = net.replace(in_head=head, in_count=count)
+    ib = gather_hs(net.in_bytes, slot)
+    net = net.replace(in_bytes=set_hs(net.in_bytes, got, slot, ib - length))
+    # clear READABLE when drained
+    empty = gather_hs(net.in_count, slot) == 0
+    flags = gather_hs(net.sk_flags, slot)
+    net = net.replace(
+        sk_flags=set_hs(net.sk_flags, got & empty, slot,
+                        flags & ~SocketFlags.READABLE)
+    )
+    return net, got, src_ip, src_port, length, payref

@@ -1,0 +1,3 @@
+from shadow_tpu.routing.graphml import Graph, parse_graphml
+from shadow_tpu.routing.topology import Topology, HostPlacement
+from shadow_tpu.routing.dns import DNS

@@ -1,0 +1,15 @@
+"""Test harness: run on CPU with 8 virtual devices so multi-chip
+sharding paths are exercised without TPU hardware. Must run before jax
+is imported anywhere."""
+
+import os
+
+# Force CPU even when the environment preselects a TPU platform
+# (JAX_PLATFORMS=axon) — tests need the virtual 8-device mesh and fast
+# iteration; TPU coverage comes from examples/ and bench.py.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

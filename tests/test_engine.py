@@ -1,0 +1,62 @@
+"""Windowed-engine semantics tests using the toy ring model from
+shadow_tpu.apps.ring (a minimal PHOLD: each event at host h schedules
+one event at (h+1)%H after a cross-host latency — ref:
+src/test/phold/test_phold.c:36-52)."""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.apps import ring
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import run
+
+LATENCY = ring.LATENCY
+
+
+def test_ring_hops_conservatively():
+    H = 4
+    sim = ring.make(H)
+    end = 100 * simtime.ONE_MILLISECOND
+    sim, stats = run(sim, ring.step, end_time=end, min_jump=LATENCY)
+    # hops at t=0,10,...,100ms inclusive -> 11 events
+    assert int(stats.events_processed) == 11
+    assert int(sim.events.overflow) == 0
+    assert int(sim.outbox.overflow) == 0
+    # each window advances by exactly one hop: windows >= 11
+    assert int(stats.windows) >= 11
+    hops = [int(x) for x in sim.hops]
+    assert sum(hops) == 11
+    assert hops[0] == 3  # t=0,40,80ms land on host 0
+
+
+def test_determinism_same_seed_same_result():
+    a1, s1 = run(ring.make(8), ring.step, end_time=simtime.ONE_SECOND,
+                 min_jump=LATENCY)
+    a2, s2 = run(ring.make(8), ring.step, end_time=simtime.ONE_SECOND,
+                 min_jump=LATENCY)
+    assert int(s1.events_processed) == int(s2.events_processed)
+    assert jnp.array_equal(a1.hops, a2.hops)
+
+
+def test_capacity_does_not_change_results():
+    outs = []
+    for K in (8, 32):
+        sim, stats = run(
+            ring.make(6, capacity=K, outbox_capacity=K), ring.step,
+            end_time=simtime.ONE_SECOND, min_jump=LATENCY,
+        )
+        outs.append(([int(x) for x in sim.hops], int(stats.events_processed)))
+    assert outs[0] == outs[1]
+
+
+def test_jit_compiles_whole_sim():
+    f = jax.jit(lambda s: run(s, ring.step, end_time=simtime.ONE_SECOND,
+                              min_jump=LATENCY))
+    sim, stats = f(ring.make(4))
+    assert int(stats.events_processed) == 101
+
+
+def test_nonpositive_min_jump_rejected():
+    with pytest.raises(ValueError):
+        run(ring.make(2), ring.step, end_time=simtime.ONE_SECOND, min_jump=0)

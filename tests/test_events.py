@@ -1,0 +1,181 @@
+"""Event-queue ordering contract tests.
+
+The contract under test is the reference's deterministic total order
+(time, dstHost, srcHost, perSourceSeq) — ref: event.c:110-153 — and
+exact delivery of cross-host events via the outbox shuffle."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import (
+    EmitBuffer,
+    EventQueue,
+    Outbox,
+    apply_emissions,
+    compact_rows,
+    emit,
+    emit_words,
+    outbox_append,
+    pop_earliest,
+    push_rows,
+    route_outbox,
+)
+
+
+def _push_one(q, host, time, kind=1, src=0, seq=0, w0=0):
+    H = q.num_hosts
+    mask = jnp.arange(H) == host
+    return push_rows(
+        q,
+        mask,
+        jnp.full((H,), time, simtime.DTYPE),
+        jnp.full((H,), kind, jnp.int32),
+        jnp.full((H,), src, jnp.int32),
+        jnp.full((H,), seq, jnp.int32),
+        emit_words(w0, num_hosts=H),
+    )
+
+
+def _drain_host(q, host, horizon=simtime.MAX):
+    """Pop row `host` to empty; return list of (time, src, seq)."""
+    out = []
+    while True:
+        q, p = pop_earliest(q, horizon)
+        if not bool(p.valid[host]):
+            break
+        out.append((int(p.time[host]), int(p.src[host]), int(p.seq[host])))
+    return q, out
+
+
+def test_pop_orders_by_time_src_seq():
+    rng = np.random.default_rng(7)
+    q = EventQueue.create(num_hosts=2, capacity=32)
+    evs = []
+    for i in range(20):
+        t = int(rng.integers(0, 5)) * 100  # force ties
+        src = int(rng.integers(0, 3))
+        seq = i
+        evs.append((t, src, seq))
+        q = _push_one(q, 0, t, src=src, seq=seq)
+    q, popped = _drain_host(q, 0)
+    assert popped == sorted(evs)
+
+
+def test_pop_respects_horizon():
+    q = EventQueue.create(num_hosts=1, capacity=8)
+    q = _push_one(q, 0, 50)
+    q = _push_one(q, 0, 150)
+    q2, p = pop_earliest(q, horizon=100)
+    assert bool(p.valid[0]) and int(p.time[0]) == 50
+    q3, p = pop_earliest(q2, horizon=100)
+    assert not bool(p.valid[0])
+    # the 150 event is still there
+    assert int(q3.min_time()[0]) == 150
+
+
+def test_push_overflow_is_counted_not_silent():
+    q = EventQueue.create(num_hosts=1, capacity=2)
+    for t in (1, 2, 3):
+        q = _push_one(q, 0, t)
+    assert int(q.overflow) == 1
+    assert int(q.fill_count()[0]) == 2
+
+
+def test_route_outbox_delivers_to_dst_rows():
+    H = 4
+    q = EventQueue.create(H, capacity=8)
+    q = _push_one(q, 2, 10)  # pre-existing event on host 2
+    out = Outbox.create(H, capacity=8)
+    rows = jnp.arange(H)
+    # every host sends one event to host 2 at time 100+h
+    out = outbox_append(
+        out,
+        jnp.ones((H,), bool),
+        jnp.full((H,), 2, jnp.int32),
+        (100 + rows).astype(simtime.DTYPE),
+        jnp.full((H,), 1, jnp.int32),
+        rows.astype(jnp.int32),
+        jnp.zeros((H,), jnp.int32),
+        emit_words(0, num_hosts=H),
+    )
+    q, out = route_outbox(q, out)
+    assert int(out.count.sum()) == 0
+    assert int(q.fill_count()[2]) == 5
+    assert int(q.fill_count()[0]) == 0
+    q, popped = _drain_host(q, 2)
+    assert [t for t, _, _ in popped] == [10, 100, 101, 102, 103]
+
+
+def test_route_outbox_overflow_counted():
+    H = 2
+    q = EventQueue.create(H, capacity=2)
+    out = Outbox.create(H, capacity=4)
+    ones = jnp.ones((H,), bool)
+    for i in range(3):
+        out = outbox_append(
+            out, ones,
+            jnp.full((H,), 1, jnp.int32),
+            jnp.full((H,), 100 + i, simtime.DTYPE),
+            jnp.full((H,), 1, jnp.int32),
+            jnp.arange(H, dtype=jnp.int32),
+            jnp.zeros((H,), jnp.int32),
+            emit_words(0, num_hosts=H),
+        )
+    q, out = route_outbox(q, out)  # 6 events -> host 1 row of capacity 2
+    assert int(q.fill_count()[1]) == 2
+    assert int(q.overflow) == 4
+
+
+def test_route_outbox_bad_dst_counted_as_overflow():
+    H = 2
+    q = EventQueue.create(H, capacity=4)
+    out = Outbox.create(H, capacity=4)
+    mask = jnp.array([True, False])
+    out = outbox_append(
+        out, mask,
+        jnp.full((H,), H, jnp.int32),  # dst out of range
+        jnp.full((H,), 100, simtime.DTYPE),
+        jnp.full((H,), 1, jnp.int32),
+        jnp.zeros((H,), jnp.int32),
+        jnp.zeros((H,), jnp.int32),
+        emit_words(0, num_hosts=H),
+    )
+    q, out = route_outbox(q, out)
+    assert int(q.fill_count().sum()) == 0
+    assert int(q.overflow) == 1
+
+
+def test_apply_emissions_assigns_seq_in_slot_order():
+    H = 2
+    q = EventQueue.create(H, capacity=8)
+    out = Outbox.create(H, capacity=8)
+    buf = EmitBuffer.create(H, capacity=4)
+    ones = jnp.ones((H,), bool)
+    lane = jnp.arange(H, dtype=jnp.int32)
+    w = emit_words(0, num_hosts=H)
+    t = jnp.full((H,), 5, simtime.DTYPE)
+    # host h emits: local@5, remote->other@5, local@5
+    buf = emit(buf, ones, lane, t, 1, w)
+    buf = emit(buf, ones, 1 - lane, t, 1, w)
+    buf = emit(buf, ones, lane, t, 1, w)
+    q, out = apply_emissions(q, out, buf)
+    assert list(np.asarray(q.next_seq)) == [3, 3]
+    # local events got seq 0 and 2; remote got seq 1
+    q2, popped = _drain_host(q, 0)
+    assert [(s, n) for _, s, n in popped] == [(0, 0), (0, 2)]
+    assert int(out.seq[0, 0]) == 1
+    assert int(out.dst[0, 0]) == 1
+
+
+def test_compact_rows_preserves_multiset():
+    q = EventQueue.create(2, capacity=6)
+    for t in (30, 10, 20):
+        q = _push_one(q, 1, t)
+    q2, p = pop_earliest(q, simtime.MAX)  # pops 10, leaves hole at slot 1
+    q3 = compact_rows(q2)
+    v = np.asarray(q3.valid()[1])
+    assert v[:2].all() and not v[2:].any()
+    _, popped = _drain_host(q3, 1)
+    assert [t for t, _, _ in popped] == [20, 30]

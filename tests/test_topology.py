@@ -1,0 +1,154 @@
+"""Topology/routing golden tests against reference semantics:
+direct-path and complete-graph rules (ref: topology.c:2019-2031),
+self paths (topology.c:1545-1653), reliability composition
+(topology.c:1442-1460), and attach hint tiers (topology.c:2126-2340)."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.core import simtime
+from shadow_tpu.routing import DNS, Topology, parse_graphml
+from shadow_tpu.routing.address import str_to_ip
+
+# the reference test suite's standard fixture: one vertex with a
+# self-loop, latency 50ms (ref: src/test/*/…xml topologies)
+SINGLE = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="d1">10240</data><data key="d2">10240</data></node>
+    <edge source="v0" target="v0">
+      <data key="d3">50.0</data><data key="d4">0.25</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+TRIANGLE = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="pl" />
+  <key attr.name="packetloss" attr.type="double" for="node" id="vpl" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <key attr.name="citycode" attr.type="string" for="node" id="cc" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <key attr.name="ip" attr.type="string" for="node" id="ip" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="up">100</data><data key="dn">100</data>
+      <data key="cc">nyc</data><data key="ty">relay</data>
+      <data key="ip">11.0.0.1</data><data key="vpl">0.1</data></node>
+    <node id="b"><data key="up">100</data><data key="dn">100</data>
+      <data key="cc">nyc</data><data key="ty">client</data>
+      <data key="ip">11.0.0.200</data></node>
+    <node id="c"><data key="up">100</data><data key="dn">100</data>
+      <data key="cc">lon</data><data key="ty">relay</data></node>
+    <edge source="a" target="b"><data key="lat">10.0</data></edge>
+    <edge source="b" target="c"><data key="lat">20.0</data></edge>
+    <edge source="a" target="c"><data key="lat">100.0</data><data key="pl">0.5</data></edge>
+  </graph>
+</graphml>"""
+
+
+def test_single_vertex_selfloop_is_complete_direct():
+    top = Topology(parse_graphml(SINGLE))
+    assert top.is_complete
+    # complete -> direct edge for every pair incl. self: 50ms, rel 0.75
+    assert top.latency_ms[0, 0] == 50.0
+    assert top.latency_ns[0, 0] == 50 * simtime.ONE_MILLISECOND
+    assert abs(top.reliability[0, 0] - 0.75) < 1e-9
+
+
+def test_triangle_shortest_path_routes_around():
+    top = Topology(parse_graphml(TRIANGLE))
+    assert not top.is_complete
+    ia, ib, ic = (top.graph.vertex_index[x] for x in "abc")
+    # a->c direct edge is 100ms with 50% loss; a-b-c is 30ms
+    assert top.latency_ms[ia, ic] == 30.0
+    # reliability: edges are lossless; vertex a has 10% loss
+    assert abs(top.reliability[ia, ic] - 0.9) < 1e-9
+    assert abs(top.reliability[ib, ic] - 1.0) < 1e-9
+    # self path: cheapest incident edge twice (a-b at 10ms)
+    assert top.latency_ms[ia, ia] == 20.0
+    assert abs(top.reliability[ia, ia] - 1.0) < 1e-9
+
+
+def test_attach_tiers_and_lpm():
+    top = Topology(parse_graphml(TRIANGLE))
+    ia, ib, ic = (top.graph.vertex_index[x] for x in "abc")
+    # city+type beats city alone
+    assert top.find_attachment(0.0, citycode="nyc", type_hint="relay") == ia
+    # city tier with two candidates: random pick covers both
+    assert top.find_attachment(0.0, citycode="nyc") == ia
+    assert top.find_attachment(1.0, citycode="nyc") == ib
+    # type-only tier
+    assert top.find_attachment(1.0, type_hint="client") == ib
+    # exact ip match wins over everything
+    assert top.find_attachment(0.5, ip_hint="11.0.0.200",
+                               citycode="lon") == ib
+    # longest-prefix: 11.0.0.3 is closer to .1 than .200
+    assert top.find_attachment(0.5, ip_hint="11.0.0.3") == ia
+    # no hints: any vertex, deterministic in the draw
+    assert top.find_attachment(0.0) == ia
+    assert top.find_attachment(1.0) == ic
+
+
+def test_attach_hosts_and_min_jump():
+    top = Topology(parse_graphml(TRIANGLE))
+    hints = [{"citycode": "nyc", "type": "relay"}, {"citycode": "lon"}]
+    pl = top.attach_hosts(hints, [0.0, 0.0])
+    assert pl.vertex.tolist() == [0, 2]
+    assert pl.bw_up_kibps.tolist() == [100, 100]
+    # min latency between attached vertices a,c = 30ms
+    assert top.min_jump_ns(pl) == 30 * simtime.ONE_MILLISECOND
+    # two hosts on one vertex: self-path latency counts
+    pl2 = top.attach_hosts([{"citycode": "nyc", "type": "relay"}] * 2, [0.0, 0.0])
+    assert top.min_jump_ns(pl2) == 20 * simtime.ONE_MILLISECOND
+
+
+def test_min_jump_floor_single_host():
+    top = Topology(parse_graphml(SINGLE))
+    pl = top.attach_hosts([{}], [0.0])
+    # one host: no cross-host pair -> 10ms default runahead
+    assert top.min_jump_ns(pl) == 10 * simtime.ONE_MILLISECOND
+
+
+def test_disconnected_graph_rejected():
+    bad = """<graphml><graph edgedefault="undirected">
+      <node id="x"/><node id="y"/>
+      <key attr.name="latency" attr.type="double" for="edge" id="lat"/>
+    </graph></graphml>"""
+    # note: keys must precede graph per spec, but parser tolerates order
+    with pytest.raises(ValueError, match="connected|no path"):
+        Topology(parse_graphml(bad))
+
+
+def test_dns_assignment_skips_reserved():
+    dns = DNS()
+    a0 = dns.register(0, "h0")
+    a1 = dns.register(1, "h1")
+    assert a0.ip == str_to_ip("1.0.0.0")  # 0.0.0.0/8 skipped
+    assert a1.ip == str_to_ip("1.0.0.1")
+    # requested IP honored when free, unrestricted
+    a2 = dns.register(2, "h2", requested_ip="11.0.0.5")
+    assert a2.ip == str_to_ip("11.0.0.5")
+    # restricted request falls back to counter
+    a3 = dns.register(3, "h3", requested_ip="192.168.1.1")
+    assert a3.ip == str_to_ip("1.0.0.2")
+    assert dns.resolve_name("h2").host_index == 2
+    assert dns.resolve_ip(a1.ip).name == "h1"
+    with pytest.raises(ValueError):
+        dns.register(4, "h0")
+
+
+def test_device_tables_gather():
+    import jax.numpy as jnp
+
+    top = Topology(parse_graphml(TRIANGLE))
+    pl = top.attach_hosts(
+        [{"citycode": "nyc", "type": "relay"}, {"citycode": "lon"}], [0.0, 0.0]
+    )
+    lat, rel, vert = top.device_tables(pl)
+    src, dst = vert[0], vert[1]
+    assert int(lat[src, dst]) == 30 * simtime.ONE_MILLISECOND
+    assert abs(float(rel[src, dst]) - 0.9) < 1e-6

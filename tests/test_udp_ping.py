@@ -1,0 +1,81 @@
+"""End-to-end slice test: 2-host UDP ping/echo over a 2-vertex
+topology — the device analog of the reference's 2-host tgen ping
+config (BASELINE.json config #1) and of the udp/ dual-mode tests."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, SimBundle, build, run
+from shadow_tpu.net.state import NetConfig
+
+TWO_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <graph edgedefault="undirected">
+    <node id="west"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">client</data></node>
+    <node id="east"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">server</data></node>
+    <edge source="west" target="west"><data key="lat">5.0</data></edge>
+    <edge source="west" target="east"><data key="lat">25.0</data></edge>
+    <edge source="east" target="east"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+PORT = 5555
+
+
+def _build(count=10, size=64, seed=1):
+    cfg = NetConfig(num_hosts=2, end_time=10 * simtime.ONE_SECOND, seed=seed)
+    hosts = [
+        HostSpec(name="client", type="client",
+                 proc_start_time=simtime.ONE_SECOND),
+        HostSpec(name="server", type="server"),
+    ]
+    b = build(cfg, TWO_VERTEX, hosts)
+    client = jnp.asarray(np.arange(2) == b.host_of("client"))
+    server = jnp.asarray(np.arange(2) == b.host_of("server"))
+    sim = pingpong.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=b.ip_of("server"), server_port=PORT,
+        count=count, size=size,
+    )
+    b.sim = sim
+    return b
+
+
+def test_ping_round_trips():
+    b = _build(count=10)
+    assert b.min_jump == 10 * simtime.ONE_MILLISECOND  # self-loop 2x5ms
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    ci, si = b.host_of("client"), b.host_of("server")
+    app = sim.app
+    assert int(app.sent[ci]) == 10
+    assert int(app.rcvd[si]) == 10       # server got all pings
+    assert int(app.rcvd[ci]) == 10       # client got all echoes
+    # RTT = 2 x 25ms per ping, no loss, no queueing
+    assert int(app.rtt_sum[ci]) == 10 * 50 * simtime.ONE_MILLISECOND
+    assert int(sim.events.overflow) == 0
+    assert int(sim.outbox.overflow) == 0
+    assert int(sim.net.rq_overflow) == 0
+    # no drops of any kind on a lossless idle network
+    assert int(sim.net.ctr_drop_reliability.sum()) == 0
+    assert int(sim.net.ctr_drop_codel.sum()) == 0
+    assert int(sim.net.ctr_drop_nosocket.sum()) == 0
+    net = sim.net
+    # 10 pings + 10 echoes, 64B payload + 42B UDP header each
+    assert int(net.ctr_tx_packets.sum()) == 20
+    assert int(net.ctr_rx_packets.sum()) == 20
+    assert int(net.ctr_tx_bytes.sum()) == 20 * (64 + 42)
+
+
+def test_ping_deterministic_across_runs():
+    r1, s1 = run(_build(), app_handlers=(pingpong.handler,))
+    r2, s2 = run(_build(), app_handlers=(pingpong.handler,))
+    assert int(s1.events_processed) == int(s2.events_processed)
+    assert jnp.array_equal(r1.app.rtt_sum, r2.app.rtt_sum)
+    assert jnp.array_equal(r1.net.ctr_rx_bytes, r2.net.ctr_rx_bytes)
