@@ -60,12 +60,29 @@ class EngineStats:
         return EngineStats(events_processed=z, micro_steps=z, windows=z)
 
 
+# route_fn(sim) -> sim: deliver the outbox into destination queues.
+# The default is the single-shard events.route_outbox; the multi-chip
+# runner substitutes the all-to-all exchange (shadow_tpu.parallel).
+def _default_route(sim):
+    q, out = route_outbox(sim.events, sim.outbox)
+    return sim.replace(events=q, outbox=out)
+
+
+# min_fn(x) -> x: reduce a per-shard scalar to the global value. The
+# multi-chip runner substitutes lax.pmin over the mesh axis — the
+# device form of the executeEvents barrier + min-next-event-time
+# reduction (ref: scheduler.c:359-414).
+def _identity(x):
+    return x
+
+
 def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
-                    emit_capacity: int = 4):
+                    emit_capacity: int = 4, lane_id=None):
     """Drain every event earlier than wend (local events only — handlers
     may keep emitting same-host events inside the window, e.g. loopback
     +1ns deliveries, ref: network_interface.c:546-554; iterate to
-    fixpoint like the reference's pop-until-NULL worker loop)."""
+    fixpoint like the reference's pop-until-NULL worker loop). Purely
+    shard-local: no collectives, so shards iterate independently."""
     H = sim.events.num_hosts
     wend = jnp.asarray(wend, simtime.DTYPE)
 
@@ -79,7 +96,7 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
         sim = sim.replace(events=q)
         buf = EmitBuffer.create(H, emit_capacity)
         sim, buf = step_fn(sim, popped, buf)
-        q, out = apply_emissions(sim.events, sim.outbox, buf)
+        q, out = apply_emissions(sim.events, sim.outbox, buf, lane_id)
         sim = sim.replace(events=q, outbox=out)
         stats = stats.replace(
             events_processed=stats.events_processed
@@ -92,16 +109,17 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
 
 
 def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
-                emit_capacity: int = 4):
+                emit_capacity: int = 4, lane_id=None,
+                route_fn=_default_route, min_fn=_identity):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
     ref: scheduler.c:634-650)."""
-    sim, stats = window_fixpoint(sim, stats, step_fn, wend, emit_capacity)
-    q, out = route_outbox(sim.events, sim.outbox)
-    sim = sim.replace(events=q, outbox=out)
+    sim, stats = window_fixpoint(sim, stats, step_fn, wend, emit_capacity,
+                                 lane_id)
+    sim = route_fn(sim)
     stats = stats.replace(windows=stats.windows + 1)
-    next_min = jnp.min(sim.events.min_time())
+    next_min = min_fn(jnp.min(sim.events.min_time()))
     return sim, stats, next_min
 
 
@@ -113,6 +131,9 @@ def run(
     min_jump: int,
     start_time: int = 0,
     emit_capacity: int = 4,
+    lane_id=None,
+    route_fn=_default_route,
+    min_fn=_identity,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -120,6 +141,11 @@ def run(
     minJump, clamped to end (ref: master.c:450-480). min_jump is the
     precomputed minimum cross-host path latency with the same 10ms
     floor the reference applies when unknown (ref: master.c:133-159).
+
+    Under shard_map, route_fn carries the only collectives (all-to-all
+    + the pmin in min_fn), both outside the inner fixpoint loop, so the
+    outer window loop runs in lockstep across shards while each shard
+    drains its own window at its own pace.
     """
     if isinstance(min_jump, int) and min_jump <= 0:
         raise ValueError(f"min_jump must be positive, got {min_jump}")
@@ -137,12 +163,14 @@ def run(
         sim, stats, wstart = carry
         wend = jnp.minimum(wstart + min_jump, end_time + 1)
         sim, stats, next_min = step_window(
-            sim, stats, step_fn, wend, emit_capacity
+            sim, stats, step_fn, wend, emit_capacity, lane_id,
+            route_fn, min_fn,
         )
         return sim, stats, next_min
 
     first = jnp.maximum(
-        jnp.min(sim.events.min_time()), jnp.asarray(start_time, simtime.DTYPE)
+        min_fn(jnp.min(sim.events.min_time())),
+        jnp.asarray(start_time, simtime.DTYPE),
     )
     sim, stats, _ = jax.lax.while_loop(cond, body, (sim, stats, first))
     return sim, stats

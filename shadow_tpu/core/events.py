@@ -264,63 +264,92 @@ def compact_rows(q: EventQueue) -> EventQueue:
     )
 
 
+def segment_ranks(sorted_keys: jax.Array) -> jax.Array:
+    """[n] rank of each element within its run of equal keys (keys must
+    already be sorted)."""
+    n = sorted_keys.shape[0]
+    pos = jnp.arange(n)
+    is_start = jnp.concatenate(
+        [jnp.ones((1,), bool), sorted_keys[1:] != sorted_keys[:-1]]
+    )
+    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
+    return pos - seg_start
+
+
+def insert_flat(
+    q: EventQueue,
+    valid: jax.Array,  # [n] bool
+    row: jax.Array,    # [n] i32 *local* destination row
+    time: jax.Array,   # [n] i64
+    kind: jax.Array,   # [n] i32
+    src: jax.Array,    # [n] i32 (global source host id)
+    seq: jax.Array,    # [n] i32
+    words: jax.Array,  # [n, NWORDS] i32
+) -> EventQueue:
+    """Insert a flat batch of events into their destination rows: sort
+    by row (stable, so the caller's order is the within-row order),
+    rank within each row's segment, scatter into the compacted row at
+    fill_count[row] + rank. Overflow is counted, never silent."""
+    n = row.shape[0]
+    H = q.num_hosts
+    skey = jnp.where(valid, row, H)
+    order = jnp.argsort(skey, stable=True)
+    row_s = skey[order]
+    time_s = time[order]
+    kind_s = kind[order]
+    src_s = src[order]
+    seq_s = seq[order]
+    words_s = words[order]
+    valid_s = row_s < H
+    rank = segment_ranks(row_s)
+
+    q = compact_rows(q)
+    base = q.fill_count()                                  # [H]
+    slot = base[jnp.where(valid_s, row_s, 0)] + rank       # [n]
+    fits = valid_s & (slot < q.capacity)
+    r = jnp.where(fits, row_s, H)                          # OOB -> drop
+    slot = jnp.where(fits, slot, q.capacity)
+    return q.replace(
+        time=q.time.at[r, slot].set(time_s, mode="drop"),
+        kind=q.kind.at[r, slot].set(kind_s, mode="drop"),
+        src=q.src.at[r, slot].set(src_s, mode="drop"),
+        seq=q.seq.at[r, slot].set(seq_s, mode="drop"),
+        words=q.words.at[r, slot, :].set(words_s, mode="drop"),
+        overflow=q.overflow + jnp.sum(valid_s & ~fits, dtype=I32),
+    )
+
+
+def clear_outbox(out: Outbox) -> Outbox:
+    H, M = out.dst.shape
+    return out.replace(
+        dst=jnp.full((H, M), -1, I32),
+        time=jnp.full((H, M), simtime.INVALID, simtime.DTYPE),
+        count=jnp.zeros((H,), I32),
+    )
+
+
 def route_outbox(q: EventQueue, out: Outbox) -> tuple[EventQueue, Outbox]:
     """Deliver all staged cross-host events into destination rows.
 
-    Single-shard version: flatten, sort by destination, compute each
-    event's rank within its destination segment, scatter into the
-    compacted destination row at fill_count[dst] + rank. The multi-chip
-    path runs the same routine after an all-to-all keyed by
-    dst // hosts_per_shard (see shadow_tpu.parallel).
+    Single-shard version: destination host ids are row indices
+    directly. The multi-chip path runs insert_flat after an all-to-all
+    keyed by dst // hosts_per_shard (see shadow_tpu.parallel.shard).
     """
     H, M = out.dst.shape
     n = H * M
     dst = out.dst.reshape(n)
     occupied = dst >= 0
-    # A dst outside [0, H) is a routing bug (or an unremapped global id
-    # on the sharded path) — count it, never silently drop.
+    # A dst outside [0, H) is a routing bug — count it, never silently
+    # drop.
     bad_dst = occupied & (dst >= H)
     valid = occupied & ~bad_dst
-    # Sort by dst (invalid last). Within a segment any order works for
-    # correctness (pop re-sorts); sorting keeps it deterministic.
-    skey = jnp.where(valid, dst, H)
-    order = jnp.argsort(skey, stable=True)
-    dst_s = skey[order]
-    time_s = out.time.reshape(n)[order]
-    kind_s = out.kind.reshape(n)[order]
-    src_s = out.src.reshape(n)[order]
-    seq_s = out.seq.reshape(n)[order]
-    words_s = out.words.reshape(n, NWORDS)[order]
-    valid_s = dst_s < H
-
-    # rank within destination segment
-    pos = jnp.arange(n)
-    is_start = jnp.concatenate([jnp.ones((1,), bool), dst_s[1:] != dst_s[:-1]])
-    seg_start = jax.lax.cummax(jnp.where(is_start, pos, 0))
-    rank = pos - seg_start
-
-    q = compact_rows(q)
-    base = q.fill_count()                                  # [H]
-    slot = base[jnp.where(valid_s, dst_s, 0)] + rank       # [n]
-    fits = valid_s & (slot < q.capacity)
-    row = jnp.where(fits, dst_s, H)                        # OOB -> drop
-    slot = jnp.where(fits, slot, q.capacity)
-    q = q.replace(
-        time=q.time.at[row, slot].set(time_s, mode="drop"),
-        kind=q.kind.at[row, slot].set(kind_s, mode="drop"),
-        src=q.src.at[row, slot].set(src_s, mode="drop"),
-        seq=q.seq.at[row, slot].set(seq_s, mode="drop"),
-        words=q.words.at[row, slot, :].set(words_s, mode="drop"),
-        overflow=q.overflow
-        + jnp.sum(valid_s & ~fits, dtype=I32)
-        + jnp.sum(bad_dst, dtype=I32),
+    q = insert_flat(
+        q, valid, dst,
+        out.time.reshape(n), out.kind.reshape(n), out.src.reshape(n),
+        out.seq.reshape(n), out.words.reshape(n, NWORDS),
     )
-    out = out.replace(
-        dst=jnp.full((H, M), -1, I32),
-        time=jnp.full((H, M), simtime.INVALID, simtime.DTYPE),
-        count=jnp.zeros((H,), I32),
-    )
-    return q, out
+    q = q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
+    return q, clear_outbox(out)
 
 
 @struct.dataclass
@@ -401,13 +430,18 @@ def emit_words(*vals, num_hosts: int | None = None) -> jax.Array:
 
 
 def apply_emissions(
-    q: EventQueue, out: Outbox, buf: EmitBuffer
+    q: EventQueue, out: Outbox, buf: EmitBuffer, lane_id: jax.Array | None = None
 ) -> tuple[EventQueue, Outbox]:
     """Move staged emissions into the local queue / cross-host outbox,
     assigning per-source sequence numbers in slot order (matching the
-    reference's per-push host_getNewEventID ordering)."""
+    reference's per-push host_getNewEventID ordering).
+
+    `lane_id` is each local row's *global* host id ([H] i32) — the
+    identity of the sharded lane. Emission dst fields are global host
+    ids; dst == lane_id means a same-host event that stays in the local
+    queue. Defaults to arange(H) (single-shard)."""
     H, E = buf.dst.shape
-    lane = jnp.arange(H, dtype=I32)
+    lane = jnp.arange(H, dtype=I32) if lane_id is None else lane_id.astype(I32)
     nvalid = jnp.zeros((H,), I32)
     for e in range(E):
         v = buf.dst[:, e] >= 0

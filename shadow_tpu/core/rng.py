@@ -7,6 +7,9 @@ execution order. Here the hierarchy is a counter-based construction:
 draw i of host h from master seed s is threefry(fold(fold(key(s), h),
 counter_h)), which is independent of thread/shard interleaving by
 construction.
+
+Keys are carried as raw uint32 key data ([H, 2]) rather than key
+arrays so they shard/transfer like any other tensor under shard_map.
 """
 
 from __future__ import annotations
@@ -18,24 +21,30 @@ I32 = jnp.int32
 
 
 def host_streams(seed: int, num_hosts: int) -> jax.Array:
-    """[H] per-host base keys (batched key array)."""
+    """[H, 2] u32 per-host base key data."""
     base = jax.random.key(seed)
-    return jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+    keys = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
         base, jnp.arange(num_hosts, dtype=jnp.uint32)
     )
+    return jax.random.key_data(keys)
 
 
-def uniform(keys: jax.Array, counters: jax.Array) -> tuple[jax.Array, jax.Array]:
+def _fold(key_data: jax.Array, counters: jax.Array) -> jax.Array:
+    keys = jax.random.wrap_key_data(key_data)
+    return jax.vmap(jax.random.fold_in)(keys, counters.astype(jnp.uint32))
+
+
+def uniform(key_data: jax.Array, counters: jax.Array) -> tuple[jax.Array, jax.Array]:
     """One f32 uniform [0,1) draw per host at its current counter;
     returns (values[H], counters+1)."""
-    ks = jax.vmap(jax.random.fold_in)(keys, counters.astype(jnp.uint32))
+    ks = _fold(key_data, counters)
     vals = jax.vmap(lambda k: jax.random.uniform(k, dtype=jnp.float32))(ks)
     return vals, counters + 1
 
 
-def randint(keys: jax.Array, counters: jax.Array, maxval) -> tuple[jax.Array, jax.Array]:
+def randint(key_data: jax.Array, counters: jax.Array, maxval) -> tuple[jax.Array, jax.Array]:
     """One i32 uniform draw in [0, maxval) per host (maxval may be [H])."""
-    ks = jax.vmap(jax.random.fold_in)(keys, counters.astype(jnp.uint32))
+    ks = _fold(key_data, counters)
     u = jax.vmap(lambda k: jax.random.uniform(k, dtype=jnp.float32))(ks)
     vals = jnp.minimum((u * maxval).astype(I32), jnp.asarray(maxval, I32) - 1)
     return vals, counters + 1

@@ -141,4 +141,5 @@ def run(bundle: SimBundle, app_handlers=(), end_time: int | None = None):
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
+        lane_id=bundle.sim.net.lane_id,
     )

@@ -90,13 +90,13 @@ def deliver_packet(net: NetState, mask, src_host, words, now):
     (ref: _networkinterface_receivePacket, network_interface.c:375-419).
     Returns net. TCP packets are routed to the TCP engine by the step
     composer before this UDP/no-socket fallback."""
-    H = mask.shape[0]
+    GH = net.host_ip.shape[0]  # global host count (host_ip replicated)
     proto = pf.proto_of(words)
     src_port, dst_port = pf.ports_of(words)
     dst_ip = ip_from_word(words[:, pf.W_DSTIP])
     src_ip = jnp.where(
-        src_host == jnp.arange(H), ip_from_word(words[:, pf.W_DSTIP]),
-        net.host_ip[jnp.clip(src_host, 0, H - 1)],
+        src_host == net.lane_id, ip_from_word(words[:, pf.W_DSTIP]),
+        net.host_ip[jnp.clip(src_host, 0, GH - 1)],
     )
     # loopback packets keep their loopback src address
     src_ip = jnp.where(dst_ip >> 24 == 127, dst_ip, src_ip)
@@ -145,7 +145,7 @@ def handle_packet_arrival(cfg: NetConfig, sim, popped, buf):
         rq_overflow=net.rq_overflow + jnp.sum(mask & ~ok, dtype=I32),
     )
     kick = ok & was_empty & ~net.nic_recv_pending
-    buf = emit(buf, kick, lane.astype(I32), popped.time, EventKind.NIC_RECV,
+    buf = emit(buf, kick, net.lane_id, popped.time, EventKind.NIC_RECV,
                _empty_words(H))
     net = net.replace(nic_recv_pending=net.nic_recv_pending | kick)
     return sim.replace(net=net), buf
@@ -262,9 +262,9 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
     can_next = bootstrap | (net.tb_recv_tokens >= pf.MTU)
     chain = mask & more & can_next
     wait = mask & more & ~can_next
-    buf = emit(buf, chain, lane.astype(I32), now, EventKind.NIC_RECV,
+    buf = emit(buf, chain, net.lane_id, now, EventKind.NIC_RECV,
                _empty_words(H))
-    buf = emit(buf, wait, lane.astype(I32), next_refill_time(now),
+    buf = emit(buf, wait, net.lane_id, next_refill_time(now),
                EventKind.NIC_RECV, _empty_words(H))
     net = net.replace(nic_recv_pending=net.nic_recv_pending | chain | wait)
     return sim.replace(net=net), buf
@@ -347,12 +347,14 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     words = words.at[:, pf.W_DSTIP].set(dst_ip.astype(jnp.uint32).astype(I32))
 
     wl = pf.wire_length(proto, length).astype(I64)
-    local = active & ((dst_ip == net.host_ip) | (dst_ip >> 24 == 127))
+    GH = net.host_ip.shape[0]
+    my_ip = net.host_ip[net.lane_id]
+    local = active & ((dst_ip == my_ip) | (dst_ip >> 24 == 127))
     remote = active & ~local
 
     # loopback: 1ns self delivery, no tokens
     # (network_interface.c:546-554)
-    buf = emit(buf, local, lane.astype(I32), now + 1, EventKind.PACKET_LOCAL,
+    buf = emit(buf, local, net.lane_id, now + 1, EventKind.PACKET_LOCAL,
                words)
 
     # remote: reliability draw + latency lookup (worker.c:243-304)
@@ -362,8 +364,8 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     known = remote & (dsth >= 0)
     u, ctr = rng.uniform(net.rng_keys, net.rng_ctr)
     net = net.replace(rng_ctr=jnp.where(remote, ctr, net.rng_ctr))
-    vsrc = net.vertex_of_host
-    vdst = net.vertex_of_host[jnp.clip(dsth, 0, H - 1)]
+    vsrc = net.vertex_of_host[net.lane_id]
+    vdst = net.vertex_of_host[jnp.clip(dsth, 0, GH - 1)]
     rel = net.reliability[vsrc, vdst]
     lat = net.latency_ns[vsrc, vdst]
     drop = known & ~bootstrap & (length > 0) & (u > rel)
@@ -385,9 +387,9 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     can_next = bootstrap | (net.tb_send_tokens >= pf.MTU)
     chain = mask & more & can_next
     wait = mask & more & ~can_next
-    buf = emit(buf, chain, lane.astype(I32), now, EventKind.NIC_SEND,
+    buf = emit(buf, chain, net.lane_id, now, EventKind.NIC_SEND,
                _empty_words(H))
-    buf = emit(buf, wait, lane.astype(I32), next_refill_time(now),
+    buf = emit(buf, wait, net.lane_id, next_refill_time(now),
                EventKind.NIC_SEND, _empty_words(H))
     net = net.replace(nic_send_pending=net.nic_send_pending | chain | wait)
     return sim.replace(net=net), buf
@@ -407,7 +409,7 @@ def notify_wants_send(sim, buf, mask, now):
     net = sim.net
     H = net.rq_head.shape[0]
     kick = mask & ~net.nic_send_pending
-    buf = emit(buf, kick, jnp.arange(H, dtype=I32), now, EventKind.NIC_SEND,
+    buf = emit(buf, kick, net.lane_id, now, EventKind.NIC_SEND,
                _empty_words(H))
     net = net.replace(nic_send_pending=net.nic_send_pending | kick)
     return sim.replace(net=net), buf

@@ -81,17 +81,34 @@ class NetConfig:
     rcvbuf: int = 174760
 
 
+# NetState fields that are *global lookup tables*: replicated across
+# shards (any host may address any other). Everything else with a
+# leading H dimension is per-host state, sharded over the mesh's host
+# axis. (Consumed by shadow_tpu.parallel.shard when building
+# PartitionSpecs.)
+REPLICATED_FIELDS = frozenset({
+    "host_ip", "ip_sorted", "host_of_ip_sorted", "vertex_of_host",
+    "latency_ns", "reliability",
+})
+
+
 @struct.dataclass
 class NetState:
-    # --- immutable lookup tables -------------------------------------
-    host_ip: jax.Array           # [H] i64 eth IP per host
+    # --- replicated global lookup tables -----------------------------
+    host_ip: jax.Array           # [H] i64 eth IP per host (global table)
     ip_sorted: jax.Array         # [H] i64 sorted IPs (for ip->host lookup)
     host_of_ip_sorted: jax.Array  # [H] i32 host index aligned to ip_sorted
-    vertex_of_host: jax.Array    # [H] i32 topology attachment
+    vertex_of_host: jax.Array    # [H] i32 topology attachment (global)
     latency_ns: jax.Array        # [V,V] i64
     reliability: jax.Array       # [V,V] f32
+    # --- per-host (sharded) state -------------------------------------
+    # Global host id of each local row. Single-shard: arange(H). Under
+    # shard_map each shard sees its own slice — handlers use this (not
+    # arange) wherever a host's *identity* matters: self-addressed
+    # emissions, src-host comparisons, global-table gathers.
+    lane_id: jax.Array           # [H] i32
     # --- per-host RNG (deterministic seed hierarchy) ------------------
-    rng_keys: jax.Array          # [H] key array
+    rng_keys: jax.Array          # [H, 2] u32 key data
     rng_ctr: jax.Array           # [H] u32 draw counters
     # --- NIC token buckets (ref: network_interface.c:93-226) ----------
     tb_send_refill: jax.Array    # [H] i64 bytes per interval
@@ -199,6 +216,7 @@ def make_net_state(
         vertex_of_host=jnp.asarray(vertex_of_host, I32),
         latency_ns=jnp.asarray(latency_ns, I64),
         reliability=jnp.asarray(reliability, jnp.float32),
+        lane_id=jnp.arange(H, dtype=I32),
         rng_keys=rng.host_streams(cfg.seed, H),
         rng_ctr=jnp.zeros((H,), jnp.uint32),
         tb_send_refill=jnp.asarray(send_refill),

@@ -43,7 +43,7 @@ def timer_set(sim, buf, mask, slot, expire_time, interval=0):
     words = jnp.zeros((H, NWORDS), I32)
     words = words.at[:, TW_SLOT].set(jnp.asarray(slot, I32))
     words = words.at[:, TW_GEN].set(gen)
-    buf = emit(buf, mask, jnp.arange(H, dtype=I32),
+    buf = emit(buf, mask, net.lane_id,
                jnp.asarray(expire_time, simtime.DTYPE), EventKind.TIMER, words)
     return sim.replace(net=net), buf
 
@@ -94,6 +94,6 @@ def handle_timer(cfg: NetConfig, sim, popped, buf):
             jnp.where(periodic, nxt, simtime.INVALID),
         )
     )
-    buf = emit(buf, periodic, jnp.arange(H, dtype=I32), nxt,
+    buf = emit(buf, periodic, net.lane_id, nxt,
                EventKind.TIMER, popped.words)
     return sim.replace(net=net), buf

@@ -1,0 +1,6 @@
+from shadow_tpu.parallel.shard import (  # noqa: F401
+    route_outbox_sharded,
+    run_sharded,
+    sharded_engine_run,
+    sim_specs,
+)

@@ -1,0 +1,238 @@
+"""Multi-chip execution: hosts sharded over a jax.sharding.Mesh axis.
+
+This is the TPU-native replacement for the reference's host→thread
+assignment and barrier machinery (ref: scheduler.c:437-531 host
+shuffling; scheduler.c:359-414 + master.c:450-480 round barriers):
+
+- Host rows (event queues, socket tables, NIC state) shard over the
+  mesh's host axis; global lookup tables (IP maps, the dense
+  latency/reliability matrices) replicate.
+- The window fixpoint is purely shard-local — each chip drains its own
+  hosts' events at its own pace, no communication (the analog of
+  worker threads running between barriers).
+- The only collectives, once per window: an all-to-all exchanging
+  cross-shard events staged in the outbox (the analog of
+  scheduler_push to another thread's queue, scheduler.c:339-357), and
+  a pmin over per-shard next-event times (the analog of the
+  executeEvents barrier + min reduction, scheduler.c:393-398). Both
+  ride ICI on a real TPU mesh.
+
+Determinism: event identity is (time, dst, src, per-source seq) and
+pop order is a lexicographic argmin over those keys (events.py), so
+results are bit-identical for any shard count — the same property the
+reference gets from its 4-key event sort (ref: event.c:110-153).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.tree_util import tree_map_with_path
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import EngineStats, run as engine_run
+from shadow_tpu.core.events import (
+    NWORDS,
+    EventQueue,
+    Outbox,
+    clear_outbox,
+    insert_flat,
+    segment_ranks,
+)
+from shadow_tpu.net.state import REPLICATED_FIELDS
+
+I32 = jnp.int32
+
+
+def sim_specs(sim, axis: str):
+    """PartitionSpec pytree for a Sim (or any engine-compatible state):
+    NetState's replicated lookup tables and scalar leaves get P();
+    everything else shards its leading (host) dimension over `axis`.
+    App states must follow the same convention: leading-H arrays or
+    scalars."""
+
+    def spec(path, leaf):
+        name = None
+        for k in reversed(path):
+            if hasattr(k, "name"):
+                name = k.name
+                break
+        if name in REPLICATED_FIELDS:
+            return P()
+        if jnp.ndim(leaf) == 0:
+            return P()
+        return P(axis)
+
+    return tree_map_with_path(spec, sim)
+
+
+def route_outbox_sharded(
+    q: EventQueue, out: Outbox, axis: str, num_shards: int,
+    lane_id: jax.Array, exchange_capacity: int | None = None,
+) -> tuple[EventQueue, Outbox]:
+    """Exchange staged cross-host events across shards and insert them
+    into destination rows — the window-boundary all-to-all of
+    (dst, time, kind, src, seq, words) records (SURVEY.md §5.8).
+
+    Each shard owns the contiguous global host range
+    [lane_id[0], lane_id[0] + Hl); an event's target shard is
+    dst // Hl. Entries are grouped per target shard by a stable sort,
+    exchanged with lax.all_to_all, then inserted with the same
+    insert_flat as the single-shard path, in the same global
+    (source row, emission slot) order — so the resulting queue state is
+    bit-identical to the single-shard route.
+
+    exchange_capacity bounds the per-peer exchange buffer (default:
+    the whole outbox, Hl*M, which can never overflow). Smaller values
+    cut ICI transfer ~linearly; entries beyond the cap are counted in
+    q.overflow, never silently dropped."""
+    Hl, M = out.dst.shape
+    GH = Hl * num_shards
+    base = lane_id[0]
+    n = Hl * M
+    C = n if exchange_capacity is None else min(exchange_capacity, n)
+
+    dst = out.dst.reshape(n)
+    occupied = dst >= 0
+    bad = occupied & (dst >= GH)
+    valid = occupied & ~bad
+    tgt = jnp.where(valid, dst // Hl, num_shards)
+
+    # group by target shard (stable keeps global source order)
+    order = jnp.argsort(tgt, stable=True)
+    tgt_s = tgt[order]
+    ok = tgt_s < num_shards
+    rank = segment_ranks(tgt_s)
+    fits = ok & (rank < C)
+    xofl = jnp.sum(ok & ~fits, dtype=I32)
+
+    row = jnp.where(fits, tgt_s, num_shards)
+    slot = jnp.where(fits, rank, C)
+
+    def to_sendbuf(a, fill):
+        flat = a.reshape((n,) + a.shape[2:])[order]
+        buf = jnp.full((num_shards, C) + a.shape[2:], fill, a.dtype)
+        return buf.at[row, slot].set(flat, mode="drop")
+
+    sb_dst = to_sendbuf(out.dst, -1)
+    sb_time = to_sendbuf(out.time, simtime.INVALID)
+    sb_kind = to_sendbuf(out.kind, 0)
+    sb_src = to_sendbuf(out.src, 0)
+    sb_seq = to_sendbuf(out.seq, 0)
+    sb_words = to_sendbuf(out.words, 0)
+
+    a2a = partial(lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0)
+    rb_dst = a2a(sb_dst)
+    rb_time = a2a(sb_time)
+    rb_kind = a2a(sb_kind)
+    rb_src = a2a(sb_src)
+    rb_seq = a2a(sb_seq)
+    rb_words = a2a(sb_words)
+
+    nn = num_shards * C
+    rdst = rb_dst.reshape(nn)
+    rvalid = rdst >= 0
+    local_row = jnp.where(rvalid, rdst - base, Hl)
+    q = insert_flat(
+        q, rvalid, local_row,
+        rb_time.reshape(nn), rb_kind.reshape(nn), rb_src.reshape(nn),
+        rb_seq.reshape(nn), rb_words.reshape(nn, NWORDS),
+    )
+    q = q.replace(overflow=q.overflow + jnp.sum(bad, dtype=I32) + xofl)
+    return q, clear_outbox(out)
+
+
+def _replicate_scalars(sim, stats: EngineStats, axis: str):
+    """psum EVERY scalar leaf of the sim so out_specs can declare them
+    replicated — scalar leaves are per-shard partial counters by
+    convention (overflow/drop totals); a new counter added anywhere in
+    the state tree is aggregated automatically instead of silently
+    returning one shard's value. stats.windows is identical on every
+    shard (lockstep outer loop), so pmax is the identity there."""
+    sim = jax.tree.map(
+        lambda leaf: lax.psum(leaf, axis) if jnp.ndim(leaf) == 0 else leaf,
+        sim,
+    )
+    stats = EngineStats(
+        events_processed=lax.psum(stats.events_processed, axis),
+        micro_steps=lax.psum(stats.micro_steps, axis),
+        windows=lax.pmax(stats.windows, axis),
+    )
+    return sim, stats
+
+
+def sharded_engine_run(
+    mesh: Mesh,
+    axis: str,
+    sim,
+    step_fn,
+    *,
+    end_time: int,
+    min_jump: int,
+    emit_capacity: int = 4,
+    lane_id_fn=None,
+):
+    """shard_map the full engine.run over `mesh[axis]`. `sim` is the
+    *global* state (as built for single-shard); sharding/replication
+    follows sim_specs. lane_id_fn(local_sim) must return the [Hl]
+    global host ids of the shard's rows (defaults to sim.net.lane_id).
+
+    Returns (sim, stats) with global arrays reassembled."""
+    num_shards = mesh.shape[axis]
+    H = sim.events.num_hosts
+    if H % num_shards != 0:
+        raise ValueError(f"num_hosts={H} not divisible by {num_shards} shards")
+    specs = sim_specs(sim, axis)
+    stats_specs = EngineStats(
+        events_processed=P(), micro_steps=P(), windows=P()
+    )
+
+    def _body(local_sim):
+        lane = (lane_id_fn(local_sim) if lane_id_fn is not None
+                else local_sim.net.lane_id)
+        out_sim, stats = engine_run(
+            local_sim,
+            step_fn,
+            end_time=end_time,
+            min_jump=min_jump,
+            emit_capacity=emit_capacity,
+            lane_id=lane,
+            route_fn=lambda s: s.replace(**dict(zip(
+                ("events", "outbox"),
+                route_outbox_sharded(s.events, s.outbox, axis, num_shards, lane),
+            ))),
+            min_fn=lambda x: lax.pmin(x, axis),
+        )
+        return _replicate_scalars(out_sim, stats, axis)
+
+    # check_vma=False: the engine's while_loop carries mix varying and
+    # replicated leaves, which static VMA checking rejects without
+    # pvary annotations throughout; replication of the declared-P()
+    # outputs is guaranteed by _replicate_scalars psumming every
+    # scalar leaf (and verified by the bit-identity tests).
+    shmapped = jax.shard_map(
+        _body, mesh=mesh, in_specs=(specs,), out_specs=(specs, stats_specs),
+        check_vma=False,
+    )
+    in_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                                is_leaf=lambda x: isinstance(x, P))
+    sim = jax.device_put(sim, in_shardings)
+    return jax.jit(shmapped)(sim)
+
+
+def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
+                end_time: int | None = None):
+    """Multi-chip variant of shadow_tpu.net.build.run."""
+    from shadow_tpu.net.step import make_step_fn
+
+    step = make_step_fn(bundle.cfg, app_handlers)
+    return sharded_engine_run(
+        mesh, axis, bundle.sim, step,
+        end_time=end_time if end_time is not None else bundle.cfg.end_time,
+        min_jump=bundle.min_jump,
+        emit_capacity=bundle.cfg.emit_capacity,
+    )

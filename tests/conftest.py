@@ -1,15 +1,25 @@
 """Test harness: run on CPU with 8 virtual devices so multi-chip
-sharding paths are exercised without TPU hardware. Must run before jax
-is imported anywhere."""
+sharding paths are exercised without TPU hardware.
+
+A pytest plugin imports jax before this file runs, so env vars alone
+are too late — but the backend is initialized lazily, so configuring
+via jax.config here (before any device use) still takes effect.
+TPU coverage comes from examples/ and bench.py.
+"""
 
 import os
 
-# Force CPU even when the environment preselects a TPU platform
-# (JAX_PLATFORMS=axon) — tests need the virtual 8-device mesh and fast
-# iteration; TPU coverage comes from examples/ and bench.py.
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+assert jax.default_backend() == "cpu", jax.default_backend()
+assert len(jax.devices()) == 8, jax.devices()

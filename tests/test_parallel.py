@@ -1,0 +1,92 @@
+"""Multi-chip sharding: results must be bit-identical to the
+single-shard run for any shard count (the reference's thread-count
+independence, ref: event.c:110-153 + determinism tests, here across
+the virtual 8-device CPU mesh from conftest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shadow_tpu.apps import pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel import run_sharded
+
+# the reference's standard single-vertex fixture: one self-looped
+# vertex, latency 50 ms (SURVEY.md §4)
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 8
+PORT = 7000
+
+
+def _build(seed=1):
+    cfg = NetConfig(num_hosts=H, end_time=5 * simtime.ONE_SECOND, seed=seed)
+    hosts = []
+    for i in range(H // 2):
+        hosts.append(HostSpec(name=f"client{i}",
+                              proc_start_time=simtime.ONE_SECOND))
+    for i in range(H // 2):
+        hosts.append(HostSpec(name=f"server{i}"))
+    b = build(cfg, ONE_VERTEX, hosts)
+    client = jnp.asarray(np.arange(H) < H // 2)
+    server = jnp.asarray(np.arange(H) >= H // 2)
+    # client i pings server i
+    server_ip = np.zeros(H, np.int64)
+    for i in range(H // 2):
+        server_ip[i] = b.ip_of(f"server{i}")
+    sim = pingpong.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=jnp.asarray(server_ip), server_port=PORT,
+        count=5, size=128,
+    )
+    b.sim = sim
+    return b
+
+
+@pytest.fixture(scope="module")
+def single():
+    sim, stats = run(_build(), app_handlers=(pingpong.handler,))
+    return jax.device_get((sim, stats))
+
+
+@pytest.mark.parametrize("nshards", [2, 8])
+def test_sharded_matches_single(single, nshards):
+    sim1, stats1 = single
+    devices = np.array(jax.devices()[:nshards])
+    mesh = Mesh(devices, ("hosts",))
+    b = _build()
+    sim2, stats2 = run_sharded(b, mesh, "hosts",
+                               app_handlers=(pingpong.handler,))
+    sim2, stats2 = jax.device_get((sim2, stats2))
+
+    assert int(stats1.events_processed) == int(stats2.events_processed)
+    assert int(stats1.windows) == int(stats2.windows)
+    assert int(sim2.events.overflow) == 0
+    assert int(sim2.outbox.overflow) == 0
+
+    # every ping completed
+    assert np.asarray(sim2.app.rcvd[: H // 2]).tolist() == [5] * (H // 2)
+    # full app + netstack state is bit-identical across shard counts
+    np.testing.assert_array_equal(np.asarray(sim1.app.rtt_sum),
+                                  np.asarray(sim2.app.rtt_sum))
+    np.testing.assert_array_equal(np.asarray(sim1.net.ctr_rx_bytes),
+                                  np.asarray(sim2.net.ctr_rx_bytes))
+    np.testing.assert_array_equal(np.asarray(sim1.net.ctr_tx_packets),
+                                  np.asarray(sim2.net.ctr_tx_packets))
+    np.testing.assert_array_equal(np.asarray(sim1.net.rng_ctr),
+                                  np.asarray(sim2.net.rng_ctr))
+    # event queue contents identical (same times in each row set)
+    np.testing.assert_array_equal(np.sort(np.asarray(sim1.events.time)),
+                                  np.sort(np.asarray(sim2.events.time)))
