@@ -1,0 +1,69 @@
+"""Benchmark: events/sec/chip on the flagship workload.
+
+Runs a many-host UDP ping/echo simulation (the tgen-ping shape of
+BASELINE.json config #1 scaled up) entirely on device and reports
+committed simulation events per wall-second. Prints ONE JSON line:
+{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+vs_baseline compares against BASELINE.json's published
+events_per_sec figure when present (the measured reference number);
+until that is filled it is reported as 0.0.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+# On a shared TPU, grab the chip; fall back to CPU quietly.
+os.environ.setdefault("JAX_PLATFORMS", "tpu,cpu")
+
+import jax
+import numpy as np
+
+
+def main() -> None:
+    from __graft_entry__ import _build
+    from shadow_tpu.apps import pingpong
+    from shadow_tpu.net.build import run
+
+    H = int(os.environ.get("BENCH_HOSTS", "1024"))
+    count = int(os.environ.get("BENCH_PINGS", "20"))
+    b = _build(num_hosts=H, end_time_s=8, count=count)
+
+    t0 = time.perf_counter()
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    stats = jax.device_get(stats)
+    compile_and_run = time.perf_counter() - t0
+
+    # timed pass (compile cached)
+    b2 = _build(num_hosts=H, end_time_s=8, count=count)
+    t0 = time.perf_counter()
+    sim2, stats2 = run(b2, app_handlers=(pingpong.handler,))
+    stats2 = jax.device_get(stats2)
+    wall = time.perf_counter() - t0
+
+    events = int(stats2.events_processed)
+    rcvd = np.asarray(jax.device_get(sim2.app.rcvd))[: H // 2]
+    assert (rcvd == count).all(), f"workload incomplete: {rcvd[:8].tolist()}"
+    value = events / wall
+
+    baseline = 0.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
+            baseline = float(json.load(f)["published"].get("events_per_sec", 0.0))
+    except Exception:
+        pass
+    vs = value / baseline if baseline else 0.0
+
+    print(json.dumps({
+        "metric": f"events_per_sec_per_chip@{H}hosts_udp_pingpong",
+        "value": round(value, 1),
+        "unit": "events/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

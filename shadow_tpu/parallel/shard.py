@@ -43,7 +43,7 @@ from shadow_tpu.core.events import (
     insert_flat,
     segment_ranks,
 )
-from shadow_tpu.net.state import REPLICATED_FIELDS
+from shadow_tpu.net.state import NetState, REPLICATED_FIELDS
 
 I32 = jnp.int32
 
@@ -56,12 +56,15 @@ def sim_specs(sim, axis: str):
     scalars."""
 
     def spec(path, leaf):
-        name = None
-        for k in reversed(path):
-            if hasattr(k, "name"):
-                name = k.name
-                break
-        if name in REPLICATED_FIELDS:
+        names = [k.name for k in path if hasattr(k, "name")]
+        # Replicated lookup tables are identified by NetState field
+        # name, scoped to the NetState subtree ("net" in a Sim, or a
+        # bare NetState) so an app field that happens to share a name
+        # still shards.
+        if names and names[-1] in REPLICATED_FIELDS and (
+            names[-2] == "net" if len(names) > 1
+            else isinstance(sim, NetState)
+        ):
             return P()
         if jnp.ndim(leaf) == 0:
             return P()
@@ -118,44 +121,58 @@ def route_outbox_sharded(
         buf = jnp.full((num_shards, C) + a.shape[2:], fill, a.dtype)
         return buf.at[row, slot].set(flat, mode="drop")
 
-    sb_dst = to_sendbuf(out.dst, -1)
+    # Pack every i32 plane into one buffer so the per-window exchange
+    # is exactly two collectives (one i32, one i64) instead of six —
+    # each all_to_all pays ICI launch latency once per window. Unwritten
+    # slots must read dst == -1 (empty), so the dst plane's fill is -1.
+    packed = jnp.concatenate(
+        [out.dst[..., None], out.kind[..., None], out.src[..., None],
+         out.seq[..., None], out.words], axis=2,
+    )  # [Hl, M, 4+NWORDS]
+    flat = packed.reshape(n, 4 + NWORDS)[order]
+    sb_i32 = jnp.zeros((num_shards, C, 4 + NWORDS), I32).at[..., 0].set(-1)
+    sb_i32 = sb_i32.at[row, slot].set(flat, mode="drop")
     sb_time = to_sendbuf(out.time, simtime.INVALID)
-    sb_kind = to_sendbuf(out.kind, 0)
-    sb_src = to_sendbuf(out.src, 0)
-    sb_seq = to_sendbuf(out.seq, 0)
-    sb_words = to_sendbuf(out.words, 0)
 
     a2a = partial(lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0)
-    rb_dst = a2a(sb_dst)
+    rb_i32 = a2a(sb_i32)
     rb_time = a2a(sb_time)
-    rb_kind = a2a(sb_kind)
-    rb_src = a2a(sb_src)
-    rb_seq = a2a(sb_seq)
-    rb_words = a2a(sb_words)
 
     nn = num_shards * C
-    rdst = rb_dst.reshape(nn)
-    rvalid = rdst >= 0
-    local_row = jnp.where(rvalid, rdst - base, Hl)
+    ri32 = rb_i32.reshape(nn, 4 + NWORDS)
+    rdst = ri32[:, 0]
+    occupied_r = rdst >= 0
+    local_row = rdst - base
+    # An arriving dst outside this shard's [base, base+Hl) block means
+    # the lane assignment violated the contiguous-block contract —
+    # count it loudly (a negative row would otherwise wrap-around
+    # write; an oversized one would be silently dropped).
+    misrouted = occupied_r & ((local_row < 0) | (local_row >= Hl))
+    rvalid = occupied_r & ~misrouted
     q = insert_flat(
-        q, rvalid, local_row,
-        rb_time.reshape(nn), rb_kind.reshape(nn), rb_src.reshape(nn),
-        rb_seq.reshape(nn), rb_words.reshape(nn, NWORDS),
+        q, rvalid, jnp.where(rvalid, local_row, Hl),
+        rb_time.reshape(nn), ri32[:, 1], ri32[:, 2],
+        ri32[:, 3], ri32[:, 4:],
     )
-    q = q.replace(overflow=q.overflow + jnp.sum(bad, dtype=I32) + xofl)
+    q = q.replace(overflow=q.overflow + jnp.sum(bad, dtype=I32) + xofl
+                  + jnp.sum(misrouted, dtype=I32))
     return q, clear_outbox(out)
 
 
-def _replicate_scalars(sim, stats: EngineStats, axis: str):
-    """psum EVERY scalar leaf of the sim so out_specs can declare them
-    replicated — scalar leaves are per-shard partial counters by
-    convention (overflow/drop totals); a new counter added anywhere in
-    the state tree is aggregated automatically instead of silently
-    returning one shard's value. stats.windows is identical on every
-    shard (lockstep outer loop), so pmax is the identity there."""
+def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
+    """psum EVERY scalar leaf's *delta* over the run so out_specs can
+    declare them replicated — scalar leaves are per-shard partial
+    counters by convention (overflow/drop totals); a new counter added
+    anywhere in the state tree is aggregated automatically instead of
+    silently returning one shard's value. The delta (not the value) is
+    summed because the initial value is replicated on every shard —
+    psumming it directly would multiply a nonzero starting count by the
+    shard count. stats.windows is identical on every shard (lockstep
+    outer loop), so pmax is the identity there."""
     sim = jax.tree.map(
-        lambda leaf: lax.psum(leaf, axis) if jnp.ndim(leaf) == 0 else leaf,
-        sim,
+        lambda leaf, init: init + lax.psum(leaf - init, axis)
+        if jnp.ndim(leaf) == 0 else leaf,
+        sim, initial_sim,
     )
     stats = EngineStats(
         events_processed=lax.psum(stats.events_processed, axis),
@@ -175,6 +192,7 @@ def sharded_engine_run(
     min_jump: int,
     emit_capacity: int = 4,
     lane_id_fn=None,
+    exchange_capacity: int | None = None,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
     *global* state (as built for single-shard); sharding/replication
@@ -203,11 +221,12 @@ def sharded_engine_run(
             lane_id=lane,
             route_fn=lambda s: s.replace(**dict(zip(
                 ("events", "outbox"),
-                route_outbox_sharded(s.events, s.outbox, axis, num_shards, lane),
+                route_outbox_sharded(s.events, s.outbox, axis, num_shards,
+                                     lane, exchange_capacity),
             ))),
             min_fn=lambda x: lax.pmin(x, axis),
         )
-        return _replicate_scalars(out_sim, stats, axis)
+        return _replicate_scalars(out_sim, local_sim, stats, axis)
 
     # check_vma=False: the engine's while_loop carries mix varying and
     # replicated leaves, which static VMA checking rejects without
@@ -225,7 +244,8 @@ def sharded_engine_run(
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
-                end_time: int | None = None):
+                end_time: int | None = None,
+                exchange_capacity: int | None = None):
     """Multi-chip variant of shadow_tpu.net.build.run."""
     from shadow_tpu.net.step import make_step_fn
 
@@ -235,4 +255,5 @@ def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
+        exchange_capacity=exchange_capacity,
     )

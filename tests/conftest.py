@@ -1,20 +1,12 @@
 """Test harness: run on CPU with 8 virtual devices so multi-chip
 sharding paths are exercised without TPU hardware.
 
-A pytest plugin imports jax before this file runs, so env vars alone
-are too late — but the backend is initialized lazily, so configuring
-via jax.config here (before any device use) still takes effect.
-TPU coverage comes from examples/ and bench.py.
+A pytest plugin (and the axon platform plugin) may import jax before
+this file runs, so env vars are unreliable — but the backend is
+initialized lazily, so configuring via jax.config here (before any
+device use) takes effect. TPU coverage comes from examples/ and
+bench.py.
 """
-
-import os
-
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
 import jax
 

@@ -90,3 +90,32 @@ def test_sharded_matches_single(single, nshards):
     # event queue contents identical (same times in each row set)
     np.testing.assert_array_equal(np.sort(np.asarray(sim1.events.time)),
                                   np.sort(np.asarray(sim2.events.time)))
+
+
+def test_exchange_capacity_counts_overflow(single):
+    """A too-small per-peer exchange buffer must count dropped entries
+    in events.overflow, never lose them silently."""
+    devices = np.array(jax.devices()[:2])
+    mesh = Mesh(devices, ("hosts",))
+    b = _build()
+    sim, stats = run_sharded(b, mesh, "hosts",
+                             app_handlers=(pingpong.handler,),
+                             exchange_capacity=1)
+    sim = jax.device_get(sim)
+    # 4 clients per shard ping 4 servers on the other shard in the same
+    # window; cap 1 forces drops, which must show up in overflow.
+    assert int(sim.events.overflow) > 0
+
+
+def test_sharded_preserves_initial_scalar_counters():
+    """Scalar counters entering the sharded run nonzero must come back
+    as initial + delta, not initial * num_shards (replicated input)."""
+    devices = np.array(jax.devices()[:4])
+    mesh = Mesh(devices, ("hosts",))
+    b = _build()
+    b.sim = b.sim.replace(
+        events=b.sim.events.replace(
+            overflow=jnp.asarray(3, jnp.int32)))
+    sim, stats = run_sharded(b, mesh, "hosts",
+                             app_handlers=(pingpong.handler,))
+    assert int(jax.device_get(sim.events.overflow)) == 3
