@@ -43,7 +43,6 @@ from shadow_tpu.net.state import (
     NetConfig,
     NetState,
     QDisc,
-    SocketType,
 )
 from shadow_tpu.net.udp import udp_deliver
 
@@ -282,7 +281,7 @@ def _qdisc_select(cfg: NetConfig, net: NetState):
     H, S = net.out_count.shape
     lane = jnp.arange(H)
     nonempty = net.out_count > 0
-    BO = net.out_dst_ip.shape[2]
+    BO = net.out_words.shape[2]
     head_pos = net.out_head % BO
     head_pri = jnp.take_along_axis(
         net.out_priority, head_pos[..., None], axis=2
@@ -316,14 +315,14 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     active = mask & can & (sel >= 0)
 
     # pop the head packet of the selected socket's output ring
-    BO = net.out_dst_ip.shape[2]
+    BO = net.out_words.shape[2]
     S = net.out_count.shape[1]
     selc = jnp.clip(sel, 0, S - 1)
     hpos = net.out_head[lane, selc] % BO
-    dst_ip = net.out_dst_ip[lane, selc, hpos]
-    dst_port = net.out_dst_port[lane, selc, hpos]
-    length = net.out_len[lane, selc, hpos]
-    payref = net.out_payref[lane, selc, hpos]
+    words = net.out_words[lane, selc, hpos]              # [H, NWORDS]
+    length = words[:, pf.W_LEN]
+    proto = pf.proto_of(words)
+    dst_ip = ip_from_word(words[:, pf.W_DSTIP])
 
     net = net.replace(
         out_head=set_hs(net.out_head, active, sel,
@@ -336,15 +335,14 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     if cfg.qdisc == QDisc.RR:
         net = net.replace(rr_ptr=jnp.where(active, (sel + 1) % S, net.rr_ptr))
 
-    proto = gather_hs(net.sk_type, sel)
-    proto = jnp.where(proto == SocketType.TCP, pf.PROTO_TCP, pf.PROTO_UDP)
-    src_port = gather_hs(net.sk_bound_port, sel)
-    words = _empty_words(H)
-    words = words.at[:, pf.W_PROTO].set(proto.astype(I32))
-    words = words.at[:, pf.W_LEN].set(length)
-    words = words.at[:, pf.W_PORTS].set(pf.pack_ports(src_port, dst_port))
-    words = words.at[:, pf.W_PAYREF].set(payref)
-    words = words.at[:, pf.W_DSTIP].set(dst_ip.astype(jnp.uint32).astype(I32))
+    # volatile TCP header fields are stamped at wire time
+    # (ref: tcp_networkInterfaceIsAboutToSendPacket, tcp.c:1090-1120)
+    if getattr(sim, "tcp", None) is not None:
+        from shadow_tpu.net import tcp as tcp_mod
+
+        words = tcp_mod.stamp_at_wire(
+            net, sim.tcp, active & (proto == pf.PROTO_TCP), sel, words, now
+        )
 
     wl = pf.wire_length(proto, length).astype(I64)
     GH = net.host_ip.shape[0]

@@ -12,11 +12,44 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.rings import gather_hs, ring_advance_push, ring_push_at, set_hs
 from shadow_tpu.net.state import NetState, SocketFlags, SocketType
 
 I32 = jnp.int32
 MIN_RANDOM_PORT = 10000  # ref: definitions.h:94
+
+
+def sk_enqueue_out(net: NetState, mask, slot, words):
+    """Push one fully-formed packet ([H, NWORDS]) onto (lane, slot)'s
+    output ring, charging W_LEN payload bytes against the send buffer
+    (ref: socket_addToOutputBuffer, socket.h:47-78) and stamping the
+    per-host app-ordering priority (ref: host.c packet priority
+    counter). Returns (net, ok[H]) — ok False when the ring or send
+    buffer lacks space (the EWOULDBLOCK condition)."""
+    H = mask.shape[0]
+    lane = jnp.arange(H)
+    BO = net.out_words.shape[2]
+    length = words[:, pf.W_LEN]
+
+    space_ok = (gather_hs(net.out_bytes, slot) + length) <= gather_hs(
+        net.sk_sndbuf, slot
+    )
+    ok, pos = ring_push_at(net.out_head, net.out_count, BO, mask & space_ok, slot)
+    s = jnp.where(ok, slot, net.out_words.shape[1])
+    net = net.replace(
+        out_words=net.out_words.at[lane, s, pos, :].set(words, mode="drop"),
+        out_priority=net.out_priority.at[lane, s, pos].set(
+            net.priority_ctr, mode="drop"),
+        priority_ctr=net.priority_ctr + ok.astype(net.priority_ctr.dtype),
+    )
+    _, count = ring_advance_push(net.out_head, net.out_count, mask, slot, ok)
+    ob = gather_hs(net.out_bytes, slot)
+    net = net.replace(
+        out_count=count,
+        out_bytes=set_hs(net.out_bytes, ok, slot, ob + length),
+    )
+    return net, ok
 
 
 def sk_create(net: NetState, mask, stype):

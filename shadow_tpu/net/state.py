@@ -152,11 +152,11 @@ class NetState:
     in_head: jax.Array           # [H,S] i32
     in_count: jax.Array          # [H,S] i32
     in_bytes: jax.Array          # [H,S] i32
-    # output ring: packetized app data waiting for the NIC
-    out_dst_ip: jax.Array        # [H,S,BO] i64
-    out_dst_port: jax.Array      # [H,S,BO] i32
-    out_len: jax.Array           # [H,S,BO] i32
-    out_payref: jax.Array        # [H,S,BO] i32
+    # output ring: fully-formed packets waiting for the NIC. Protocols
+    # write complete packet words at enqueue time; volatile TCP header
+    # fields (ack/window/ts) are re-stamped at wire time by the NIC
+    # (ref: tcp_networkInterfaceIsAboutToSendPacket, tcp.c:1090-1120).
+    out_words: jax.Array         # [H,S,BO,NWORDS] i32
     out_priority: jax.Array      # [H,S,BO] i64
     out_head: jax.Array          # [H,S] i32
     out_count: jax.Array         # [H,S] i32
@@ -186,6 +186,7 @@ class Sim:
     outbox: Outbox
     net: NetState
     app: Any = None
+    tcp: Any = None  # TcpState when any TCP socket exists (net/tcp.py)
 
 
 def make_net_state(
@@ -257,10 +258,7 @@ def make_net_state(
         in_head=jnp.zeros((H, S), I32),
         in_count=jnp.zeros((H, S), I32),
         in_bytes=jnp.zeros((H, S), I32),
-        out_dst_ip=jnp.zeros((H, S, BO), I64),
-        out_dst_port=jnp.zeros((H, S, BO), I32),
-        out_len=jnp.zeros((H, S, BO), I32),
-        out_payref=jnp.zeros((H, S, BO), I32),
+        out_words=jnp.zeros((H, S, BO, NWORDS), I32),
         out_priority=jnp.zeros((H, S, BO), I64),
         out_head=jnp.zeros((H, S), I32),
         out_count=jnp.zeros((H, S), I32),

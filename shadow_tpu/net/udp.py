@@ -10,6 +10,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from shadow_tpu.core.events import NWORDS
 from shadow_tpu.net import packetfmt as pf
 from shadow_tpu.net.rings import (
     gather_hs,
@@ -19,6 +20,7 @@ from shadow_tpu.net.rings import (
     ring_peek_at,
     set_hs,
 )
+from shadow_tpu.net.sockets import sk_enqueue_out
 from shadow_tpu.net.state import NetState, SocketFlags
 
 I32 = jnp.int32
@@ -31,32 +33,19 @@ def udp_enqueue_send(net: NetState, mask, slot, dst_ip, dst_port, length, payref
     app-visible EWOULDBLOCK condition (ref: socket buffer accounting,
     socket.h:47-78)."""
     H = mask.shape[0]
-    lane = jnp.arange(H)
     length = jnp.asarray(length, I32)
-    BO = net.out_dst_ip.shape[2]
-
-    space_ok = (gather_hs(net.out_bytes, slot) + length) <= gather_hs(
-        net.sk_sndbuf, slot
-    )
-    ok, pos = ring_push_at(net.out_head, net.out_count, BO, mask & space_ok, slot)
-    s = jnp.where(ok, slot, net.out_dst_ip.shape[1])
-    pri = net.priority_ctr  # per-host app-ordering priority (host.c)
-    net = net.replace(
-        out_dst_ip=net.out_dst_ip.at[lane, s, pos].set(
-            jnp.asarray(dst_ip, net.out_dst_ip.dtype), mode="drop"),
-        out_dst_port=net.out_dst_port.at[lane, s, pos].set(
-            jnp.asarray(dst_port, I32), mode="drop"),
-        out_len=net.out_len.at[lane, s, pos].set(length, mode="drop"),
-        out_payref=net.out_payref.at[lane, s, pos].set(
-            jnp.asarray(payref, I32), mode="drop"),
-        out_priority=net.out_priority.at[lane, s, pos].set(pri, mode="drop"),
-        priority_ctr=net.priority_ctr + ok.astype(net.priority_ctr.dtype),
-    )
-    _, count = ring_advance_push(net.out_head, net.out_count, mask, slot, ok)
-    net = net.replace(out_count=count)
-    ob = gather_hs(net.out_bytes, slot)
-    net = net.replace(out_bytes=set_hs(net.out_bytes, ok, slot, ob + length))
-    return net, ok
+    src_port = gather_hs(net.sk_bound_port, slot)
+    words = jnp.zeros((H, NWORDS), I32)
+    words = words.at[:, pf.W_PROTO].set(pf.PROTO_UDP)
+    words = words.at[:, pf.W_LEN].set(jnp.broadcast_to(length, (H,)))
+    words = words.at[:, pf.W_PORTS].set(
+        pf.pack_ports(src_port, jnp.asarray(dst_port, I32)))
+    words = words.at[:, pf.W_PAYREF].set(
+        jnp.broadcast_to(jnp.asarray(payref, I32), (H,)))
+    words = words.at[:, pf.W_DSTIP].set(
+        jnp.broadcast_to(
+            jnp.asarray(dst_ip).astype(jnp.uint32).astype(I32), (H,)))
+    return sk_enqueue_out(net, mask, slot, words)
 
 
 def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
