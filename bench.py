@@ -1,13 +1,14 @@
 """Benchmark: events/sec/chip on the flagship workload.
 
-Runs a many-host UDP ping/echo simulation (the tgen-ping shape of
-BASELINE.json config #1 scaled up) entirely on device and reports
-committed simulation events per wall-second. Prints ONE JSON line:
-{"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+Default workload is PHOLD (the PDES-scheduler stress benchmark the
+reference also uses, src/test/phold/): every host keeps `load`
+messages circulating, so all lanes stay busy and the committed-events
+rate measures raw engine throughput. BENCH_WORKLOAD=pingpong|bulk
+selects the other BASELINE.json shapes.
 
-vs_baseline compares against BASELINE.json's published
-events_per_sec figure when present (the measured reference number);
-until that is filled it is reported as 0.0.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline compares against BASELINE.json's published events_per_sec
+when present; 0.0 until measured.
 """
 
 from __future__ import annotations
@@ -22,43 +23,91 @@ os.environ.setdefault("JAX_PLATFORMS", "tpu,cpu")
 import jax
 import numpy as np
 
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="poi" target="poi"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
 
-def main() -> None:
+
+def _build_phold(H: int, load: int, sim_s: int, seed: int = 1):
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+
+    cap = max(64, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(16, 2 * load))
+    hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _run_phold(H, load, sim_s, seed=1):
+    from shadow_tpu.apps import phold
+    from shadow_tpu.net.build import run
+
+    b = _build_phold(H, load, sim_s, seed)
+    sim, stats = run(b, app_handlers=(phold.handler,))
+    stats = jax.device_get(stats)
+    assert int(jax.device_get(sim.events.overflow)) == 0
+    assert int(jax.device_get(sim.app.rcvd.sum())) > 0
+    return int(stats.events_processed)
+
+
+def _run_pingpong(H, sim_s):
     from __graft_entry__ import _build
     from shadow_tpu.apps import pingpong
     from shadow_tpu.net.build import run
 
-    H = int(os.environ.get("BENCH_HOSTS", "1024"))
-    count = int(os.environ.get("BENCH_PINGS", "20"))
-    b = _build(num_hosts=H, end_time_s=8, count=count)
-
-    t0 = time.perf_counter()
+    b = _build(num_hosts=H, end_time_s=sim_s, count=20, tcp=False)
     sim, stats = run(b, app_handlers=(pingpong.handler,))
     stats = jax.device_get(stats)
-    compile_and_run = time.perf_counter() - t0
+    rcvd = np.asarray(jax.device_get(sim.app.rcvd))[: H // 2]
+    assert (rcvd == 20).all(), f"workload incomplete: {rcvd[:8].tolist()}"
+    return int(stats.events_processed)
 
-    # timed pass (compile cached)
-    b2 = _build(num_hosts=H, end_time_s=8, count=count)
+
+def main() -> None:
+    workload = os.environ.get("BENCH_WORKLOAD", "phold")
+    H = int(os.environ.get("BENCH_HOSTS", "1024"))
+    sim_s = int(os.environ.get("BENCH_SIM_SECONDS", "5"))
+    load = int(os.environ.get("BENCH_LOAD", "8"))
+
+    if workload == "phold":
+        runner = lambda: _run_phold(H, load, sim_s)
+        name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
+    else:
+        runner = lambda: _run_pingpong(H, sim_s)
+        name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
+
+    runner()                      # compile + warm
     t0 = time.perf_counter()
-    sim2, stats2 = run(b2, app_handlers=(pingpong.handler,))
-    stats2 = jax.device_get(stats2)
+    events = runner()             # timed (compile cached)
     wall = time.perf_counter() - t0
-
-    events = int(stats2.events_processed)
-    rcvd = np.asarray(jax.device_get(sim2.app.rcvd))[: H // 2]
-    assert (rcvd == count).all(), f"workload incomplete: {rcvd[:8].tolist()}"
     value = events / wall
 
     baseline = 0.0
     try:
-        with open(os.path.join(os.path.dirname(__file__), "BASELINE.json")) as f:
-            baseline = float(json.load(f)["published"].get("events_per_sec", 0.0))
+        with open(os.path.join(os.path.dirname(__file__),
+                               "BASELINE.json")) as f:
+            baseline = float(
+                json.load(f)["published"].get("events_per_sec", 0.0))
     except Exception:
         pass
     vs = value / baseline if baseline else 0.0
 
     print(json.dumps({
-        "metric": f"events_per_sec_per_chip@{H}hosts_udp_pingpong",
+        "metric": name,
         "value": round(value, 1),
         "unit": "events/s",
         "vs_baseline": round(vs, 3),

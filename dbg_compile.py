@@ -1,0 +1,44 @@
+"""Times XLA lowering+compilation of the full device program (no run)."""
+import time
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import bulk
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.net.step import make_step_fn
+from shadow_tpu.core.engine import run as engine_run
+
+GRAPH = open("tests/test_tcp.py").read().split('GRAPH = """')[1].split('"""')[0]
+GRAPH = GRAPH.replace("{LOSS}", "0.0")
+
+cfg = NetConfig(num_hosts=2, end_time=30 * simtime.ONE_SECOND, seed=1)
+hosts = [
+    HostSpec(name="client", type="client", proc_start_time=simtime.ONE_SECOND),
+    HostSpec(name="server", type="server"),
+]
+b = build(cfg, GRAPH, hosts)
+client = jnp.asarray(np.arange(2) == b.host_of("client"))
+server = jnp.asarray(np.arange(2) == b.host_of("server"))
+b.sim = bulk.setup(b.sim, client_mask=client, server_mask=server,
+                   server_ip=b.ip_of("server"), server_port=8080,
+                   total_bytes=100_000)
+
+step = make_step_fn(b.cfg, (bulk.handler,))
+f = jax.jit(lambda sim: engine_run(
+    sim, step, end_time=b.cfg.end_time, min_jump=b.min_jump,
+    emit_capacity=b.cfg.emit_capacity, lane_id=sim.net.lane_id))
+
+t0 = time.time()
+lowered = f.lower(b.sim)
+t1 = time.time()
+print(f"lower: {t1-t0:.1f}s")
+compiled = lowered.compile()
+t2 = time.time()
+print(f"compile: {t2-t1:.1f}s")

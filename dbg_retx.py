@@ -1,0 +1,58 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from shadow_tpu.apps import bulk
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.net import tcp as tcpmod
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <graph edgedefault="undirected">
+    <node id="west"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">client</data></node>
+    <node id="east"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">server</data></node>
+    <edge source="west" target="west"><data key="lat">5.0</data></edge>
+    <edge source="west" target="east"><data key="lat">25.0</data></edge>
+    <edge source="east" target="east"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+total = 100_000
+cfg = NetConfig(num_hosts=2, end_time=30 * simtime.ONE_SECOND, seed=1)
+hosts = [HostSpec(name="client", type="client", proc_start_time=simtime.ONE_SECOND),
+         HostSpec(name="server", type="server")]
+b = build(cfg, GRAPH, hosts)
+client = jnp.asarray(np.arange(2) == b.host_of("client"))
+server = jnp.asarray(np.arange(2) == b.host_of("server"))
+b.sim = bulk.setup(b.sim, client_mask=client, server_mask=server,
+                   server_ip=b.ip_of("server"), server_port=8080,
+                   total_bytes=total)
+
+# instrument: wrap _retransmit_one to print when a retransmit happens
+orig = tcpmod._retransmit_one
+def traced(cfg2, sim, mask, slot, now, buf):
+    if bool(jnp.any(mask)):
+        lanes = np.nonzero(np.asarray(mask))[0]
+        for h in lanes:
+            print(f"RETX at t={int(now[h])/1e6:.3f}ms lane={h} slot={int(slot[h])} "
+                  f"una={int(sim.tcp.snd_una[h, int(slot[h])])} "
+                  f"nxt={int(sim.tcp.snd_nxt[h, int(slot[h])])} "
+                  f"max={int(sim.tcp.snd_max[h, int(slot[h])])} "
+                  f"end={int(sim.tcp.snd_end[h, int(slot[h])])} "
+                  f"st={int(sim.tcp.st[h, int(slot[h])])} "
+                  f"dup={int(sim.tcp.dup_acks[h, int(slot[h])])} "
+                  f"rto={int(sim.tcp.rto_ms[h, int(slot[h])])}")
+    return orig(cfg2, sim, mask, slot, now, buf)
+tcpmod._retransmit_one = traced
+
+with jax.disable_jit():
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+print("retx:", np.asarray(sim.tcp.retx_segs), "rcvd:", np.asarray(sim.app.rcvd))
+print("st:", np.asarray(sim.tcp.st))

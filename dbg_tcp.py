@@ -1,0 +1,49 @@
+import warnings
+warnings.simplefilter("error", FutureWarning)
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import bulk
+from shadow_tpu.core import simtime
+from shadow_tpu.net import tcp
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = open("tests/test_tcp.py").read().split('GRAPH = """')[1].split('"""')[0]
+GRAPH = GRAPH.replace("{LOSS}", "0.0")
+
+cfg = NetConfig(num_hosts=2, end_time=3 * simtime.ONE_SECOND, seed=1)
+hosts = [
+    HostSpec(name="client", type="client", proc_start_time=simtime.ONE_SECOND),
+    HostSpec(name="server", type="server"),
+]
+b = build(cfg, GRAPH, hosts)
+client = jnp.asarray(np.arange(2) == b.host_of("client"))
+server = jnp.asarray(np.arange(2) == b.host_of("server"))
+b.sim = bulk.setup(b.sim, client_mask=client, server_mask=server,
+                   server_ip=b.ip_of("server"), server_port=8080,
+                   total_bytes=5000)
+
+with jax.disable_jit():
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+
+print("events:", int(stats.events_processed), "windows:", int(stats.windows))
+print("tcp st:\n", np.asarray(sim.tcp.st))
+print("snd_una:", np.asarray(sim.tcp.snd_una))
+print("snd_nxt:", np.asarray(sim.tcp.snd_nxt))
+print("snd_end:", np.asarray(sim.tcp.snd_end))
+print("rcv_nxt:", np.asarray(sim.tcp.rcv_nxt))
+print("app_rbytes:", np.asarray(sim.tcp.app_rbytes))
+print("rcvd:", np.asarray(sim.app.rcvd), "eof:", np.asarray(sim.app.eof))
+print("to_send:", np.asarray(sim.app.to_send), "child:", np.asarray(sim.app.child))
+print("tx_packets:", np.asarray(sim.net.ctr_tx_packets))
+print("rx_packets:", np.asarray(sim.net.ctr_rx_packets))
+print("nosock:", np.asarray(sim.net.ctr_drop_nosocket))
+print("overflow ev/out:", int(sim.events.overflow), int(sim.outbox.overflow))
+print("retx:", np.asarray(sim.tcp.retx_segs))
+print("sk_type:\n", np.asarray(sim.net.sk_type))
+print("sk_flags:\n", np.asarray(sim.net.sk_flags))

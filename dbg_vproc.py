@@ -1,0 +1,52 @@
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+import numpy as np
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import ProcessRuntime
+
+GRAPH = open("tests/test_vproc.py").read().split('GRAPH = """')[1].split('"""')[0]
+cfg = NetConfig(num_hosts=2, end_time=20 * simtime.ONE_SECOND)
+hosts = [HostSpec(name="client", ip="11.0.0.1"),
+         HostSpec(name="server", ip="11.0.0.2")]
+b = build(cfg, GRAPH, hosts)
+server_ip = b.ip_of("server")
+log = []
+PORT = 7000
+
+def server(host):
+    fd = yield vproc.socket(SocketType.UDP)
+    yield vproc.bind(fd, PORT)
+    for _ in range(3):
+        src_ip, src_port, n = yield vproc.recvfrom(fd)
+        t = yield vproc.gettime()
+        print(f"  server got {n}B at {t/1e6:.3f}ms")
+        yield vproc.sendto(fd, src_ip, src_port, n)
+    yield vproc.close(fd)
+
+def client(host):
+    fd = yield vproc.socket(SocketType.UDP)
+    yield vproc.bind(fd, 0)
+    for i in range(3):
+        t0 = yield vproc.gettime()
+        yield vproc.sendto(fd, server_ip, PORT, 100)
+        src, sport, n = yield vproc.recvfrom(fd)
+        t1 = yield vproc.gettime()
+        print(f"  client rtt {i}: {(t1-t0)/1e6:.3f}ms  t0={t0/1e6:.3f} t1={t1/1e6:.3f}")
+        log.append((n, t1 - t0))
+    yield vproc.close(fd)
+
+rt = ProcessRuntime(b)
+rt.spawn(b.host_of("server"), server)
+rt.spawn(b.host_of("client"), client, start_time=simtime.ONE_SECOND)
+
+orig = rt._jit_window
+def traced(sim, wstart, wend):
+    print(f"window [{int(wstart)/1e6:.3f}, {int(wend)/1e6:.3f}) ms")
+    return orig(sim, wstart, wend)
+rt._jit_window = traced
+sim, stats = rt.run()
+print("log:", [(n, r/1e6) for n, r in log])

@@ -1,0 +1,131 @@
+"""On-device TCP bulk-transfer application — the tgen bulk-download
+analog (BASELINE.json config #2; the reference's filetransfer /
+tgen-over-interposition workloads, ref: examples.c:10-30 "1000 clients
+downloading"), and the workload shape of the dual-mode tcp tests
+(src/test/tcp/test_tcp.c: client streams N bytes to a server which
+counts them).
+
+Client: at PROC_START, connects to its assigned server and streams
+`total_bytes`; when everything has been submitted it closes (the FIN
+rides out behind the data). Server: accepts children off the listener
+and drains them until EOF, counting received bytes.
+
+Each host can be client, server, or both (distinct sockets). Servers
+here handle ACCEPTS_MAX concurrent children per event via one
+accept/recv lane per micro-step — the event-driven pattern means later
+children are picked up on subsequent events.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net import tcp
+from shadow_tpu.net.rings import gather_hs
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+CHUNK = 1 << 20  # max bytes submitted to the socket per app wakeup
+
+
+@struct.dataclass
+class BulkApp:
+    is_client: jax.Array    # [H] bool
+    is_server: jax.Array    # [H] bool
+    lsock: jax.Array        # [H] i32 server listener slot (-1)
+    csock: jax.Array        # [H] i32 client connection slot (-1)
+    child: jax.Array        # [H] i32 server-side accepted child (-1)
+    server_ip: jax.Array    # [H] i64
+    server_port: jax.Array  # [H] i32
+    to_send: jax.Array      # [H] i32 bytes not yet submitted
+    connected: jax.Array    # [H] bool client connect() issued
+    closed: jax.Array       # [H] bool client close() issued
+    rcvd: jax.Array         # [H] i64 server bytes received
+    eof: jax.Array          # [H] bool server saw EOF
+    done_at: jax.Array      # [H] i64 sim time of server EOF (-1)
+
+
+def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
+          total_bytes: int):
+    """Create sockets (listener bound+listening; client socket made but
+    not connected) — build-time, host side."""
+    H = sim.net.host_ip.shape[0]
+    net, lsock = sk_create(sim.net, server_mask, SocketType.TCP)
+    net, _ = sk_bind(net, server_mask, lsock, 0, server_port)
+    sim = sim.replace(net=net)
+    sim = tcp.tcp_listen(sim, server_mask, lsock)
+    net, csock = sk_create(sim.net, client_mask, SocketType.TCP)
+    sim = sim.replace(net=net)
+    app = BulkApp(
+        is_client=client_mask,
+        is_server=server_mask,
+        lsock=jnp.where(server_mask, lsock, -1),
+        csock=jnp.where(client_mask, csock, -1),
+        child=jnp.full((H,), -1, I32),
+        server_ip=jnp.broadcast_to(jnp.asarray(server_ip, I64), (H,)),
+        server_port=jnp.full((H,), server_port, I32),
+        to_send=jnp.where(client_mask, total_bytes, 0).astype(I32),
+        connected=jnp.zeros((H,), bool),
+        closed=jnp.zeros((H,), bool),
+        rcvd=jnp.zeros((H,), I64),
+        eof=jnp.zeros((H,), bool),
+        done_at=jnp.full((H,), -1, I64),
+    )
+    return sim.replace(app=app)
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+    woke = popped.valid  # react to any event on this host
+
+    # ---- client: connect once at PROC_START --------------------------
+    start = woke & (popped.kind == EventKind.PROC_START) \
+        & app.is_client & ~app.connected
+    sim, buf = tcp.tcp_connect(cfg, sim, start, app.csock,
+                               app.server_ip, app.server_port, now, buf)
+    app = app.replace(connected=app.connected | start)
+    sim = sim.replace(app=app)
+
+    # ---- client: keep the send buffer full ---------------------------
+    feeding = woke & app.is_client & app.connected & (app.to_send > 0)
+    sim, buf, accepted = tcp.tcp_send(cfg, sim, feeding, app.csock,
+                                      jnp.minimum(app.to_send, CHUNK), now, buf)
+    app = app.replace(to_send=app.to_send - accepted)
+    sim = sim.replace(app=app)
+
+    # ---- client: close once everything is submitted ------------------
+    finish = woke & app.is_client & app.connected & (app.to_send == 0) \
+        & ~app.closed
+    sim, buf = tcp.tcp_close(cfg, sim, finish, app.csock, now, buf)
+    app = app.replace(closed=app.closed | finish)
+    sim = sim.replace(app=app)
+
+    # ---- server: accept one pending child per wakeup -----------------
+    lready = (gather_hs(sim.net.sk_flags, app.lsock)
+              & SocketFlags.READABLE) != 0
+    acc = woke & app.is_server & (app.child < 0) & lready
+    sim, got, child = tcp.tcp_accept(sim, acc, app.lsock)
+    app = app.replace(child=jnp.where(got, child, app.child))
+    sim = sim.replace(app=app)
+
+    # ---- server: drain the child -------------------------------------
+    drain = woke & app.is_server & (app.child >= 0) & ~app.eof
+    sim, buf, nread, eof = tcp.tcp_recv(sim, drain, app.child,
+                                        jnp.full(drain.shape, CHUNK, I32),
+                                        now, buf)
+    app = app.replace(
+        rcvd=app.rcvd + nread.astype(I64),
+        eof=app.eof | eof,
+        done_at=jnp.where(eof & (app.done_at < 0), now, app.done_at),
+    )
+    sim = sim.replace(app=app)
+    # close our side in response to EOF (server-side passive close)
+    sim, buf = tcp.tcp_close(cfg, sim, eof, app.child, now, buf)
+    return sim, buf

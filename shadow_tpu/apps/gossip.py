@@ -1,0 +1,230 @@
+"""Bitcoin-gossip-shaped application model (BASELINE.json config #4:
+"5k-node Bitcoin"). The reference runs real bitcoind under
+interposition; the TPU-native model reproduces the traffic shape that
+makes that simulation interesting — block flooding over a static
+random peer graph with dedup — as an on-device state machine
+(SURVEY.md §7.1).
+
+Protocol: host m "mines" block b (deterministic schedule: block b is
+mined by host (b * MINER_STRIDE) % H at time b * block_interval) and
+pushes it to its K peers as one UDP datagram whose app-tag word
+carries the block id (synthetic payloads reuse the payref field as an
+opaque app tag — packetfmt.PAYREF_NONE convention). A host seeing a
+block id above its known tip relays it to all K peers exactly once
+(inv/getdata collapse into direct push; dedup via the tip counter —
+blocks arrive in mining order on every path because ids are assigned
+in time order, so "tip" subsumes a seen-set).
+
+Metrics: blocks_known per host, duplicate receptions (gossip
+overhead), relays sent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EventKind, emit, emit_words
+from shadow_tpu.net import nic, udp
+from shadow_tpu.net.rings import gather_hs
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+KIND_MINE = EventKind.USER + 1
+KIND_RELAY = EventKind.USER + 2  # self-chained per-peer block push
+BLOCK_BYTES = 20_000             # fits one datagram (< 65507)
+PORT = 8333
+
+
+@struct.dataclass
+class GossipApp:
+    peers: jax.Array        # [H, K] i32 static peer graph (undirected)
+    sock: jax.Array         # [H] i32
+    tip: jax.Array          # [H] i32 highest block id seen (-1 none)
+    relay_block: jax.Array  # [H] i32 block id being relayed (-1 idle)
+    relay_next: jax.Array   # [H] i32 next peer index to push to
+    next_block: jax.Array   # [H] i32 next block id this host mines
+    blocks_mined: jax.Array  # [H] i64
+    dup_rx: jax.Array       # [H] i64 duplicate receptions
+    relays: jax.Array       # [H] i64 datagrams pushed
+    block_interval: jax.Array  # [] i64 ns between blocks (global)
+    max_blocks: jax.Array   # [] i32
+
+
+def make_peer_graph(num_hosts: int, k: int, seed: int) -> np.ndarray:
+    """Static undirected k-regular-ish random peer graph (each host
+    gets >= k peers; the union of k out-choices symmetrized then
+    truncated back to K columns, ring fallback guarantees
+    connectivity)."""
+    rng = np.random.default_rng(seed)
+    peers = [[((i + 1) % num_hosts), ((i - 1) % num_hosts)]
+             for i in range(num_hosts)]  # ring base: connected
+    for i in range(num_hosts):
+        for p in rng.choice(num_hosts, size=k, replace=False):
+            p = int(p)
+            if p != i and p not in peers[i] and len(peers[i]) < k:
+                peers[i].append(p)
+                if i not in peers[p] and len(peers[p]) < k:
+                    peers[p].append(i)
+    out = np.full((num_hosts, k), -1, np.int32)
+    for i, ps in enumerate(peers):
+        out[i, :len(ps[:k])] = ps[:k]
+    return out
+
+
+def setup(sim, *, peers_per_host: int = 8,
+          block_interval=10 * simtime.ONE_SECOND, max_blocks: int = 100,
+          miner_stride: int = 1, graph_seed: int = 42):
+    """Bind sockets, build the peer graph, seed each host's first MINE
+    event. Block b is mined by host (b * miner_stride) % H."""
+    H = sim.net.host_ip.shape[0]
+    every = jnp.ones((H,), bool)
+    net, sock = sk_create(sim.net, every, SocketType.UDP)
+    net, _ = sk_bind(net, every, sock, 0, PORT)
+    sim = sim.replace(net=net)
+
+    peers = make_peer_graph(H, peers_per_host, graph_seed)
+    # first block id mined by host h: smallest b >= 0 with
+    # (b * stride) % H == h  (stride=1: b == h)
+    first = np.full(H, -1, np.int64)
+    for b in range(H):
+        m = (b * miner_stride) % H
+        if first[m] < 0:
+            first[m] = b
+    app = GossipApp(
+        peers=jnp.asarray(peers),
+        sock=sock,
+        tip=jnp.full((H,), -1, I32),
+        relay_block=jnp.full((H,), -1, I32),
+        relay_next=jnp.zeros((H,), I32),
+        next_block=jnp.asarray(first, I32),
+        blocks_mined=jnp.zeros((H,), I64),
+        dup_rx=jnp.zeros((H,), I64),
+        relays=jnp.zeros((H,), I64),
+        block_interval=jnp.asarray(block_interval, I64),
+        max_blocks=jnp.asarray(max_blocks, I32),
+    )
+    sim = sim.replace(app=app)
+
+    # seed each miner's first MINE event
+    from shadow_tpu.core.events import push_rows
+
+    have = jnp.asarray(first >= 0)
+    t = jnp.asarray(np.maximum(first, 0), I64) * block_interval
+    q = push_rows(
+        sim.events, have, t,
+        jnp.full((H,), KIND_MINE, I32), jnp.arange(H, dtype=I32),
+        jnp.zeros((H,), I32), emit_words(0, num_hosts=H))
+    q = q.replace(next_seq=q.next_seq + have.astype(I32))
+    return sim.replace(events=q)
+
+
+def _start_relay(app, mask, block):
+    """Begin pushing `block` to all peers (one datagram per
+    micro-step via the KIND_RELAY self-chain)."""
+    return app.replace(
+        relay_block=jnp.where(mask, block, app.relay_block),
+        relay_next=jnp.where(mask, 0, app.relay_next),
+    )
+
+
+def _relay_step(cfg, sim, buf, mask, now):
+    """Push the current block to the next peer; chain until done."""
+    app = sim.app
+    H, K = app.peers.shape
+    lane = jnp.arange(H)
+    idx = jnp.clip(app.relay_next, 0, K - 1)
+    peer = app.peers[lane, idx]
+    active = mask & (app.relay_block >= 0) & (app.relay_next < K) & (peer >= 0)
+    GH = sim.net.host_ip.shape[0]
+    dst_ip = sim.net.host_ip[jnp.clip(peer, 0, GH - 1)]
+    net, ok = udp.udp_enqueue_send(
+        sim.net, active, app.sock, dst_ip,
+        jnp.full((H,), PORT, I32), BLOCK_BYTES, app.relay_block)
+    app = app.replace(
+        relay_next=app.relay_next + active.astype(I32),
+        relays=app.relays + ok.astype(I64),
+    )
+    sim = sim.replace(net=net, app=app)
+    sim, buf = nic.notify_wants_send(sim, buf, ok, now)
+    # chain to the next peer (or stop)
+    more = active & (app.relay_next < K)
+    nxt_peer = app.peers[lane, jnp.clip(app.relay_next, 0, K - 1)]
+    more = more & (nxt_peer >= 0)
+    buf = emit(buf, more, sim.net.lane_id, now, KIND_RELAY,
+               emit_words(0, num_hosts=H))
+    done = mask & ~more
+    app = sim.app.replace(
+        relay_block=jnp.where(done, -1, sim.app.relay_block))
+    return sim.replace(app=app), buf
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+    H = app.sock.shape[0]
+
+    # ---- mine a block ------------------------------------------------
+    mine = popped.valid & (popped.kind == KIND_MINE) \
+        & (app.next_block >= 0) & (app.next_block < app.max_blocks) \
+        & (app.relay_block < 0)
+    # busy relaying? retry shortly (rare: block interval >> relay time)
+    busy = popped.valid & (popped.kind == KIND_MINE) \
+        & (app.next_block >= 0) & (app.next_block < app.max_blocks) \
+        & (app.relay_block >= 0)
+    buf = emit(buf, busy, sim.net.lane_id,
+               now + simtime.ONE_MILLISECOND, KIND_MINE,
+               emit_words(0, num_hosts=H))
+    new_tip = jnp.maximum(app.tip, app.next_block)
+    app = app.replace(
+        tip=jnp.where(mine, new_tip, app.tip),
+        blocks_mined=app.blocks_mined + mine.astype(I64),
+    )
+    app = _start_relay(app, mine, app.next_block)
+    # kick the relay chain for the freshly mined block
+    buf = emit(buf, mine, sim.net.lane_id, now, KIND_RELAY,
+               emit_words(0, num_hosts=H))
+    # schedule this host's next mining slot (stride pattern: +H blocks)
+    nxt = app.next_block + H
+    mine_t = nxt.astype(I64) * app.block_interval
+    sched = mine & (nxt < app.max_blocks)
+    buf = emit(buf, sched, sim.net.lane_id, mine_t, KIND_MINE,
+               emit_words(0, num_hosts=H))
+    app = app.replace(next_block=jnp.where(mine, nxt, app.next_block))
+    sim = sim.replace(app=app)
+
+    # ---- receive blocks ----------------------------------------------
+    may_have = popped.valid & (
+        (popped.kind == EventKind.NIC_RECV)
+        | (popped.kind == EventKind.PACKET_LOCAL))
+    readable = gather_hs(sim.net.in_count, sim.app.sock) > 0
+    net, got, _, _, _, block = udp.udp_recv(
+        sim.net, may_have & readable, sim.app.sock)
+    sim = sim.replace(net=net)
+    app = sim.app
+    fresh = got & (block > app.tip) & (app.relay_block < 0)
+    stale = got & (block <= app.tip)
+    # a fresh block while still relaying the previous one: adopt the
+    # tip but skip re-relaying (bounded state; peers will also hear it
+    # from the origin's other neighbors)
+    adopt = got & (block > app.tip)
+    app = app.replace(
+        tip=jnp.where(adopt, block, app.tip),
+        dup_rx=app.dup_rx + stale.astype(I64),
+    )
+    app = _start_relay(app, fresh, block)
+    sim = sim.replace(app=app)
+    kick = fresh
+    buf = emit(buf, kick, sim.net.lane_id, now, KIND_RELAY,
+               emit_words(0, num_hosts=H))
+
+    # ---- relay chain -------------------------------------------------
+    relay = popped.valid & (popped.kind == KIND_RELAY)
+    sim, buf = _relay_step(cfg, sim, buf, relay, now)
+    return sim, buf

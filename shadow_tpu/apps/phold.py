@@ -1,0 +1,107 @@
+"""PHOLD — the classic PDES stress benchmark as an on-device app
+(ref: src/test/phold/test_phold.c:36-52 and
+phold.test.shadow.config.xml:22-26: every host seeds `load` UDP
+messages; each received message triggers one send to a random peer, so
+`H * load` messages circulate forever and the event rate measures raw
+scheduler throughput).
+
+The reference picks targets by configured weights; this build draws
+uniformly over the other hosts from the per-host counter PRNG stream
+(deterministic: the draw sequence is fixed by the deterministic event
+order). Weighted targeting can layer on by inverse-CDF over a
+replicated weight table.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import rng
+from shadow_tpu.core.events import EventKind, emit, emit_words
+from shadow_tpu.net import nic, udp
+from shadow_tpu.net.rings import gather_hs
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+KIND_INJECT = EventKind.USER + 0   # self-chained initial-load injector
+MSG_SIZE = 64
+
+
+@struct.dataclass
+class PholdApp:
+    sock: jax.Array       # [H] i32
+    port: jax.Array       # [H] i32
+    remaining: jax.Array  # [H] i32 initial-load messages still to inject
+    sent: jax.Array       # [H] i64
+    rcvd: jax.Array       # [H] i64
+
+
+def setup(sim, *, load: int, port: int = 9000):
+    """All hosts run PHOLD: bind a UDP socket, seed `load` messages."""
+    H = sim.net.host_ip.shape[0]
+    if H < 2:
+        raise ValueError("PHOLD needs at least 2 hosts")
+    every = jnp.ones((H,), bool)
+    net, sock = sk_create(sim.net, every, SocketType.UDP)
+    net, _ = sk_bind(net, every, sock, 0, port)
+    app = PholdApp(
+        sock=sock,
+        port=jnp.full((H,), port, I32),
+        remaining=jnp.full((H,), load, I32),
+        sent=jnp.zeros((H,), I64),
+        rcvd=jnp.zeros((H,), I64),
+    )
+    return sim.replace(net=net, app=app)
+
+
+def _send_one(cfg, sim, buf, mask, now):
+    """Send one message per masked lane to a uniformly random peer
+    (excluding self), drawn from the host's deterministic PRNG
+    stream."""
+    app = sim.app
+    net = sim.net
+    GH = net.host_ip.shape[0]
+    u, ctr = rng.uniform(net.rng_keys, net.rng_ctr)
+    net = net.replace(rng_ctr=jnp.where(mask, ctr, net.rng_ctr))
+    peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
+    peer = jnp.where(peer >= net.lane_id, peer + 1, peer)  # skip self
+    dst_ip = net.host_ip[jnp.clip(peer, 0, GH - 1)]
+    net, ok = udp.udp_enqueue_send(net, mask, app.sock, dst_ip, app.port,
+                                   MSG_SIZE, -1)
+    app = app.replace(sent=app.sent + ok.astype(I64))
+    sim = sim.replace(net=net, app=app)
+    return nic.notify_wants_send(sim, buf, ok, now)
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+    H = app.sock.shape[0]
+
+    # initial load: one message per micro-step, chained by a
+    # same-time self event until `load` have been injected
+    inject = popped.valid & (
+        (popped.kind == EventKind.PROC_START) | (popped.kind == KIND_INJECT)
+    ) & (app.remaining > 0)
+    sim, buf = _send_one(cfg, sim, buf, inject, now)
+    app = sim.app.replace(remaining=sim.app.remaining - inject.astype(I32))
+    sim = sim.replace(app=app)
+    more = inject & (app.remaining > 0)
+    buf = emit(buf, more, sim.net.lane_id, now, KIND_INJECT,
+               emit_words(0, num_hosts=H))
+
+    # every received message triggers one send to a new random peer
+    may_have = popped.valid & (
+        (popped.kind == EventKind.NIC_RECV)
+        | (popped.kind == EventKind.PACKET_LOCAL))
+    readable = gather_hs(sim.net.in_count, app.sock) > 0
+    net, got, _, _, _, _ = udp.udp_recv(sim.net, may_have & readable, app.sock)
+    sim = sim.replace(net=net,
+                      app=sim.app.replace(rcvd=sim.app.rcvd + got.astype(I64)))
+    sim, buf = _send_one(cfg, sim, buf, got, now)
+    return sim, buf

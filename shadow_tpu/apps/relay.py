@@ -1,0 +1,174 @@
+"""Tor-relay-shaped application model (BASELINE.json config #3:
+"10k-host Tor"). The reference's marquee workload runs real Tor
+binaries under interposition; the TPU-native model reproduces the
+structural load — fixed circuits of TCP hops
+(client -> guard -> middle -> exit -> server) where every relay
+stream-forwards bytes between an upstream and a downstream TCP
+connection — as an on-device state machine (SURVEY.md §7.1; Tor's
+crypto is irrelevant to network-simulation load).
+
+Circuits are disjoint host chains (HOSTS_PER_CIRCUIT hosts each), so
+10k hosts = 2k circuits running concurrently. Each hop connects
+downstream at PROC_START; data rides behind the handshakes
+(send-before-established buffering in net/tcp.py). Relays apply
+store-and-forward backpressure: bytes read upstream but not yet
+accepted downstream are held in `fwd_pending` (bounded by the
+downstream send buffer + our recv window).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net import tcp
+from shadow_tpu.net.rings import gather_hs
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+PORT = 9001
+CHUNK = 1 << 20
+
+ROLE_NONE = 0
+ROLE_CLIENT = 1
+ROLE_RELAY = 2
+ROLE_SERVER = 3
+
+
+@struct.dataclass
+class RelayApp:
+    role: jax.Array        # [H] i32
+    lsock: jax.Array       # [H] i32 listener (relay/server; -1)
+    up_conn: jax.Array     # [H] i32 accepted upstream child (-1)
+    down_sock: jax.Array   # [H] i32 downstream connection (-1)
+    next_ip: jax.Array     # [H] i64 downstream hop IP (0 none)
+    connected: jax.Array   # [H] bool downstream connect issued
+    to_send: jax.Array     # [H] i32 client payload left to submit
+    fwd_pending: jax.Array  # [H] i32 relay bytes read but not yet sent
+    up_eof: jax.Array      # [H] bool upstream finished
+    closed_down: jax.Array  # [H] bool downstream closed
+    rcvd: jax.Array        # [H] i64 server bytes received
+    done_at: jax.Array     # [H] i64 server EOF time (-1)
+
+
+def setup(sim, *, circuits: list[list[int]], total_bytes: int):
+    """circuits: each a host-index chain [client, r1, ..., server].
+    Client streams total_bytes through the chain."""
+    H = sim.net.host_ip.shape[0]
+    role = np.zeros(H, np.int32)
+    next_hop = np.full(H, -1, np.int64)
+    for chain in circuits:
+        role[chain[0]] = ROLE_CLIENT
+        role[chain[-1]] = ROLE_SERVER
+        for r in chain[1:-1]:
+            role[r] = ROLE_RELAY
+        for a, b in zip(chain, chain[1:]):
+            next_hop[a] = b
+
+    host_ips = np.asarray(sim.net.host_ip)
+    next_ip = np.where(next_hop >= 0, host_ips[np.maximum(next_hop, 0)], 0)
+
+    is_listener = (role == ROLE_RELAY) | (role == ROLE_SERVER)
+    has_down = next_hop >= 0
+
+    net, lsock = sk_create(sim.net, jnp.asarray(is_listener), SocketType.TCP)
+    net, _ = sk_bind(net, jnp.asarray(is_listener), lsock, 0, PORT)
+    sim = sim.replace(net=net)
+    sim = tcp.tcp_listen(sim, jnp.asarray(is_listener), lsock)
+    net, down = sk_create(sim.net, jnp.asarray(has_down), SocketType.TCP)
+    sim = sim.replace(net=net)
+
+    app = RelayApp(
+        role=jnp.asarray(role),
+        lsock=jnp.where(jnp.asarray(is_listener), lsock, -1),
+        up_conn=jnp.full((H,), -1, I32),
+        down_sock=jnp.where(jnp.asarray(has_down), down, -1),
+        next_ip=jnp.asarray(next_ip, I64),
+        connected=jnp.zeros((H,), bool),
+        to_send=jnp.where(jnp.asarray(role == ROLE_CLIENT),
+                          total_bytes, 0).astype(I32),
+        fwd_pending=jnp.zeros((H,), I32),
+        up_eof=jnp.zeros((H,), bool),
+        closed_down=jnp.zeros((H,), bool),
+        rcvd=jnp.zeros((H,), I64),
+        done_at=jnp.full((H,), -1, I64),
+    )
+    return sim.replace(app=app)
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+    woke = popped.valid
+
+    # ---- connect downstream at PROC_START ----------------------------
+    start = woke & (popped.kind == EventKind.PROC_START) \
+        & (app.down_sock >= 0) & ~app.connected
+    sim, buf = tcp.tcp_connect(cfg, sim, start, app.down_sock,
+                               app.next_ip, jnp.full_like(app.role, PORT),
+                               now, buf)
+    app = app.replace(connected=app.connected | start)
+    sim = sim.replace(app=app)
+
+    # ---- accept one upstream child -----------------------------------
+    lready = (gather_hs(sim.net.sk_flags, app.lsock)
+              & SocketFlags.READABLE) != 0
+    acc = woke & (app.lsock >= 0) & (app.up_conn < 0) & lready
+    sim, got, child = tcp.tcp_accept(sim, acc, app.lsock)
+    app = app.replace(up_conn=jnp.where(got, child, app.up_conn))
+    sim = sim.replace(app=app)
+
+    # ---- client: feed the stream -------------------------------------
+    feeding = woke & (app.role == ROLE_CLIENT) & app.connected \
+        & (app.to_send > 0)
+    sim, buf, accepted = tcp.tcp_send(cfg, sim, feeding, app.down_sock,
+                                      jnp.minimum(app.to_send, CHUNK),
+                                      now, buf)
+    app = app.replace(to_send=app.to_send - accepted)
+    sim = sim.replace(app=app)
+    fin_client = woke & (app.role == ROLE_CLIENT) & app.connected \
+        & (app.to_send == 0) & ~app.closed_down
+    sim, buf = tcp.tcp_close(cfg, sim, fin_client, app.down_sock, now, buf)
+    app = app.replace(closed_down=app.closed_down | fin_client)
+    sim = sim.replace(app=app)
+
+    # ---- relay/server: drain upstream --------------------------------
+    drain = woke & (app.up_conn >= 0) & ~app.up_eof
+    sim, buf, nread, eof = tcp.tcp_recv(
+        sim, drain, app.up_conn, jnp.full_like(app.role, CHUNK), now, buf)
+    is_srv = app.role == ROLE_SERVER
+    app = app.replace(
+        fwd_pending=app.fwd_pending
+        + jnp.where(is_srv, 0, nread).astype(I32),
+        rcvd=app.rcvd + jnp.where(is_srv, nread, 0).astype(I64),
+        up_eof=app.up_eof | eof,
+        done_at=jnp.where(eof & is_srv & (app.done_at < 0), now,
+                          app.done_at),
+    )
+    sim = sim.replace(app=app)
+    # server closes its side on EOF
+    sim, buf = tcp.tcp_close(cfg, sim, eof & is_srv, app.up_conn, now, buf)
+
+    # ---- relay: forward downstream -----------------------------------
+    app = sim.app
+    fwd = woke & (app.role == ROLE_RELAY) & (app.fwd_pending > 0) \
+        & app.connected
+    sim, buf, fsent = tcp.tcp_send(cfg, sim, fwd, app.down_sock,
+                                   app.fwd_pending, now, buf)
+    app = app.replace(fwd_pending=app.fwd_pending - fsent)
+    sim = sim.replace(app=app)
+    # relay propagates EOF once everything has been forwarded
+    relay_fin = woke & (app.role == ROLE_RELAY) & app.up_eof \
+        & (app.fwd_pending == 0) & ~app.closed_down
+    sim, buf = tcp.tcp_close(cfg, sim, relay_fin, app.down_sock, now, buf)
+    app = sim.app.replace(closed_down=sim.app.closed_down | relay_fin)
+    # ... and closes its upstream side
+    sim = sim.replace(app=app)
+    sim, buf = tcp.tcp_close(cfg, sim, relay_fin, app.up_conn, now, buf)
+    return sim, buf

@@ -1,0 +1,135 @@
+"""Command-line entry point — the analog of the reference's bootstrap
++ Options layer (ref: main.c:734-802, options.c). No TLS/relaunch
+dance (SURVEY.md §7.5): parse flags, load the XML config, build device
+state, run, report.
+
+Flag parity with options.c (flags whose mechanism has no TPU analog
+are accepted and mapped or no-op'd, so reference invocations keep
+working):
+  --workers       -> number of mesh shards (device axis size)
+  --scheduler-policy -> accepted; all policies map to the one device
+                     scheduler (ref policies are pthread shardings)
+  --seed, --runahead, --bootstrap-end, --interface-qdisc,
+  --socket-recv-buffer, --socket-send-buffer, --log-level,
+  --heartbeat-frequency, --tcp-congestion-control (reno only)
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow-tpu",
+        description="TPU-native parallel discrete-event network simulator",
+    )
+    p.add_argument("config", nargs="?", help="shadow.config.xml path")
+    p.add_argument("--test", action="store_true",
+                   help="run the built-in example config (ref: --test)")
+    p.add_argument("--test-clients", type=int, default=100)
+    p.add_argument("-w", "--workers", type=int, default=1,
+                   help="device shards (ref: worker threads)")
+    p.add_argument("-s", "--seed", type=int, default=1)
+    p.add_argument("--scheduler-policy", default="device",
+                   choices=["device", "host", "steal", "thread",
+                            "threadXthread", "threadXhost"],
+                   help="accepted for config compatibility; one device "
+                        "scheduler implements the window semantics")
+    p.add_argument("--runahead", type=int, default=0,
+                   help="minimum window (ms), 0 = derive from topology "
+                        "min latency (ref: master.c:133-159)")
+    p.add_argument("--bootstrap-end", type=int, default=0,
+                   help="unlimited-bandwidth bootstrap period (s)")
+    p.add_argument("--interface-qdisc", default="fifo",
+                   choices=["fifo", "rr"])
+    p.add_argument("--socket-recv-buffer", type=int, default=174760)
+    p.add_argument("--socket-send-buffer", type=int, default=131072)
+    p.add_argument("--tcp-congestion-control", default="reno",
+                   choices=["reno"])
+    p.add_argument("-l", "--log-level", default="message",
+                   choices=["error", "critical", "warning", "message",
+                            "info", "debug"])
+    p.add_argument("--heartbeat-frequency", type=int, default=60,
+                   help="tracker heartbeat interval (s)")
+    p.add_argument("--heartbeat-log-level", default="message")
+    p.add_argument("-d", "--data-directory", default="shadow.data")
+    p.add_argument("--sockets-per-host", type=int, default=4)
+    p.add_argument("--event-capacity", type=int, default=32)
+    p.add_argument("--version", action="version",
+                   version="shadow-tpu 0.1 (capability target: shadow 1.x)")
+    return p
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+
+    from shadow_tpu.config.examples import example_config
+    from shadow_tpu.config.loader import load
+    from shadow_tpu.config.xmlconfig import parse_config
+    from shadow_tpu.utils.shadowlog import SimLogger, level_from_name
+
+    if args.test:
+        text = example_config(clients=args.test_clients)
+    elif args.config:
+        with open(args.config) as f:
+            text = f.read()
+    else:
+        print("error: provide a config path or --test", file=sys.stderr)
+        return 1
+
+    logger = SimLogger(level=level_from_name(args.log_level))
+    cfg = parse_config(text)
+    loaded = load(cfg, seed=args.seed, overrides={
+        "interface_qdisc": args.interface_qdisc,
+        "socket_recv_buffer": args.socket_recv_buffer,
+        "socket_send_buffer": args.socket_send_buffer,
+        "runahead": args.runahead,
+        "sockets_per_host": args.sockets_per_host,
+        "event_capacity": args.event_capacity,
+    })
+    b = loaded.bundle
+    logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
+                   f"min window {b.min_jump} ns, "
+                   f"end {b.cfg.end_time} ns")
+
+    t0 = time.time()
+    if args.workers > 1:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from shadow_tpu.parallel.shard import run_sharded
+
+        devs = jax.devices()[:args.workers]
+        mesh = Mesh(np.array(devs), ("hosts",))
+        sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers)
+    else:
+        from shadow_tpu.net.build import run
+
+        sim, stats = run(b, app_handlers=loaded.handlers)
+    wall = time.time() - t0
+
+    ev = int(stats.events_processed)
+    sim_s = b.cfg.end_time / 1e9
+    report = {
+        "events": ev,
+        "windows": int(stats.windows),
+        "wall_seconds": round(wall, 3),
+        "events_per_second": round(ev / wall, 1) if wall > 0 else None,
+        "simulated_seconds_per_wall_second":
+            round(sim_s / wall, 3) if wall > 0 else None,
+        "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
+        + int(sim.net.rq_overflow),
+    }
+    logger.message(b.cfg.end_time, "shadow-tpu", "simulation complete "
+                   + json.dumps(report))
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,36 @@
+"""Built-in example configuration behind `--test` (ref: examples.c —
+the reference bakes in a 1000-client filetransfer XML; here a
+100-client bulk-download over one network vertex, scaled to finish
+quickly on any backend)."""
+
+EXAMPLE_GRAPHML = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">50.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>"""
+
+
+def example_config(clients: int = 100, kib: int = 330) -> str:
+    """ref: example_getTestContents (examples.c:10-30)."""
+    return f"""<shadow stoptime="60">
+  <topology><![CDATA[{EXAMPLE_GRAPHML}]]></topology>
+  <plugin id="filex" path="bulk"/>
+  <host id="server" bandwidthdown="102400" bandwidthup="102400">
+    <process plugin="filex" starttime="1" arguments="mode=server port=80"/>
+  </host>
+  <host id="client" quantity="{clients}">
+    <process plugin="filex" starttime="2"
+      arguments="mode=client server=server port=80 bytes={kib * 1024}"/>
+  </host>
+</shadow>"""

@@ -1,0 +1,233 @@
+"""Build a runnable simulation from a parsed ShadowConfig — the
+device-era analog of master's load-configuration + register-plugins +
+register-hosts path (ref: master.c:161-398).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.config.xmlconfig import ShadowConfig, kv_arguments
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, SimBundle, build
+from shadow_tpu.net.state import NetConfig, QDisc
+
+# plugin name -> configure(bundle, assignments) -> handlers tuple.
+# assignments: list of (host_index, ProcessSpec). configure must set
+# bundle.sim (app state installed) and return the app handler(s).
+# An optional `hints(assignments) -> dict of NetConfig overrides` lets
+# a model size the fixed-capacity rings before the build (e.g. PHOLD's
+# event population is load-proportional; the reference's heaps grow
+# dynamically, ours are static shapes that must be provisioned).
+_REGISTRY: dict[str, Callable] = {}
+
+
+def register_plugin(name: str, configure: Callable, hints: Callable = None):
+    if hints is not None:
+        configure.hints = hints
+    _REGISTRY[name] = configure
+
+
+def plugin_names():
+    return sorted(_REGISTRY)
+
+
+def _configure_phold(bundle: SimBundle, assignments):
+    from shadow_tpu.apps import phold
+
+    load = 25
+    port = 9000
+    for _, spec in assignments:
+        kv = kv_arguments(spec.arguments)
+        load = int(kv.get("load", load))
+        port = int(kv.get("port", port))
+    bundle.sim = phold.setup(bundle.sim, load=load, port=port)
+    return (phold.handler,)
+
+
+def _configure_pingpong(bundle: SimBundle, assignments):
+    from shadow_tpu.apps import pingpong
+
+    H = bundle.cfg.num_hosts
+    client = np.zeros(H, bool)
+    server = np.zeros(H, bool)
+    server_name = None
+    port, count, size = 5000, 10, 64
+    for hi, spec in assignments:
+        kv = kv_arguments(spec.arguments)
+        mode = kv.get("mode", "client")
+        port = int(kv.get("port", port))
+        count = int(kv.get("count", count))
+        size = int(kv.get("size", size))
+        if mode == "server":
+            server[hi] = True
+        else:
+            client[hi] = True
+            server_name = kv.get("server", server_name)
+    if server_name is None:
+        si = int(np.argmax(server))
+        server_ip = int(bundle.dns.host_ips(H)[si])
+    else:
+        server_ip = bundle.ip_of(server_name)
+    bundle.sim = pingpong.setup(
+        bundle.sim, client_mask=jnp.asarray(client),
+        server_mask=jnp.asarray(server), server_ip=server_ip,
+        server_port=port, count=count, size=size)
+    return (pingpong.handler,)
+
+
+def _configure_bulk(bundle: SimBundle, assignments):
+    from shadow_tpu.apps import bulk
+
+    H = bundle.cfg.num_hosts
+    client = np.zeros(H, bool)
+    server = np.zeros(H, bool)
+    server_name = None
+    port, nbytes = 8080, 1 << 20
+    for hi, spec in assignments:
+        kv = kv_arguments(spec.arguments)
+        mode = kv.get("mode", "client")
+        port = int(kv.get("port", port))
+        nbytes = int(kv.get("bytes", nbytes))
+        if mode == "server":
+            server[hi] = True
+        else:
+            client[hi] = True
+            server_name = kv.get("server", server_name)
+    if server_name is None:
+        si = int(np.argmax(server))
+        server_ip = int(bundle.dns.host_ips(H)[si])
+    else:
+        server_ip = bundle.ip_of(server_name)
+    bundle.sim = bulk.setup(
+        bundle.sim, client_mask=jnp.asarray(client),
+        server_mask=jnp.asarray(server), server_ip=server_ip,
+        server_port=port, total_bytes=nbytes)
+    return (bulk.handler,)
+
+
+def _phold_hints(assignments):
+    load = 25
+    for _, spec in assignments:
+        kv = kv_arguments(spec.arguments)
+        load = int(kv.get("load", load))
+    # random targeting makes per-host event populations bursty; 4x the
+    # mean in-flight count keeps overflow at zero in practice (and
+    # overflow is counted, never silent, if it ever isn't)
+    cap = max(32, 4 * load)
+    return {"event_capacity": cap, "outbox_capacity": cap,
+            "router_ring": cap, "in_ring": max(16, 2 * load),
+            "tcp": False}
+
+
+_configure_phold.hints = _phold_hints
+
+register_plugin("phold", _configure_phold)
+register_plugin("shadow-plugin-test-phold", _configure_phold)
+def _tcp_stream_hints(assignments):
+    # a conservative window can deliver a full receive window of
+    # in-flight segments at once (rcvbuf/MSS ~ 122 at the default
+    # 174760 B buffer); provision the event rows / outbox / router
+    # ring for that burst (SURVEY.md §7.4.6 capacity policy)
+    return {"event_capacity": 256, "outbox_capacity": 256,
+            "router_ring": 256}
+
+
+_configure_bulk.hints = _tcp_stream_hints
+
+register_plugin("pingpong", _configure_pingpong)
+register_plugin("tgen-ping", _configure_pingpong)
+register_plugin("bulk", _configure_bulk)
+register_plugin("tgen-bulk", _configure_bulk)
+register_plugin("filetransfer", _configure_bulk)
+
+
+@dataclass
+class LoadedSim:
+    bundle: SimBundle
+    handlers: tuple
+    config: ShadowConfig
+
+
+def load(config: ShadowConfig, *, seed: int = 1,
+         overrides: dict | None = None) -> LoadedSim:
+    """ShadowConfig -> built SimBundle + app handlers. `overrides`
+    carries CLI-level settings (qdisc, buffers, runahead — the
+    reference's Options-beats-XML precedence is inverted for host
+    element attributes, matching master.c:355-364)."""
+    overrides = overrides or {}
+    if config.topology_text is not None:
+        graphml = config.topology_text
+    else:
+        with open(config.topology_path) as f:
+            graphml = f.read()
+
+    host_specs: list[HostSpec] = []
+    assignments: dict[str, list] = {}
+    sndbuf = overrides.get("socket_send_buffer", 131072)
+    rcvbuf = overrides.get("socket_recv_buffer", 174760)
+    for idx, (name, he) in enumerate(config.expanded_hosts()):
+        start = min((p.starttime for p in he.processes), default=None)
+        host_specs.append(HostSpec(
+            name=name,
+            ip=he.iphint if he.quantity == 1 else None,
+            citycode=he.citycodehint,
+            countrycode=he.countrycodehint,
+            geocode=he.geocodehint,
+            type=he.typehint,
+            bandwidthdown=he.bandwidthdown,
+            bandwidthup=he.bandwidthup,
+            proc_start_time=start,
+        ))
+        if he.socketsendbuffer:
+            sndbuf = he.socketsendbuffer
+        if he.socketrecvbuffer:
+            rcvbuf = he.socketrecvbuffer
+        for p in he.processes:
+            if p.plugin not in config.plugins:
+                raise ValueError(f"process references unknown plugin "
+                                 f"'{p.plugin}'")
+            model = config.plugins[p.plugin].path
+            assignments.setdefault(model, []).append((idx, p))
+
+    # model-provided capacity hints (CLI overrides still win)
+    hinted: dict = {}
+    for model, asg in assignments.items():
+        h = getattr(_REGISTRY.get(model), "hints", None)
+        if h is not None:
+            for k, v in h(asg).items():
+                hinted[k] = max(hinted.get(k, 0), v)
+    for k, v in hinted.items():
+        overrides.setdefault(k, v)
+
+    qdisc_name = overrides.get("interface_qdisc", "fifo")
+    cfg = NetConfig(
+        num_hosts=len(host_specs),
+        end_time=config.stoptime,
+        bootstrap_end=config.bootstraptime,
+        seed=seed,
+        qdisc=QDisc.RR if qdisc_name == "rr" else QDisc.FIFO,
+        sndbuf=sndbuf,
+        rcvbuf=rcvbuf,
+        **{k: v for k, v in overrides.items()
+           if k in ("sockets_per_host", "event_capacity", "outbox_capacity",
+                    "router_ring", "in_ring", "out_ring", "timers_per_host",
+                    "emit_capacity", "tcp")},
+    )
+    bundle = build(cfg, graphml, host_specs)
+    if "runahead" in overrides and overrides["runahead"]:
+        bundle.min_jump = int(overrides["runahead"]
+                              * simtime.ONE_MILLISECOND)
+
+    handlers: list = []
+    for model, asg in assignments.items():
+        if model not in _REGISTRY:
+            raise ValueError(
+                f"unknown plugin model '{model}' (registered: "
+                f"{plugin_names()}); register_plugin() to extend")
+        handlers.extend(_REGISTRY[model](bundle, asg))
+    return LoadedSim(bundle=bundle, handlers=tuple(handlers), config=config)

@@ -1,0 +1,184 @@
+"""shadow.config.xml parser — format-compatible with the reference's
+GMarkup Configuration (ref: configuration.c, configuration.h:24-108),
+covering both element generations the reference accepts:
+`<node>`/`<application>` (1.x configs, e.g.
+src/test/phold/phold.test.shadow.config.xml) and
+`<host>`/`<process>`, plus `<kill time="..."/>` and the
+`<shadow stoptime bootstraptime>` attributes.
+
+Plugins cannot be ELF .so paths on a TPU (SURVEY.md §7.1): the
+`path` of a `<plugin>` names an app model from the plugin registry
+(builtin: phold, pingpong, bulk/tgen; extendable via
+register_plugin). `arguments` strings are passed through to the
+model's configure hook, split shell-style.
+"""
+
+from __future__ import annotations
+
+import shlex
+import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
+from typing import Optional
+
+
+@dataclass
+class PluginSpec:
+    id: str
+    path: str                      # model name (see plugins registry)
+
+
+@dataclass
+class ProcessSpec:
+    plugin: str
+    starttime: int                 # ns
+    stoptime: Optional[int]        # ns
+    arguments: list[str] = field(default_factory=list)
+
+
+@dataclass
+class HostElem:
+    """One <host>/<node> element (pre-quantity expansion)
+    (ref: configuration.h:62-101)."""
+
+    id: str
+    quantity: int = 1
+    iphint: Optional[str] = None
+    citycodehint: Optional[str] = None
+    countrycodehint: Optional[str] = None
+    geocodehint: Optional[str] = None
+    typehint: Optional[str] = None
+    bandwidthdown: Optional[int] = None    # KiB/s
+    bandwidthup: Optional[int] = None
+    socketrecvbuffer: Optional[int] = None
+    socketsendbuffer: Optional[int] = None
+    interfacebuffer: Optional[int] = None
+    qdisc: Optional[str] = None
+    loglevel: Optional[str] = None
+    heartbeatfrequency: Optional[int] = None  # seconds
+    logpcap: bool = False
+    processes: list[ProcessSpec] = field(default_factory=list)
+
+
+@dataclass
+class ShadowConfig:
+    stoptime: int                  # ns
+    bootstraptime: int             # ns
+    topology_text: Optional[str]   # inline GraphML
+    topology_path: Optional[str]
+    plugins: dict[str, PluginSpec]
+    hosts: list[HostElem]
+
+    def expanded_hosts(self):
+        """Yield (name, HostElem) with quantity stamped out the way the
+        reference does (hostname, hostname2, hostname3, ...; ref:
+        master.c host registration loop)."""
+        for h in self.hosts:
+            for i in range(h.quantity):
+                name = h.id if i == 0 else f"{h.id}{i + 1}"
+                yield name, h
+
+
+_SECONDS = 1_000_000_000
+
+
+def _seconds_attr(elem, *names, default=None):
+    for n in names:
+        v = elem.get(n)
+        if v is not None:
+            return int(float(v) * _SECONDS)
+    return default
+
+
+def _int_attr(elem, *names, default=None):
+    for n in names:
+        v = elem.get(n)
+        if v is not None:
+            return int(v)
+    return default
+
+
+def parse_config(text: str) -> ShadowConfig:
+    root = ET.fromstring(text)
+    if root.tag != "shadow":
+        raise ValueError(f"root element must be <shadow>, got <{root.tag}>")
+
+    stoptime = _seconds_attr(root, "stoptime", default=None)
+    bootstraptime = _seconds_attr(root, "bootstraptime", default=0)
+
+    topology_text = None
+    topology_path = None
+    plugins: dict[str, PluginSpec] = {}
+    hosts: list[HostElem] = []
+
+    for child in root:
+        if child.tag == "kill":
+            stoptime = _seconds_attr(child, "time", default=stoptime)
+        elif child.tag == "topology":
+            topology_path = child.get("path")
+            if child.text and child.text.strip():
+                topology_text = child.text
+        elif child.tag == "plugin":
+            pid = child.get("id")
+            if pid is None:
+                raise ValueError("<plugin> requires id")
+            plugins[pid] = PluginSpec(id=pid, path=child.get("path", pid))
+        elif child.tag in ("host", "node"):
+            hid = child.get("id")
+            if hid is None:
+                raise ValueError(f"<{child.tag}> requires id")
+            he = HostElem(
+                id=hid,
+                quantity=_int_attr(child, "quantity", default=1),
+                iphint=child.get("iphint") or child.get("ip"),
+                citycodehint=child.get("citycodehint"),
+                countrycodehint=child.get("countrycodehint"),
+                geocodehint=child.get("geocodehint"),
+                typehint=child.get("typehint"),
+                bandwidthdown=_int_attr(child, "bandwidthdown"),
+                bandwidthup=_int_attr(child, "bandwidthup"),
+                socketrecvbuffer=_int_attr(child, "socketrecvbuffer"),
+                socketsendbuffer=_int_attr(child, "socketsendbuffer"),
+                interfacebuffer=_int_attr(child, "interfacebuffer"),
+                qdisc=child.get("interfacequeue") or child.get("qdisc"),
+                loglevel=child.get("loglevel"),
+                heartbeatfrequency=_int_attr(child, "heartbeatfrequency"),
+                logpcap=child.get("logpcap", "false").lower() == "true",
+            )
+            for sub in child:
+                if sub.tag in ("process", "application"):
+                    plugin = sub.get("plugin")
+                    if plugin is None:
+                        raise ValueError(f"<{sub.tag}> requires plugin")
+                    he.processes.append(ProcessSpec(
+                        plugin=plugin,
+                        starttime=_seconds_attr(sub, "starttime", "time",
+                                                default=0),
+                        stoptime=_seconds_attr(sub, "stoptime"),
+                        arguments=shlex.split(sub.get("arguments", "")),
+                    ))
+            hosts.append(he)
+        # unknown elements are ignored (forward compatible)
+
+    if stoptime is None:
+        raise ValueError("config must set <shadow stoptime> or <kill time>")
+    if topology_text is None and topology_path is None:
+        raise ValueError("config must provide a <topology>")
+    return ShadowConfig(
+        stoptime=stoptime,
+        bootstraptime=bootstraptime,
+        topology_text=topology_text,
+        topology_path=topology_path,
+        plugins=plugins,
+        hosts=hosts,
+    )
+
+
+def kv_arguments(args: list[str]) -> dict[str, str]:
+    """The reference's phold-style `key=value` argument convention
+    (test_phold.c argument parsing)."""
+    out = {}
+    for a in args:
+        if "=" in a:
+            k, v = a.split("=", 1)
+            out[k] = v
+    return out

@@ -123,6 +123,24 @@ class Popped(NamedTuple):
         return self.words[:, i]
 
 
+def _onehot(mask: jax.Array, slot: jax.Array, width: int) -> jax.Array:
+    """[H] masked slot -> [H, width] one-hot row selector. Writes via
+    jnp.where(onehot, ...) instead of scatter: XLA fuses selects, while
+    each scatter is a separate slow-to-compile op (this path runs every
+    micro-step)."""
+    return mask[:, None] & (jnp.arange(width)[None, :] == slot[:, None])
+
+
+def _put(arr: jax.Array, sel: jax.Array, value) -> jax.Array:
+    """Masked row write arr[H,W] (or [H,W,NWORDS] when value is
+    [H,NWORDS]) under a one-hot selector."""
+    value = jnp.asarray(value, arr.dtype)
+    if arr.ndim == 3:
+        return jnp.where(sel[:, :, None], value[:, None, :], arr)
+    v = value[:, None] if value.ndim == 1 else value
+    return jnp.where(sel, v, arr)
+
+
 def _tie_key(src: jax.Array, seq: jax.Array) -> jax.Array:
     """Pack (srcHost, perSourceSeq) into one sortable i64 — the 3rd and
     4th keys of the reference's event order (ref: event.c:137-152).
@@ -155,8 +173,8 @@ def pop_earliest(q: EventQueue, horizon) -> tuple[EventQueue, Popped]:
         words=q.words[rows, idx],
     )
     # Clear popped slots (only where valid).
-    clear_idx = jnp.where(valid, idx, q.capacity)  # OOB -> drop
-    new_time = q.time.at[rows, clear_idx].set(simtime.INVALID, mode="drop")
+    sel = _onehot(valid, idx, q.capacity)
+    new_time = jnp.where(sel, simtime.INVALID, q.time)
     return q.replace(time=new_time), popped
 
 
@@ -174,14 +192,13 @@ def push_rows(
     has_free = jnp.any(free, axis=1)
     slot = jnp.argmax(free, axis=1)                       # first free slot
     ok = mask & has_free
-    rows = jnp.arange(q.num_hosts)
-    slot = jnp.where(ok, slot, q.capacity)                # OOB -> drop
+    sel = _onehot(ok, slot, q.capacity)
     q = q.replace(
-        time=q.time.at[rows, slot].set(time, mode="drop"),
-        kind=q.kind.at[rows, slot].set(kind, mode="drop"),
-        src=q.src.at[rows, slot].set(src, mode="drop"),
-        seq=q.seq.at[rows, slot].set(seq, mode="drop"),
-        words=q.words.at[rows, slot, :].set(words, mode="drop"),
+        time=_put(q.time, sel, time),
+        kind=_put(q.kind, sel, kind),
+        src=_put(q.src, sel, src),
+        seq=_put(q.seq, sel, seq),
+        words=_put(q.words, sel, words),
         overflow=q.overflow + jnp.sum(mask & ~has_free, dtype=I32),
     )
     return q
@@ -236,16 +253,15 @@ def outbox_append(
     seq: jax.Array,    # [H] i32
     words: jax.Array,  # [H, NWORDS] i32
 ) -> Outbox:
-    rows = jnp.arange(out.num_hosts)
     ok = mask & (out.count < out.capacity)
-    slot = jnp.where(ok, out.count, out.capacity)  # OOB -> drop
+    sel = _onehot(ok, out.count, out.capacity)
     return out.replace(
-        dst=out.dst.at[rows, slot].set(dst, mode="drop"),
-        time=out.time.at[rows, slot].set(time, mode="drop"),
-        kind=out.kind.at[rows, slot].set(kind, mode="drop"),
-        src=out.src.at[rows, slot].set(src, mode="drop"),
-        seq=out.seq.at[rows, slot].set(seq, mode="drop"),
-        words=out.words.at[rows, slot, :].set(words, mode="drop"),
+        dst=_put(out.dst, sel, dst),
+        time=_put(out.time, sel, time),
+        kind=_put(out.kind, sel, kind),
+        src=_put(out.src, sel, src),
+        seq=_put(out.seq, sel, seq),
+        words=_put(out.words, sel, words),
         count=out.count + ok.astype(I32),
         overflow=out.overflow + jnp.sum(mask & ~(out.count < out.capacity), dtype=I32),
     )
@@ -397,15 +413,14 @@ def emit(
     words: jax.Array,         # [H, NWORDS] i32
 ) -> EmitBuffer:
     H = buf.num_hosts
-    rows = jnp.arange(H)
     kind = jnp.broadcast_to(jnp.asarray(kind, I32), (H,))
     ok = mask & (buf.count < buf.capacity)
-    slot = jnp.where(ok, buf.count, buf.capacity)
+    sel = _onehot(ok, buf.count, buf.capacity)
     return buf.replace(
-        dst=buf.dst.at[rows, slot].set(dst, mode="drop"),
-        time=buf.time.at[rows, slot].set(time, mode="drop"),
-        kind=buf.kind.at[rows, slot].set(kind, mode="drop"),
-        words=buf.words.at[rows, slot, :].set(words, mode="drop"),
+        dst=_put(buf.dst, sel, dst),
+        time=_put(buf.time, sel, time),
+        kind=_put(buf.kind, sel, kind),
+        words=_put(buf.words, sel, words),
         count=buf.count + ok.astype(I32),
         overflow=buf.overflow + jnp.sum(mask & ~(buf.count < buf.capacity), dtype=I32),
     )

@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from shadow_tpu.core import rng, simtime
 from shadow_tpu.core.events import EventKind, emit
 from shadow_tpu.net import packetfmt as pf
-from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net.rings import gather_hs, set_hs, set_row
 from shadow_tpu.net.sockets import lookup_socket
 from shadow_tpu.net.state import (
     TB_REFILL_INTERVAL,
@@ -84,11 +84,13 @@ def _empty_words(H):
     return jnp.zeros((H, NWORDS), I32)
 
 
-def deliver_packet(net: NetState, mask, src_host, words, now):
+def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
     """Hand one arrived packet per masked lane to the bound socket
     (ref: _networkinterface_receivePacket, network_interface.c:375-419).
-    Returns net. TCP packets are routed to the TCP engine by the step
-    composer before this UDP/no-socket fallback."""
+    UDP goes to the datagram ring; TCP enters the connection state
+    machine (socket_pushInPacket -> protocol process, socket.h:84-87).
+    Returns (sim, buf)."""
+    net = sim.net
     GH = net.host_ip.shape[0]  # global host count (host_ip replicated)
     proto = pf.proto_of(words)
     src_port, dst_port = pf.ports_of(words)
@@ -114,7 +116,15 @@ def deliver_packet(net: NetState, mask, src_host, words, now):
         ctr_rx_bytes=net.ctr_rx_bytes
         + jnp.where(found, pf.wire_length(proto, words[:, pf.W_LEN]), 0).astype(I64),
     )
-    return net
+    sim = sim.replace(net=net)
+    if getattr(sim, "tcp", None) is not None:
+        from shadow_tpu.net import tcp as tcp_mod
+
+        is_tcp = found & (proto == pf.PROTO_TCP)
+        sim, buf = tcp_mod.tcp_packet_in(
+            cfg, sim, is_tcp, slot, words, src_ip, src_port, now, buf
+        )
+    return sim, buf
 
 
 # ---------------------------------------------------------------------
@@ -127,18 +137,17 @@ def handle_packet_arrival(cfg: NetConfig, sim, popped, buf):
     router.c:104-125)."""
     net = sim.net
     H = net.rq_head.shape[0]
-    lane = jnp.arange(H)
     mask = popped.valid & (popped.kind == EventKind.PACKET)
     R = cfg.router_ring
 
     was_empty = net.rq_count == 0
     ok = mask & (net.rq_count < R)
-    pos = jnp.where(ok, (net.rq_head + net.rq_count) % R, R)
+    pos = (net.rq_head + net.rq_count) % R
     wl = pf.wire_length(pf.proto_of(popped.words), popped.words[:, pf.W_LEN])
     net = net.replace(
-        rq_src=net.rq_src.at[lane, pos].set(popped.src, mode="drop"),
-        rq_enq_ts=net.rq_enq_ts.at[lane, pos].set(popped.time, mode="drop"),
-        rq_words=net.rq_words.at[lane, pos, :].set(popped.words, mode="drop"),
+        rq_src=set_row(net.rq_src, ok, pos, popped.src),
+        rq_enq_ts=set_row(net.rq_enq_ts, ok, pos, popped.time),
+        rq_words=set_row(net.rq_words, ok, pos, popped.words),
         rq_count=net.rq_count + ok.astype(I32),
         rq_bytes=net.rq_bytes + jnp.where(ok, wl, 0).astype(I64),
         rq_overflow=net.rq_overflow + jnp.sum(mask & ~ok, dtype=I32),
@@ -245,7 +254,16 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
     )
 
     delivered = active & ~drop_now
-    net = deliver_packet(net, delivered, e_src, e_words, now)
+    # merge loopback deliveries (kind=PACKET_LOCAL, disjoint lanes —
+    # one popped event per host) into one deliver_packet call so the
+    # TCP state machine is materialized once per micro-step, not twice
+    local = popped.valid & (popped.kind == EventKind.PACKET_LOCAL)
+    d_mask = delivered | local
+    d_src = jnp.where(local, popped.src, e_src)
+    d_words = jnp.where(local[:, None], popped.words, e_words)
+    sim = sim.replace(net=net)
+    sim, buf = deliver_packet(cfg, sim, d_mask, d_src, d_words, now, buf)
+    net = sim.net
 
     # consume rx tokens for delivered packets only (CoDel drops happen
     # inside router_dequeue, before bandwidth accounting)
@@ -395,10 +413,10 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
 
 def handle_packet_local(cfg: NetConfig, sim, popped, buf):
     """kind=PACKET_LOCAL: direct same-host delivery bypassing router
-    and token buckets (network_interface.c:546-554)."""
-    mask = popped.valid & (popped.kind == EventKind.PACKET_LOCAL)
-    net = deliver_packet(sim.net, mask, popped.src, popped.words, popped.time)
-    return sim.replace(net=net), buf
+    and token buckets (network_interface.c:546-554). Delivery itself
+    happens inside handle_nic_recv's merged deliver_packet call; this
+    handler only exists for documentation/ordering clarity."""
+    return sim, buf
 
 
 def notify_wants_send(sim, buf, mask, now):

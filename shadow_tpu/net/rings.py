@@ -7,6 +7,8 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from shadow_tpu.core.events import _onehot, _put
+
 I32 = jnp.int32
 
 
@@ -19,11 +21,32 @@ def gather_hs(arr, slot):
 
 
 def set_hs(arr, mask, slot, value):
-    """arr[H,S] masked scatter at (lane, slot)."""
-    H, S = arr.shape[:2]
-    lane = jnp.arange(H)
-    s = jnp.where(mask, slot, S)  # OOB -> drop
-    return arr.at[lane, s].set(value, mode="drop")
+    """arr[H,S] masked write at (lane, slot). One-hot select, not a
+    scatter: S is small, and XLA fuses selects where per-element
+    scatters would each become a separate (slow-to-compile,
+    slow-to-run) scatter op (shared core.events._onehot/_put)."""
+    return _put(arr, _onehot(mask, slot, arr.shape[1]), value)
+
+
+def set_ring(arr, mask, slot, pos, value):
+    """arr[H,S,B] (or [H,S,B,W] with value [H,W]) masked write at
+    (lane, slot, pos) via one-hot select — same rationale as set_hs:
+    selects fuse, scatters don't."""
+    H, S, B = arr.shape[:3]
+    sel = (mask[:, None, None]
+           & (jnp.arange(S)[None, :, None] == slot[:, None, None])
+           & (jnp.arange(B)[None, None, :] == pos[:, None, None]))
+    value = jnp.asarray(value, arr.dtype)
+    if arr.ndim == 4:
+        return jnp.where(sel[..., None], value[:, None, None, :], arr)
+    v = value[:, None, None] if value.ndim == 1 else value
+    return jnp.where(sel, v, arr)
+
+
+def set_row(arr, mask, pos, value):
+    """arr[H,R] (or [H,R,W] with value [H,W]) masked write at
+    (lane, pos) via one-hot select."""
+    return _put(arr, _onehot(mask, pos, arr.shape[1]), value)
 
 
 def ring_push_at(head, count, capacity: int, mask, slot):

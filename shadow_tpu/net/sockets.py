@@ -13,7 +13,13 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from shadow_tpu.net import packetfmt as pf
-from shadow_tpu.net.rings import gather_hs, ring_advance_push, ring_push_at, set_hs
+from shadow_tpu.net.rings import (
+    gather_hs,
+    ring_advance_push,
+    ring_push_at,
+    set_hs,
+    set_ring,
+)
 from shadow_tpu.net.state import NetState, SocketFlags, SocketType
 
 I32 = jnp.int32
@@ -36,11 +42,10 @@ def sk_enqueue_out(net: NetState, mask, slot, words):
         net.sk_sndbuf, slot
     )
     ok, pos = ring_push_at(net.out_head, net.out_count, BO, mask & space_ok, slot)
-    s = jnp.where(ok, slot, net.out_words.shape[1])
     net = net.replace(
-        out_words=net.out_words.at[lane, s, pos, :].set(words, mode="drop"),
-        out_priority=net.out_priority.at[lane, s, pos].set(
-            net.priority_ctr, mode="drop"),
+        out_words=set_ring(net.out_words, ok, slot, pos, words),
+        out_priority=set_ring(net.out_priority, ok, slot, pos,
+                              net.priority_ctr),
         priority_ctr=net.priority_ctr + ok.astype(net.priority_ctr.dtype),
     )
     _, count = ring_advance_push(net.out_head, net.out_count, mask, slot, ok)
@@ -111,9 +116,12 @@ def sk_set_flag(net: NetState, mask, slot, flag: int, on):
 def lookup_socket(net: NetState, mask, proto, dst_ip, dst_port, src_ip, src_port):
     """Find the receiving socket slot per lane ([H] -> slot or -1).
 
-    Order matches the reference (network_interface.c:388-403): first
-    the general association (bound port, no peer — servers), then the
-    (peer ip, peer port)-specific association."""
+    The (peer ip, peer port)-specific association wins over the
+    general (peer-less) one, so packets for an established TCP child
+    reach the child and only unmatched SYNs reach the listener (ref:
+    network_interface.c:375-419 + tcp.c's child multiplexing keyed by
+    hash(peerIP,peerPort), tcp.c:91-113,1822-1852 — here children are
+    their own socket slots instead of sub-objects of the server)."""
     S = net.sk_type.shape[1]
     pr = jnp.asarray(proto)[:, None]
     dip = jnp.asarray(dst_ip)[:, None]
@@ -137,4 +145,4 @@ def lookup_socket(net: NetState, mask, proto, dst_ip, dst_port, src_ip, src_port
 
     g = first_slot(general)
     s = first_slot(specific)
-    return jnp.where(g >= 0, g, s)
+    return jnp.where(s >= 0, s, g)

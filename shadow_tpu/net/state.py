@@ -70,6 +70,10 @@ class NetConfig:
     event_capacity: int = 32
     outbox_capacity: int = 32
     qdisc: int = QDisc.FIFO
+    tcp: bool = True             # False skips building TcpState and
+                                 # inlining the TCP machine into the
+                                 # device program (UDP-only workloads
+                                 # compile much faster)
     bootstrap_end: int = 0       # "unlimited bandwidth" period end
                                  # (ref: master.c:261-268)
     end_time: int = simtime.ONE_SECOND
@@ -186,7 +190,7 @@ class Sim:
     outbox: Outbox
     net: NetState
     app: Any = None
-    tcp: Any = None  # TcpState when any TCP socket exists (net/tcp.py)
+    tcp: Any = None  # TcpState when cfg.tcp (net/tcp.py), else None
 
 
 def make_net_state(
@@ -280,11 +284,17 @@ def make_net_state(
 
 
 def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
+    tcp = None
+    if cfg.tcp:
+        from shadow_tpu.net.tcp import TcpState
+
+        tcp = TcpState.create(cfg.num_hosts, cfg.sockets_per_host)
     return Sim(
         events=EventQueue.create(cfg.num_hosts, cfg.event_capacity),
         outbox=Outbox.create(cfg.num_hosts, cfg.outbox_capacity),
         net=net,
         app=app,
+        tcp=tcp,
     )
 
 

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 from typing import Callable, Sequence
 
-from shadow_tpu.net import nic, timers
+from shadow_tpu.net import nic, tcp, timers
 from shadow_tpu.net.state import NetConfig
 
 AppHandler = Callable  # (cfg, sim, popped, buf) -> (sim, buf)
@@ -24,14 +24,21 @@ _NET_HANDLERS = (
     nic.handle_nic_send,
     nic.handle_packet_local,
     timers.handle_timer,
+    tcp.handle_tcp_rtx,
+    tcp.handle_tcp_close,
 )
 
 
 def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
-    """Build the engine step_fn: netstack handlers then app handlers."""
+    """Build the engine step_fn: netstack handlers then app handlers.
+    TCP timer handlers are included only when the config carries TCP
+    state (cfg.tcp) — UDP-only device programs stay small."""
+    handlers = _NET_HANDLERS if cfg.tcp else tuple(
+        h for h in _NET_HANDLERS
+        if h not in (tcp.handle_tcp_rtx, tcp.handle_tcp_close))
 
     def step(sim, popped, buf):
-        for h in _NET_HANDLERS:
+        for h in handlers:
             sim, buf = h(cfg, sim, popped, buf)
         for h in app_handlers:
             sim, buf = h(cfg, sim, popped, buf)

@@ -1,0 +1,970 @@
+"""Simulated TCP as vmapped struct-of-arrays state transitions.
+
+This is the device-native re-design of the reference's tcp.c (2520
+lines of per-socket heap objects + callbacks): all sockets' TCP state
+lives in [H,S]-shaped tensors; packet processing, the state machine
+(ref: tcp.c:1777-2100), Reno congestion control (ref:
+tcp_cong_reno.c), RTO/RTT estimation (ref: tcp.c:991-1026), and flush
+(ref: _tcp_flush, tcp.c:1121-...) are masked batch updates over one
+(host, socket) pair per lane per micro-step.
+
+Design choices vs the reference, called out explicitly:
+
+- Sequence space is non-wrapping int32 starting at ISS=0 (the
+  reference uses wrapping guint32). Streams are limited to 2^31 bytes
+  per connection — far beyond any simulated workload here.
+- Retransmission regenerates segments from the [snd_una, snd_end)
+  byte range instead of keeping a retransmit queue of packet copies
+  (ref: tcp.c:854-1027). Payload bytes are host-side pool references
+  keyed by (socket, seq), so regeneration is lossless.
+- The receiver's reassembly queue (ref: unorderedInput PQ,
+  tcp.c:222-230) is a bounded set of OO_RANGES byte ranges; segments
+  that would need a 5th disjoint range are dropped (the sender
+  retransmits). SACK advertises the first (lowest) range only, vs the
+  reference's full sack list (packet.h:52,77); the sender's
+  interval-set scoreboard (tcp_retransmit_tally.cc) is reduced to
+  that single range.
+- Server sockets multiplex children as separate socket slots with a
+  peer-specific association instead of sub-objects keyed by
+  hash(peerIP,peerPort) (ref: tcp.c:91-113,1822-1852); the accept
+  queue holds child slot indices.
+- cwnd/ssthresh count packets exactly like the reference
+  (tcp_cong_reno.c), not bytes.
+- No zero-window probe events: a window-limited sender recovers via
+  the window update ACK sent when the app drains the receive buffer,
+  plus the RTO as backstop.
+
+Volatile header fields (ack, advertised window, timestamps) are
+stamped when the NIC actually emits the packet — stamp_at_wire() —
+matching tcp_networkInterfaceIsAboutToSendPacket (tcp.c:1090-1120).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import NWORDS, EventKind, emit
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.rings import gather_hs, set_hs, set_ring
+from shadow_tpu.net.sockets import sk_bind, sk_enqueue_out
+from shadow_tpu.net.state import NetConfig, NetState, SocketFlags, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+MSS = pf.MTU - pf.HDR_TCP          # 1434 payload bytes per segment
+OO_RANGES = 4                      # receiver reassembly ranges
+ACCEPT_QUEUE = 4                   # pending-children ring per listener
+FLUSH_SEGMENTS = 2                 # max segments packetized per flush call
+                                   # (2 sustains slow-start doubling: each
+                                   # ACK may admit two new segments)
+INIT_CWND = 10                     # packets (ref: definitions.h initial cwnd)
+INIT_SSTHRESH = 0x7FFFFFFF
+RTO_MIN_MS = 200                   # Linux-like floor
+RTO_MAX_MS = 60_000
+RTO_INIT_MS = 1_000
+MAX_BACKOFF = 8                    # cap exponential backoff shift
+TIMEWAIT_NS = 60 * simtime.ONE_SECOND  # ref: definitions.h:198, tcp.c:604-699
+
+
+class TcpSt:
+    """Connection states (ref: tcp.c:42-47)."""
+
+    CLOSED = 0
+    LISTEN = 1
+    SYN_SENT = 2
+    SYN_RCVD = 3
+    ESTABLISHED = 4
+    FIN_WAIT_1 = 5
+    FIN_WAIT_2 = 6
+    CLOSING = 7
+    TIME_WAIT = 8
+    CLOSE_WAIT = 9
+    LAST_ACK = 10
+
+
+@struct.dataclass
+class TcpState:
+    """All TCP sockets' protocol state, [H,S] per-socket columns."""
+
+    st: jax.Array          # [H,S] i32 TcpSt
+    # send side (absolute seq; SYN occupies 0, data starts at 1)
+    snd_una: jax.Array     # [H,S] i32 oldest unacked
+    snd_nxt: jax.Array     # [H,S] i32 next to send
+    snd_max: jax.Array     # [H,S] i32 highest seq ever sent (ack
+                           # validity bound; survives go-back-N rewinds)
+    snd_end: jax.Array     # [H,S] i32 end of app-buffered stream data
+    snd_wnd: jax.Array     # [H,S] i32 peer advertised window (bytes)
+    fin_pending: jax.Array  # [H,S] bool app called close; cleared only
+                            # on free. "FIN ever sent" is derived:
+                            # fin_pending & (snd_max == snd_end + 1) —
+                            # a flag would go stale across go-back-N
+                            # rewinds + healing ACKs
+    dup_acks: jax.Array    # [H,S] i32
+    cwnd: jax.Array        # [H,S] i32 packets
+    ssthresh: jax.Array    # [H,S] i32 packets
+    ca_acc: jax.Array      # [H,S] i32 congestion-avoidance accumulator
+    in_recovery: jax.Array  # [H,S] bool fast recovery
+    recover: jax.Array     # [H,S] i32 recovery point
+    sack_l: jax.Array      # [H,S] i32 peer-sacked range (0,0 = none)
+    sack_r: jax.Array      # [H,S] i32
+    # receive side
+    rcv_nxt: jax.Array     # [H,S] i32
+    app_rbytes: jax.Array  # [H,S] i32 in-order bytes awaiting app recv
+    fin_rcvd: jax.Array    # [H,S] bool
+    fin_rseq: jax.Array    # [H,S] i32 seq of peer FIN
+    oo_l: jax.Array        # [H,S,OO_RANGES] i32 out-of-order [l, r)
+    oo_r: jax.Array        # [H,S,OO_RANGES] i32
+    ts_recent: jax.Array   # [H,S] i32 last peer tsval (echoed back)
+    # RTT / RTO (Karn/Jacobson via timestamps, ref: tcp.c:991-1026)
+    srtt_ms: jax.Array     # [H,S] i32 (-1 = no sample yet)
+    rttvar_ms: jax.Array   # [H,S] i32
+    rto_ms: jax.Array      # [H,S] i32
+    backoff: jax.Array     # [H,S] i32 exponential backoff shift
+    # retransmission timer: at most one in-flight event per socket;
+    # the event checks rtx_expire on fire and re-arms if moved
+    # (the reference's timer invalidation pattern, timer.c:23-42)
+    rtx_expire: jax.Array  # [H,S] i64 deadline (INVALID = disarmed)
+    rtx_event: jax.Array   # [H,S] bool an event is in flight
+    # listener / accept (ref: tcp server multiplexing, tcp.c:260-321)
+    parent: jax.Array      # [H,S] i32 child -> listener slot (-1)
+    aq: jax.Array          # [H,S,ACCEPT_QUEUE] i32 ready child slots
+    aq_head: jax.Array     # [H,S] i32
+    aq_count: jax.Array    # [H,S] i32
+    # counters (tracker parity: retransmission tally)
+    retx_segs: jax.Array   # [H] i64 segments retransmitted
+    drop_oo_full: jax.Array  # [H] i64 segs dropped, reassembly full
+    drop_rwin: jax.Array   # [H] i64 segs dropped, recv buffer full
+
+    @staticmethod
+    def create(num_hosts: int, sockets_per_host: int) -> "TcpState":
+        H, S = num_hosts, sockets_per_host
+        zi = jnp.zeros((H, S), I32)
+        zb = jnp.zeros((H, S), bool)
+        zh = jnp.zeros((H,), I64)
+        return TcpState(
+            st=zi, snd_una=zi, snd_nxt=zi, snd_max=zi, snd_end=zi,
+            snd_wnd=jnp.full((H, S), MSS, I32),
+            fin_pending=zb, dup_acks=zi,
+            cwnd=jnp.full((H, S), INIT_CWND, I32),
+            ssthresh=jnp.full((H, S), INIT_SSTHRESH, I32),
+            ca_acc=zi, in_recovery=zb, recover=zi,
+            sack_l=zi, sack_r=zi,
+            rcv_nxt=zi, app_rbytes=zi, fin_rcvd=zb, fin_rseq=zi,
+            oo_l=jnp.zeros((H, S, OO_RANGES), I32),
+            oo_r=jnp.zeros((H, S, OO_RANGES), I32),
+            ts_recent=zi,
+            srtt_ms=jnp.full((H, S), -1, I32),
+            rttvar_ms=zi,
+            rto_ms=jnp.full((H, S), RTO_INIT_MS, I32),
+            backoff=zi,
+            rtx_expire=jnp.full((H, S), simtime.INVALID, I64),
+            rtx_event=zb,
+            parent=jnp.full((H, S), -1, I32),
+            aq=jnp.zeros((H, S, ACCEPT_QUEUE), I32),
+            aq_head=zi, aq_count=zi,
+            retx_segs=zh, drop_oo_full=zh, drop_rwin=zh,
+        )
+
+
+# ---------------------------------------------------------------------
+# small helpers
+# ---------------------------------------------------------------------
+
+def _ms(now):
+    return (now // simtime.ONE_MILLISECOND).astype(I32)
+
+
+def _set(tcp: TcpState, field: str, mask, slot, value):
+    return tcp.replace(**{field: set_hs(getattr(tcp, field), mask, slot, value)})
+
+
+def _seg_words(net: NetState, mask, slot, flags, seq, length, payref=None):
+    """Build [H, NWORDS] TCP packet words addressed to (slot)'s peer.
+    Volatile fields (ack/win/ts) are left zero for stamp_at_wire."""
+    H = mask.shape[0]
+    src_port = gather_hs(net.sk_bound_port, slot)
+    dst_port = gather_hs(net.sk_peer_port, slot)
+    dst_ip = gather_hs(net.sk_peer_ip, slot)
+    words = jnp.zeros((H, NWORDS), I32)
+    flags = jnp.broadcast_to(jnp.asarray(flags, I32), (H,))
+    words = words.at[:, pf.W_PROTO].set(pf.PROTO_TCP | (flags << 8))
+    words = words.at[:, pf.W_LEN].set(
+        jnp.broadcast_to(jnp.asarray(length, I32), (H,)))
+    words = words.at[:, pf.W_PORTS].set(pf.pack_ports(src_port, dst_port))
+    words = words.at[:, pf.W_SEQ].set(
+        jnp.broadcast_to(jnp.asarray(seq, I32), (H,)))
+    if payref is None:
+        payref = jnp.full((H,), pf.PAYREF_NONE, I32)
+    words = words.at[:, pf.W_PAYREF].set(payref)
+    words = words.at[:, pf.W_DSTIP].set(dst_ip.astype(jnp.uint32).astype(I32))
+    return words
+
+
+def _adv_window(net: NetState, tcp: TcpState, slot):
+    """Receive window to advertise: buffer capacity minus bytes held
+    for the app and parked in reassembly (ref: autotune-less branch of
+    tcp.c:407-592 — autotuning is a later addition)."""
+    oo_bytes = jnp.sum(tcp.oo_r - tcp.oo_l, axis=2, dtype=I32)  # [H,S]
+    free = gather_hs(net.sk_rcvbuf, slot) - gather_hs(tcp.app_rbytes, slot) \
+        - gather_hs(oo_bytes, slot)
+    return jnp.maximum(free, 0)
+
+
+def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
+    """Fill ack / advertised window / timestamps on a departing TCP
+    packet (ref: tcp_networkInterfaceIsAboutToSendPacket,
+    tcp.c:1090-1120)."""
+    ack = gather_hs(tcp.rcv_nxt, slot)
+    win = _adv_window(net, tcp, slot)
+    tse = gather_hs(tcp.ts_recent, slot)
+    # first OO range (lowest l) advertises the single SACK block
+    oo_valid = tcp.oo_r > tcp.oo_l                      # [H,S,NR]
+    key = jnp.where(oo_valid, tcp.oo_l, jnp.iinfo(I32).max)
+    first = jnp.argmin(key, axis=2)                     # [H,S]
+    has_oo = jnp.any(oo_valid, axis=2)
+    sl = jnp.take_along_axis(tcp.oo_l, first[..., None], axis=2)[..., 0]
+    sr = jnp.take_along_axis(tcp.oo_r, first[..., None], axis=2)[..., 0]
+    sackl = jnp.where(gather_hs(has_oo, slot), gather_hs(sl, slot), 0)
+    sackr = jnp.where(gather_hs(has_oo, slot), gather_hs(sr, slot), 0)
+
+    def put(w, col, val):
+        return w.at[:, col].set(jnp.where(mask, val, w[:, col]))
+
+    words = put(words, pf.W_ACK, ack)
+    words = put(words, pf.W_WIN, win)
+    words = put(words, pf.W_TSVAL, _ms(now))
+    words = put(words, pf.W_TSECHO, tse)
+    words = put(words, pf.W_SACKL, sackl)
+    words = put(words, pf.W_SACKR, sackr)
+    return words
+
+
+def _enqueue_seg(sim, buf, mask, slot, flags, seq, length, now):
+    """Push one segment on the socket output ring + kick the NIC.
+    Returns (sim, buf, ok[H]); ok False when the ring/sndbuf was full
+    (the segment was NOT queued — callers must not advance snd_nxt)."""
+    from shadow_tpu.net import nic
+
+    words = _seg_words(sim.net, mask, slot, flags, seq, length)
+    net, ok = sk_enqueue_out(sim.net, mask, slot, words)
+    sim = sim.replace(net=net)
+    sim, buf = nic.notify_wants_send(sim, buf, ok, now)
+    return sim, buf, ok
+
+
+def _arm_rtx(sim, buf, mask, slot, now):
+    """Ensure an RTO deadline + an in-flight timer event exist
+    (ref: _tcp_setRetransmitTimer)."""
+    tcp = sim.tcp
+    H = mask.shape[0]
+    rto_ns = (gather_hs(tcp.rto_ms, slot).astype(I64)
+              << jnp.minimum(gather_hs(tcp.backoff, slot), MAX_BACKOFF).astype(I64)
+              ) * simtime.ONE_MILLISECOND
+    rto_ns = jnp.minimum(rto_ns, I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
+    deadline = now + rto_ns
+    tcp = _set(tcp, "rtx_expire", mask, slot, deadline)
+    need_event = mask & ~gather_hs(tcp.rtx_event, slot)
+    tcp = _set(tcp, "rtx_event", need_event, slot, True)
+    sim = sim.replace(tcp=tcp)
+    w = jnp.zeros((H, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    buf = emit(buf, need_event, sim.net.lane_id, deadline,
+               EventKind.TCP_RTX_TIMER, w)
+    return sim, buf
+
+
+def _disarm_rtx(tcp: TcpState, mask, slot):
+    """Clear the deadline; the in-flight event (if any) will see
+    INVALID and die silently."""
+    return _set(tcp, "rtx_expire", mask, slot,
+                jnp.full(mask.shape, simtime.INVALID, I64))
+
+
+# ---------------------------------------------------------------------
+# app-facing API (the process_emu_* surface for TCP,
+# ref: host.c:1111-1359, process.h:103-437)
+# ---------------------------------------------------------------------
+
+def tcp_connect(cfg: NetConfig, sim, mask, slot, dst_ip, dst_port, now, buf):
+    """Active open: SYN_SENT + SYN on the wire (ref: tcp_connectToPeer,
+    host.c:1193-1230)."""
+    from shadow_tpu.net.sockets import sk_connect_peer
+
+    net = sk_connect_peer(sim.net, mask, slot, dst_ip, dst_port)
+    sim = sim.replace(net=net)
+    tcp = sim.tcp
+    tcp = _set(tcp, "st", mask, slot, jnp.full(mask.shape, TcpSt.SYN_SENT, I32))
+    tcp = _set(tcp, "snd_una", mask, slot, jnp.zeros(mask.shape, I32))
+    tcp = _set(tcp, "snd_nxt", mask, slot, jnp.ones(mask.shape, I32))
+    tcp = _set(tcp, "snd_max", mask, slot, jnp.ones(mask.shape, I32))
+    tcp = _set(tcp, "snd_end", mask, slot, jnp.ones(mask.shape, I32))
+    sim = sim.replace(tcp=tcp)
+    sim, buf, _ = _enqueue_seg(sim, buf, mask, slot, pf.TCPF_SYN,
+                            jnp.zeros(mask.shape, I32), 0, now)
+    return _arm_rtx(sim, buf, mask, slot, now)
+
+
+def tcp_listen(sim, mask, slot):
+    """Passive open on a bound socket (ref: host_listenForPeer)."""
+    tcp = _set(sim.tcp, "st", mask, slot,
+               jnp.full(mask.shape, TcpSt.LISTEN, I32))
+    return sim.replace(tcp=tcp)
+
+
+def tcp_accept(sim, mask, slot):
+    """Pop one established child from the listener's accept queue.
+    Returns (sim, got[H], child_slot[H])."""
+    tcp = sim.tcp
+    cnt = gather_hs(tcp.aq_count, slot)
+    head = gather_hs(tcp.aq_head, slot)
+    got = mask & (cnt > 0)
+    H, S = tcp.aq_head.shape
+    lane = jnp.arange(H)
+    sc = jnp.clip(slot, 0, S - 1)
+    child = tcp.aq[lane, sc, jnp.clip(head, 0, ACCEPT_QUEUE - 1)]
+    child = jnp.where(got, child, -1)
+    tcp = _set(tcp, "aq_head", got, slot, (head + 1) % ACCEPT_QUEUE)
+    tcp = _set(tcp, "aq_count", got, slot, cnt - 1)
+    # listener readable while children remain queued
+    drained = got & (cnt - 1 == 0)
+    flags = gather_hs(sim.net.sk_flags, slot)
+    net = sim.net.replace(
+        sk_flags=set_hs(sim.net.sk_flags, drained, slot,
+                        flags & ~SocketFlags.READABLE))
+    return sim.replace(net=net, tcp=tcp), got, child
+
+
+def tcp_send(cfg: NetConfig, sim, mask, slot, nbytes, now, buf):
+    """Append nbytes of stream data (ref: tcp_sendUserData,
+    tcp.c:2126-2190). Accepts up to the send-buffer limit; returns
+    (sim, buf, accepted[H] bytes)."""
+    tcp = sim.tcp
+    st = gather_hs(tcp.st, slot)
+    can = mask & ((st == TcpSt.ESTABLISHED) | (st == TcpSt.CLOSE_WAIT)
+                  | (st == TcpSt.SYN_SENT) | (st == TcpSt.SYN_RCVD))
+    una = gather_hs(tcp.snd_una, slot)
+    end = gather_hs(tcp.snd_end, slot)
+    sndbuf = gather_hs(sim.net.sk_sndbuf, slot)
+    room = jnp.maximum(sndbuf - (end - una), 0)
+    accepted = jnp.where(can, jnp.minimum(jnp.asarray(nbytes, I32), room), 0)
+    tcp = _set(tcp, "snd_end", can, slot, end + accepted)
+    sim = sim.replace(tcp=tcp)
+    sim, buf = tcp_flush(cfg, sim, mask, slot, now, buf)
+    return sim, buf, accepted
+
+
+def tcp_recv(sim, mask, slot, maxbytes, now, buf):
+    """Consume in-order received bytes (ref: tcp_receiveUserData,
+    tcp.c:2192-...). Returns (sim, buf, nread[H], eof[H]).
+
+    Window updates: an ACK is sent only when the read reopens a
+    *constrained* window (was < 2 MSS, grew by >= 1 MSS) — receiver
+    silly-window avoidance. A receiver that drains promptly never
+    sends gratuitous ACKs, which matters because a pure ACK with an
+    unchanged window is indistinguishable from a loss-signalling
+    duplicate ACK at the sender."""
+    tcp = sim.tcp
+    win_before = _adv_window(sim.net, tcp, slot)
+    avail = gather_hs(tcp.app_rbytes, slot)
+    nread = jnp.where(mask, jnp.minimum(jnp.asarray(maxbytes, I32), avail), 0)
+    tcp = _set(tcp, "app_rbytes", mask, slot, avail - nread)
+    eof = mask & gather_hs(tcp.fin_rcvd, slot) & (avail - nread == 0) & (
+        gather_hs(tcp.rcv_nxt, slot) > gather_hs(tcp.fin_rseq, slot))
+    drained = mask & (avail - nread == 0) & ~eof
+    flags = gather_hs(sim.net.sk_flags, slot)
+    net = sim.net.replace(
+        sk_flags=set_hs(sim.net.sk_flags, drained, slot,
+                        flags & ~SocketFlags.READABLE))
+    sim = sim.replace(net=net, tcp=tcp)
+    win_after = _adv_window(net, tcp, slot)
+    update = mask & (win_before < 2 * MSS) & (win_after - win_before >= MSS)
+    sim, buf, _ = _enqueue_seg(sim, buf, update, slot, pf.TCPF_ACK,
+                               gather_hs(tcp.snd_nxt, slot), 0, now)
+    return sim, buf, nread, eof
+
+
+def tcp_close(cfg: NetConfig, sim, mask, slot, now, buf):
+    """Active/passive close (ref: tcp_close, tcp.c:604-699): mark the
+    FIN pending; flush emits it once all data is out."""
+    tcp = sim.tcp
+    st = gather_hs(tcp.st, slot)
+    # buffered-but-unsent stream data exists iff snd_end advanced past
+    # the SYN (data seq space starts at 1)
+    has_data = gather_hs(tcp.snd_end, slot) > 1
+    to_finwait = mask & ((st == TcpSt.ESTABLISHED) | (st == TcpSt.SYN_RCVD))
+    to_lastack = mask & (st == TcpSt.CLOSE_WAIT)
+    # close during active open with data already submitted: defer —
+    # the FIN_WAIT_1 transition happens when the SYN|ACK establishes
+    deferred = mask & (st == TcpSt.SYN_SENT) & has_data
+    # closing a never-connected, listening, or empty-handshake socket
+    # frees it directly
+    direct = mask & ((st == TcpSt.CLOSED) | (st == TcpSt.LISTEN)
+                     | ((st == TcpSt.SYN_SENT) & ~has_data))
+    tcp = _set(tcp, "st", to_finwait, slot,
+               jnp.full(mask.shape, TcpSt.FIN_WAIT_1, I32))
+    tcp = _set(tcp, "st", to_lastack, slot,
+               jnp.full(mask.shape, TcpSt.LAST_ACK, I32))
+    tcp = _set(tcp, "fin_pending", to_finwait | to_lastack | deferred,
+               slot, True)
+    sim = sim.replace(tcp=tcp)
+    sim = _free_socket(sim, direct, slot)
+    return tcp_flush(cfg, sim, mask & ~direct, slot, now, buf)
+
+
+def _free_socket(sim, mask, slot):
+    """Release a socket slot for reuse (ref: descriptor close +
+    handle recycling, host.c:696-767)."""
+    net = sim.net
+    zero = jnp.zeros(mask.shape, I32)
+    net = net.replace(
+        sk_type=set_hs(net.sk_type, mask, slot, zero),
+        sk_flags=set_hs(net.sk_flags, mask, slot, zero),
+        sk_bound_ip=set_hs(net.sk_bound_ip, mask, slot,
+                           jnp.zeros(mask.shape, I64)),
+        sk_bound_port=set_hs(net.sk_bound_port, mask, slot, zero),
+        sk_peer_ip=set_hs(net.sk_peer_ip, mask, slot,
+                          jnp.zeros(mask.shape, I64)),
+        sk_peer_port=set_hs(net.sk_peer_port, mask, slot, zero),
+    )
+    tcp = sim.tcp
+    tcp = _set(tcp, "st", mask, slot, zero)
+    tcp = _set(tcp, "snd_una", mask, slot, zero)
+    tcp = _set(tcp, "snd_nxt", mask, slot, zero)
+    tcp = _set(tcp, "snd_max", mask, slot, zero)
+    tcp = _set(tcp, "snd_end", mask, slot, zero)
+    tcp = _set(tcp, "snd_wnd", mask, slot, jnp.full(mask.shape, MSS, I32))
+    tcp = _set(tcp, "fin_pending", mask, slot, False)
+    tcp = _set(tcp, "dup_acks", mask, slot, zero)
+    tcp = _set(tcp, "cwnd", mask, slot, jnp.full(mask.shape, INIT_CWND, I32))
+    tcp = _set(tcp, "ssthresh", mask, slot,
+               jnp.full(mask.shape, INIT_SSTHRESH, I32))
+    tcp = _set(tcp, "ca_acc", mask, slot, zero)
+    tcp = _set(tcp, "in_recovery", mask, slot, False)
+    tcp = _set(tcp, "sack_l", mask, slot, zero)
+    tcp = _set(tcp, "sack_r", mask, slot, zero)
+    tcp = _set(tcp, "rcv_nxt", mask, slot, zero)
+    tcp = _set(tcp, "app_rbytes", mask, slot, zero)
+    tcp = _set(tcp, "fin_rcvd", mask, slot, False)
+    tcp = _set(tcp, "ts_recent", mask, slot, zero)
+    tcp = _set(tcp, "srtt_ms", mask, slot, jnp.full(mask.shape, -1, I32))
+    tcp = _set(tcp, "rttvar_ms", mask, slot, zero)
+    tcp = _set(tcp, "rto_ms", mask, slot, jnp.full(mask.shape, RTO_INIT_MS, I32))
+    tcp = _set(tcp, "backoff", mask, slot, zero)
+    tcp = _disarm_rtx(tcp, mask, slot)
+    tcp = _set(tcp, "parent", mask, slot, jnp.full(mask.shape, -1, I32))
+    tcp = _set(tcp, "aq_head", mask, slot, zero)
+    tcp = _set(tcp, "aq_count", mask, slot, zero)
+    S = tcp.oo_l.shape[1]
+    sel = mask[:, None] & (jnp.arange(S)[None, :] == slot[:, None])
+    tcp = tcp.replace(
+        oo_l=jnp.where(sel[..., None], 0, tcp.oo_l),
+        oo_r=jnp.where(sel[..., None], 0, tcp.oo_r),
+    )
+    return sim.replace(net=net, tcp=tcp)
+
+
+# ---------------------------------------------------------------------
+# flush: packetize allowed stream bytes onto the output ring
+# (ref: _tcp_flush, tcp.c:1121-...)
+# ---------------------------------------------------------------------
+
+def tcp_flush(cfg: NetConfig, sim, mask, slot, now, buf):
+    for _ in range(FLUSH_SEGMENTS):
+        tcp = sim.tcp
+        st = gather_hs(tcp.st, slot)
+        can_data = mask & (
+            (st == TcpSt.ESTABLISHED) | (st == TcpSt.CLOSE_WAIT)
+            | (st == TcpSt.FIN_WAIT_1) | (st == TcpSt.LAST_ACK))
+        una = gather_hs(tcp.snd_una, slot)
+        nxt = gather_hs(tcp.snd_nxt, slot)
+        end = gather_hs(tcp.snd_end, slot)
+        cwnd_b = gather_hs(tcp.cwnd, slot) * MSS
+        wnd = jnp.minimum(cwnd_b, gather_hs(tcp.snd_wnd, slot))
+        usable = una + wnd - nxt
+        seg = jnp.minimum(jnp.minimum(end - nxt, MSS), usable)
+        do = can_data & (seg > 0)
+        sim, buf, sent = _enqueue_seg(sim, buf, do, slot, pf.TCPF_ACK, nxt,
+                                      seg, now)
+        tcp = _set(sim.tcp, "snd_nxt", sent, slot,
+                   nxt + jnp.where(sent, seg, 0))
+        tcp = _set(tcp, "snd_max", sent, slot,
+                   jnp.maximum(gather_hs(tcp.snd_max, slot),
+                               nxt + jnp.where(sent, seg, 0)))
+        sim = sim.replace(tcp=tcp)
+    # FIN rides once all data is packetized (FIN seq == snd_end)
+    tcp = sim.tcp
+    nxt = gather_hs(tcp.snd_nxt, slot)
+    end = gather_hs(tcp.snd_end, slot)
+    fin = mask & gather_hs(tcp.fin_pending, slot) & (nxt == end)
+    sim, buf, fsent = _enqueue_seg(sim, buf, fin,
+                                   slot, pf.TCPF_FIN | pf.TCPF_ACK,
+                                   nxt, 0, now)
+    tcp = sim.tcp
+    tcp = _set(tcp, "snd_nxt", fsent, slot, nxt + 1)
+    tcp = _set(tcp, "snd_max", fsent, slot,
+               jnp.maximum(gather_hs(tcp.snd_max, slot), nxt + 1))
+    sim = sim.replace(tcp=tcp)
+    # outstanding data must be covered by a retransmission deadline
+    tcp = sim.tcp
+    outstanding = mask & (gather_hs(tcp.snd_una, slot)
+                          < gather_hs(tcp.snd_nxt, slot))
+    need = outstanding & (gather_hs(tcp.rtx_expire, slot) == simtime.INVALID)
+    return _arm_rtx(sim, buf, need, slot, now)
+
+
+# ---------------------------------------------------------------------
+# segment regeneration for retransmission
+# ---------------------------------------------------------------------
+
+def _retransmit_one(cfg, sim, mask, slot, now, buf):
+    """Re-send the segment at snd_una (ref: _tcp_retransmitPacket).
+    SYN / SYN|ACK / FIN are regenerated from the state machine; data
+    segments from the [snd_una, snd_end) byte range."""
+    tcp = sim.tcp
+    st = gather_hs(tcp.st, slot)
+    una = gather_hs(tcp.snd_una, slot)
+    end = gather_hs(tcp.snd_end, slot)
+    fin_ever = gather_hs(tcp.fin_pending, slot) & (
+        gather_hs(tcp.snd_max, slot) == end + 1)
+
+    is_syn = mask & (una == 0) & (st == TcpSt.SYN_SENT)
+    is_synack = mask & (una == 0) & (st == TcpSt.SYN_RCVD)
+    is_fin = mask & ~is_syn & ~is_synack & fin_ever & (una == end)
+    is_data = mask & ~is_syn & ~is_synack & ~is_fin & (una < end)
+
+    sim, buf, _ = _enqueue_seg(sim, buf, is_syn, slot, pf.TCPF_SYN,
+                            jnp.zeros(mask.shape, I32), 0, now)
+    sim, buf, _ = _enqueue_seg(sim, buf, is_synack, slot,
+                            pf.TCPF_SYN | pf.TCPF_ACK,
+                            jnp.zeros(mask.shape, I32), 0, now)
+    sim, buf, _ = _enqueue_seg(sim, buf, is_fin, slot,
+                            pf.TCPF_FIN | pf.TCPF_ACK, una, 0, now)
+    seg = jnp.minimum(end - una, MSS)
+    sim, buf, _ = _enqueue_seg(sim, buf, is_data, slot, pf.TCPF_ACK, una, seg, now)
+    sent = is_syn | is_synack | is_fin | is_data
+    tcp = sim.tcp
+    tcp = tcp.replace(retx_segs=tcp.retx_segs + sent.astype(I64))
+    return sim.replace(tcp=tcp), buf, sent
+
+
+# ---------------------------------------------------------------------
+# inbound packet processing (ref: tcp_processPacket, tcp.c:1777-2100)
+# ---------------------------------------------------------------------
+
+def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
+                  now, buf):
+    """Process one inbound TCP segment per masked lane, already matched
+    to socket `slot` (child-specific association wins over the
+    listener)."""
+    tcp = sim.tcp
+    net = sim.net
+    H = mask.shape[0]
+    slot = jnp.asarray(slot, I32)
+
+    flags = pf.tcp_flags_of(words)
+    seq = words[:, pf.W_SEQ]
+    ack = words[:, pf.W_ACK]
+    length = words[:, pf.W_LEN]
+    peer_win = words[:, pf.W_WIN]
+    tsval = words[:, pf.W_TSVAL]
+    tsecho = words[:, pf.W_TSECHO]
+    sackl = words[:, pf.W_SACKL]
+    sackr = words[:, pf.W_SACKR]
+    f_syn = (flags & pf.TCPF_SYN) != 0
+    f_ack = (flags & pf.TCPF_ACK) != 0
+    f_fin = (flags & pf.TCPF_FIN) != 0
+    f_rst = (flags & pf.TCPF_RST) != 0
+    st = gather_hs(tcp.st, slot)
+
+    # ---- RST tears the connection down (ref: tcp.c RST handling) ----
+    rst = mask & f_rst & (st != TcpSt.CLOSED) & (st != TcpSt.LISTEN)
+    sim = sim.replace(tcp=tcp)
+    sim = _free_socket(sim, rst, slot)
+    tcp, net = sim.tcp, sim.net
+    mask = mask & ~rst
+    st = gather_hs(tcp.st, slot)
+
+    # ---- LISTEN + SYN: spawn a child in SYN_RCVD ---------------------
+    # (ref: server multiplexing, tcp.c:1822-1852)
+    syn_to_listen = mask & (st == TcpSt.LISTEN) & f_syn
+    from shadow_tpu.net.sockets import sk_create
+
+    net, child = sk_create(net, syn_to_listen, SocketType.TCP)
+    spawned = syn_to_listen & (child >= 0)
+    net = net.replace(
+        sk_bound_ip=set_hs(net.sk_bound_ip, spawned, child,
+                           gather_hs(net.sk_bound_ip, slot)),
+        sk_bound_port=set_hs(net.sk_bound_port, spawned, child,
+                             gather_hs(net.sk_bound_port, slot)),
+        sk_peer_ip=set_hs(net.sk_peer_ip, spawned, child, src_ip),
+        sk_peer_port=set_hs(net.sk_peer_port, spawned, child, src_port),
+    )
+    tcp = _set(tcp, "st", spawned, child,
+               jnp.full((H,), TcpSt.SYN_RCVD, I32))
+    tcp = _set(tcp, "rcv_nxt", spawned, child, seq + 1)
+    tcp = _set(tcp, "ts_recent", spawned, child, tsval)
+    tcp = _set(tcp, "snd_una", spawned, child, jnp.zeros((H,), I32))
+    tcp = _set(tcp, "snd_nxt", spawned, child, jnp.ones((H,), I32))
+    tcp = _set(tcp, "snd_max", spawned, child, jnp.ones((H,), I32))
+    tcp = _set(tcp, "snd_end", spawned, child, jnp.ones((H,), I32))
+    tcp = _set(tcp, "snd_wnd", spawned, child, jnp.maximum(peer_win, MSS))
+    tcp = _set(tcp, "parent", spawned, child, slot)
+    sim = sim.replace(net=net, tcp=tcp)
+    sim, buf, _ = _enqueue_seg(sim, buf, spawned, child,
+                            pf.TCPF_SYN | pf.TCPF_ACK,
+                            jnp.zeros((H,), I32), 0, now)
+    sim, buf = _arm_rtx(sim, buf, spawned, child, now)
+    tcp, net = sim.tcp, sim.net
+    # everything below operates on the matched socket only
+    mask = mask & ~syn_to_listen
+    st = gather_hs(tcp.st, slot)
+
+    # ---- repeat SYN to a SYN_RCVD child: re-offer SYN|ACK ------------
+    resyn = mask & (st == TcpSt.SYN_RCVD) & f_syn & ~f_ack
+    sim = sim.replace(net=net, tcp=tcp)
+    sim, buf, _ = _enqueue_seg(sim, buf, resyn, slot, pf.TCPF_SYN | pf.TCPF_ACK,
+                            jnp.zeros((H,), I32), 0, now)
+    tcp, net = sim.tcp, sim.net
+    mask = mask & ~resyn
+
+    # ---- SYN_SENT + SYN|ACK: complete active open --------------------
+    synack = mask & (st == TcpSt.SYN_SENT) & f_syn & f_ack & (ack == 1)
+    # a deferred close (tcp_close during the handshake) lands the
+    # connection straight in FIN_WAIT_1
+    est_st = jnp.where(gather_hs(tcp.fin_pending, slot),
+                       TcpSt.FIN_WAIT_1, TcpSt.ESTABLISHED).astype(I32)
+    tcp = _set(tcp, "st", synack, slot, est_st)
+    tcp = _set(tcp, "rcv_nxt", synack, slot, seq + 1)
+    tcp = _set(tcp, "snd_una", synack, slot, jnp.ones((H,), I32))
+    tcp = _set(tcp, "snd_wnd", synack, slot, jnp.maximum(peer_win, MSS))
+    tcp = _set(tcp, "ts_recent", synack, slot, tsval)
+    tcp = _set(tcp, "backoff", synack, slot, jnp.zeros((H,), I32))
+    tcp = _disarm_rtx(tcp, synack, slot)
+    fl = gather_hs(net.sk_flags, slot)
+    net = net.replace(sk_flags=set_hs(net.sk_flags, synack, slot,
+                                      fl | SocketFlags.WRITABLE))
+    sim = sim.replace(net=net, tcp=tcp)
+    # the handshake-completing ACK and any buffered data ride the
+    # merged flush + pure-ACK paths at the end of this function (one
+    # inlined copy instead of one per trigger — compile-time matters)
+    st = gather_hs(tcp.st, slot)
+
+    # ---- ts_recent update (in-window segments) -----------------------
+    inwin = mask & (seq <= gather_hs(tcp.rcv_nxt, slot))
+    tcp = _set(tcp, "ts_recent", inwin & (tsval >= gather_hs(tcp.ts_recent, slot)),
+               slot, tsval)
+
+    # ---- SYN_RCVD + final ACK: ESTABLISHED + accept queue ------------
+    est_child = mask & (st == TcpSt.SYN_RCVD) & f_ack & ~f_syn & (ack == 1)
+    tcp = _set(tcp, "st", est_child, slot,
+               jnp.full((H,), TcpSt.ESTABLISHED, I32))
+    tcp = _set(tcp, "snd_una", est_child, slot, jnp.ones((H,), I32))
+    tcp = _set(tcp, "backoff", est_child, slot, jnp.zeros((H,), I32))
+    tcp = _disarm_rtx(tcp, est_child, slot)
+    parent = gather_hs(tcp.parent, slot)
+    queue_ok = est_child & (parent >= 0) & (
+        gather_hs(tcp.aq_count, parent) < ACCEPT_QUEUE)
+    pos = (gather_hs(tcp.aq_head, parent)
+           + gather_hs(tcp.aq_count, parent)) % ACCEPT_QUEUE
+    tcp = tcp.replace(aq=set_ring(tcp.aq, queue_ok, parent, pos,
+                                  slot.astype(I32)))
+    tcp = _set(tcp, "aq_count", queue_ok, parent,
+               gather_hs(tcp.aq_count, parent) + 1)
+    pfl = gather_hs(net.sk_flags, parent)
+    net = net.replace(sk_flags=set_hs(net.sk_flags, queue_ok, parent,
+                                      pfl | SocketFlags.READABLE))
+    st = gather_hs(tcp.st, slot)
+
+    # ---- ACK processing (ref: tcp.c ACK path + tcp_cong_reno.c) ------
+    conn = mask & f_ack & (st >= TcpSt.ESTABLISHED)
+    una = gather_hs(tcp.snd_una, slot)
+    nxt = gather_hs(tcp.snd_nxt, slot)
+    wnd_prev = gather_hs(tcp.snd_wnd, slot)
+    tcp = _set(tcp, "snd_wnd", conn, slot, peer_win)
+    tcp = _set(tcp, "sack_l", conn & (sackr > sackl), slot, sackl)
+    tcp = _set(tcp, "sack_r", conn & (sackr > sackl), slot, sackr)
+
+    smax = gather_hs(tcp.snd_max, slot)
+    new_ack = conn & (ack > una) & (ack <= smax)
+    # an ACK above a rewound snd_nxt means those bytes arrived from the
+    # pre-rewind transmission: jump forward, nothing to resend below it
+    heal = new_ack & (ack > nxt)
+    tcp = _set(tcp, "snd_nxt", heal, slot, ack)
+    nxt = jnp.where(heal, ack, nxt)
+    # a true duplicate ACK carries no data, no SYN/FIN, AND no window
+    # update — window updates from a draining receiver must not feed
+    # the fast-retransmit counter (RFC 5681 §2 dup-ACK definition)
+    dup_ack = conn & (ack == una) & (una < nxt) & (length == 0) \
+        & ~f_syn & ~f_fin & (peer_win == wnd_prev)
+
+    # RTT sample (Karn-safe via timestamps, ref: tcp.c:991-1026)
+    rtt = jnp.maximum(_ms(now) - tsecho, 1)
+    srtt = gather_hs(tcp.srtt_ms, slot)
+    rttvar = gather_hs(tcp.rttvar_ms, slot)
+    first = new_ack & (srtt < 0)
+    srtt_n = jnp.where(first, rtt, srtt + (rtt - srtt) // 8)
+    rttvar_n = jnp.where(first, rtt // 2,
+                         (3 * rttvar + jnp.abs(srtt - rtt)) // 4)
+    rto_n = jnp.clip(srtt_n + jnp.maximum(4 * rttvar_n, 1),
+                     RTO_MIN_MS, RTO_MAX_MS)
+    tcp = _set(tcp, "srtt_ms", new_ack & (tsecho > 0), slot, srtt_n)
+    tcp = _set(tcp, "rttvar_ms", new_ack & (tsecho > 0), slot, rttvar_n)
+    tcp = _set(tcp, "rto_ms", new_ack & (tsecho > 0), slot, rto_n)
+    tcp = _set(tcp, "backoff", new_ack, slot, jnp.zeros((H,), I32))
+
+    # Reno new-ack (ref: tcp_cong_reno.c slow start / CA)
+    in_rec = gather_hs(tcp.in_recovery, slot)
+    recover = gather_hs(tcp.recover, slot)
+    cwnd = gather_hs(tcp.cwnd, slot)
+    ssth = gather_hs(tcp.ssthresh, slot)
+    ca = gather_hs(tcp.ca_acc, slot)
+
+    full_rec = new_ack & in_rec & (ack >= recover)
+    partial = new_ack & in_rec & (ack < recover)
+    normal = new_ack & ~in_rec
+
+    ss = normal & (cwnd < ssth)
+    cwnd1 = jnp.where(ss, cwnd + 1, cwnd)
+    ca1 = jnp.where(normal & ~ss, ca + 1, ca)
+    bump = normal & ~ss & (ca1 >= cwnd1)
+    cwnd1 = jnp.where(bump, cwnd1 + 1, cwnd1)
+    ca1 = jnp.where(bump, 0, ca1)
+    # leaving recovery deflates to ssthresh (ref: reno fast recovery)
+    cwnd1 = jnp.where(full_rec, ssth, cwnd1)
+    tcp = _set(tcp, "cwnd", new_ack, slot, cwnd1)
+    tcp = _set(tcp, "ca_acc", new_ack, slot, ca1)
+    tcp = _set(tcp, "in_recovery", full_rec, slot, False)
+    tcp = _set(tcp, "dup_acks", new_ack, slot, jnp.zeros((H,), I32))
+    tcp = _set(tcp, "snd_una", new_ack, slot, ack)
+
+    # dup-ack counting / fast retransmit (ref: reno dupack_ev)
+    da = gather_hs(tcp.dup_acks, slot) + 1
+    tcp = _set(tcp, "dup_acks", dup_ack, slot, da)
+    enter_fr = dup_ack & (da == 3) & ~in_rec
+    ssth_fr = jnp.maximum(cwnd // 2, 2)
+    tcp = _set(tcp, "ssthresh", enter_fr, slot, ssth_fr)
+    tcp = _set(tcp, "cwnd", enter_fr, slot, ssth_fr + 3)
+    tcp = _set(tcp, "in_recovery", enter_fr, slot, True)
+    tcp = _set(tcp, "recover", enter_fr, slot, nxt)
+    # window inflation while in recovery
+    inflate = dup_ack & in_rec
+    tcp = _set(tcp, "cwnd", inflate, slot, gather_hs(tcp.cwnd, slot) + 1)
+
+    sim = sim.replace(net=net, tcp=tcp)
+    sim, buf, _ = _retransmit_one(cfg, sim, enter_fr | partial, slot, now, buf)
+    tcp = sim.tcp
+
+    # re-arm / disarm the RTO deadline after progress
+    still_out = new_ack & (ack < smax)
+    done = new_ack & (ack >= smax)
+    rto_ns = gather_hs(tcp.rto_ms, slot).astype(I64) * simtime.ONE_MILLISECOND
+    tcp = _set(tcp, "rtx_expire", still_out, slot, now + rto_ns)
+    tcp = _disarm_rtx(tcp, done, slot)
+    sim = sim.replace(tcp=tcp)
+
+    # window may have opened (new_ack) or the connection just
+    # established with buffered data (synack): push more data
+    sim, buf = tcp_flush(cfg, sim, new_ack | synack, slot, now, buf)
+    tcp, net = sim.tcp, sim.net
+    st = gather_hs(tcp.st, slot)
+
+    # ---- ACK of our FIN: teardown transitions ------------------------
+    smax2 = gather_hs(tcp.snd_max, slot)
+    fin_ever = gather_hs(tcp.fin_pending, slot) & (
+        smax2 == gather_hs(tcp.snd_end, slot) + 1)
+    fin_acked = mask & f_ack & fin_ever & (ack == smax2)
+    tcp = _set(tcp, "st", fin_acked & (st == TcpSt.FIN_WAIT_1), slot,
+               jnp.full((H,), TcpSt.FIN_WAIT_2, I32))
+    tcp = _set(tcp, "st", fin_acked & (st == TcpSt.CLOSING), slot,
+               jnp.full((H,), TcpSt.TIME_WAIT, I32))
+    closed_now = fin_acked & (st == TcpSt.LAST_ACK)
+    sim = sim.replace(net=net, tcp=tcp)
+    sim = _free_socket(sim, closed_now, slot)
+    tcp, net = sim.tcp, sim.net
+    # TIME_WAIT entered via CLOSING: arm the 60 s reaper
+    tw1 = fin_acked & (st == TcpSt.CLOSING)
+    w = jnp.zeros((H, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    buf = emit(buf, tw1, net.lane_id, now + TIMEWAIT_NS,
+               EventKind.TCP_CLOSE_TIMER, w)
+    st = gather_hs(tcp.st, slot)
+
+    # ---- inbound data (ref: tcp.c data path + unordered input) -------
+    has_data = mask & (length > 0) & (
+        (st == TcpSt.ESTABLISHED) | (st == TcpSt.FIN_WAIT_1)
+        | (st == TcpSt.FIN_WAIT_2))
+    rcv_nxt = gather_hs(tcp.rcv_nxt, slot)
+    seg_end = seq + length
+    old = has_data & (seg_end <= rcv_nxt)
+    fresh = has_data & ~old
+
+    # receive-buffer guard: drop segments that cannot be stored
+    oo_bytes = jnp.sum(tcp.oo_r - tcp.oo_l, axis=2, dtype=I32)
+    freeb = gather_hs(net.sk_rcvbuf, slot) - gather_hs(tcp.app_rbytes, slot) \
+        - gather_hs(oo_bytes, slot)
+    fits = fresh & (length <= freeb)
+    tcp = tcp.replace(drop_rwin=tcp.drop_rwin + (fresh & ~fits).astype(I64))
+
+    inorder = fits & (seq <= rcv_nxt)
+    adv = jnp.where(inorder, seg_end - rcv_nxt, 0)
+    rcv1 = rcv_nxt + adv
+    rbytes = gather_hs(tcp.app_rbytes, slot) + adv
+    # merge any reassembly range now contiguous (unrolled bounded scan)
+    lane = jnp.arange(H)
+    S = tcp.oo_l.shape[1]
+    sc = jnp.clip(slot, 0, S - 1)
+    for _ in range(OO_RANGES):
+        ool = tcp.oo_l[lane, sc]      # [H, NR]
+        oor = tcp.oo_r[lane, sc]
+        hit = (ool <= rcv1[:, None]) & (oor > ool)     # contiguous/overlap
+        take = jnp.any(hit & inorder[:, None], axis=1)
+        pick = jnp.argmax(hit, axis=1)
+        new_r = oor[lane, pick]
+        gain = jnp.where(take & (new_r > rcv1), new_r - rcv1, 0)
+        rcv1 = rcv1 + gain
+        rbytes = rbytes + gain
+        # clear consumed range
+        tcp = tcp.replace(
+            oo_l=set_ring(tcp.oo_l, take & inorder, slot, pick, 0),
+            oo_r=set_ring(tcp.oo_r, take & inorder, slot, pick, 0),
+        )
+    tcp = _set(tcp, "rcv_nxt", inorder, slot, rcv1)
+    tcp = _set(tcp, "app_rbytes", inorder, slot, rbytes)
+
+    # out-of-order: park [seq, seg_end) in a reassembly range
+    ooseg = fits & (seq > rcv_nxt)
+    ool = tcp.oo_l[lane, sc]
+    oor = tcp.oo_r[lane, sc]
+    overlap = (seq[:, None] <= oor) & (seg_end[:, None] >= ool) & (oor > ool)
+    mergeable = jnp.any(overlap, axis=1)
+    mpick = jnp.argmax(overlap, axis=1)
+    empty_rng = oor <= ool
+    has_empty = jnp.any(empty_rng, axis=1)
+    epick = jnp.argmax(empty_rng, axis=1)
+    do_merge = ooseg & mergeable
+    do_new = ooseg & ~mergeable & has_empty
+    dropped_oo = ooseg & ~mergeable & ~has_empty
+    tcp = tcp.replace(drop_oo_full=tcp.drop_oo_full + dropped_oo.astype(I64))
+    pick = jnp.where(do_merge, mpick, epick)
+    nl = jnp.where(do_merge, jnp.minimum(ool[lane, pick], seq), seq)
+    nr = jnp.where(do_merge, jnp.maximum(oor[lane, pick], seg_end), seg_end)
+    tcp = tcp.replace(
+        oo_l=set_ring(tcp.oo_l, do_merge | do_new, slot, pick, nl),
+        oo_r=set_ring(tcp.oo_r, do_merge | do_new, slot, pick, nr),
+    )
+
+    # readable status for the app (epoll analog)
+    readable = inorder & (gather_hs(tcp.app_rbytes, slot) > 0)
+    fl = gather_hs(net.sk_flags, slot)
+    net = net.replace(sk_flags=set_hs(net.sk_flags, readable, slot,
+                                      fl | SocketFlags.READABLE))
+
+    # ---- peer FIN (ref: tcp.c FIN processing) ------------------------
+    fin_seen = mask & f_fin & (st >= TcpSt.ESTABLISHED) & (
+        st != TcpSt.TIME_WAIT)
+    tcp = _set(tcp, "fin_rcvd", fin_seen, slot, True)
+    tcp = _set(tcp, "fin_rseq", fin_seen, slot, seg_end)
+    # consume the FIN only when all data before it has arrived
+    rn = gather_hs(tcp.rcv_nxt, slot)
+    fin_now = mask & gather_hs(tcp.fin_rcvd, slot) & (
+        rn == gather_hs(tcp.fin_rseq, slot)) & (
+        st != TcpSt.TIME_WAIT) & (st >= TcpSt.ESTABLISHED)
+    tcp = _set(tcp, "rcv_nxt", fin_now, slot, rn + 1)
+    to_close_wait = fin_now & (st == TcpSt.ESTABLISHED)
+    to_closing = fin_now & (st == TcpSt.FIN_WAIT_1)
+    to_timewait = fin_now & (st == TcpSt.FIN_WAIT_2)
+    tcp = _set(tcp, "st", to_close_wait, slot,
+               jnp.full((H,), TcpSt.CLOSE_WAIT, I32))
+    tcp = _set(tcp, "st", to_closing, slot, jnp.full((H,), TcpSt.CLOSING, I32))
+    tcp = _set(tcp, "st", to_timewait, slot,
+               jnp.full((H,), TcpSt.TIME_WAIT, I32))
+    buf = emit(buf, to_timewait, net.lane_id, now + TIMEWAIT_NS,
+               EventKind.TCP_CLOSE_TIMER, w)
+    # EOF is app-visible readability (recv returns 0)
+    fl = gather_hs(net.sk_flags, slot)
+    net = net.replace(sk_flags=set_hs(net.sk_flags, fin_now, slot,
+                                      fl | SocketFlags.READABLE))
+
+    # ---- ACK generation ----------------------------------------------
+    # every data/FIN segment is acknowledged immediately (the
+    # reference's quick-ACK path; delayed ACKs are a tuning TODO).
+    # synack lanes send the handshake-completing ACK here.
+    send_ack = (has_data | fin_now | old | synack) & (st != TcpSt.CLOSED)
+    sim = sim.replace(net=net, tcp=tcp)
+    sim, buf, _ = _enqueue_seg(sim, buf, send_ack, slot, pf.TCPF_ACK,
+                            gather_hs(tcp.snd_nxt, slot), 0, now)
+    return sim, buf
+
+
+# ---------------------------------------------------------------------
+# timer event handlers
+# ---------------------------------------------------------------------
+
+def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
+    """kind=TCP_RTX_TIMER (ref: retransmit timer + exponential backoff,
+    tcp.c:1280-...). The single in-flight event per socket re-arms
+    itself while the deadline keeps moving."""
+    if sim.tcp is None:
+        return sim, buf
+    mask = popped.valid & (popped.kind == EventKind.TCP_RTX_TIMER)
+    slot = popped.word(0)
+    now = popped.time
+    tcp = sim.tcp
+    H = mask.shape[0]
+
+    deadline = gather_hs(tcp.rtx_expire, slot)
+    disarmed = mask & (deadline == simtime.INVALID)
+    pending = mask & ~disarmed & (now < deadline)
+    due = mask & ~disarmed & ~pending
+
+    # the in-flight event dies unless re-emitted
+    tcp = _set(tcp, "rtx_event", disarmed, slot, False)
+    w = jnp.zeros((H, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    buf = emit(buf, pending, sim.net.lane_id, deadline,
+               EventKind.TCP_RTX_TIMER, w)
+
+    # timeout: collapse to slow start and go back to snd_una
+    # (ref: reno timeout_ev + _tcp_retransmitTimerExpired)
+    una = gather_hs(tcp.snd_una, slot)
+    nxt = gather_hs(tcp.snd_nxt, slot)
+    live = due & (una < nxt)
+    cwnd = gather_hs(tcp.cwnd, slot)
+    tcp = _set(tcp, "ssthresh", live, slot, jnp.maximum(cwnd // 2, 2))
+    tcp = _set(tcp, "cwnd", live, slot, jnp.ones((H,), I32))
+    tcp = _set(tcp, "ca_acc", live, slot, jnp.zeros((H,), I32))
+    tcp = _set(tcp, "in_recovery", live, slot, False)
+    tcp = _set(tcp, "dup_acks", live, slot, jnp.zeros((H,), I32))
+    tcp = _set(tcp, "backoff", live, slot,
+               jnp.minimum(gather_hs(tcp.backoff, slot) + 1, MAX_BACKOFF))
+    tcp = _set(tcp, "rtx_event", due, slot, False)
+    tcp = _disarm_rtx(tcp, due, slot)
+    sim = sim.replace(tcp=tcp)
+    sim, buf, _ = _retransmit_one(cfg, sim, live, slot, now, buf)
+    # go-back-N: snd_nxt rewinds to just past the retransmitted
+    # segment; later ACK arrivals flush the rest of the range again.
+    tcp = sim.tcp
+    end = gather_hs(tcp.snd_end, slot)
+    fin_ever = gather_hs(tcp.fin_pending, slot) & (
+        gather_hs(tcp.snd_max, slot) == end + 1)
+    is_ctl = (una == 0) | (fin_ever & (una == end))
+    resent_end = jnp.where(is_ctl, una + 1,
+                           una + jnp.minimum(end - una, MSS))
+    rewind = live & (resent_end < nxt)
+    tcp = _set(tcp, "snd_nxt", rewind, slot, resent_end)
+    sim = sim.replace(tcp=tcp)
+    sim, buf = _arm_rtx(sim, buf, live, slot, now)
+    return sim, buf
+
+
+def handle_tcp_close(cfg: NetConfig, sim, popped, buf):
+    """kind=TCP_CLOSE_TIMER: the TIME_WAIT reaper (ref: 60 s close
+    timer, tcp.c:604-699)."""
+    if sim.tcp is None:
+        return sim, buf
+    mask = popped.valid & (popped.kind == EventKind.TCP_CLOSE_TIMER)
+    slot = popped.word(0)
+    st = gather_hs(sim.tcp.st, slot)
+    reap = mask & (st == TcpSt.TIME_WAIT)
+    return _free_socket(sim, reap, slot), buf

@@ -19,6 +19,7 @@ from shadow_tpu.net.rings import (
     ring_push_at,
     ring_peek_at,
     set_hs,
+    set_ring,
 )
 from shadow_tpu.net.sockets import sk_enqueue_out
 from shadow_tpu.net.state import NetState, SocketFlags
@@ -51,8 +52,6 @@ def udp_enqueue_send(net: NetState, mask, slot, dst_ip, dst_port, length, payref
 def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
     """Push one received datagram into (lane, slot)'s input ring; drop
     (counted) when the receive buffer is full. Returns net."""
-    H = mask.shape[0]
-    lane = jnp.arange(H)
     length = jnp.asarray(length, I32)
     BI = net.in_src_ip.shape[2]
 
@@ -60,15 +59,14 @@ def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
         net.sk_rcvbuf, slot
     )
     ok, pos = ring_push_at(net.in_head, net.in_count, BI, mask & space_ok, slot)
-    s = jnp.where(ok, slot, net.in_src_ip.shape[1])
     net = net.replace(
-        in_src_ip=net.in_src_ip.at[lane, s, pos].set(
-            jnp.asarray(src_ip, net.in_src_ip.dtype), mode="drop"),
-        in_src_port=net.in_src_port.at[lane, s, pos].set(
-            jnp.asarray(src_port, I32), mode="drop"),
-        in_len=net.in_len.at[lane, s, pos].set(length, mode="drop"),
-        in_payref=net.in_payref.at[lane, s, pos].set(
-            jnp.asarray(payref, I32), mode="drop"),
+        in_src_ip=set_ring(net.in_src_ip, ok, slot, pos,
+                           jnp.asarray(src_ip, net.in_src_ip.dtype)),
+        in_src_port=set_ring(net.in_src_port, ok, slot, pos,
+                             jnp.asarray(src_port, I32)),
+        in_len=set_ring(net.in_len, ok, slot, pos, length),
+        in_payref=set_ring(net.in_payref, ok, slot, pos,
+                           jnp.asarray(payref, I32)),
     )
     _, count = ring_advance_push(net.in_head, net.in_count, mask, slot, ok)
     net = net.replace(in_count=count)

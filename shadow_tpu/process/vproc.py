@@ -1,0 +1,448 @@
+"""Virtual processes: host-side Python coroutines against a
+simulated-syscall surface.
+
+This is the TPU-native replacement for the reference's L5 — the
+interposition stack that loads real ELF binaries into linker
+namespaces, interposes their libc calls, and runs them on cooperative
+green threads (ref: process.c:1055-1195, interposer.c:37-170,
+src/external/rpth). A TPU cannot dlmopen a Linux binary, so
+applications are written as Python generator coroutines that *yield
+syscalls* — the same contract as the ~400 process_emu_* entry points
+(ref: process.h:103-437) with the same blocking semantics: a blocking
+call suspends the coroutine (the rpth green-thread block,
+pth_high.c) until the simulated kernel marks it runnable again
+(the epoll notify -> process_continue chain, epoll.c:638-680,
+process.c:1197-1275).
+
+Scheduling granularity — an explicit deviation from the reference:
+coroutines are resumed at conservative-window boundaries, not at
+individual events. The device drains a whole window, the runtime
+fetches readiness state once, and every runnable coroutine advances
+until it blocks (the analog of `pth_yield` until all threads block,
+process.c:1227-1229). Syscall effects are applied at the next window
+start time. This batching is what makes host<->device traffic feasible
+(SURVEY.md §7.4.4); latency-critical apps should be written as
+on-device handler models instead (apps/pingpong, apps/bulk,
+apps/phold).
+
+Determinism: coroutines resume in host-id order, syscalls apply in
+resume order, and window boundaries are deterministic — so runs are
+exactly reproducible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable, Generator, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import EngineStats, step_window
+from shadow_tpu.core.events import EmitBuffer, apply_emissions
+from shadow_tpu.net import tcp as tcpmod
+from shadow_tpu.net import udp as udpmod
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
+from shadow_tpu.net.step import make_step_fn
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+# ---------------------------------------------------------------------
+# syscall surface (the process_emu_* contract, ref: process.h:103-437)
+# ---------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Sys:
+    """One yielded syscall. Coroutines receive the result as the value
+    of the `yield` expression."""
+
+    op: str
+    args: tuple = ()
+
+
+def socket(stype=SocketType.UDP):
+    return Sys("socket", (stype,))
+
+
+def bind(fd, port):
+    return Sys("bind", (fd, port))
+
+
+def listen(fd):
+    return Sys("listen", (fd,))
+
+
+def connect(fd, ip, port):
+    """TCP active open; blocks until ESTABLISHED (or reset -> -1)."""
+    return Sys("connect", (fd, ip, port))
+
+
+def accept(fd):
+    """Blocks until a child is queued; returns the child fd."""
+    return Sys("accept", (fd,))
+
+
+def send(fd, nbytes):
+    """TCP stream send; blocks until >0 bytes are accepted, returns
+    that count (partial sends happen when the send buffer is near
+    full)."""
+    return Sys("send", (fd, nbytes))
+
+
+def sendto(fd, ip, port, nbytes):
+    """UDP datagram send; non-blocking, returns True if queued."""
+    return Sys("sendto", (fd, ip, port, nbytes))
+
+
+def recv(fd, maxbytes=1 << 30):
+    """Blocks until data (returns byte count) or EOF (returns 0)."""
+    return Sys("recv", (fd, maxbytes))
+
+
+def recvfrom(fd):
+    """UDP receive; blocks until a datagram arrives, returns
+    (src_ip, src_port, nbytes)."""
+    return Sys("recvfrom", (fd,))
+
+
+def close(fd):
+    return Sys("close", (fd,))
+
+
+def sleep(ns):
+    """nanosleep (ref: process_emu_nanosleep -> pth_nanosleep,
+    process.c:3141-3148); wakes at the first window boundary >= the
+    deadline."""
+    return Sys("sleep", (ns,))
+
+
+def gettime():
+    """gettimeofday/clock_gettime analog: the current sim time in ns
+    (ref: worker_getEmulatedTime, worker.c:385-390)."""
+    return Sys("gettime", ())
+
+
+def wait_readable(fds):
+    """epoll_wait analog over this process's fds: blocks until one is
+    readable, returns the list of readable fds (ref: epoll.c
+    readiness engine)."""
+    return Sys("wait_readable", (tuple(fds),))
+
+
+# ---------------------------------------------------------------------
+# runtime
+# ---------------------------------------------------------------------
+
+ProcFn = Callable[..., Generator]  # called as proc_fn(host_id) -> generator
+
+
+@dataclass
+class _Proc:
+    host: int
+    gen: Generator
+    start_time: int = 0
+    started: bool = False
+    done: bool = False
+    # blocking state
+    block: Optional[Sys] = None
+    pending: Optional[Sys] = None  # next syscall to execute
+    wake_time: int = -1            # for sleep
+
+
+class ProcessRuntime:
+    """Runs virtual processes over a SimBundle (the master/slave loop
+    of the reference, slave.c:413-466, with coroutine continuation in
+    place of pth scheduling)."""
+
+    def __init__(self, bundle, app_handlers=()):
+        self.bundle = bundle
+        self.cfg: NetConfig = bundle.cfg
+        self.sim = bundle.sim
+        self.procs: list[_Proc] = []
+        self._step = make_step_fn(self.cfg, app_handlers)
+        self._jit_window = jax.jit(self._window)
+
+    # -- process registration -----------------------------------------
+
+    def spawn(self, host: int, proc_fn: ProcFn, start_time: int = 0):
+        """Register proc_fn(host) to start at sim time start_time
+        (ref: <process starttime>, configuration.h:96-101)."""
+        self.procs.append(_Proc(host=host, gen=proc_fn(host),
+                                start_time=start_time))
+
+    # -- device side ----------------------------------------------------
+
+    def _window(self, sim, wstart, wend):
+        stats = EngineStats.create()
+        sim, stats, next_min = step_window(
+            sim, stats, self._step, wend,
+            emit_capacity=self.cfg.emit_capacity,
+            lane_id=sim.net.lane_id,
+        )
+        return sim, stats, next_min
+
+    # -- syscall execution ---------------------------------------------
+
+    def _lane(self, host):
+        m = np.zeros(self.cfg.num_hosts, bool)
+        m[host] = True
+        return jnp.asarray(m)
+
+    def _apply(self, fn):
+        """Run a state-op that may emit events, then fold the emissions
+        into the queues exactly like a device micro-step does."""
+        buf = EmitBuffer.create(self.cfg.num_hosts, self.cfg.emit_capacity)
+        sim, buf = fn(self.sim, buf)
+        q, out = apply_emissions(sim.events, sim.outbox, buf,
+                                 sim.net.lane_id)
+        self.sim = sim.replace(events=q, outbox=out)
+
+    def _exec(self, p: _Proc, call: Sys, now: int):
+        """Execute one non-blocking syscall (or the completion of a
+        blocking one). Blocking decisions come from the live device
+        state / the op's own result — never from a snapshot, which
+        would go stale the moment an earlier syscall in the same pass
+        mutated state. Returns (ready, result)."""
+        h = p.host
+        mask = self._lane(h)
+        op, a = call.op, call.args
+
+        if op == "socket":
+            net, slot = sk_create(self.sim.net, mask, a[0])
+            self.sim = self.sim.replace(net=net)
+            return True, int(slot[h])
+        if op == "bind":
+            net, port = sk_bind(self.sim.net, mask, jnp.full_like(mask, a[0], I32),
+                                0, a[1])
+            self.sim = self.sim.replace(net=net)
+            return True, int(port[h])
+        if op == "listen":
+            self.sim = tcpmod.tcp_listen(self.sim, mask,
+                                         jnp.full_like(mask, a[0], I32))
+            return True, 0
+        if op == "gettime":
+            return True, now
+        if op == "sendto":
+            fd, ip, port, n = a
+            ok = None
+
+            def do(sim, buf):
+                nonlocal ok
+                net, okk = udpmod.udp_enqueue_send(
+                    sim.net, mask, jnp.full_like(mask, fd, I32), ip, port, n, -1)
+                ok = okk
+                from shadow_tpu.net import nic
+                return nic.notify_wants_send(sim.replace(net=net), buf, okk, now)
+
+            self._apply(do)
+            return True, bool(ok[h])
+        if op == "connect":
+            fd, ip, port = a
+            st = int(self.sim.tcp.st[h, fd])
+            if p.block is None:
+                # issue the SYN, then block until established
+                self._apply(lambda sim, buf: tcpmod.tcp_connect(
+                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
+                    ip, port, now, buf))
+                return False, None
+            if st == tcpmod.TcpSt.ESTABLISHED or st >= tcpmod.TcpSt.FIN_WAIT_1:
+                return True, 0
+            if st == tcpmod.TcpSt.CLOSED:
+                return True, -1       # connection refused/reset
+            return False, None
+        if op == "accept":
+            fd = a[0]
+            child = None
+
+            def do(sim, buf):
+                nonlocal child
+                sim, got, ch = tcpmod.tcp_accept(
+                    sim, mask, jnp.full_like(mask, fd, I32))
+                child = int(ch[h])
+                return sim, buf
+
+            self._apply(do)
+            if child is not None and child >= 0:
+                return True, child
+            return False, None
+        if op == "send":
+            fd, n = a
+            acc = None
+
+            def do(sim, buf):
+                nonlocal acc
+                sim, buf, accepted = tcpmod.tcp_send(
+                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
+                    n, now, buf)
+                acc = int(accepted[h])
+                return sim, buf
+
+            self._apply(do)
+            if acc and acc > 0:
+                return True, acc
+            return False, None
+        if op == "recv":
+            fd, maxb = a
+            is_tcp = self.sim.tcp is not None and (
+                int(self.sim.net.sk_type[h, fd]) == SocketType.TCP
+                or int(self.sim.tcp.st[h, fd]) != 0)
+            if is_tcp:
+                nread = eof = None
+
+                def do(sim, buf):
+                    nonlocal nread, eof
+                    sim, buf, nr, ef = tcpmod.tcp_recv(
+                        sim, mask, jnp.full_like(mask, fd, I32),
+                        maxb, now, buf)
+                    nread, eof = int(nr[h]), bool(ef[h])
+                    return sim, buf
+
+                self._apply(do)
+                if nread and nread > 0:
+                    return True, nread
+                if eof:
+                    return True, 0     # EOF
+                return False, None
+            # UDP fd: byte-count of one datagram
+            res = None
+            got_any = False
+
+            def do(sim, buf):
+                nonlocal res, got_any
+                net, got, sip, spt, ln, _ = udpmod.udp_recv(
+                    sim.net, mask, jnp.full_like(mask, fd, I32))
+                res, got_any = int(ln[h]), bool(got[h])
+                return sim.replace(net=net), buf
+
+            self._apply(do)
+            if got_any:
+                return True, res
+            return False, None
+        if op == "recvfrom":
+            fd = a[0]
+            res = None
+            got_any = False
+
+            def do(sim, buf):
+                nonlocal res, got_any
+                net, got, sip, spt, ln, _ = udpmod.udp_recv(
+                    sim.net, mask, jnp.full_like(mask, fd, I32))
+                res = (int(sip[h]), int(spt[h]), int(ln[h]))
+                got_any = bool(got[h])
+                return sim.replace(net=net), buf
+
+            self._apply(do)
+            if got_any:
+                return True, res
+            return False, None
+        if op == "close":
+            fd = a[0]
+            if int(self.sim.net.sk_type[h, fd]) == SocketType.TCP:
+                self._apply(lambda sim, buf: tcpmod.tcp_close(
+                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
+                    now, buf))
+            else:
+                net = self.sim.net
+                sel = self._lane(h)
+                from shadow_tpu.net.rings import set_hs
+
+                slot = jnp.full_like(mask, fd, I32)
+                net = net.replace(
+                    sk_type=set_hs(net.sk_type, sel, slot,
+                                   jnp.zeros_like(slot)),
+                    sk_flags=set_hs(net.sk_flags, sel, slot,
+                                    jnp.zeros_like(slot)),
+                    sk_bound_port=set_hs(net.sk_bound_port, sel, slot,
+                                         jnp.zeros_like(slot)),
+                )
+                self.sim = self.sim.replace(net=net)
+            return True, 0
+        if op == "sleep":
+            if p.block is None:
+                p.wake_time = now + int(a[0])
+                return False, None
+            if now >= p.wake_time:
+                return True, 0
+            return False, None
+        if op == "wait_readable":
+            fds = a[0]
+            flags = np.asarray(self.sim.net.sk_flags[h])
+            ready = [fd for fd in fds
+                     if (int(flags[fd]) & SocketFlags.READABLE)]
+            if ready:
+                return True, ready
+            return False, None
+        raise ValueError(f"unknown syscall {op}")
+
+    # -- scheduler ------------------------------------------------------
+
+    def _resume_all(self, now: int) -> None:
+        """Advance every runnable coroutine until all are blocked
+        (the pth_yield loop, process.c:1227-1229). Processes run in
+        spawn order — deterministic."""
+        for p in self.procs:
+            if p.done or now < p.start_time:
+                continue
+            if not p.started:
+                p.started = True
+                try:
+                    p.pending = next(p.gen)
+                except StopIteration:
+                    p.done = True
+                    continue
+                p.block = None
+            # run until this process blocks
+            while True:
+                call = getattr(p, "pending", None)
+                if call is None:
+                    break
+                ready, result = self._exec(p, call, now)
+                if not ready:
+                    p.block = call
+                    break
+                p.block = None
+                try:
+                    p.pending = p.gen.send(result)
+                except StopIteration:
+                    p.done = True
+                    p.pending = None
+                    break
+
+    def run(self, end_time: int | None = None):
+        """The master window loop (ref: master.c:450-480 +
+        slave.c:413-466) with coroutine continuation between windows."""
+        end = end_time if end_time is not None else self.cfg.end_time
+        min_jump = max(int(self.bundle.min_jump), 1)
+
+        total = EngineStats.create()
+        now = 0
+        while now <= end:
+            self._resume_all(now)
+
+            # next window start: earliest of device events, sleep
+            # deadlines, and not-yet-started process start times
+            cands = [int(jnp.min(self.sim.events.min_time()))]
+            cands += [p.wake_time for p in self.procs
+                      if not p.done and p.block is not None
+                      and p.block.op == "sleep"]
+            cands += [p.start_time for p in self.procs
+                      if not p.done and not p.started]
+            wstart = min(c for c in cands if c >= 0)
+            if wstart > end or wstart >= simtime.INVALID:
+                break
+            wend = min(wstart + min_jump, end + 1)
+            self.sim, stats, next_min = self._jit_window(
+                self.sim, wstart, wend)
+            total = EngineStats(
+                events_processed=total.events_processed
+                + stats.events_processed,
+                micro_steps=total.micro_steps + stats.micro_steps,
+                windows=total.windows + 1,
+            )
+            now = int(wend)
+        return self.sim, total

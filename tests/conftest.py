@@ -8,10 +8,19 @@ device use) takes effect. TPU coverage comes from examples/ and
 bench.py.
 """
 
+import pathlib
+
 import jax
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)
+# The full device program is large (the whole netstack + TCP state
+# machine inlined into one while-loop body); persist compiled binaries
+# so the multi-minute XLA compile is paid once per (shape, code)
+# rather than once per pytest invocation.
+_cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+jax.config.update("jax_compilation_cache_dir", str(_cache))
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()

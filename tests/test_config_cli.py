@@ -1,0 +1,100 @@
+"""Config-system tests: the reference's own phold XML parses and runs
+(format compatibility with configuration.c), the builtin example
+works, CLI flags parse, and the logger sorts by sim time."""
+
+import io
+import json
+
+from shadow_tpu.cli import make_parser
+from shadow_tpu.config.examples import example_config
+from shadow_tpu.config.loader import load
+from shadow_tpu.config.xmlconfig import kv_arguments, parse_config
+from shadow_tpu.utils.shadowlog import LogLevel, SimLogger
+
+REFERENCE_PHOLD_XML = """<shadow>
+  <topology><![CDATA[<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
+  <key attr.name="latency" attr.type="double" for="edge" id="d3" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="d2" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="d1" />
+  <key attr.name="countrycode" attr.type="string" for="node" id="d0" />
+  <graph edgedefault="undirected">
+    <node id="poi-1">
+      <data key="d0">US</data>
+      <data key="d1">10240</data>
+      <data key="d2">10240</data>
+    </node>
+    <edge source="poi-1" target="poi-1">
+      <data key="d3">50.0</data>
+      <data key="d4">0.0</data>
+    </edge>
+  </graph>
+</graphml>
+]]></topology>
+  <kill time="3"/>
+  <plugin id="testphold" path="shadow-plugin-test-phold"/>
+  <node id="peer" quantity="10">
+    <application plugin="testphold" starttime="1"
+      arguments="loglevel=info basename=peer quantity=10 load=25 weightsfilepath=weights.txt"/>
+  </node>
+</shadow>"""
+
+
+def test_parse_reference_phold_config():
+    cfg = parse_config(REFERENCE_PHOLD_XML)
+    assert cfg.stoptime == 3_000_000_000
+    assert "testphold" in cfg.plugins
+    assert cfg.plugins["testphold"].path == "shadow-plugin-test-phold"
+    names = [n for n, _ in cfg.expanded_hosts()]
+    assert len(names) == 10
+    assert names[0] == "peer" and names[1] == "peer2"
+    (name, he) = next(iter(cfg.expanded_hosts()))
+    assert he.processes[0].starttime == 1_000_000_000
+    kv = kv_arguments(he.processes[0].arguments)
+    assert kv["load"] == "25"
+
+
+def test_load_and_run_reference_phold():
+    cfg = parse_config(REFERENCE_PHOLD_XML)
+    loaded = load(cfg, seed=3)
+    from shadow_tpu.net.build import run
+
+    sim, stats = run(loaded.bundle, app_handlers=loaded.handlers)
+    # 10 peers x load 25 all injected, messages circulating
+    assert int(sim.app.remaining.sum()) == 0
+    assert int(sim.app.rcvd.sum()) > 0
+    assert int(sim.events.overflow) == 0
+
+
+def test_example_config_parses():
+    cfg = parse_config(example_config(clients=5))
+    assert len(list(cfg.expanded_hosts())) == 6
+    loaded = load(cfg)
+    assert loaded.bundle.cfg.num_hosts == 6
+    assert len(loaded.handlers) == 1
+
+
+def test_cli_flag_parity():
+    p = make_parser()
+    a = p.parse_args([
+        "conf.xml", "-w", "4", "--seed", "7", "--scheduler-policy", "steal",
+        "--runahead", "10", "--interface-qdisc", "rr",
+        "--socket-recv-buffer", "100000", "--tcp-congestion-control",
+        "reno", "-l", "info", "--heartbeat-frequency", "30",
+    ])
+    assert a.workers == 4 and a.seed == 7
+    assert a.scheduler_policy == "steal"
+    assert a.runahead == 10 and a.interface_qdisc == "rr"
+
+
+def test_logger_sorts_by_simtime():
+    out = io.StringIO()
+    lg = SimLogger(level=LogLevel.INFO, stream=out)
+    lg.info(2_000_000_000, "b", "later")
+    lg.info(1_000_000_000, "a", "earlier")
+    lg.message(1_000_000_000, "a", "earlier-second")  # same time: emit order
+    lg.flush()
+    lines = out.getvalue().splitlines()
+    assert lines[0].startswith("00:00:01.000000000 [info] [a] earlier")
+    assert lines[1].endswith("earlier-second")
+    assert lines[2].startswith("00:00:02.000000000")

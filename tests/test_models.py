@@ -1,0 +1,84 @@
+"""App-model tests: Tor-relay-shaped circuit forwarding and
+Bitcoin-gossip block flooding (the on-device analogs of the
+reference's Tor/Bitcoin workloads, BASELINE.json configs #3/#4)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import gossip, relay
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="up">10240</data><data key="dn">10240</data>
+    </node>
+    <edge source="poi" target="poi"><data key="lat">25.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def test_relay_circuits_end_to_end():
+    """2 circuits x 5 hops: every byte must traverse 4 TCP connections
+    and arrive exactly once."""
+    H, total = 10, 30_000
+    cfg = NetConfig(num_hosts=H, end_time=30 * simtime.ONE_SECOND,
+                    sockets_per_host=4)
+    hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    circuits = [[0, 1, 2, 3, 4], [5, 6, 7, 8, 9]]
+    b.sim = relay.setup(b.sim, circuits=circuits, total_bytes=total)
+    sim, stats = run(b, app_handlers=(relay.handler,))
+    app = sim.app
+    for chain in circuits:
+        srv = chain[-1]
+        assert int(app.rcvd[srv]) == total, f"server {srv}"
+        assert bool(app.up_eof[srv])
+    assert int(app.to_send.sum()) == 0
+    assert int(app.fwd_pending.sum()) == 0
+    assert int(sim.events.overflow) == 0
+    assert int(sim.outbox.overflow) == 0
+
+
+def test_gossip_blocks_propagate():
+    """Every mined block must reach every host (flooding over the
+    K-peer graph with dedup)."""
+    H = 12
+    cfg = NetConfig(num_hosts=H, end_time=20 * simtime.ONE_SECOND,
+                    event_capacity=64, router_ring=64, tcp=False)
+    hosts = [HostSpec(name=f"n{i}") for i in range(H)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    b.sim = gossip.setup(b.sim, peers_per_host=4,
+                         block_interval=simtime.ONE_SECOND, max_blocks=8)
+    sim, stats = run(b, app_handlers=(gossip.handler,))
+    app = sim.app
+    assert int(app.blocks_mined.sum()) == 8
+    # every host converged to the final tip
+    assert jnp.all(app.tip == 7), np.asarray(app.tip)
+    assert int(app.relays.sum()) > 0
+    assert int(sim.events.overflow) == 0
+    assert int(sim.net.rq_overflow) == 0
+
+
+def test_gossip_deterministic():
+    def once():
+        H = 12
+        cfg = NetConfig(num_hosts=H, end_time=10 * simtime.ONE_SECOND,
+                        event_capacity=64, router_ring=64, tcp=False)
+        hosts = [HostSpec(name=f"n{i}") for i in range(H)]
+        b = build(cfg, ONE_VERTEX, hosts)
+        b.sim = gossip.setup(b.sim, peers_per_host=4,
+                             block_interval=simtime.ONE_SECOND,
+                             max_blocks=5)
+        return run(b, app_handlers=(gossip.handler,))
+
+    r1, s1 = once()
+    r2, s2 = once()
+    assert int(s1.events_processed) == int(s2.events_processed)
+    assert jnp.array_equal(r1.app.dup_rx, r2.app.dup_rx)
+    assert jnp.array_equal(r1.app.relays, r2.app.relays)

@@ -1,0 +1,113 @@
+"""Native-component tests (retransmit tally interval semantics per
+tcp_retransmit_tally.h:52-76; payload pool refcounting per
+payload.c). Both the native build and the Python fallback are
+exercised."""
+
+import ctypes
+
+import numpy as np
+import pytest
+
+from shadow_tpu.native import load
+from shadow_tpu.native.pool import PayloadPool
+from shadow_tpu.native.tally import _PyTally, RetransmitTally
+
+
+def _scoreboard_scenario(t):
+    # 10 MSS-sized (1000 B) segments outstanding: [0, 10000)
+    # SACKs arrive for 3000-4000 and 6000-8000; 3 dup acks; recovery
+    # point 10000 -> lost = [0,3000) U [4000,6000) U [8000,10000)
+    t.mark_sacked(3000, 4000)
+    t.mark_sacked(6000, 7000)
+    t.mark_sacked(7000, 8000)   # coalesces with previous
+    t.set_recovery_point(10000)
+    t.dupl_ack()
+    t.dupl_ack()
+    assert t.lost_ranges() == []          # below dup-ack threshold
+    t.dupl_ack()
+    assert t.lost_ranges() == [(0, 3000), (4000, 6000), (8000, 10000)]
+    assert t.is_sacked(6000, 8000)
+    assert not t.is_sacked(2000, 3500)
+    assert t.sacked_bytes() == 3000
+    # retransmitting the first hole removes it from the lost report
+    t.mark_retransmitted(0, 1000)
+    assert t.lost_ranges() == [(1000, 3000), (4000, 6000), (8000, 10000)]
+    # cumulative ACK past the first two holes
+    t.advance(6000)
+    t.dupl_ack()
+    t.dupl_ack()
+    t.dupl_ack()
+    assert t.lost_ranges() == [(8000, 10000)]
+    # full recovery
+    t.advance(10000)
+    assert t.lost_ranges() == []
+
+
+def test_tally_python_fallback():
+    _scoreboard_scenario(_PyTally(0))
+
+
+def test_tally_native():
+    t = RetransmitTally(0)
+    assert t.native, "native library should build in this environment"
+    _scoreboard_scenario(t)
+
+
+def test_native_and_python_agree_randomized():
+    rng = np.random.default_rng(7)
+    nat = RetransmitTally(0)
+    py = _PyTally(0)
+    assert nat.native
+    for _ in range(300):
+        op = rng.integers(0, 4)
+        b = int(rng.integers(0, 50000))
+        e = b + int(rng.integers(1, 3000))
+        if op == 0:
+            nat.mark_sacked(b, e)
+            py.mark_sacked(b, e)
+        elif op == 1:
+            nat.dupl_ack()
+            py.dupl_ack()
+        elif op == 2:
+            rp = int(rng.integers(0, 60000))
+            nat.set_recovery_point(rp)
+            py.set_recovery_point(rp)
+        else:
+            adv = int(rng.integers(0, 30000))
+            nat.advance(adv)
+            py.advance(adv)
+        assert nat.lost_ranges() == py.lost_ranges()
+        assert nat.sacked_bytes() == py.sacked_bytes()
+
+
+def test_payload_pool():
+    pool = PayloadPool()
+    a = pool.put(b"hello world")
+    b = pool.put(b"x" * 1000)
+    assert pool.get(a) == b"hello world"
+    assert pool.get(b) == b"x" * 1000
+    assert pool.live_bytes() == 11 + 1000
+    assert pool.ref(a) == 2
+    assert pool.unref(a) == 1
+    assert pool.unref(a) == 0
+    assert pool.live_bytes() == 1000
+    # slot recycled
+    c = pool.put(b"yo")
+    assert c == a
+    assert pool.total_allocs() == 3
+
+
+def test_logsort():
+    lib = load()
+    assert lib is not None
+    n = 1000
+    rng = np.random.default_rng(3)
+    times = rng.integers(0, 50, n).astype(np.int64)
+    seqs = np.arange(n, dtype=np.int64)
+    out = np.zeros(n, dtype=np.int64)
+    lib.logsort_argsort(
+        times.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        seqs.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        n, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    expect = np.lexsort((seqs, times))
+    assert np.array_equal(out, expect)

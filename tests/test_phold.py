@@ -1,0 +1,79 @@
+"""PHOLD scheduler stress + determinism regression — the device port
+of the reference's phold test (src/test/phold/) and determinism gate
+(src/test/determinism/: identical runs must be byte-equal; here
+additionally shard-count invariance, which the reference gets from its
+thread-count-independent event sort, event.c:110-153)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh
+
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel.shard import run_sharded
+
+# the reference's standard fixture: one self-looped vertex, all hosts
+# attached (latency 50 ms)
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="up">10240</data><data key="dn">10240</data>
+    </node>
+    <edge source="poi" target="poi"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _build(num_hosts=16, load=4, seconds=2, seed=1):
+    cfg = NetConfig(num_hosts=num_hosts, tcp=False,
+                    end_time=seconds * simtime.ONE_SECOND, seed=seed)
+    hosts = [HostSpec(name=f"peer{i}", proc_start_time=0)
+             for i in range(num_hosts)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def test_phold_circulates():
+    b = _build()
+    sim, stats = run(b, app_handlers=(phold.handler,))
+    app = sim.app
+    total_sent = int(app.sent.sum())
+    total_rcvd = int(app.rcvd.sum())
+    assert int(app.remaining.sum()) == 0          # all load injected
+    assert total_sent == 16 * 4 + total_rcvd      # each rx caused one tx
+    # 2 sim-seconds at ~100 ms/hop: each of the 64 messages makes
+    # ~20 hops
+    assert total_rcvd > 64 * 10
+    assert int(sim.events.overflow) == 0
+    assert int(sim.outbox.overflow) == 0
+    assert int(sim.net.rq_overflow) == 0
+    assert int(sim.net.ctr_drop_nosocket.sum()) == 0
+    assert int(sim.net.ctr_drop_bufferfull.sum()) == 0
+
+
+def test_phold_deterministic_across_runs():
+    r1, s1 = run(_build(), app_handlers=(phold.handler,))
+    r2, s2 = run(_build(), app_handlers=(phold.handler,))
+    assert int(s1.events_processed) == int(s2.events_processed)
+    assert jnp.array_equal(r1.app.sent, r2.app.sent)
+    assert jnp.array_equal(r1.app.rcvd, r2.app.rcvd)
+
+
+def test_phold_shard_count_invariance():
+    """The determinism contract across parallelism degrees: 8-shard
+    run must produce bit-identical per-host results to the
+    single-shard run (the analog of the reference's
+    thread-count-independent determinism tests)."""
+    single, _ = run(_build(), app_handlers=(phold.handler,))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sharded, _ = run_sharded(_build(), mesh, app_handlers=(phold.handler,))
+    assert jnp.array_equal(single.app.sent, sharded.app.sent)
+    assert jnp.array_equal(single.app.rcvd, sharded.app.rcvd)
+    assert jnp.array_equal(single.net.rng_ctr, sharded.net.rng_ctr)
+    assert jnp.array_equal(single.net.ctr_rx_bytes, sharded.net.ctr_rx_bytes)

@@ -1,0 +1,109 @@
+"""TCP end-to-end tests — the device analog of the reference's
+dual-mode tcp tests (src/test/tcp/): a client streams a fixed byte
+count to a server over lossless and lossy topologies; the lossy run
+exercises retransmission/recovery end-to-end
+(ref: tcp-blocking-lossy.test.shadow.config.xml:3-28)."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import bulk
+from shadow_tpu.core import simtime
+from shadow_tpu.net import tcp
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="pl" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <graph edgedefault="undirected">
+    <node id="west"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">client</data></node>
+    <node id="east"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">server</data></node>
+    <edge source="west" target="west"><data key="lat">5.0</data></edge>
+    <edge source="west" target="east"><data key="lat">25.0</data>
+      <data key="pl">{LOSS}</data></edge>
+    <edge source="east" target="east"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+PORT = 8080
+
+
+def _build(total_bytes, loss=0.0, seed=1, end_s=30):
+    # capacity provisioning: a window can deliver a full receive
+    # window of in-flight segments (rcvbuf/MSS ~ 122) at once; the
+    # event rows / outbox / router ring must absorb that burst
+    # (overflow is counted, never silent — SURVEY.md §7.4.6)
+    cfg = NetConfig(num_hosts=2, end_time=end_s * simtime.ONE_SECOND,
+                    seed=seed, event_capacity=256, outbox_capacity=256,
+                    router_ring=256)
+    hosts = [
+        HostSpec(name="client", type="client",
+                 proc_start_time=simtime.ONE_SECOND),
+        HostSpec(name="server", type="server"),
+    ]
+    b = build(cfg, GRAPH.replace("{LOSS}", str(loss)), hosts)
+    client = jnp.asarray(np.arange(2) == b.host_of("client"))
+    server = jnp.asarray(np.arange(2) == b.host_of("server"))
+    b.sim = bulk.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=b.ip_of("server"), server_port=PORT,
+        total_bytes=total_bytes,
+    )
+    return b
+
+
+def test_tcp_lossless_transfer():
+    total = 100_000
+    b = _build(total)
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+    si = b.host_of("server")
+    ci = b.host_of("client")
+    app = sim.app
+    assert int(app.rcvd[si]) == total
+    assert bool(app.eof[si])
+    # server child fully closed (freed); client lingers in TIME_WAIT
+    # until the +60 s reaper (past end_time), listener still listening
+    assert int((sim.tcp.st == tcp.TcpSt.LISTEN).sum()) == 1
+    assert int((sim.tcp.st == tcp.TcpSt.TIME_WAIT).sum()) == 1
+    assert int((sim.tcp.st != tcp.TcpSt.CLOSED).sum()) == 2
+    # no loss -> no retransmissions, no drops
+    assert int(sim.tcp.retx_segs.sum()) == 0
+    assert int(sim.net.ctr_drop_reliability.sum()) == 0
+    assert int(sim.events.overflow) == 0
+    assert int(sim.outbox.overflow) == 0
+    # sanity: transfer takes at least one RTT + serialization time
+    assert int(app.done_at[si]) > 50 * simtime.ONE_MILLISECOND
+
+
+def test_tcp_lossy_transfer_completes():
+    """0.10 edge loss both directions: retransmission machinery must
+    recover every lost segment and the byte count must still be exact
+    (the reference's lossy config uses 0.25; we use a tamer rate to
+    keep runtime down, the machinery exercised is the same)."""
+    total = 60_000
+    b = _build(total, loss=0.10, end_s=60)
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+    si = b.host_of("server")
+    app = sim.app
+    assert int(sim.net.ctr_drop_reliability.sum()) > 0  # loss did happen
+    assert int(sim.tcp.retx_segs.sum()) > 0             # recovery did happen
+    assert int(app.rcvd[si]) == total                   # and it all arrived
+    assert bool(app.eof[si])
+    assert int(sim.events.overflow) == 0
+
+
+def test_tcp_deterministic():
+    r1, s1 = run(_build(60_000, loss=0.10, end_s=60),
+                 app_handlers=(bulk.handler,))
+    r2, s2 = run(_build(60_000, loss=0.10, end_s=60),
+                 app_handlers=(bulk.handler,))
+    assert int(s1.events_processed) == int(s2.events_processed)
+    assert jnp.array_equal(r1.app.rcvd, r2.app.rcvd)
+    assert jnp.array_equal(r1.tcp.retx_segs, r2.tcp.retx_segs)
+    assert jnp.array_equal(r1.net.ctr_rx_bytes, r2.net.ctr_rx_bytes)

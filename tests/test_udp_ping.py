@@ -30,7 +30,8 @@ PORT = 5555
 
 
 def _build(count=10, size=64, seed=1):
-    cfg = NetConfig(num_hosts=2, end_time=10 * simtime.ONE_SECOND, seed=seed)
+    cfg = NetConfig(num_hosts=2, end_time=10 * simtime.ONE_SECOND,
+                    seed=seed, tcp=False)
     hosts = [
         HostSpec(name="client", type="client",
                  proc_start_time=simtime.ONE_SECOND),

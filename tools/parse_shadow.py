@@ -1,0 +1,82 @@
+#!/usr/bin/env python3
+"""Parse shadow-tpu logs into stats JSON — the analog of the
+reference's src/tools/parse-shadow.py (:9-40): stream a (possibly
+xz/gz-compressed) log, extract per-interval node throughput from
+heartbeat lines and sim-vs-wall progress ticks, emit
+stats.shadow.json.
+
+Usage: parse_shadow.py shadow.log [-o stats.shadow.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+
+HEARTBEAT_RE = re.compile(
+    r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) \[\w+\] "
+    r"\[(?P<host>[^\]]+)\] \[shadow-heartbeat\] \[node\] "
+    r"(?P<fields>[\d,\-]+)")
+NODE_FIELDS = ["interval_seconds", "recv_bytes", "send_bytes",
+               "recv_packets", "send_packets", "retransmitted_segments",
+               "dropped_packets"]
+TICK_RE = re.compile(
+    r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) .*simulation complete "
+    r"(?P<json>\{.*\})")
+
+
+def _open(path: str):
+    if path == "-":
+        return sys.stdin
+    if path.endswith(".xz"):
+        import lzma
+
+        return lzma.open(path, "rt")
+    if path.endswith(".gz"):
+        import gzip
+
+        return gzip.open(path, "rt")
+    return open(path)
+
+
+def parse(stream):
+    nodes: dict[str, dict] = {}
+    ticks = []
+    for line in stream:
+        m = HEARTBEAT_RE.match(line)
+        if m:
+            t = (int(m["h"]) * 3600 + int(m["m"]) * 60 + int(m["s"]))
+            vals = [int(x) for x in m["fields"].split(",")]
+            rec = dict(zip(NODE_FIELDS, vals))
+            node = nodes.setdefault(m["host"], {
+                "recv_bytes_by_second": {}, "send_bytes_by_second": {},
+                "retransmits_by_second": {}, "drops_by_second": {}})
+            node["recv_bytes_by_second"][t] = rec["recv_bytes"]
+            node["send_bytes_by_second"][t] = rec["send_bytes"]
+            node["retransmits_by_second"][t] = rec["retransmitted_segments"]
+            node["drops_by_second"][t] = rec["dropped_packets"]
+            continue
+        m = TICK_RE.match(line)
+        if m:
+            ticks.append(json.loads(m["json"]))
+    return {"nodes": nodes, "ticks": ticks}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("log")
+    ap.add_argument("-o", "--output", default="stats.shadow.json")
+    args = ap.parse_args(argv)
+    with _open(args.log) as f:
+        stats = parse(f)
+    with open(args.output, "w") as f:
+        json.dump(stats, f, indent=1)
+    print(f"wrote {args.output}: {len(stats['nodes'])} nodes, "
+          f"{len(stats['ticks'])} ticks")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

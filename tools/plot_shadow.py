@@ -1,0 +1,87 @@
+#!/usr/bin/env python3
+"""Plot stats.shadow.json files — the analog of the reference's
+src/tools/plot-shadow.py (throughput time series + CDFs across
+experiments).
+
+Usage: plot_shadow.py -d stats.shadow.json LABEL [-d ... LABEL2]
+                      [-o prefix]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _series(node_block: dict, key: str) -> tuple[list, list]:
+    by_sec = node_block.get(key, {})
+    xs = sorted(int(k) for k in by_sec)
+    ys = [by_sec[str(x)] if str(x) in by_sec else by_sec[x] for x in xs]
+    return xs, ys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-d", "--data", nargs=2, action="append",
+                    metavar=("FILE", "LABEL"), required=True)
+    ap.add_argument("-o", "--output-prefix", default="shadow.results")
+    args = ap.parse_args(argv)
+
+    try:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+    except ImportError:
+        print("matplotlib unavailable; install it to plot", file=sys.stderr)
+        return 1
+
+    fig, axes = plt.subplots(2, 2, figsize=(11, 7))
+    (ax_rx, ax_tx), (ax_cdf, ax_retx) = axes
+
+    for path, label in args.data:
+        with open(path) as f:
+            stats = json.load(f)
+        # aggregate per-second totals over all nodes
+        rx_tot: dict[int, int] = {}
+        tx_tot: dict[int, int] = {}
+        retx_tot: dict[int, int] = {}
+        final_rx = []
+        for node, blk in stats["nodes"].items():
+            for key, acc in (("recv_bytes_by_second", rx_tot),
+                             ("send_bytes_by_second", tx_tot),
+                             ("retransmits_by_second", retx_tot)):
+                xs, ys = _series(blk, key)
+                for x, y in zip(xs, ys):
+                    acc[x] = acc.get(x, 0) + y
+            xs, ys = _series(blk, "recv_bytes_by_second")
+            if ys:
+                final_rx.append(sum(ys))
+        for acc, ax, name in ((rx_tot, ax_rx, "recv"), (tx_tot, ax_tx, "send"),
+                              (retx_tot, ax_retx, "retransmits")):
+            xs = sorted(acc)
+            ax.plot(xs, [acc[x] / (1 << 20) for x in xs], label=label)
+            ax.set_xlabel("sim time (s)")
+            ax.set_ylabel(f"{name} MiB/interval"
+                          if name != "retransmits" else "segments/interval")
+        if final_rx:
+            final_rx.sort()
+            n = len(final_rx)
+            ax_cdf.plot([b / (1 << 20) for b in final_rx],
+                        [(i + 1) / n for i in range(n)], label=label)
+            ax_cdf.set_xlabel("total recv MiB per node")
+            ax_cdf.set_ylabel("CDF")
+
+    for ax in axes.flat:
+        ax.legend(fontsize=8)
+        ax.grid(alpha=0.3)
+    fig.tight_layout()
+    out = f"{args.output_prefix}.pdf"
+    fig.savefig(out)
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
