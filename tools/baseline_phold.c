@@ -1,0 +1,245 @@
+/* baseline_phold.c — CPU stand-in for the reference's PDES hot loop.
+ *
+ * The reference (Shadow 1.x) cannot be built in this image (no
+ * glib-2.0 dev headers, no igraph), so this program re-creates its
+ * scheduler hot path with the same semantics and measures events/s on
+ * the host CPU, as the published baseline for BASELINE.json:
+ *
+ *   - per-host locked binary min-heaps of events, ordered by the
+ *     4-key comparator (time, dstHost, srcHost, perSourceSeq)
+ *     [ref: src/main/core/work/event.c:110-153]
+ *   - conservative windowed rounds: threads drain events with
+ *     time < windowEnd for their owned hosts, barrier, min-reduce the
+ *     next event time, master advances the window by minJump
+ *     [ref: scheduler.c:359-414, master.c:450-480]
+ *   - host-partitioned worker threads (SP_PARALLEL_HOST_SINGLE)
+ *     [ref: scheduler_policy_host_single.c:237-305]
+ *   - PHOLD event execution: per-host PRNG draw, random peer,
+ *     reliability draw, fixed path latency, push to the destination
+ *     host's heap under its lock [ref: worker_sendPacket,
+ *     worker.c:243-304; src/test/phold/test_phold.c:36-52]
+ *
+ * This measures ONLY the scheduler+heap+RNG skeleton — the real
+ * reference additionally runs the full UDP socket/NIC/router stack
+ * and the interposer boundary per PHOLD message, so this number is an
+ * UPPER BOUND on reference throughput (deliberately conservative for
+ * our vs_baseline comparison).
+ *
+ * Build:  gcc -O2 -pthread -o baseline_phold baseline_phold.c
+ * Run:    ./baseline_phold [hosts=1024] [load=8] [sim_s=5] [threads=nproc]
+ * Output: one JSON line {"events": N, "wall_s": W, "events_per_sec": R}
+ */
+
+#define _GNU_SOURCE
+#include <pthread.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <time.h>
+#include <unistd.h>
+
+typedef struct {
+    uint64_t time;
+    int32_t dst, src;
+    uint32_t seq;
+} Event;
+
+/* the reference's total deterministic order (event.c:110-153) */
+static inline int ev_before(const Event *a, const Event *b) {
+    if (a->time != b->time) return a->time < b->time;
+    if (a->dst != b->dst) return a->dst < b->dst;
+    if (a->src != b->src) return a->src < b->src;
+    return a->seq < b->seq;
+}
+
+typedef struct {
+    Event *heap;
+    int count, cap;
+    pthread_mutex_t lock;   /* per-host queue lock
+                               (scheduler_policy_host_single.c:20-25) */
+    uint64_t rng;           /* per-host PRNG stream (random.c) */
+    uint32_t seq_ctr;       /* per-source sequence numbers */
+} HostQ;
+
+static HostQ *hosts;
+static int NH, LOAD, NTHREADS;
+static uint64_t SIM_NS, LATENCY_NS, WINDOW_NS;
+static pthread_barrier_t round_barrier;
+static volatile uint64_t window_start, window_end;
+static uint64_t *thread_min_next;   /* per-thread min next-event time */
+static uint64_t *thread_events;     /* per-thread executed count */
+static volatile int keep_running = 1;
+
+static void hq_push(HostQ *q, Event e) {
+    pthread_mutex_lock(&q->lock);
+    if (q->count == q->cap) {
+        q->cap *= 2;
+        q->heap = realloc(q->heap, q->cap * sizeof(Event));
+    }
+    int i = q->count++;
+    while (i > 0) {
+        int p = (i - 1) / 2;
+        if (ev_before(&e, &q->heap[p])) {
+            q->heap[i] = q->heap[p];
+            i = p;
+        } else break;
+    }
+    q->heap[i] = e;
+    pthread_mutex_unlock(&q->lock);
+}
+
+/* pop the head if it falls inside the window, else report its time */
+static int hq_pop_window(HostQ *q, uint64_t wend, Event *out,
+                         uint64_t *next_time) {
+    pthread_mutex_lock(&q->lock);
+    if (q->count == 0) {
+        *next_time = UINT64_MAX;
+        pthread_mutex_unlock(&q->lock);
+        return 0;
+    }
+    if (q->heap[0].time >= wend) {
+        *next_time = q->heap[0].time;
+        pthread_mutex_unlock(&q->lock);
+        return 0;
+    }
+    *out = q->heap[0];
+    Event last = q->heap[--q->count];
+    int i = 0;
+    for (;;) {
+        int l = 2 * i + 1, r = l + 1, m = i;
+        Event *h = q->heap;
+        if (l < q->count && ev_before(&h[l], &last) &&
+            (r >= q->count || ev_before(&h[l], &h[r]))) m = l;
+        else if (r < q->count && ev_before(&h[r], &last)) m = r;
+        if (m == i) break;
+        q->heap[i] = q->heap[m];
+        i = m;
+    }
+    if (q->count) q->heap[i] = last;
+    pthread_mutex_unlock(&q->lock);
+    return 1;
+}
+
+static inline uint64_t xorshift64(uint64_t *s) {
+    uint64_t x = *s;
+    x ^= x << 13; x ^= x >> 7; x ^= x << 17;
+    return *s = x;
+}
+
+/* execute one PHOLD event: draw a random peer, apply the reliability
+ * Bernoulli (loss 0 on the one-vertex fixture — still drawn, as
+ * worker_sendPacket always draws), schedule the next hop */
+static inline void phold_execute(int self, Event *e, int tid) {
+    HostQ *q = &hosts[self];
+    uint64_t r = xorshift64(&q->rng);
+    int peer = (int)(r % (uint64_t)NH);
+    uint64_t rel_draw = xorshift64(&q->rng);
+    (void)rel_draw;
+    Event n = { e->time + LATENCY_NS, peer, self, q->seq_ctr++ };
+    if (n.time < SIM_NS) hq_push(&hosts[peer], n);
+    thread_events[tid]++;
+}
+
+typedef struct { int tid, lo, hi; } WorkerArg;
+
+static void *worker(void *argp) {
+    WorkerArg *a = (WorkerArg *)argp;
+    Event e;
+    while (keep_running) {
+        uint64_t wend = window_end;
+        uint64_t my_min = UINT64_MAX;
+        /* host-rotation pop loop
+         * (scheduler_policy_host_single.c:237-267) */
+        int progress = 1;
+        while (progress) {
+            progress = 0;
+            for (int h = a->lo; h < a->hi; h++) {
+                uint64_t nt;
+                while (hq_pop_window(&hosts[h], wend, &e, &nt)) {
+                    phold_execute(h, &e, a->tid);
+                    progress = 1;
+                }
+            }
+        }
+        for (int h = a->lo; h < a->hi; h++) {
+            pthread_mutex_lock(&hosts[h].lock);
+            if (hosts[h].count && hosts[h].heap[0].time < my_min)
+                my_min = hosts[h].heap[0].time;
+            pthread_mutex_unlock(&hosts[h].lock);
+        }
+        thread_min_next[a->tid] = my_min;
+        /* executeEventsBarrier + collectInfo (scheduler.c:377-408) */
+        pthread_barrier_wait(&round_barrier);
+        /* master advances the window (master.c:450-480) on tid 0 */
+        if (a->tid == 0) {
+            uint64_t mn = UINT64_MAX;
+            for (int t = 0; t < NTHREADS; t++)
+                if (thread_min_next[t] < mn) mn = thread_min_next[t];
+            if (mn >= SIM_NS || mn == UINT64_MAX) keep_running = 0;
+            else { window_start = mn; window_end = mn + WINDOW_NS; }
+        }
+        /* prepareRoundBarrier */
+        pthread_barrier_wait(&round_barrier);
+    }
+    return NULL;
+}
+
+int main(int argc, char **argv) {
+    NH = argc > 1 ? atoi(argv[1]) : 1024;
+    LOAD = argc > 2 ? atoi(argv[2]) : 8;
+    int sim_s = argc > 3 ? atoi(argv[3]) : 5;
+    NTHREADS = argc > 4 ? atoi(argv[4])
+                        : (int)sysconf(_SC_NPROCESSORS_ONLN);
+    if (NTHREADS > NH) NTHREADS = NH;
+    SIM_NS = (uint64_t)sim_s * 1000000000ull;
+    LATENCY_NS = 50ull * 1000000ull;   /* one-vertex fixture: 50 ms */
+    WINDOW_NS = LATENCY_NS;            /* minJump = min path latency */
+
+    hosts = calloc(NH, sizeof(HostQ));
+    for (int h = 0; h < NH; h++) {
+        hosts[h].cap = 4 * LOAD + 8;
+        hosts[h].heap = malloc(hosts[h].cap * sizeof(Event));
+        pthread_mutex_init(&hosts[h].lock, NULL);
+        hosts[h].rng = 0x9E3779B97F4A7C15ull ^ (uint64_t)(h + 1);
+        /* seed hierarchy analog: distinct per-host streams */
+        for (int k = 0; k < 4; k++) xorshift64(&hosts[h].rng);
+    }
+    /* initial population: `load` self-messages per host in the first
+     * window (phold.test.shadow.config.xml:22-26 analog) */
+    for (int h = 0; h < NH; h++)
+        for (int k = 0; k < LOAD; k++) {
+            Event e = { xorshift64(&hosts[h].rng) % LATENCY_NS, h, h,
+                        hosts[h].seq_ctr++ };
+            hq_push(&hosts[h], e);
+        }
+
+    window_start = 0;
+    window_end = WINDOW_NS;
+    thread_min_next = calloc(NTHREADS, sizeof(uint64_t));
+    thread_events = calloc(NTHREADS, sizeof(uint64_t));
+    pthread_barrier_init(&round_barrier, NULL, NTHREADS);
+
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    pthread_t tids[256];
+    WorkerArg args[256];
+    int per = (NH + NTHREADS - 1) / NTHREADS;
+    for (int t = 0; t < NTHREADS; t++) {
+        args[t].tid = t;
+        args[t].lo = t * per;
+        args[t].hi = (t + 1) * per < NH ? (t + 1) * per : NH;
+        pthread_create(&tids[t], NULL, worker, &args[t]);
+    }
+    for (int t = 0; t < NTHREADS; t++) pthread_join(tids[t], NULL);
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+
+    uint64_t total = 0;
+    for (int t = 0; t < NTHREADS; t++) total += thread_events[t];
+    double wall = (t1.tv_sec - t0.tv_sec) + (t1.tv_nsec - t0.tv_nsec) / 1e9;
+    printf("{\"hosts\": %d, \"load\": %d, \"sim_s\": %d, \"threads\": %d, "
+           "\"events\": %llu, \"wall_s\": %.4f, \"events_per_sec\": %.1f}\n",
+           NH, LOAD, sim_s, NTHREADS,
+           (unsigned long long)total, wall, total / wall);
+    return 0;
+}
