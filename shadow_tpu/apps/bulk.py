@@ -11,9 +11,11 @@ rides out behind the data). Server: accepts children off the listener
 and drains them until EOF, counting received bytes.
 
 Each host can be client, server, or both (distinct sockets). Servers
-here handle ACCEPTS_MAX concurrent children per event via one
-accept/recv lane per micro-step — the event-driven pattern means later
-children are picked up on subsequent events.
+drain one child at a time: accept a child, read it to EOF, close it,
+then accept the next — later connections wait in the listener's accept
+queue (SYN-retry backpressure once that fills). `rcvd` accumulates
+across children; `eof` is sticky ("saw at least one EOF") and
+`done_at` tracks the latest EOF time.
 """
 
 from __future__ import annotations
@@ -116,16 +118,19 @@ def handler(cfg: NetConfig, sim, popped, buf):
     sim = sim.replace(app=app)
 
     # ---- server: drain the child -------------------------------------
-    drain = woke & app.is_server & (app.child >= 0) & ~app.eof
+    drain = woke & app.is_server & (app.child >= 0)
     sim, buf, nread, eof = tcp.tcp_recv(sim, drain, app.child,
                                         jnp.full(drain.shape, CHUNK, I32),
                                         now, buf)
     app = app.replace(
         rcvd=app.rcvd + nread.astype(I64),
         eof=app.eof | eof,
-        done_at=jnp.where(eof & (app.done_at < 0), now, app.done_at),
+        done_at=jnp.where(eof, now, app.done_at),
     )
     sim = sim.replace(app=app)
-    # close our side in response to EOF (server-side passive close)
+    # close our side in response to EOF (server-side passive close),
+    # then release the child slot so the next queued connection can be
+    # accepted on a later wakeup
     sim, buf = tcp.tcp_close(cfg, sim, eof, app.child, now, buf)
-    return sim, buf
+    app = sim.app.replace(child=jnp.where(eof, -1, sim.app.child))
+    return sim.replace(app=app), buf

@@ -201,7 +201,8 @@ def handler(cfg: NetConfig, sim, popped, buf):
 
     # ---- receive blocks ----------------------------------------------
     may_have = popped.valid & (
-        (popped.kind == EventKind.NIC_RECV)
+        (popped.kind == EventKind.PACKET)      # fused same-step delivery
+        | (popped.kind == EventKind.NIC_RECV)  # deferred drain
         | (popped.kind == EventKind.PACKET_LOCAL))
     readable = gather_hs(sim.net.in_count, sim.app.sock) > 0
     net, got, _, _, _, block = udp.udp_recv(

@@ -97,7 +97,8 @@ def handler(cfg: NetConfig, sim, popped, buf):
 
     # every received message triggers one send to a new random peer
     may_have = popped.valid & (
-        (popped.kind == EventKind.NIC_RECV)
+        (popped.kind == EventKind.PACKET)      # fused same-step delivery
+        | (popped.kind == EventKind.NIC_RECV)  # deferred drain
         | (popped.kind == EventKind.PACKET_LOCAL))
     readable = gather_hs(sim.net.in_count, app.sock) > 0
     net, got, _, _, _, _ = udp.udp_recv(sim.net, may_have & readable, app.sock)

@@ -104,7 +104,8 @@ def handler(cfg: NetConfig, sim, popped, buf):
     # delivery or this host's chained events.
     app = sim.app
     may_have_data = popped.valid & (
-        (popped.kind == EventKind.NIC_RECV)
+        (popped.kind == EventKind.PACKET)      # fused same-step delivery
+        | (popped.kind == EventKind.NIC_RECV)  # deferred drain
         | (popped.kind == EventKind.PACKET_LOCAL)
     ) & (app.role != ROLE_NONE)
     readable = gather_hs(sim.net.in_count, app.sock) > 0

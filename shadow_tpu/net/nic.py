@@ -37,12 +37,14 @@ from shadow_tpu.core import rng, simtime
 from shadow_tpu.core.events import EventKind, emit
 from shadow_tpu.net import packetfmt as pf
 from shadow_tpu.net.rings import gather_hs, set_hs, set_row
-from shadow_tpu.net.sockets import lookup_socket
+from shadow_tpu.net.sockets import lookup_socket, set_writable
 from shadow_tpu.net.state import (
     TB_REFILL_INTERVAL,
     NetConfig,
     NetState,
     QDisc,
+    SocketFlags,
+    SocketType,
 )
 from shadow_tpu.net.udp import udp_deliver
 
@@ -110,6 +112,36 @@ def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
         words[:, pf.W_PAYREF],
     )
     nosock = mask & (slot < 0)
+    # TCP segment matching no socket: answer with RST so an active
+    # open to a dead port fails promptly instead of retransmitting
+    # SYNs forever (ref: the reference's RST-on-closed path in
+    # tcp_processPacket; never RST a RST). The RST bypasses the NIC
+    # rings — it belongs to no socket — and rides the event fabric
+    # directly; 0-length control packets are exempt from reliability
+    # drops either way.
+    flags = pf.tcp_flags_of(words)
+    need_rst = nosock & (proto == pf.PROTO_TCP) & ((flags & pf.TCPF_RST) == 0)
+    f_ack = (flags & pf.TCPF_ACK) != 0
+    f_syn = (flags & pf.TCPF_SYN) != 0
+    rseq = jnp.where(f_ack, words[:, pf.W_ACK], 0)
+    rack = words[:, pf.W_SEQ] + words[:, pf.W_LEN] + f_syn.astype(I32)
+    rst = jnp.zeros_like(words)
+    rst = rst.at[:, pf.W_PROTO].set(
+        pf.PROTO_TCP | ((pf.TCPF_RST | pf.TCPF_ACK) << 8))
+    rst = rst.at[:, pf.W_PORTS].set(pf.pack_ports(dst_port, src_port))
+    rst = rst.at[:, pf.W_SEQ].set(rseq)
+    rst = rst.at[:, pf.W_ACK].set(rack)
+    rst = rst.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
+    rst = rst.at[:, pf.W_DSTIP].set(src_ip.astype(jnp.uint32).astype(I32))
+    srch = jnp.clip(src_host, 0, GH - 1)
+    rst_local = need_rst & (src_host == net.lane_id)
+    vme = net.vertex_of_host[net.lane_id]
+    vsrc = net.vertex_of_host[srch]
+    lat = net.latency_ns[vme, vsrc]
+    buf = emit(buf, rst_local, net.lane_id, now + 1,
+               EventKind.PACKET_LOCAL, rst)
+    buf = emit(buf, need_rst & ~rst_local & (src_host >= 0), src_host,
+               now + lat, EventKind.PACKET, rst)
     net = net.replace(
         ctr_drop_nosocket=net.ctr_drop_nosocket + nosock.astype(I64),
         ctr_rx_packets=net.ctr_rx_packets + found.astype(I64),
@@ -128,54 +160,50 @@ def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
 
 
 # ---------------------------------------------------------------------
-# arrival: packet reaches dst host's upstream router
-# ---------------------------------------------------------------------
-
-def handle_packet_arrival(cfg: NetConfig, sim, popped, buf):
-    """kind=PACKET: enqueue into the router ring; kick the NIC receive
-    path when the queue was empty (ref: router_enqueue,
-    router.c:104-125)."""
-    net = sim.net
-    H = net.rq_head.shape[0]
-    mask = popped.valid & (popped.kind == EventKind.PACKET)
-    R = cfg.router_ring
-
-    was_empty = net.rq_count == 0
-    ok = mask & (net.rq_count < R)
-    pos = (net.rq_head + net.rq_count) % R
-    wl = pf.wire_length(pf.proto_of(popped.words), popped.words[:, pf.W_LEN])
-    net = net.replace(
-        rq_src=set_row(net.rq_src, ok, pos, popped.src),
-        rq_enq_ts=set_row(net.rq_enq_ts, ok, pos, popped.time),
-        rq_words=set_row(net.rq_words, ok, pos, popped.words),
-        rq_count=net.rq_count + ok.astype(I32),
-        rq_bytes=net.rq_bytes + jnp.where(ok, wl, 0).astype(I64),
-        rq_overflow=net.rq_overflow + jnp.sum(mask & ~ok, dtype=I32),
-    )
-    kick = ok & was_empty & ~net.nic_recv_pending
-    buf = emit(buf, kick, net.lane_id, popped.time, EventKind.NIC_RECV,
-               _empty_words(H))
-    net = net.replace(nic_recv_pending=net.nic_recv_pending | kick)
-    return sim.replace(net=net), buf
-
-
-# ---------------------------------------------------------------------
-# receive: drain router queue through the rx token bucket + CoDel
+# receive: packet arrival -> router ring -> CoDel dequeue -> delivery,
+# fused into one handler pass
 # ---------------------------------------------------------------------
 
 def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
-    """kind=NIC_RECV: CoDel-dequeue one packet and deliver it; chain
-    another NIC_RECV at the same sim time while packets and tokens
-    remain (the reference's while-loop, network_interface.c:432-455,
-    unrolled across micro-steps)."""
+    """kinds PACKET, NIC_RECV, PACKET_LOCAL, fused.
+
+    An arriving packet (kind=PACKET) is enqueued into the router ring
+    and — when the queue was idle — dequeued and delivered in the SAME
+    micro-step, exactly like the reference's synchronous
+    router_enqueue -> networkinterface_receivePackets call chain
+    (router.c:104-125): no same-time event round-trip. kind=NIC_RECV
+    events exist only for deferred drains (token-bucket refill waits,
+    multi-packet chains). Chaining while packets and tokens remain
+    mirrors the reference's while-loop (network_interface.c:432-455),
+    unrolled across micro-steps."""
     net = sim.net
     H = net.rq_head.shape[0]
     lane = jnp.arange(H)
-    mask = popped.valid & (popped.kind == EventKind.NIC_RECV)
     now = popped.time
     R = cfg.router_ring
 
-    net = net.replace(nic_recv_pending=net.nic_recv_pending & ~mask)
+    # -- arrival enqueue (ref: router_enqueue, router.c:104-125) ------
+    arr = popped.valid & (popped.kind == EventKind.PACKET)
+    was_empty = net.rq_count == 0
+    aok = arr & (net.rq_count < R)
+    apos = (net.rq_head + net.rq_count) % R
+    awl = pf.wire_length(pf.proto_of(popped.words), popped.words[:, pf.W_LEN])
+    net = net.replace(
+        rq_src=set_row(net.rq_src, aok, apos, popped.src),
+        rq_enq_ts=set_row(net.rq_enq_ts, aok, apos, popped.time),
+        rq_words=set_row(net.rq_words, aok, apos, popped.words),
+        rq_count=net.rq_count + aok.astype(I32),
+        rq_bytes=net.rq_bytes + jnp.where(aok, awl, 0).astype(I64),
+        rq_overflow=net.rq_overflow + jnp.sum(arr & ~aok, dtype=I32),
+    )
+    # fused drain: idle queue served immediately; a busy queue already
+    # has a drain in flight (nic_recv_pending invariant)
+    kick = aok & was_empty & ~net.nic_recv_pending
+
+    # -- drain one packet (deferred NIC_RECV event or fused kick) -----
+    ev = popped.valid & (popped.kind == EventKind.NIC_RECV)
+    mask = ev | kick
+    net = net.replace(nic_recv_pending=net.nic_recv_pending & ~ev)
     net = refill_tokens(net, mask, now)
 
     bootstrap = now < cfg.bootstrap_end
@@ -315,16 +343,25 @@ def _qdisc_select(cfg: NetConfig, net: NetState):
 
 
 def handle_nic_send(cfg: NetConfig, sim, popped, buf):
-    """kind=NIC_SEND: send one packet chosen by the qdisc; chain at the
-    same sim time while sendable (ref: _networkinterface_sendPackets,
-    network_interface.c:519-579)."""
+    """Send one packet chosen by the qdisc; chain at the same sim time
+    while sendable (ref: _networkinterface_sendPackets,
+    network_interface.c:519-579).
+
+    Runs LAST in the handler pipeline and acts on kind=NIC_SEND events
+    *plus* lanes whose nic_send_now bit was set earlier in this
+    micro-step (data enqueued by TCP/app handlers) — the fused form of
+    the reference's synchronous networkinterface_wantsSend call.
+    NIC_SEND events exist only for deferred sends (refill waits,
+    multi-packet chains)."""
     net = sim.net
     H = net.rq_head.shape[0]
     lane = jnp.arange(H)
-    mask = popped.valid & (popped.kind == EventKind.NIC_SEND)
+    ev = popped.valid & (popped.kind == EventKind.NIC_SEND)
+    mask = ev | net.nic_send_now
     now = popped.time
 
-    net = net.replace(nic_send_pending=net.nic_send_pending & ~mask)
+    net = net.replace(nic_send_pending=net.nic_send_pending & ~ev,
+                      nic_send_now=jnp.zeros((H,), bool))
     net = refill_tokens(net, mask, now)
 
     bootstrap = now < cfg.bootstrap_end
@@ -350,6 +387,11 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
         out_bytes=set_hs(net.out_bytes, active, sel,
                          net.out_bytes[lane, selc] - length),
     )
+    # draining freed output capacity: restore WRITABLE for datagram
+    # sockets (TCP writability is sndbuf-room-based; its ACK path
+    # restores it). Ref: descriptor_adjustStatus -> epoll EPOLLOUT.
+    is_dgram = active & (net.sk_type[lane, selc] == SocketType.UDP)
+    net = set_writable(net, is_dgram, sel, True)
     if cfg.qdisc == QDisc.RR:
         net = net.replace(rr_ptr=jnp.where(active, (sel + 1) % S, net.rr_ptr))
 
@@ -398,11 +440,12 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
         ),
     )
 
-    # continue or re-arm
+    # continue or re-arm (guard against lanes that already have a
+    # deferred NIC_SEND in flight — fused fresh lanes can overlap one)
     more = jnp.any(net.out_count > 0, axis=1)
     can_next = bootstrap | (net.tb_send_tokens >= pf.MTU)
-    chain = mask & more & can_next
-    wait = mask & more & ~can_next
+    chain = mask & more & can_next & ~net.nic_send_pending
+    wait = mask & more & ~can_next & ~net.nic_send_pending
     buf = emit(buf, chain, net.lane_id, now, EventKind.NIC_SEND,
                _empty_words(H))
     buf = emit(buf, wait, net.lane_id, next_refill_time(now),
@@ -411,21 +454,25 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     return sim.replace(net=net), buf
 
 
-def handle_packet_local(cfg: NetConfig, sim, popped, buf):
-    """kind=PACKET_LOCAL: direct same-host delivery bypassing router
-    and token buckets (network_interface.c:546-554). Delivery itself
-    happens inside handle_nic_recv's merged deliver_packet call; this
-    handler only exists for documentation/ordering clarity."""
-    return sim, buf
-
-
 def notify_wants_send(sim, buf, mask, now):
-    """App enqueued data on a socket: make sure a NIC_SEND will run
-    (ref: networkinterface_wantsSend, network_interface.c:583-…)."""
+    """App/TCP enqueued data on a socket: flag the lane so the send
+    drain at the end of this micro-step's pipeline picks it up (the
+    synchronous networkinterface_wantsSend, network_interface.c:583-…).
+    Host-side syscall paths (vproc), which run outside the pipeline,
+    must follow up with flush_wants_send()."""
+    net = sim.net.replace(nic_send_now=sim.net.nic_send_now | mask)
+    return sim.replace(net=net), buf
+
+
+def flush_wants_send(sim, buf, now):
+    """Convert lingering nic_send_now bits into NIC_SEND events — used
+    by host-side syscall execution where no pipeline send drain will
+    run this 'micro-step' (ProcessRuntime._apply)."""
     net = sim.net
     H = net.rq_head.shape[0]
-    kick = mask & ~net.nic_send_pending
+    kick = net.nic_send_now & ~net.nic_send_pending
     buf = emit(buf, kick, net.lane_id, now, EventKind.NIC_SEND,
                _empty_words(H))
-    net = net.replace(nic_send_pending=net.nic_send_pending | kick)
+    net = net.replace(nic_send_pending=net.nic_send_pending | kick,
+                      nic_send_now=jnp.zeros((H,), bool))
     return sim.replace(net=net), buf

@@ -26,6 +26,25 @@ I32 = jnp.int32
 MIN_RANDOM_PORT = 10000  # ref: definitions.h:94
 
 
+def set_writable(net: NetState, mask, slot, on):
+    """Set/clear WRITABLE for (lane, slot), bumping the out-readiness
+    generation on the not-writable -> writable transition (the edge ET
+    epoll watches key off; ref: descriptor_adjustStatus ->
+    epoll.c:583). The single helper keeps the NIC-drain, TCP-ACK, and
+    enqueue-full call sites consistent."""
+    fl = gather_hs(net.sk_flags, slot)
+    on = jnp.broadcast_to(jnp.asarray(on, bool), mask.shape)
+    edge = mask & on & ((fl & SocketFlags.WRITABLE) == 0)
+    return net.replace(
+        sk_flags=set_hs(
+            net.sk_flags, mask, slot,
+            jnp.where(on, fl | SocketFlags.WRITABLE,
+                      fl & ~SocketFlags.WRITABLE)),
+        sk_out_gen=set_hs(net.sk_out_gen, edge, slot,
+                          gather_hs(net.sk_out_gen, slot) + 1),
+    )
+
+
 def sk_enqueue_out(net: NetState, mask, slot, words):
     """Push one fully-formed packet ([H, NWORDS]) onto (lane, slot)'s
     output ring, charging W_LEN payload bytes against the send buffer
@@ -54,6 +73,21 @@ def sk_enqueue_out(net: NetState, mask, slot, words):
         out_count=count,
         out_bytes=set_hs(net.out_bytes, ok, slot, ob + length),
     )
+    # Writable status tracks output capacity for datagram sockets
+    # (ref: descriptor_adjustStatus WRITABLE): clear when the ring or
+    # byte budget is exhausted — including when THIS enqueue failed (or
+    # an EPOLLOUT waiter livelocks retrying) — and let the NIC drain
+    # restore it. TCP sockets are excluded: their app-visible
+    # writability is STREAM-buffer room, managed by tcp_send / the ACK
+    # path; this ring is internal segment staging there (pure ACKs
+    # piling up during a token stall must not eat the app's WRITABLE,
+    # which no TCP path would ever restore for a data-less socket).
+    full = mask & (gather_hs(net.sk_type, slot) != SocketType.TCP) \
+        & (~ok
+           | (gather_hs(net.out_count, slot) >= BO)
+           | (gather_hs(net.out_bytes, slot)
+              >= gather_hs(net.sk_sndbuf, slot)))
+    net = set_writable(net, full, slot, False)
     return net, ok
 
 

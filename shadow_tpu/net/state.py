@@ -122,6 +122,15 @@ class NetState:
     tb_quantum: jax.Array        # [H] i64 last analytic refill quantum
     nic_send_pending: jax.Array  # [H] bool — a future NIC_SEND exists
     nic_recv_pending: jax.Array  # [H] bool
+    # Transient intra-micro-step flag: data was enqueued on a socket
+    # this micro-step and the send drain (which runs last in the
+    # handler pipeline) should pick it up NOW — the device form of the
+    # reference's synchronous networkinterface_wantsSend call
+    # (network_interface.c:583-...) instead of a same-time event
+    # round-trip. Always consumed by handle_nic_send in the same
+    # micro-step; host-side syscall paths must flush it explicitly
+    # (vproc flush_wants_send).
+    nic_send_now: jax.Array      # [H] bool
     rr_ptr: jax.Array            # [H] i32 round-robin qdisc cursor
     port_ctr: jax.Array          # [H] i32 ephemeral port allocator
                                  # (counter analog of host.c:1058-1110)
@@ -148,6 +157,14 @@ class NetState:
     sk_peer_port: jax.Array      # [H,S] i32
     sk_sndbuf: jax.Array         # [H,S] i32 byte limits
     sk_rcvbuf: jax.Array         # [H,S] i32
+    # Monotonic readiness generations: bumped every time new input
+    # data/EOF raises READABLE (in) or freed capacity raises WRITABLE
+    # (out). Edge-triggered epoll watches key off these — a new
+    # arrival on an already-readable socket is still an edge, exactly
+    # like the reference's per-status-change notify
+    # (descriptor_adjustStatus -> epoll.c:583).
+    sk_in_gen: jax.Array         # [H,S] i32
+    sk_out_gen: jax.Array        # [H,S] i32
     # input ring: packets delivered, waiting for app recv
     in_src_ip: jax.Array         # [H,S,BI] i64
     in_src_port: jax.Array       # [H,S,BI] i32
@@ -233,6 +250,7 @@ def make_net_state(
         tb_quantum=z_h,
         nic_send_pending=jnp.zeros((H,), bool),
         nic_recv_pending=jnp.zeros((H,), bool),
+        nic_send_now=jnp.zeros((H,), bool),
         rr_ptr=zi_h,
         port_ctr=zi_h,
         priority_ctr=z_h,
@@ -255,6 +273,8 @@ def make_net_state(
         sk_peer_port=jnp.zeros((H, S), I32),
         sk_sndbuf=jnp.full((H, S), cfg.sndbuf, I32),
         sk_rcvbuf=jnp.full((H, S), cfg.rcvbuf, I32),
+        sk_in_gen=jnp.zeros((H, S), I32),
+        sk_out_gen=jnp.zeros((H, S), I32),
         in_src_ip=jnp.zeros((H, S, BI), I64),
         in_src_port=jnp.zeros((H, S, BI), I32),
         in_len=jnp.zeros((H, S, BI), I32),

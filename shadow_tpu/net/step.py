@@ -18,29 +18,36 @@ from shadow_tpu.net.state import NetConfig
 
 AppHandler = Callable  # (cfg, sim, popped, buf) -> (sim, buf)
 
-_NET_HANDLERS = (
-    nic.handle_packet_arrival,
-    nic.handle_nic_recv,
-    nic.handle_nic_send,
-    nic.handle_packet_local,
+# Receive side runs first so app handlers observe freshly delivered
+# data; the send drain runs LAST so packets enqueued anywhere in this
+# micro-step (TCP ACKs, app replies) hit the wire without a same-time
+# event round-trip (the nic_send_now fusion).
+_PRE_APP = (
+    nic.handle_nic_recv,       # PACKET + NIC_RECV + PACKET_LOCAL, fused
     timers.handle_timer,
     tcp.handle_tcp_rtx,
     tcp.handle_tcp_close,
 )
+_POST_APP = (
+    nic.handle_nic_send,       # NIC_SEND + fused nic_send_now drain
+)
 
 
 def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
-    """Build the engine step_fn: netstack handlers then app handlers.
-    TCP timer handlers are included only when the config carries TCP
-    state (cfg.tcp) — UDP-only device programs stay small."""
-    handlers = _NET_HANDLERS if cfg.tcp else tuple(
-        h for h in _NET_HANDLERS
+    """Build the engine step_fn: netstack receive/timer handlers, then
+    app handlers, then the send drain. TCP timer handlers are included
+    only when the config carries TCP state (cfg.tcp) — UDP-only device
+    programs stay small."""
+    pre = _PRE_APP if cfg.tcp else tuple(
+        h for h in _PRE_APP
         if h not in (tcp.handle_tcp_rtx, tcp.handle_tcp_close))
 
     def step(sim, popped, buf):
-        for h in handlers:
+        for h in pre:
             sim, buf = h(cfg, sim, popped, buf)
         for h in app_handlers:
+            sim, buf = h(cfg, sim, popped, buf)
+        for h in _POST_APP:
             sim, buf = h(cfg, sim, popped, buf)
         return sim, buf
 

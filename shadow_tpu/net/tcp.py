@@ -49,7 +49,7 @@ from shadow_tpu.core import simtime
 from shadow_tpu.core.events import NWORDS, EventKind, emit
 from shadow_tpu.net import packetfmt as pf
 from shadow_tpu.net.rings import gather_hs, set_hs, set_ring
-from shadow_tpu.net.sockets import sk_bind, sk_enqueue_out
+from shadow_tpu.net.sockets import sk_bind, sk_enqueue_out, set_writable
 from shadow_tpu.net.state import NetConfig, NetState, SocketFlags, SocketType
 
 I32 = jnp.int32
@@ -124,11 +124,18 @@ class TcpState:
     rttvar_ms: jax.Array   # [H,S] i32
     rto_ms: jax.Array      # [H,S] i32
     backoff: jax.Array     # [H,S] i32 exponential backoff shift
-    # retransmission timer: at most one in-flight event per socket;
-    # the event checks rtx_expire on fire and re-arms if moved
-    # (the reference's timer invalidation pattern, timer.c:23-42)
+    # retransmission timer: one *canonical* in-flight event per socket,
+    # identified by a generation counter (the reference's timer
+    # invalidation pattern, timer.c:23-42). The event checks rtx_expire
+    # on fire and re-arms if the deadline moved later; arming an
+    # *earlier* deadline than the in-flight event's fire time emits a
+    # replacement event with a bumped generation (stale events die
+    # silently on gen mismatch) — so the earliest deadline always has
+    # a covering event.
     rtx_expire: jax.Array  # [H,S] i64 deadline (INVALID = disarmed)
-    rtx_event: jax.Array   # [H,S] bool an event is in flight
+    rtx_event: jax.Array   # [H,S] bool a current-gen event is in flight
+    rtx_fire: jax.Array    # [H,S] i64 fire time of that event
+    rtx_gen: jax.Array     # [H,S] i32 current generation
     # listener / accept (ref: tcp server multiplexing, tcp.c:260-321)
     parent: jax.Array      # [H,S] i32 child -> listener slot (-1)
     aq: jax.Array          # [H,S,ACCEPT_QUEUE] i32 ready child slots
@@ -136,6 +143,7 @@ class TcpState:
     aq_count: jax.Array    # [H,S] i32
     # counters (tracker parity: retransmission tally)
     retx_segs: jax.Array   # [H] i64 segments retransmitted
+    fr_entries: jax.Array  # [H] i64 fast-recovery entries (3 dup ACKs)
     drop_oo_full: jax.Array  # [H] i64 segs dropped, reassembly full
     drop_rwin: jax.Array   # [H] i64 segs dropped, recv buffer full
 
@@ -163,10 +171,12 @@ class TcpState:
             backoff=zi,
             rtx_expire=jnp.full((H, S), simtime.INVALID, I64),
             rtx_event=zb,
+            rtx_fire=jnp.full((H, S), simtime.INVALID, I64),
+            rtx_gen=jnp.zeros((H, S), I32),
             parent=jnp.full((H, S), -1, I32),
             aq=jnp.zeros((H, S, ACCEPT_QUEUE), I32),
             aq_head=zi, aq_count=zi,
-            retx_segs=zh, drop_oo_full=zh, drop_rwin=zh,
+            retx_segs=zh, fr_entries=zh, drop_oo_full=zh, drop_rwin=zh,
         )
 
 
@@ -206,11 +216,16 @@ def _seg_words(net: NetState, mask, slot, flags, seq, length, payref=None):
 
 def _adv_window(net: NetState, tcp: TcpState, slot):
     """Receive window to advertise: buffer capacity minus bytes held
-    for the app and parked in reassembly (ref: autotune-less branch of
-    tcp.c:407-592 — autotuning is a later addition)."""
-    oo_bytes = jnp.sum(tcp.oo_r - tcp.oo_l, axis=2, dtype=I32)  # [H,S]
-    free = gather_hs(net.sk_rcvbuf, slot) - gather_hs(tcp.app_rbytes, slot) \
-        - gather_hs(oo_bytes, slot)
+    for the app (ref: autotune-less branch of tcp.c:407-592).
+
+    Out-of-order parked bytes deliberately do NOT shrink the window:
+    they sit inside already-advertised sequence space, and subtracting
+    them would make every dup-ACK generated after an OO arrival carry
+    a smaller window than the last — defeating the sender's dup-ACK
+    test (peer_win == wnd_prev) and disabling fast retransmit. This is
+    Linux's monotonic-window-edge behavior; the data-path drop guard
+    still accounts OO bytes for memory safety."""
+    free = gather_hs(net.sk_rcvbuf, slot) - gather_hs(tcp.app_rbytes, slot)
     return jnp.maximum(free, 0)
 
 
@@ -257,8 +272,12 @@ def _enqueue_seg(sim, buf, mask, slot, flags, seq, length, now):
 
 
 def _arm_rtx(sim, buf, mask, slot, now):
-    """Ensure an RTO deadline + an in-flight timer event exist
-    (ref: _tcp_setRetransmitTimer)."""
+    """Ensure an RTO deadline + a covering timer event exist
+    (ref: _tcp_setRetransmitTimer). If the new deadline is *earlier*
+    than the in-flight event's fire time (backoff collapse after an
+    ACK, or slot reuse with a far-future stale event), emit a
+    replacement event under a bumped generation — the old event dies
+    silently on gen mismatch."""
     tcp = sim.tcp
     H = mask.shape[0]
     rto_ns = (gather_hs(tcp.rto_ms, slot).astype(I64)
@@ -267,10 +286,17 @@ def _arm_rtx(sim, buf, mask, slot, now):
     rto_ns = jnp.minimum(rto_ns, I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
     deadline = now + rto_ns
     tcp = _set(tcp, "rtx_expire", mask, slot, deadline)
-    need_event = mask & ~gather_hs(tcp.rtx_event, slot)
+    in_flight = gather_hs(tcp.rtx_event, slot)
+    earlier = mask & in_flight & (deadline < gather_hs(tcp.rtx_fire, slot))
+    need_event = (mask & ~in_flight) | earlier
+    gen = gather_hs(tcp.rtx_gen, slot) + 1
+    tcp = _set(tcp, "rtx_gen", need_event, slot, gen)
     tcp = _set(tcp, "rtx_event", need_event, slot, True)
+    tcp = _set(tcp, "rtx_fire", need_event, slot, deadline)
     sim = sim.replace(tcp=tcp)
-    w = jnp.zeros((H, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    w = (jnp.zeros((H, NWORDS), I32)
+         .at[:, 0].set(slot.astype(I32))
+         .at[:, 1].set(gen))
     buf = emit(buf, need_event, sim.net.lane_id, deadline,
                EventKind.TCP_RTX_TIMER, w)
     return sim, buf
@@ -351,7 +377,10 @@ def tcp_send(cfg: NetConfig, sim, mask, slot, nbytes, now, buf):
     room = jnp.maximum(sndbuf - (end - una), 0)
     accepted = jnp.where(can, jnp.minimum(jnp.asarray(nbytes, I32), room), 0)
     tcp = _set(tcp, "snd_end", can, slot, end + accepted)
-    sim = sim.replace(tcp=tcp)
+    # stream buffer exhausted: drop WRITABLE until ACK progress frees
+    # room (ref: descriptor_adjustStatus; drives epoll EPOLLOUT waits)
+    bfull = can & (room - accepted <= 0)
+    sim = sim.replace(tcp=tcp, net=set_writable(sim.net, bfull, slot, False))
     sim, buf = tcp_flush(cfg, sim, mask, slot, now, buf)
     return sim, buf, accepted
 
@@ -588,11 +617,20 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     st = gather_hs(tcp.st, slot)
 
     # ---- LISTEN + SYN: spawn a child in SYN_RCVD ---------------------
-    # (ref: server multiplexing, tcp.c:1822-1852)
+    # (ref: server multiplexing, tcp.c:1822-1852). A full backlog —
+    # queued children plus children still in handshake — refuses the
+    # connection by dropping the SYN unanswered, so the client's SYN
+    # retransmit retries later (the reference refuses at capacity
+    # rather than orphaning an ESTABLISHED child no accept() can see).
     syn_to_listen = mask & (st == TcpSt.LISTEN) & f_syn
+    in_handshake = jnp.sum(
+        (tcp.parent == slot[:, None]) & (tcp.st == TcpSt.SYN_RCVD),
+        axis=1, dtype=I32)
+    backlog = gather_hs(tcp.aq_count, slot) + in_handshake
+    syn_ok = syn_to_listen & (backlog < ACCEPT_QUEUE)
     from shadow_tpu.net.sockets import sk_create
 
-    net, child = sk_create(net, syn_to_listen, SocketType.TCP)
+    net, child = sk_create(net, syn_ok, SocketType.TCP)
     spawned = syn_to_listen & (child >= 0)
     net = net.replace(
         sk_bound_ip=set_hs(net.sk_bound_ip, spawned, child,
@@ -643,9 +681,9 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "ts_recent", synack, slot, tsval)
     tcp = _set(tcp, "backoff", synack, slot, jnp.zeros((H,), I32))
     tcp = _disarm_rtx(tcp, synack, slot)
-    fl = gather_hs(net.sk_flags, slot)
-    net = net.replace(sk_flags=set_hs(net.sk_flags, synack, slot,
-                                      fl | SocketFlags.WRITABLE))
+    # establish raises WRITABLE through the helper so the out-gen edge
+    # fires for ET EPOLLOUT watches armed during the handshake
+    net = set_writable(net, synack, slot, True)
     sim = sim.replace(net=net, tcp=tcp)
     # the handshake-completing ACK and any buffered data ride the
     # merged flush + pure-ACK paths at the end of this function (one
@@ -658,15 +696,20 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
                slot, tsval)
 
     # ---- SYN_RCVD + final ACK: ESTABLISHED + accept queue ------------
-    est_child = mask & (st == TcpSt.SYN_RCVD) & f_ack & ~f_syn & (ack == 1)
+    # If the completing ACK races a (transiently) full accept queue,
+    # the ACK is ignored: the child stays SYN_RCVD and its SYN|ACK
+    # retransmit re-offers — never an orphaned ESTABLISHED child that
+    # no accept() can reach.
+    est_cand = mask & (st == TcpSt.SYN_RCVD) & f_ack & ~f_syn & (ack == 1)
+    parent = gather_hs(tcp.parent, slot)
+    queue_ok = est_cand & (parent >= 0) & (
+        gather_hs(tcp.aq_count, parent) < ACCEPT_QUEUE)
+    est_child = est_cand & (queue_ok | (parent < 0))
     tcp = _set(tcp, "st", est_child, slot,
                jnp.full((H,), TcpSt.ESTABLISHED, I32))
     tcp = _set(tcp, "snd_una", est_child, slot, jnp.ones((H,), I32))
     tcp = _set(tcp, "backoff", est_child, slot, jnp.zeros((H,), I32))
     tcp = _disarm_rtx(tcp, est_child, slot)
-    parent = gather_hs(tcp.parent, slot)
-    queue_ok = est_child & (parent >= 0) & (
-        gather_hs(tcp.aq_count, parent) < ACCEPT_QUEUE)
     pos = (gather_hs(tcp.aq_head, parent)
            + gather_hs(tcp.aq_count, parent)) % ACCEPT_QUEUE
     tcp = tcp.replace(aq=set_ring(tcp.aq, queue_ok, parent, pos,
@@ -674,8 +717,13 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "aq_count", queue_ok, parent,
                gather_hs(tcp.aq_count, parent) + 1)
     pfl = gather_hs(net.sk_flags, parent)
-    net = net.replace(sk_flags=set_hs(net.sk_flags, queue_ok, parent,
-                                      pfl | SocketFlags.READABLE))
+    net = net.replace(
+        sk_flags=set_hs(net.sk_flags, queue_ok, parent,
+                        pfl | SocketFlags.READABLE),
+        # each newly queued child is an IN edge on the listener
+        sk_in_gen=set_hs(net.sk_in_gen, queue_ok, parent,
+                         gather_hs(net.sk_in_gen, parent) + 1),
+    )
     st = gather_hs(tcp.st, slot)
 
     # ---- ACK processing (ref: tcp.c ACK path + tcp_cong_reno.c) ------
@@ -739,6 +787,12 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "in_recovery", full_rec, slot, False)
     tcp = _set(tcp, "dup_acks", new_ack, slot, jnp.zeros((H,), I32))
     tcp = _set(tcp, "snd_una", new_ack, slot, ack)
+    # ACK progress reopened stream-buffer room: restore WRITABLE
+    # (ref: descriptor_adjustStatus on buffer drain -> epoll wakeup)
+    wroom = new_ack & (
+        gather_hs(net.sk_sndbuf, slot)
+        - (gather_hs(tcp.snd_end, slot) - ack) > 0)
+    net = set_writable(net, wroom, slot, True)
 
     # dup-ack counting / fast retransmit (ref: reno dupack_ev)
     da = gather_hs(tcp.dup_acks, slot) + 1
@@ -749,6 +803,7 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "cwnd", enter_fr, slot, ssth_fr + 3)
     tcp = _set(tcp, "in_recovery", enter_fr, slot, True)
     tcp = _set(tcp, "recover", enter_fr, slot, nxt)
+    tcp = tcp.replace(fr_entries=tcp.fr_entries + enter_fr.astype(I64))
     # window inflation while in recovery
     inflate = dup_ack & in_rec
     tcp = _set(tcp, "cwnd", inflate, slot, gather_hs(tcp.cwnd, slot) + 1)
@@ -855,11 +910,16 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
         oo_r=set_ring(tcp.oo_r, do_merge | do_new, slot, pick, nr),
     )
 
-    # readable status for the app (epoll analog)
+    # readable status for the app (epoll analog); each in-order
+    # arrival is an edge for ET watches
     readable = inorder & (gather_hs(tcp.app_rbytes, slot) > 0)
     fl = gather_hs(net.sk_flags, slot)
-    net = net.replace(sk_flags=set_hs(net.sk_flags, readable, slot,
-                                      fl | SocketFlags.READABLE))
+    net = net.replace(
+        sk_flags=set_hs(net.sk_flags, readable, slot,
+                        fl | SocketFlags.READABLE),
+        sk_in_gen=set_hs(net.sk_in_gen, readable, slot,
+                         gather_hs(net.sk_in_gen, slot) + 1),
+    )
 
     # ---- peer FIN (ref: tcp.c FIN processing) ------------------------
     fin_seen = mask & f_fin & (st >= TcpSt.ESTABLISHED) & (
@@ -884,14 +944,24 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
                EventKind.TCP_CLOSE_TIMER, w)
     # EOF is app-visible readability (recv returns 0)
     fl = gather_hs(net.sk_flags, slot)
-    net = net.replace(sk_flags=set_hs(net.sk_flags, fin_now, slot,
-                                      fl | SocketFlags.READABLE))
+    net = net.replace(
+        sk_flags=set_hs(net.sk_flags, fin_now, slot,
+                        fl | SocketFlags.READABLE),
+        sk_in_gen=set_hs(net.sk_in_gen, fin_now, slot,
+                         gather_hs(net.sk_in_gen, slot) + 1),
+    )
 
     # ---- ACK generation ----------------------------------------------
     # every data/FIN segment is acknowledged immediately (the
     # reference's quick-ACK path; delayed ACKs are a tuning TODO).
-    # synack lanes send the handshake-completing ACK here.
-    send_ack = (has_data | fin_now | old | synack) & (st != TcpSt.CLOSED)
+    # synack lanes send the handshake-completing ACK here. A SYN|ACK
+    # retransmitted to an already-ESTABLISHED peer (its completing ACK
+    # was dropped by a then-full accept backlog) also elicits a pure
+    # ACK — RFC 793 out-of-window behavior — so the handshake retries
+    # even on a connection that never sends data.
+    resynack = mask & f_syn & f_ack & (st >= TcpSt.ESTABLISHED)
+    send_ack = (has_data | fin_now | old | synack | resynack) \
+        & (st != TcpSt.CLOSED)
     sim = sim.replace(net=net, tcp=tcp)
     sim, buf, _ = _enqueue_seg(sim, buf, send_ack, slot, pf.TCPF_ACK,
                             gather_hs(tcp.snd_nxt, slot), 0, now)
@@ -910,10 +980,14 @@ def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
         return sim, buf
     mask = popped.valid & (popped.kind == EventKind.TCP_RTX_TIMER)
     slot = popped.word(0)
+    egen = popped.word(1)
     now = popped.time
     tcp = sim.tcp
     H = mask.shape[0]
 
+    # superseded events (generation mismatch) die silently — a newer
+    # event with an earlier deadline has replaced them
+    mask = mask & (egen == gather_hs(tcp.rtx_gen, slot))
     deadline = gather_hs(tcp.rtx_expire, slot)
     disarmed = mask & (deadline == simtime.INVALID)
     pending = mask & ~disarmed & (now < deadline)
@@ -921,9 +995,12 @@ def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
 
     # the in-flight event dies unless re-emitted
     tcp = _set(tcp, "rtx_event", disarmed, slot, False)
-    w = jnp.zeros((H, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    w = (jnp.zeros((H, NWORDS), I32)
+         .at[:, 0].set(slot.astype(I32))
+         .at[:, 1].set(egen))
     buf = emit(buf, pending, sim.net.lane_id, deadline,
                EventKind.TCP_RTX_TIMER, w)
+    tcp = _set(tcp, "rtx_fire", pending, slot, deadline)
 
     # timeout: collapse to slow start and go back to snd_una
     # (ref: reno timeout_ev + _tcp_retransmitTimerExpired)

@@ -72,10 +72,13 @@ def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
     net = net.replace(in_count=count)
     ib = gather_hs(net.in_bytes, slot)
     net = net.replace(in_bytes=set_hs(net.in_bytes, ok, slot, ib + length))
-    # readable on data arrival (ref: descriptor_adjustStatus READABLE)
+    # readable on data arrival (ref: descriptor_adjustStatus READABLE);
+    # every arrival is an edge for ET epoll, even when already readable
     flags = gather_hs(net.sk_flags, slot)
     net = net.replace(
-        sk_flags=set_hs(net.sk_flags, ok, slot, flags | SocketFlags.READABLE)
+        sk_flags=set_hs(net.sk_flags, ok, slot, flags | SocketFlags.READABLE),
+        sk_in_gen=set_hs(net.sk_in_gen, ok, slot,
+                         gather_hs(net.sk_in_gen, slot) + 1),
     )
     dropped = mask & ~space_ok
     net = net.replace(

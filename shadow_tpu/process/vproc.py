@@ -128,10 +128,80 @@ def gettime():
 
 
 def wait_readable(fds):
-    """epoll_wait analog over this process's fds: blocks until one is
-    readable, returns the list of readable fds (ref: epoll.c
-    readiness engine)."""
+    """Convenience: blocks until one of `fds` is readable, returns the
+    list of readable fds (a level-triggered EPOLLIN wait without an
+    explicit epoll object)."""
     return Sys("wait_readable", (tuple(fds),))
+
+
+# ---------------------------------------------------------------------
+# epoll: the readiness engine (ref: descriptor/epoll.c)
+# ---------------------------------------------------------------------
+#
+# The reference's epoll is the app-wakeup spine: descriptor status
+# changes notify EpollWatches, which schedule a task that re-enters
+# process_continue (epoll.c:583-680). Here the *status* half lives on
+# device (SocketFlags.READABLE/WRITABLE maintained by the netstack —
+# udp_deliver/udp_recv, tcp data/ACK paths, sk_enqueue_out, NIC drain)
+# and the *watch* half is host-side per-process state polled at
+# window-boundary resumption. Level/edge/oneshot flag algebra follows
+# epoll.c:24-67; an epoll is itself watchable (nesting, epoll.c:96-98)
+# — its readiness is "has at least one ready watch".
+#
+# Edge-trigger granularity — an explicit deviation: edges are detected
+# between consecutive polls of the same watch (readiness transitions
+# within one conservative window collapse), consistent with the
+# window-batched scheduling model described in the module docstring.
+
+class EPOLL:
+    IN = 1        # maps to SocketFlags.READABLE
+    OUT = 2       # maps to SocketFlags.WRITABLE
+    ET = 4        # edge-triggered
+    ONESHOT = 8   # disarm after first report (re-arm via MOD)
+    CTL_ADD = 1
+    CTL_MOD = 2
+    CTL_DEL = 3
+
+
+EPOLL_FD_BASE = 1 << 16   # epoll fds live above the socket-slot space
+
+
+def epoll_create():
+    """Returns an epoll fd (ref: epoll_new, epoll.c)."""
+    return Sys("epoll_create", ())
+
+
+def epoll_ctl(epfd, op, fd, events=0):
+    """op in {EPOLL.CTL_ADD, CTL_MOD, CTL_DEL}; events is a mask of
+    EPOLL.IN|OUT plus EPOLL.ET/ONESHOT behavior flags
+    (ref: epoll_control, epoll.c)."""
+    return Sys("epoll_ctl", (epfd, op, fd, events))
+
+
+def epoll_wait(epfd):
+    """Blocks until at least one watch reports; returns a list of
+    (fd, ready_mask) pairs (ref: epoll_getEvents + the notify ->
+    process_continue chain, epoll.c:344-366,638-680)."""
+    return Sys("epoll_wait", (epfd,))
+
+
+@dataclass
+class _EpollWatch:
+    interest: int         # EPOLL.IN|OUT
+    flags: int            # EPOLL.ET|ONESHOT
+    # Edge bases: the readiness generations consumed by the previous
+    # poll. -1 = never polled, so readiness present at ADD time is
+    # reported once (Linux's ep_insert queues an initial event for a
+    # ready fd). New arrivals bump the device-side generation, so an
+    # already-readable socket still edges on each arrival.
+    prev_in_gen: int = -1
+    prev_out_gen: int = -1
+    armed: bool = True    # oneshot disarm state
+
+
+@dataclass
+class _Epoll:
+    watches: "dict[int, _EpollWatch]" = field(default_factory=dict)
 
 
 # ---------------------------------------------------------------------
@@ -152,6 +222,9 @@ class _Proc:
     block: Optional[Sys] = None
     pending: Optional[Sys] = None  # next syscall to execute
     wake_time: int = -1            # for sleep
+    # per-process epoll instances (epfd -> _Epoll)
+    epolls: "dict[int, _Epoll]" = field(default_factory=dict)
+    next_epfd: int = EPOLL_FD_BASE
 
 
 class ProcessRuntime:
@@ -166,6 +239,10 @@ class ProcessRuntime:
         self.procs: list[_Proc] = []
         self._step = make_step_fn(self.cfg, app_handlers)
         self._jit_window = jax.jit(self._window)
+        # host-side snapshot of sk_flags, fetched at most once between
+        # state mutations (readiness polls would otherwise do one
+        # device->host transfer per watch per resume)
+        self._flags_cache = None
 
     # -- process registration -----------------------------------------
 
@@ -193,14 +270,88 @@ class ProcessRuntime:
         m[host] = True
         return jnp.asarray(m)
 
-    def _apply(self, fn):
+    def _apply(self, fn, now=0):
         """Run a state-op that may emit events, then fold the emissions
-        into the queues exactly like a device micro-step does."""
+        into the queues exactly like a device micro-step does. Any
+        nic_send_now bits the op set are converted into NIC_SEND
+        events — no pipeline send drain runs out here."""
+        from shadow_tpu.net import nic
+
         buf = EmitBuffer.create(self.cfg.num_hosts, self.cfg.emit_capacity)
         sim, buf = fn(self.sim, buf)
+        sim, buf = nic.flush_wants_send(sim, buf, now)
         q, out = apply_emissions(sim.events, sim.outbox, buf,
                                  sim.net.lane_id)
         self.sim = sim.replace(events=q, outbox=out)
+        self._flags_cache = None
+
+    # -- readiness (the epoll.c status engine, host side) ---------------
+
+    def _net_rows(self):
+        if self._flags_cache is None:
+            net = self.sim.net
+            self._flags_cache = (
+                np.asarray(net.sk_flags),
+                np.asarray(net.sk_in_gen),
+                np.asarray(net.sk_out_gen),
+            )
+        return self._flags_cache
+
+    def _flags_row(self, host):
+        return self._net_rows()[0][host]
+
+    def _fd_gens(self, p: _Proc, fd: int, _depth: int = 0):
+        """(in_gen, out_gen) of a socket fd; for a nested epoll, the
+        sum of its watches' generations (monotonic — any child edge
+        advances the parent's)."""
+        if fd >= EPOLL_FD_BASE:
+            ep = p.epolls.get(fd)
+            if ep is None or _depth > 8:
+                return (0, 0)
+            gi = go = 0
+            for wfd in ep.watches:
+                a, b = self._fd_gens(p, wfd, _depth + 1)
+                gi += a
+                go += b
+            return (gi, go)
+        _, ig, og = self._net_rows()
+        return (int(ig[p.host][fd]), int(og[p.host][fd]))
+
+    def _watch_report(self, p: _Proc, wfd: int, w: _EpollWatch,
+                      _depth: int = 0) -> int:
+        """What this watch would report NOW (non-destructive)."""
+        cur = self._fd_ready(p, wfd, _depth) & w.interest
+        if not (w.flags & EPOLL.ET):
+            return cur
+        gin, gout = self._fd_gens(p, wfd, _depth)
+        report = 0
+        if (cur & EPOLL.IN) and gin != w.prev_in_gen:
+            report |= EPOLL.IN
+        if (cur & EPOLL.OUT) and gout != w.prev_out_gen:
+            report |= EPOLL.OUT
+        return report
+
+    def _fd_ready(self, p: _Proc, fd: int, _depth: int = 0) -> int:
+        """Current EPOLL.IN|OUT readiness of a socket fd or a nested
+        epoll fd (an epoll is readable when it would report at least
+        one event — epoll-as-descriptor, ref: epoll.c:96-98)."""
+        if fd >= EPOLL_FD_BASE:
+            if _depth > 8:       # nesting depth guard (cycles)
+                return 0
+            ep = p.epolls.get(fd)
+            if ep is None:
+                return 0
+            for wfd, w in ep.watches.items():
+                if w.armed and self._watch_report(p, wfd, w, _depth + 1):
+                    return EPOLL.IN
+            return 0
+        flags = int(self._flags_row(p.host)[fd])
+        m = 0
+        if flags & SocketFlags.READABLE:
+            m |= EPOLL.IN
+        if flags & SocketFlags.WRITABLE:
+            m |= EPOLL.OUT
+        return m
 
     def _exec(self, p: _Proc, call: Sys, now: int):
         """Execute one non-blocking syscall (or the completion of a
@@ -215,7 +366,51 @@ class ProcessRuntime:
         if op == "socket":
             net, slot = sk_create(self.sim.net, mask, a[0])
             self.sim = self.sim.replace(net=net)
+            self._flags_cache = None
             return True, int(slot[h])
+        if op == "epoll_create":
+            epfd = p.next_epfd
+            p.next_epfd += 1
+            p.epolls[epfd] = _Epoll()
+            return True, epfd
+        if op == "epoll_ctl":
+            epfd, ctl, fd, events = a
+            ep = p.epolls.get(epfd)
+            if ep is None:
+                return True, -1
+            if ctl in (EPOLL.CTL_ADD, EPOLL.CTL_MOD):
+                if ctl == EPOLL.CTL_ADD and fd in ep.watches:
+                    return True, -1       # EEXIST
+                if ctl == EPOLL.CTL_MOD and fd not in ep.watches:
+                    return True, -1       # ENOENT
+                # MOD resets the edge base and re-arms oneshot
+                # (ref: epoll.c watch flag algebra, epoll.c:24-67)
+                ep.watches[fd] = _EpollWatch(
+                    interest=events & (EPOLL.IN | EPOLL.OUT),
+                    flags=events & (EPOLL.ET | EPOLL.ONESHOT),
+                )
+            elif ctl == EPOLL.CTL_DEL:
+                if ep.watches.pop(fd, None) is None:
+                    return True, -1       # ENOENT
+            return True, 0
+        if op == "epoll_wait":
+            ep = p.epolls.get(a[0])
+            if ep is None:
+                return True, []
+            events = []
+            for wfd, w in ep.watches.items():
+                if not w.armed:
+                    continue
+                report = self._watch_report(p, wfd, w)
+                # consume the edge base whether or not it reported
+                w.prev_in_gen, w.prev_out_gen = self._fd_gens(p, wfd)
+                if report:
+                    events.append((wfd, report))
+                    if w.flags & EPOLL.ONESHOT:
+                        w.armed = False
+            if events:
+                return True, events
+            return False, None
         if op == "bind":
             net, port = sk_bind(self.sim.net, mask, jnp.full_like(mask, a[0], I32),
                                 0, a[1])
@@ -239,7 +434,7 @@ class ProcessRuntime:
                 from shadow_tpu.net import nic
                 return nic.notify_wants_send(sim.replace(net=net), buf, okk, now)
 
-            self._apply(do)
+            self._apply(do, now)
             return True, bool(ok[h])
         if op == "connect":
             fd, ip, port = a
@@ -248,7 +443,7 @@ class ProcessRuntime:
                 # issue the SYN, then block until established
                 self._apply(lambda sim, buf: tcpmod.tcp_connect(
                     self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
-                    ip, port, now, buf))
+                    ip, port, now, buf), now)
                 return False, None
             if st == tcpmod.TcpSt.ESTABLISHED or st >= tcpmod.TcpSt.FIN_WAIT_1:
                 return True, 0
@@ -266,7 +461,7 @@ class ProcessRuntime:
                 child = int(ch[h])
                 return sim, buf
 
-            self._apply(do)
+            self._apply(do, now)
             if child is not None and child >= 0:
                 return True, child
             return False, None
@@ -282,7 +477,7 @@ class ProcessRuntime:
                 acc = int(accepted[h])
                 return sim, buf
 
-            self._apply(do)
+            self._apply(do, now)
             if acc and acc > 0:
                 return True, acc
             return False, None
@@ -302,7 +497,7 @@ class ProcessRuntime:
                     nread, eof = int(nr[h]), bool(ef[h])
                     return sim, buf
 
-                self._apply(do)
+                self._apply(do, now)
                 if nread and nread > 0:
                     return True, nread
                 if eof:
@@ -319,7 +514,7 @@ class ProcessRuntime:
                 res, got_any = int(ln[h]), bool(got[h])
                 return sim.replace(net=net), buf
 
-            self._apply(do)
+            self._apply(do, now)
             if got_any:
                 return True, res
             return False, None
@@ -336,16 +531,25 @@ class ProcessRuntime:
                 got_any = bool(got[h])
                 return sim.replace(net=net), buf
 
-            self._apply(do)
+            self._apply(do, now)
             if got_any:
                 return True, res
             return False, None
         if op == "close":
             fd = a[0]
+            if fd >= EPOLL_FD_BASE:
+                p.epolls.pop(fd, None)
+                return True, 0
+            # closing a socket removes its watches (the reference
+            # deregisters listeners when a descriptor is freed) —
+            # otherwise a stale watch reports the readiness of
+            # whatever unrelated socket later reuses the slot
+            for ep in p.epolls.values():
+                ep.watches.pop(fd, None)
             if int(self.sim.net.sk_type[h, fd]) == SocketType.TCP:
                 self._apply(lambda sim, buf: tcpmod.tcp_close(
                     self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
-                    now, buf))
+                    now, buf), now)
             else:
                 net = self.sim.net
                 sel = self._lane(h)
@@ -361,6 +565,7 @@ class ProcessRuntime:
                                          jnp.zeros_like(slot)),
                 )
                 self.sim = self.sim.replace(net=net)
+                self._flags_cache = None
             return True, 0
         if op == "sleep":
             if p.block is None:
@@ -370,10 +575,7 @@ class ProcessRuntime:
                 return True, 0
             return False, None
         if op == "wait_readable":
-            fds = a[0]
-            flags = np.asarray(self.sim.net.sk_flags[h])
-            ready = [fd for fd in fds
-                     if (int(flags[fd]) & SocketFlags.READABLE)]
+            ready = [fd for fd in a[0] if self._fd_ready(p, fd) & EPOLL.IN]
             if ready:
                 return True, ready
             return False, None
@@ -438,6 +640,10 @@ class ProcessRuntime:
             wend = min(wstart + min_jump, end + 1)
             self.sim, stats, next_min = self._jit_window(
                 self.sim, wstart, wend)
+            # the device window mutated readiness state (flags/gens):
+            # drop the host-side snapshot or blocked epoll_wait /
+            # wait_readable polls read stale readiness forever
+            self._flags_cache = None
             total = EngineStats(
                 events_processed=total.events_processed
                 + stats.events_processed,

@@ -98,6 +98,61 @@ def test_tcp_lossy_transfer_completes():
     assert int(sim.events.overflow) == 0
 
 
+def test_tcp_fast_retransmit_fires():
+    """Fast retransmit must actually engage under loss: out-of-order
+    arrivals park bytes in reassembly, the receiver's dup-ACKs must
+    keep a stable advertised window (monotonic window edge) so the
+    sender's dup-ACK counter reaches 3 (regression: subtracting OO
+    bytes from the window made every dup-ACK look like a window
+    update, silently disabling Reno fast recovery)."""
+    b = _build(200_000, loss=0.05, end_s=60)
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+    si = b.host_of("server")
+    assert int(sim.app.rcvd[si]) == 200_000
+    assert int(sim.tcp.fr_entries.sum()) > 0
+
+
+MULTI_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">10.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def test_tcp_multi_client_sequential_accept():
+    """Three clients stream to one server. The server accepts and
+    drains one child at a time, releasing the slot after each passive
+    close; later connections wait in the accept queue (and SYN-retry
+    if the backlog is momentarily full). Regression for: child slot
+    never released after EOF (single-connection server) and orphaned
+    ESTABLISHED children when the accept queue was full."""
+    import jax.numpy as jnp
+
+    total = 20_000
+    cfg = NetConfig(num_hosts=4, end_time=60 * simtime.ONE_SECOND,
+                    event_capacity=256, outbox_capacity=256,
+                    router_ring=256)
+    hosts = [HostSpec(name=f"client{i}",
+                      proc_start_time=simtime.ONE_SECOND)
+             for i in range(3)] + [HostSpec(name="server")]
+    b = build(cfg, MULTI_GRAPH, hosts)
+    client = jnp.asarray(np.arange(4) < 3)
+    server = jnp.asarray(np.arange(4) == 3)
+    b.sim = bulk.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=b.ip_of("server"), server_port=PORT,
+        total_bytes=total,
+    )
+    sim, stats = run(b, app_handlers=(bulk.handler,))
+    si = b.host_of("server")
+    assert int(sim.app.rcvd[si]) == 3 * total
+    assert int(sim.events.overflow) == 0
+
+
 def test_tcp_deterministic():
     r1, s1 = run(_build(60_000, loss=0.10, end_s=60),
                  app_handlers=(bulk.handler,))

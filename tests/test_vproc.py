@@ -76,6 +76,28 @@ def test_udp_echo_coroutines():
     assert all(p.done for p in rt.procs)
 
 
+def test_tcp_connect_refused():
+    """An active open to a port nobody listens on must fail promptly:
+    the destination host answers the SYN with RST (no matching
+    socket), the connecting socket is torn down, and connect()
+    returns -1 — instead of retransmitting SYNs forever (ref: the
+    reference's RST-on-closed path in tcp_processPacket)."""
+    b = _bundle(seconds=10)
+    server_ip = b.ip_of("server")
+    results = []
+
+    def client(host):
+        fd = yield vproc.socket(SocketType.TCP)
+        rc = yield vproc.connect(fd, server_ip, 9999)  # nothing listens
+        results.append(rc)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(b.host_of("client"), client, start_time=simtime.ONE_SECOND)
+    sim, stats = rt.run()
+    assert results == [-1]
+    assert all(p.done for p in rt.procs)
+
+
 def test_tcp_transfer_coroutines():
     b = _bundle(seconds=30)
     server_ip = b.ip_of("server")
