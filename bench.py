@@ -63,7 +63,7 @@ def _phold_runner(H, load, sim_s, seed=1):
     from shadow_tpu.net.build import make_runner
 
     b = _build_phold(H, load, sim_s, seed)
-    fn = make_runner(b, app_handlers=(phold.handler,))
+    fn = make_runner(b, app_handlers=(phold.handler,), app_bulk=phold.BULK)
     # pre-build distinct-seed inputs so the timed call measures only
     # the device program, not host-side setup
     sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i).sim

@@ -78,6 +78,62 @@ def _send_one(cfg, sim, buf, mask, now):
     return nic.notify_wants_send(sim, buf, ok, now)
 
 
+class PholdBulk:
+    """Bulk window pass hooks (net.bulk.AppBulk contract): consume
+    every delivered message, reply to one uniformly random peer per
+    message, reproducing the serial handler's draw stream exactly —
+    per consumed event j (in time order): draw 2j is the peer choice
+    (_send_one), draw 2j+1 the NIC reliability Bernoulli
+    (handle_nic_send, same micro-step)."""
+
+    max_send_len = MSG_SIZE
+
+    def precheck(self, cfg, sim):
+        # injection still running (PROC_START/KIND_INJECT chains) is
+        # excluded by the engine's kind eligibility; this guards the
+        # app-state side of the same condition.
+        return sim.app.remaining == 0
+
+    def run(self, cfg, sim, d):
+        from shadow_tpu.net import bulk as bulkmod
+
+        app = sim.app
+        net = sim.net
+        GH = net.host_ip.shape[0]
+        H, K = d.mask.shape
+        lane = net.lane_id
+
+        rc = bulkmod.rank_in_order(d.before, d.mask)   # consumed rank
+        app_ctr = net.rng_ctr[:, None] + 2 * rc.astype(jnp.uint32)
+        u = rng.uniform_at(net.rng_keys, app_ctr)
+        peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
+        peer = jnp.where(peer >= lane[:, None], peer + 1, peer)
+        dst_ip = net.host_ip[jnp.clip(peer, 0, GH - 1)]
+
+        m = jnp.sum(d.mask, axis=1, dtype=I32)
+        sim = sim.replace(
+            net=net.replace(rng_ctr=net.rng_ctr + 2 * m.astype(jnp.uint32)),
+            app=app.replace(
+                rcvd=app.rcvd + m.astype(I64),
+                sent=app.sent + m.astype(I64),
+            ),
+        )
+        sends = bulkmod.BulkSends(
+            mask=d.mask,
+            slot=jnp.broadcast_to(app.sock[:, None], (H, K)),
+            dst_ip=dst_ip,
+            dst_host=peer,
+            dst_port=jnp.broadcast_to(app.port[:, None], (H, K)),
+            length=jnp.full((H, K), MSG_SIZE, I32),
+            payref=jnp.full((H, K), -1, I32),
+            nic_draw_ctr=app_ctr + 1,
+        )
+        return sim, sends
+
+
+BULK = PholdBulk()
+
+
 def handler(cfg: NetConfig, sim, popped, buf):
     app = sim.app
     now = popped.time

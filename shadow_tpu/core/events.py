@@ -148,17 +148,13 @@ def _tie_key(src: jax.Array, seq: jax.Array) -> jax.Array:
     return (src.astype(jnp.int64) << 32) | seq.astype(jnp.uint32).astype(jnp.int64)
 
 
-def pop_earliest(q: EventQueue, horizon, mask=None,
-                 require_kind: int | None = None) -> tuple[EventQueue, Popped]:
+def pop_earliest(q: EventQueue, horizon) -> tuple[EventQueue, Popped]:
     """Pop each host's earliest event with time < horizon.
 
     This is the device analog of one scheduler_pop round across all
     hosts at once (ref: scheduler.c:359-377): one host's events stay
     serial (one pop per micro-step), different hosts pop in parallel.
-
-    `mask` restricts which lanes pop at all; `require_kind` pops only
-    when the head event has that kind (the slot is left untouched
-    otherwise) — used by the batched pop below.
+    (Whole-window batching lives in net/bulk.py instead.)
     """
     t = q.time  # [H, K]
     # Lexicographic argmin over (time, src, seq) within each row.
@@ -169,15 +165,10 @@ def pop_earliest(q: EventQueue, horizon, mask=None,
     rows = jnp.arange(q.num_hosts)
     ptime = t[rows, idx]
     valid = ptime < jnp.asarray(horizon, simtime.DTYPE)
-    if mask is not None:
-        valid = valid & mask
-    kind = q.kind[rows, idx]
-    if require_kind is not None:
-        valid = valid & (kind == require_kind)
     popped = Popped(
         valid=valid,
         time=ptime,
-        kind=kind,
+        kind=q.kind[rows, idx],
         src=q.src[rows, idx],
         seq=q.seq[rows, idx],
         words=q.words[rows, idx],
@@ -186,28 +177,6 @@ def pop_earliest(q: EventQueue, horizon, mask=None,
     sel = _onehot(valid, idx, q.capacity)
     new_time = jnp.where(sel, simtime.INVALID, q.time)
     return q.replace(time=new_time), popped
-
-
-def pop_earliest_k(q: EventQueue, horizon, k: int
-                   ) -> tuple[EventQueue, list[Popped]]:
-    """Pop up to k in-window events per host in deterministic order.
-
-    The first pop is unrestricted; pops 2..k continue only through a
-    PREFIX of kind==PACKET events (packet arrivals commute: they
-    append to the router ring in pop order, so draining a run of them
-    in one micro-step is bit-identical to k single-pop micro-steps).
-    Any other kind — or a PACKET following a non-PACKET head — waits
-    for the next micro-step, preserving the reference's serial
-    per-host execution order (event.c:110-153)."""
-    q, p0 = pop_earliest(q, horizon)
-    pops = [p0]
-    cont = p0.valid & (p0.kind == EventKind.PACKET)
-    for _ in range(k - 1):
-        q, p = pop_earliest(q, horizon, mask=cont,
-                            require_kind=EventKind.PACKET)
-        pops.append(p)
-        cont = cont & p.valid
-    return q, pops
 
 
 def push_rows(

@@ -42,6 +42,24 @@ def uniform(key_data: jax.Array, counters: jax.Array) -> tuple[jax.Array, jax.Ar
     return vals, counters + 1
 
 
+def uniform_at(key_data: jax.Array, counters: jax.Array) -> jax.Array:
+    """f32 uniform [0,1) draws at explicit counters ([H, ...] u32,
+    leading dim = hosts). Bit-identical to repeated uniform() calls at
+    the same counter values — the bulk window pass uses this to
+    reproduce the serial path's draw stream out of order."""
+    H = key_data.shape[0]
+    flat = counters.reshape(H, -1)
+
+    def one(kd, cs):
+        k = jax.random.wrap_key_data(kd)
+        ks = jax.vmap(jax.random.fold_in, in_axes=(None, 0))(
+            k, cs.astype(jnp.uint32))
+        return jax.vmap(lambda kk: jax.random.uniform(kk, dtype=jnp.float32))(ks)
+
+    vals = jax.vmap(one)(key_data, flat)
+    return vals.reshape(counters.shape)
+
+
 def randint(key_data: jax.Array, counters: jax.Array, maxval) -> tuple[jax.Array, jax.Array]:
     """One i32 uniform draw in [0, maxval) per host (maxval may be [H])."""
     ks = _fold(key_data, counters)

@@ -133,22 +133,33 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
 
 
 def make_runner(bundle: SimBundle, app_handlers=(),
-                end_time: int | None = None):
+                end_time: int | None = None, app_bulk=None):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
     seconds per call at this op count; a reused jitted callable pays
     it once and then hits the C++ dispatch fast path (this is what a
-    benchmark's timed iteration must call)."""
+    benchmark's timed iteration must call).
+
+    `app_bulk` (a net.bulk.AppBulk) turns on the bulk window pass:
+    eligible hosts' whole windows are consumed in one vectorized pass
+    per window instead of one micro-step per event, bit-identically
+    (see net/bulk.py)."""
     import jax
 
     step = make_step_fn(bundle.cfg, app_handlers)
     end = end_time if end_time is not None else bundle.cfg.end_time
+    bulk_fn = None
+    if app_bulk is not None:
+        from shadow_tpu.net.bulk import make_bulk_fn
+
+        bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
 
     def _go(sim):
         return engine_run(
             sim, step, end_time=end, min_jump=bundle.min_jump,
             emit_capacity=bundle.cfg.emit_capacity,
             lane_id=sim.net.lane_id,
+            bulk_fn=bulk_fn,
         )
 
     return jax.jit(_go)

@@ -1,0 +1,457 @@
+"""Bulk window pass: process a host's whole window of UDP packet
+arrivals in ONE vectorized pass instead of one micro-step per event.
+
+This is SURVEY.md §7.2's sort+segment design, implemented without any
+sort: every order-dependent quantity is computed with masked
+compare-reduces over the [H, K, K] "event i precedes event j" relation
+(XLA fuses the broadcast compare into the reduce, so the cube is never
+materialized), and the token-bucket evolution — a chain of
+refill-then-consume steps f_i(x) = min(cap, x + dq_i*refill) - w_i —
+telescopes into the closed form
+
+    F(s0) = min(s0 + (q_K - q_0)*refill - sum(w),
+                min_i [cap - w_i + (q_K - q_i)*refill - suffw_i])
+
+because min-affine maps compose associatively (each f is
+x -> min(m, x + c)).
+
+Semantics contract: for every ELIGIBLE host, the final device state is
+bit-identical to what the serial micro-step engine (engine.py +
+nic.py's fused arrival->router->deliver->app->wire chain) would
+produce — the golden test in tests/test_bulk.py runs both paths and
+compares. Hosts that fail eligibility are left untouched; the serial
+window fixpoint that runs right after naturally picks them up
+(their in-window events are still queued).
+
+Eligibility (per host) — the conditions under which the serial path's
+per-event work is provably independent across the window:
+
+- every in-window event is a remote UDP PACKET arrival
+  (timers / process events / TCP / loopback -> serial path);
+- the NIC is quiescent: router ring empty, no deferred NIC_RECV or
+  NIC_SEND events in flight, socket rings empty;
+- CoDel is in its idle good state (interval_expire == 0, not
+  dropping) — then every dequeue has sojourn 0 and provably leaves
+  the CoDel state untouched (ref: router_queue_codel.c:161-196);
+- token buckets conservatively cover the whole window's wire bytes
+  without relying on refills, so the serial drain never defers
+  (ref: network_interface.c:421-455,519-579);
+- the app's bulk handler accepts the host (precheck) and its sends
+  fit the send buffer without tripping the transient-full WRITABLE
+  clear in sk_enqueue_out.
+
+Reference mapping: this is the device analog of running the per-host
+pop loop (scheduler_policy_host_single.c:237-267) to completion for
+the window with the event.c:110-153 order, exploiting that the
+handlers the events reach (UDP deliver + app recv/send + NIC drain)
+commute up to the state deltas reproduced here.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core import rng, simtime
+from shadow_tpu.core.events import EventKind, EventQueue, _tie_key
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.state import (
+    TB_REFILL_INTERVAL,
+    NetConfig,
+    QDisc,
+    SocketFlags,
+    SocketType,
+    host_of_ip,
+)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+def precedes(t, tie):
+    """[H,K,K] bool: in-row strict event order 'i precedes j' under the
+    deterministic total order (time, then (src, seq) tie key — the
+    reference's event.c:110-153 comparator; dst is the row). Returned
+    as a broadcastable expression; use inside a single reduce so XLA
+    fuses it instead of materializing the cube."""
+    ti, tj = t[:, :, None], t[:, None, :]
+    ki, kj = tie[:, :, None], tie[:, None, :]
+    return (ti < tj) | ((ti == tj) & (ki < kj))
+
+
+def rank_in_order(before, weight):
+    """[H,K] number of weighted events strictly preceding each slot:
+    rank_j = sum_i weight_i * before[i,j]."""
+    return jnp.sum(weight[:, :, None] & before, axis=1, dtype=I32)
+
+
+def suffix_sum(before, value):
+    """[H,K] sum of value_i over events strictly AFTER each slot:
+    suff_j = sum_i value_i * before[j,i]."""
+    return jnp.sum(jnp.where(before, value[:, None, :], 0), axis=2,
+                   dtype=value.dtype)
+
+
+@dataclass(frozen=True)
+class BulkDeliveries:
+    """The window's UDP arrivals presented to the app bulk handler, in
+    SLOT layout ([H,K] aligned with the event queue's slots; use
+    `rank` helpers for time-order-dependent logic)."""
+
+    mask: Any       # [H,K] bool — matched, delivered-to-app arrivals
+    time: Any       # [H,K] i64
+    tie: Any        # [H,K] i64 order tie key
+    before: Any     # broadcastable [H,K,K] precedence (fused use only)
+    slot: Any       # [H,K] i32 receiving socket
+    src_ip: Any     # [H,K] i64
+    src_port: Any   # [H,K] i32
+    length: Any     # [H,K] i32
+    payref: Any     # [H,K] i32
+
+
+@dataclass(frozen=True)
+class BulkSends:
+    """App's reply sends, one per delivered event at the event's time.
+    Contract (v1): every send is remote (dst != self, not loopback)
+    with length > 0; `nic_draw_ctr` is the absolute per-host RNG
+    counter at which the NIC's reliability draw for this send must
+    happen — the app owns the interleaved draw-stream layout (and
+    must advance sim.net.rng_ctr past ALL of the window's draws,
+    including these NIC draws, before returning) so the stream
+    matches the serial path's execution order."""
+
+    mask: Any           # [H,K] bool
+    slot: Any           # [H,K] i32 sending socket
+    dst_ip: Any         # [H,K] i64
+    dst_host: Any       # [H,K] i32 (-1 = resolve from dst_ip)
+    dst_port: Any       # [H,K] i32
+    length: Any         # [H,K] i32
+    payref: Any         # [H,K] i32
+    nic_draw_ctr: Any   # [H,K] u32
+
+
+class AppBulk:
+    """Interface an on-device app exposes to opt into the bulk pass.
+
+    max_send_len: static upper bound on reply payload length.
+    precheck(cfg, sim) -> [H] bool — app-side eligibility (no mutation).
+    run(cfg, sim, d: BulkDeliveries) -> (sim, BulkSends) — consume
+    EVERY delivery in d.mask and stage at most one reply per event.
+    """
+
+    max_send_len: int = 0
+
+    def precheck(self, cfg, sim):
+        raise NotImplementedError
+
+    def run(self, cfg, sim, d):
+        raise NotImplementedError
+
+
+def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok):
+    net = sim.net
+    q = sim.events
+    kind_ok = jnp.all(~inwin | (q.kind == EventKind.PACKET), axis=1)
+    proto = q.words[:, :, pf.W_PROTO] & 0xFF
+    udp_ok = jnp.all(~inwin | (proto == pf.PROTO_UDP), axis=1)
+    # remote arrivals only (loopback PACKET_LOCAL is a different kind;
+    # a self-addressed PACKET cannot occur — sends to self go loopback)
+    quiesced = (
+        (net.rq_count == 0)
+        & ~net.nic_recv_pending
+        & ~net.nic_send_pending
+        & (jnp.sum(net.out_count, axis=1) == 0)
+        & (jnp.sum(net.in_count, axis=1) == 0)
+    )
+    codel_ok = ~net.codel_dropping & (net.codel_interval_expire == 0)
+    recv_need = jnp.sum(jnp.where(inwin & nonboot, wl, 0), axis=1)
+    recv_ok = (recv_need == 0) | (
+        net.tb_recv_tokens >= recv_need + pf.MTU)
+    n_nonboot = jnp.sum(inwin & nonboot, axis=1)
+    send_ok = (n_nonboot == 0) | (
+        net.tb_send_tokens >= (n_nonboot + 1).astype(I64) * pf.MTU)
+    return (kind_ok & udp_ok & quiesced & codel_ok & recv_ok & send_ok
+            & app_ok)
+
+
+def _lookup_bulk(net, mask, dst_ip, dst_port, src_ip, src_port):
+    """lookup_socket vectorized over [H,K] events (see
+    sockets.lookup_socket for the precedence rules being reproduced:
+    peer-specific association beats the general one,
+    ref: network_interface.c:375-419)."""
+    skt = net.sk_type[:, None, :]
+    skf = net.sk_flags[:, None, :]
+    bip = net.sk_bound_ip[:, None, :]
+    bpt = net.sk_bound_port[:, None, :]
+    pip = net.sk_peer_ip[:, None, :]
+    ppt = net.sk_peer_port[:, None, :]
+    base = (
+        mask[:, :, None]
+        & (skt == pf.PROTO_UDP)
+        & ((skf & SocketFlags.CLOSED) == 0)
+        & (bpt == dst_port[:, :, None])
+        & ((bip == 0) | (bip == dst_ip[:, :, None]))
+    )
+    general = base & (ppt == 0)
+    specific = base & (pip == src_ip[:, :, None]) & (
+        ppt == src_port[:, :, None])
+
+    def first(m):
+        has = jnp.any(m, axis=2)
+        return jnp.where(has, jnp.argmax(m, axis=2).astype(I32), -1)
+
+    g = first(general)
+    s = first(specific)
+    return jnp.where(s >= 0, s, g)
+
+
+def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
+    """Build the per-window bulk pass, or None when the config cannot
+    support it (static preconditions)."""
+    if cfg.tcp:
+        return None
+    if cfg.qdisc != QDisc.FIFO:
+        return None
+    if cfg.out_ring < 2:
+        return None
+    if cfg.outbox_capacity < cfg.event_capacity:
+        return None
+    # Replies must fit one MTU on the wire: then each send consumes at
+    # most MTU tokens, the (n+1)*MTU eligibility budget is a true upper
+    # bound, and the serial path's max(tokens-w, 0) floor can never
+    # engage mid-window (the closed form below doesn't model it).
+    if app_bulk.max_send_len + pf.HDR_UDP > pf.MTU:
+        return None
+
+    def bulk_fn(sim, wend):
+        net = sim.net
+        q = sim.events
+        H, K = q.time.shape
+        GH = net.host_ip.shape[0]
+        lane = net.lane_id
+
+        t = q.time
+        inwin = t < jnp.asarray(wend, simtime.DTYPE)
+        tie = _tie_key(q.src, q.seq)
+        length = q.words[:, :, pf.W_LEN]
+        wl_all = pf.wire_length(
+            jnp.full((H, K), pf.PROTO_UDP, I32), length).astype(I64)
+        wl = jnp.where(inwin, wl_all, 0)
+        nonboot = t >= cfg.bootstrap_end
+        app_ok = app_bulk.precheck(cfg, sim)
+        sndbuf_ok = jnp.min(net.sk_sndbuf, axis=1) > app_bulk.max_send_len
+
+        # ---- receive side: router dequeue + socket delivery ----------
+        src = q.src
+        pw = q.words[:, :, pf.W_PORTS]
+        src_port = pw & 0xFFFF
+        dst_port = (pw >> 16) & 0xFFFF
+        dst_ip = q.words[:, :, pf.W_DSTIP].astype(jnp.uint32).astype(I64)
+        src_ip = net.host_ip[jnp.clip(src, 0, GH - 1)]
+        payref = q.words[:, :, pf.W_PAYREF]
+
+        slot = _lookup_bulk(net, inwin, dst_ip, dst_port, src_ip, src_port)
+        # Receive-buffer fit: with the input rings empty (quiescence)
+        # and every delivery consumed in its own event, the serial
+        # udp_deliver drops exactly the datagrams with
+        # length > sk_rcvbuf (space check at in_bytes == 0,
+        # ref: socket.h:47-78) — fall back rather than model the drop.
+        rcvbuf_at = _gather_hs_bulk(net.sk_rcvbuf, slot)
+        rcv_fit = jnp.all(
+            ~inwin | (slot < 0) | (length <= rcvbuf_at), axis=1)
+
+        elig = _eligibility(cfg, sim, inwin, t, wl, nonboot,
+                            app_ok & sndbuf_ok & rcv_fit)
+
+        ev = inwin & elig[:, None]                     # events we consume
+        n_ev = jnp.sum(ev, axis=1, dtype=I32)          # [H]
+        before = precedes(t, tie) & ev[:, :, None] & ev[:, None, :]
+
+        matched = ev & (slot >= 0)
+        nosock = ev & (slot < 0)
+
+        # per-socket arrival counts (matched only reach the rings)
+        S = net.sk_type.shape[1]
+        arr_per_sock = jnp.sum(
+            matched[:, :, None]
+            & (slot[:, :, None] == jnp.arange(S)[None, None, :]),
+            axis=1, dtype=I32)                         # [H,S]
+
+        # ---- app: consume every matched delivery, stage replies ------
+        d = BulkDeliveries(
+            mask=matched, time=t, tie=tie, before=before, slot=slot,
+            src_ip=src_ip, src_port=src_port, length=length, payref=payref,
+        )
+        sim2, sends = app_bulk.run(cfg, sim, d)
+        net = sim2.net
+
+        smask = sends.mask & elig[:, None]
+        # source port stamped into reply words (udp_enqueue_send)
+        sport = _gather_hs_bulk(net.sk_bound_port, sends.slot)
+
+        # sends per socket -> out ring head advance + priority counter
+        send_per_sock = jnp.sum(
+            smask[:, :, None]
+            & (sends.slot[:, :, None] == jnp.arange(S)[None, None, :]),
+            axis=1, dtype=I32)                         # [H,S]
+        n_send = jnp.sum(smask, axis=1, dtype=I32)
+
+        # ---- NIC egress: reliability draw, latency, outbox entries ---
+        dsth = jnp.where(
+            sends.dst_host >= 0, sends.dst_host,
+            host_of_ip(net, sends.dst_ip))
+        known = smask & (dsth >= 0)
+        u2 = rng.uniform_at(net.rng_keys, sends.nic_draw_ctr)
+        V = net.latency_ns.shape[0]
+        if V == 1:
+            rel = net.reliability[0, 0]
+            lat = net.latency_ns[0, 0]
+        else:
+            vsrc = net.vertex_of_host[lane][:, None]
+            vdst = net.vertex_of_host[jnp.clip(dsth, 0, GH - 1)]
+            rel = net.reliability[vsrc, vdst]
+            lat = net.latency_ns[vsrc, vdst]
+        drop = known & nonboot & (sends.length > 0) & (u2 > rel)
+        emit_ok = known & ~drop
+        swl = jnp.where(smask, pf.wire_length(
+            jnp.full((H, K), pf.PROTO_UDP, I32), sends.length), 0).astype(I64)
+
+        # ---- token buckets: closed-form final values ------------------
+        qq = jnp.where(ev, t // TB_REFILL_INTERVAL, 0)
+        q_last = jnp.maximum(jnp.max(qq, axis=1), net.tb_quantum)
+        q_last = jnp.where(n_ev > 0, q_last, net.tb_quantum)
+        qv = jnp.where(ev, qq, q_last[:, None])  # inactive -> no clamp bite
+        w_recv = jnp.where(nonboot, wl, 0)
+        w_send = jnp.where(nonboot & smask, swl, 0)
+        # suffix sums in time order
+        suff_recv = suffix_sum(before, w_recv)
+        suff_send = suffix_sum(before, w_send)
+        cap_r = net.tb_recv_refill + pf.MTU
+        cap_s = net.tb_send_refill + pf.MTU
+        big = jnp.iinfo(jnp.int64).max // 2
+        dq_total = (q_last - net.tb_quantum)
+
+        def bucket_final(s0, cap, refill, w, suffw):
+            straight = s0 + dq_total * refill - jnp.sum(w, axis=1)
+            clamp = jnp.where(
+                ev,
+                cap[:, None] - w + (q_last[:, None] - qv) * refill[:, None]
+                - suffw,
+                big,
+            )
+            return jnp.minimum(straight, jnp.min(clamp, axis=1))
+
+        new_recv_tok = bucket_final(net.tb_recv_tokens, cap_r,
+                                    net.tb_recv_refill, w_recv, suff_recv)
+        new_send_tok = bucket_final(net.tb_send_tokens, cap_s,
+                                    net.tb_send_refill, w_send, suff_send)
+
+        # ---- outbox entries at the event's time-order column ----------
+        ord_col = rank_in_order(before, ev)            # [H,K] rank < K <= M
+        send_rank = rank_in_order(before, emit_ok)
+        seq = q.next_seq[:, None] + send_rank
+        M = sim.outbox.capacity
+        colsel = emit_ok[:, :, None] & (
+            ord_col[:, :, None] == jnp.arange(M)[None, None, :])
+
+        def place(val, fill, dtype):
+            v = jnp.asarray(val, dtype)
+            got = jnp.any(colsel, axis=1)
+            picked = jnp.sum(jnp.where(colsel, v[:, :, None], 0), axis=1,
+                             dtype=dtype)
+            return got, jnp.where(got, picked, fill).astype(dtype)
+
+        out = sim.outbox
+        got_col, o_dst = place(dsth, -1, I32)
+        _, o_time = place(t + lat, simtime.INVALID, I64)
+        _, o_src = place(jnp.broadcast_to(lane[:, None], (H, K)), 0, I32)
+        _, o_seq = place(seq, 0, I32)
+        o_kind = jnp.where(got_col, EventKind.PACKET, 0).astype(I32)
+        # reply packet words (udp_enqueue_send layout)
+        wds = jnp.zeros((H, K, q.words.shape[2]), I32)
+        wds = wds.at[:, :, pf.W_PROTO].set(pf.PROTO_UDP)
+        wds = wds.at[:, :, pf.W_LEN].set(sends.length)
+        wds = wds.at[:, :, pf.W_PORTS].set(
+            pf.pack_ports(sport, sends.dst_port))
+        wds = wds.at[:, :, pf.W_PAYREF].set(sends.payref)
+        wds = wds.at[:, :, pf.W_DSTIP].set(
+            sends.dst_ip.astype(jnp.uint32).astype(I32))
+        o_words = jnp.sum(
+            jnp.where(colsel[:, :, :, None], wds[:, :, None, :], 0), axis=1,
+            dtype=I32)
+        keep = ~got_col
+        out = out.replace(
+            dst=jnp.where(keep, out.dst, o_dst),
+            time=jnp.where(keep, out.time, o_time),
+            kind=jnp.where(keep, out.kind, o_kind),
+            src=jnp.where(keep, out.src, o_src),
+            seq=jnp.where(keep, out.seq, o_seq),
+            words=jnp.where(keep[:, :, None], out.words, o_words),
+            count=jnp.where(elig, jnp.sum(got_col, axis=1, dtype=I32),
+                            out.count),
+        )
+
+        # ---- state deltas (bit-identical to the serial chain) ---------
+        BI = net.in_src_ip.shape[2]
+        BO = net.out_words.shape[2]
+        R = net.rq_src.shape[1]
+        any_arr = arr_per_sock > 0
+        net = net.replace(
+            tb_recv_tokens=jnp.where(elig, new_recv_tok, net.tb_recv_tokens),
+            tb_send_tokens=jnp.where(elig, new_send_tok, net.tb_send_tokens),
+            tb_quantum=jnp.where(elig, q_last, net.tb_quantum),
+            # every arrival cycles through the router ring (enqueue at
+            # head+count, dequeue advances head): head moves by the
+            # arrival count, count/bytes return to zero
+            rq_head=jnp.where(elig, (net.rq_head + n_ev) % R, net.rq_head),
+            # input rings: k push/pop pairs advance head by k, leave
+            # count/bytes unchanged; READABLE ends cleared, one in-gen
+            # edge per arrival (udp.udp_deliver/udp_recv)
+            in_head=jnp.where(any_arr, (net.in_head + arr_per_sock) % BI,
+                              net.in_head),
+            sk_in_gen=net.sk_in_gen + arr_per_sock,
+            sk_flags=jnp.where(any_arr,
+                               net.sk_flags & ~SocketFlags.READABLE,
+                               net.sk_flags),
+            # output rings: enqueue+drain pairs advance head, bump the
+            # per-host packet priority counter (sk_enqueue_out)
+            out_head=jnp.where(send_per_sock > 0,
+                               (net.out_head + send_per_sock) % BO,
+                               net.out_head),
+            priority_ctr=net.priority_ctr + n_send.astype(I64),
+            ctr_rx_packets=net.ctr_rx_packets
+            + jnp.sum(matched, axis=1, dtype=I64),
+            ctr_rx_bytes=net.ctr_rx_bytes
+            + jnp.sum(jnp.where(matched, wl, 0), axis=1),
+            ctr_drop_nosocket=net.ctr_drop_nosocket
+            + jnp.sum(nosock, axis=1, dtype=I64)
+            + jnp.sum(smask & (dsth < 0), axis=1, dtype=I64),
+            ctr_tx_packets=net.ctr_tx_packets
+            + jnp.sum(smask, axis=1, dtype=I64),
+            ctr_tx_bytes=net.ctr_tx_bytes
+            + jnp.sum(jnp.where(smask, swl, 0), axis=1),
+            ctr_drop_reliability=net.ctr_drop_reliability
+            + jnp.sum(drop, axis=1, dtype=I64),
+        )
+
+        # consume the window's events
+        q = q.replace(
+            time=jnp.where(ev, simtime.INVALID, q.time),
+            next_seq=q.next_seq + jnp.sum(emit_ok, axis=1, dtype=I32),
+        )
+        sim2 = sim2.replace(events=q, outbox=out, net=net)
+        return sim2, jnp.sum(n_ev, dtype=I64)
+
+    return bulk_fn
+
+
+def _gather_hs_bulk(arr, slot):
+    """arr[H,S] -> [H,K] values at (h, slot[h,k]) via one-hot reduce
+    (slot domain S is small)."""
+    S = arr.shape[1]
+    sel = slot[:, :, None] == jnp.arange(S)[None, None, :]
+    return jnp.sum(jnp.where(sel, arr[:, None, :], 0), axis=2,
+                   dtype=arr.dtype)

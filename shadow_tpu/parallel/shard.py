@@ -193,6 +193,7 @@ def sharded_engine_run(
     emit_capacity: int = 4,
     lane_id_fn=None,
     exchange_capacity: int | None = None,
+    bulk_fn=None,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
     *global* state (as built for single-shard); sharding/replication
@@ -225,6 +226,7 @@ def sharded_engine_run(
                                      lane, exchange_capacity),
             ))),
             min_fn=lambda x: lax.pmin(x, axis),
+            bulk_fn=bulk_fn,
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 
@@ -245,15 +247,25 @@ def sharded_engine_run(
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
                 end_time: int | None = None,
-                exchange_capacity: int | None = None):
-    """Multi-chip variant of shadow_tpu.net.build.run."""
+                exchange_capacity: int | None = None,
+                app_bulk=None):
+    """Multi-chip variant of shadow_tpu.net.build.run. `app_bulk`
+    enables the bulk window pass (net/bulk.py) — it is purely
+    lane-local (no collectives), so it composes with the sharded
+    window loop unchanged."""
     from shadow_tpu.net.step import make_step_fn
 
     step = make_step_fn(bundle.cfg, app_handlers)
+    bulk_fn = None
+    if app_bulk is not None:
+        from shadow_tpu.net.bulk import make_bulk_fn
+
+        bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
     return sharded_engine_run(
         mesh, axis, bundle.sim, step,
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
+        bulk_fn=bulk_fn,
     )

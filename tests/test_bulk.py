@@ -1,0 +1,186 @@
+"""Golden bit-identity: the bulk window pass (net/bulk.py) must
+produce EXACTLY the state the serial micro-step engine produces, for
+every eligible host — and fall back serially (still bit-identical)
+when eligibility fails.
+
+Dead-storage arrays (ring payload slots already consumed, stale outbox
+planes cleared by route) are excluded: the serial path leaves stale
+bytes in them that carry no semantics (consumed ring entries are
+unreachable below head, ref: the reference frees its packet objects
+instead — packet.c refcounts)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">%(bw)d</data><data key="dn">%(bw)d</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+# state arrays whose consumed-slot contents are dead storage
+DEAD = {
+    "in_src_ip", "in_src_port", "in_len", "in_payref",
+    "out_words", "out_priority",
+    "rq_src", "rq_enq_ts", "rq_words",
+}
+# outbox planes not reset by clear_outbox (masked dead by dst == -1)
+DEAD_OUTBOX = {"kind", "src", "seq", "words"}
+
+
+def _build(H, load, sim_s, seed, bw_kibps=102400):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH % {"bw": bw_kibps}, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _compare(sim_a, sim_b, stats_a, stats_b):
+    na, nb = sim_a.net, sim_b.net
+    for f in type(na).__dataclass_fields__:
+        if f in DEAD:
+            continue
+        a, b = getattr(na, f), getattr(nb, f)
+        np.testing.assert_array_equal(
+            np.asarray(a), np.asarray(b), err_msg=f"net.{f} diverged")
+    qa, qb = sim_a.events, sim_b.events
+    for f in ("time", "kind", "src", "seq", "words", "next_seq", "overflow"):
+        a = np.asarray(getattr(qa, f))
+        b = np.asarray(getattr(qb, f))
+        if f in ("kind", "src", "seq", "words"):
+            # consumed slots hold dead values; only live slots compare
+            live_a = np.asarray(qa.time) != simtime.INVALID
+            live_b = np.asarray(qb.time) != simtime.INVALID
+            if f == "words":
+                live_a = live_a[..., None]
+                live_b = live_b[..., None]
+            a = np.where(live_a, a, 0)
+            b = np.where(live_b, b, 0)
+        np.testing.assert_array_equal(a, b, err_msg=f"events.{f} diverged")
+    for f in ("dst", "time", "count", "overflow"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sim_a.outbox, f)),
+            np.asarray(getattr(sim_b.outbox, f)),
+            err_msg=f"outbox.{f} diverged")
+    for f in ("sock", "port", "remaining", "sent", "rcvd"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sim_a.app, f)),
+            np.asarray(getattr(sim_b.app, f)),
+            err_msg=f"app.{f} diverged")
+    assert int(stats_a.events_processed) == int(stats_b.events_processed)
+    assert int(stats_a.windows) == int(stats_b.windows)
+
+
+@pytest.mark.parametrize("seed", [1, 7])
+def test_bulk_phold_bit_identical(seed):
+    H, load, sim_s = 32, 4, 1
+    b1 = _build(H, load, sim_s, seed)
+    serial = make_runner(b1, app_handlers=(phold.handler,))
+    sim_s1, stats_s = serial(b1.sim)
+
+    b2 = _build(H, load, sim_s, seed)
+    bulked = make_runner(b2, app_handlers=(phold.handler,),
+                         app_bulk=phold.BULK)
+    sim_b1, stats_b = bulked(b2.sim)
+
+    assert int(sim_s1.events.overflow) == 0
+    assert int(sim_b1.events.overflow) == 0
+    assert int(stats_b.events_processed) > 0
+    # the bulk path must actually engage: far fewer micro-steps
+    assert int(stats_b.micro_steps) < int(stats_s.micro_steps) // 2, (
+        int(stats_b.micro_steps), int(stats_s.micro_steps))
+    _compare(sim_s1, sim_b1, stats_s, stats_b)
+
+
+def test_bulk_fallback_when_throttled_bit_identical():
+    """Tiny bandwidth: token buckets run dry, NIC defers, eligibility
+    fails -> everything runs serially on both paths, still identical,
+    and the bulk runner takes no shortcut that diverges."""
+    H, load, sim_s = 16, 3, 1
+    # ~8 KiB/s: a window's ~3 messages (92 wire bytes each) still fit,
+    # but refill quanta matter, so some windows are throttled
+    b1 = _build(H, load, sim_s, 3, bw_kibps=2)
+    serial = make_runner(b1, app_handlers=(phold.handler,))
+    sim_a, st_a = serial(b1.sim)
+
+    b2 = _build(H, load, sim_s, 3, bw_kibps=2)
+    bulked = make_runner(b2, app_handlers=(phold.handler,),
+                         app_bulk=phold.BULK)
+    sim_b, st_b = bulked(b2.sim)
+    _compare(sim_a, sim_b, st_a, st_b)
+
+
+def test_bulk_rcvbuf_too_small_bit_identical():
+    """sk_rcvbuf smaller than the datagram: serial udp_deliver drops
+    it as bufferfull and the app never replies; the bulk pass must
+    fall back (rcv_fit eligibility) rather than deliver."""
+    H, load, sim_s = 8, 2, 1
+    cap = 32
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=11,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, rcvbuf=32)  # < MSG_SIZE=64
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b1 = build(cfg, GRAPH % {"bw": 102400}, hosts)
+    b1.sim = phold.setup(b1.sim, load=load)
+    sim_a, st_a = make_runner(b1, app_handlers=(phold.handler,))(b1.sim)
+
+    b2 = build(cfg, GRAPH % {"bw": 102400}, hosts)
+    b2.sim = phold.setup(b2.sim, load=load)
+    sim_b, st_b = make_runner(b2, app_handlers=(phold.handler,),
+                              app_bulk=phold.BULK)(b2.sim)
+    assert int(np.asarray(sim_a.net.ctr_drop_bufferfull).sum()) > 0
+    _compare(sim_a, sim_b, st_a, st_b)
+
+
+def test_bulk_sharded_bit_identical():
+    """The bulk pass is lane-local, so it must compose with the
+    sharded window loop: a 4-shard bulk run matches the single-shard
+    serial run bit-for-bit (the same contract the serial sharded path
+    already satisfies, ref: event.c:110-153 shard-count independence)."""
+    from jax.sharding import Mesh
+
+    from shadow_tpu.parallel import run_sharded
+
+    H, load, sim_s = 16, 3, 1
+    b1 = _build(H, load, sim_s, 5)
+    serial = make_runner(b1, app_handlers=(phold.handler,))
+    sim_a, st_a = serial(b1.sim)
+
+    b2 = _build(H, load, sim_s, 5)
+    devs = np.array(jax.devices()[:4])
+    mesh = Mesh(devs, ("hosts",))
+    sim_b, st_b = run_sharded(b2, mesh, "hosts",
+                              app_handlers=(phold.handler,),
+                              app_bulk=phold.BULK)
+    assert int(st_b.micro_steps) < int(st_a.micro_steps)
+    _compare(sim_a, sim_b, st_a, st_b)
+
+
+def test_bulk_static_preconditions():
+    from shadow_tpu.net.bulk import make_bulk_fn
+
+    cfg = NetConfig(num_hosts=4, tcp=True)
+    assert make_bulk_fn(cfg, phold.BULK) is None
+    cfg = NetConfig(num_hosts=4, tcp=False, outbox_capacity=8,
+                    event_capacity=32)
+    assert make_bulk_fn(cfg, phold.BULK) is None
