@@ -51,10 +51,15 @@ class BulkApp:
     rcvd: jax.Array         # [H] i64 server bytes received
     eof: jax.Array          # [H] bool server saw EOF
     done_at: jax.Array      # [H] i64 sim time of server EOF (-1)
+    recv_chunk: jax.Array   # [H] i32 max bytes drained per wakeup
+    drain_after: jax.Array  # [H] i64 server drains only at/after this
+                            # sim time (models a stalled reader; the
+                            # zero-window probe tests use it)
 
 
 def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
-          total_bytes: int):
+          total_bytes: int, server_recv_chunk: int = CHUNK,
+          server_drain_after: int = 0):
     """Create sockets (listener bound+listening; client socket made but
     not connected) — build-time, host side."""
     H = sim.net.host_ip.shape[0]
@@ -78,6 +83,8 @@ def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
         rcvd=jnp.zeros((H,), I64),
         eof=jnp.zeros((H,), bool),
         done_at=jnp.full((H,), -1, I64),
+        recv_chunk=jnp.full((H,), server_recv_chunk, I32),
+        drain_after=jnp.full((H,), server_drain_after, I64),
     )
     return sim.replace(app=app)
 
@@ -119,9 +126,9 @@ def handler(cfg: NetConfig, sim, popped, buf):
 
     # ---- server: drain the child -------------------------------------
     drain = woke & app.is_server & (app.child >= 0)
+    chunk = jnp.where(now >= app.drain_after, app.recv_chunk, 0)
     sim, buf, nread, eof = tcp.tcp_recv(sim, drain, app.child,
-                                        jnp.full(drain.shape, CHUNK, I32),
-                                        now, buf)
+                                        chunk, now, buf)
     app = app.replace(
         rcvd=app.rcvd + nread.astype(I64),
         eof=app.eof | eof,

@@ -95,12 +95,20 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
         q, popped = pop_earliest(sim.events, wend)
         sim = sim.replace(events=q)
         buf = EmitBuffer.create(H, emit_capacity)
+        # events_processed counts EXECUTED events: pops the CPU
+        # admission gate re-queues (step._cpu_gate) are excluded via
+        # the blocked-counter delta, so a repeatedly deferred event
+        # still counts exactly once
+        blocked0 = (jnp.sum(sim.net.ctr_cpu_blocked)
+                    if hasattr(sim, "net") else jnp.zeros((), I64))
         sim, buf = step_fn(sim, popped, buf)
+        blocked1 = (jnp.sum(sim.net.ctr_cpu_blocked)
+                    if hasattr(sim, "net") else jnp.zeros((), I64))
         q, out = apply_emissions(sim.events, sim.outbox, buf, lane_id)
         sim = sim.replace(events=q, outbox=out)
         stats = stats.replace(
             events_processed=stats.events_processed
-            + jnp.sum(popped.valid, dtype=I64),
+            + jnp.sum(popped.valid, dtype=I64) - (blocked1 - blocked0),
             micro_steps=stats.micro_steps + 1,
         )
         return sim, stats

@@ -29,9 +29,9 @@ from shadow_tpu.core import simtime
 I32 = jnp.int32
 # Number of generic int32 payload words carried by every event. Wide
 # enough for a simulated TCP header (ref: packet.h:66-86): src/dst
-# ports, seq, ack, flags, window, timestamp, ts-echo, sack range,
-# payload ref+len.
-NWORDS = 12
+# ports, seq, ack, flags, window, timestamp, ts-echo, a 3-range
+# selective-ack list, payload ref+len.
+NWORDS = 16
 
 
 class EventKind:
@@ -51,6 +51,11 @@ class EventKind:
     TCP_CLOSE_TIMER = 9  # TIMEWAIT 60s close timer (ref: tcp.c:604-699)
     TCP_DACK_TIMER = 10  # delayed-ACK timer
     HEARTBEAT = 11      # tracker heartbeat (ref: tracker.c:607)
+    TCP_FLUSH = 12      # same-time flush continuation: one coalesced
+                        # ACK can admit far more segments than one
+                        # micro-step packetizes; the chain unwinds in
+                        # the window fixpoint (ref: _tcp_flush's while
+                        # loop, tcp.c:1121-...)
     USER = 16
 
 

@@ -45,6 +45,8 @@ class HostSpec:
     type: str | None = None
     bandwidthdown: int | None = None  # KiB/s override
     bandwidthup: int | None = None
+    cpufrequency_khz: int | None = None  # virtual CPU speed (ref:
+                                         # host cpufrequency attr)
     proc_start_time: int | None = None  # PROC_START event time (ns)
 
     def hints(self) -> dict:
@@ -103,6 +105,8 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
         vertex_of_host=placement.vertex,
         latency_ns=top.latency_ns,
         reliability=top.reliability,
+        cpu_freq_khz=np.array(
+            [h.cpufrequency_khz or 0 for h in hosts], np.int64),
     )
     sim = make_sim(cfg, net, app=app)
 

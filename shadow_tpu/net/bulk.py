@@ -219,6 +219,10 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         return None
     if cfg.outbox_capacity < cfg.event_capacity:
         return None
+    if cfg.cpu_threshold_ns >= 0:
+        # the CPU admission gate serializes event execution per host;
+        # the bulk pass has no equivalent yet
+        return None
     # Replies must fit one MTU on the wire: then each send consumes at
     # most MTU tokens, the (n+1)*MTU eligibility budget is a true upper
     # bound, and the serial path's max(tokens-w, 0) floor can never

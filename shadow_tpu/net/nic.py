@@ -400,9 +400,12 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     if getattr(sim, "tcp", None) is not None:
         from shadow_tpu.net import tcp as tcp_mod
 
-        words = tcp_mod.stamp_at_wire(
-            net, sim.tcp, active & (proto == pf.PROTO_TCP), sel, words, now
-        )
+        tmask = active & (proto == pf.PROTO_TCP)
+        words = tcp_mod.stamp_at_wire(net, sim.tcp, tmask, sel, words, now)
+        # a departing ACK cancels the pending delayed ACK
+        acked = tmask & ((pf.tcp_flags_of(words) & pf.TCPF_ACK) != 0)
+        sim = sim.replace(
+            tcp=tcp_mod.wire_ack_departed(sim.tcp, acked, sel))
 
     wl = pf.wire_length(proto, length).astype(I64)
     GH = net.host_ip.shape[0]

@@ -23,9 +23,16 @@ W_ACK = 5      # TCP acknowledgment
 W_WIN = 6      # TCP advertised window
 W_TSVAL = 7    # TCP timestamp value (ms)
 W_TSECHO = 8   # TCP timestamp echo (ms)
-W_SACKL = 9    # TCP selective-ack range left edge
-W_SACKR = 10   # TCP selective-ack range right edge
+W_SACKL = 9    # TCP selective-ack range 1 left edge
+W_SACKR = 10   # TCP selective-ack range 1 right edge
 W_DSTIP = 11   # destination IP (distinguishes loopback vs eth delivery)
+# full SACK list: ranges 2 and 3 (the reference carries a full
+# selective-ack list in its TCP header, packet.h:52,77; three ranges
+# cover Linux's practical SACK option limit)
+W_SACKL2 = 12
+W_SACKR2 = 13
+W_SACKL3 = 14
+W_SACKR3 = 15
 
 PAYREF_NONE = -1
 

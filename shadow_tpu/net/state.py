@@ -56,6 +56,12 @@ class QDisc:
 # token-bucket refill interval (ref: network_interface.c:93-95)
 TB_REFILL_INTERVAL = simtime.ONE_MILLISECOND
 
+# default socket buffer byte limits (ref: definitions.h:153-159);
+# a config that pins a different value disables that direction's TCP
+# buffer autotuning (ref: master.c:355-364)
+DEFAULT_SNDBUF = 131072
+DEFAULT_RCVBUF = 174760
+
 
 @dataclass(frozen=True)
 class NetConfig:
@@ -69,7 +75,25 @@ class NetConfig:
     timers_per_host: int = 4
     event_capacity: int = 32
     outbox_capacity: int = 32
+    # --- virtual CPU model (ref: cpu.c:56-110, event.c:71-89) --------
+    # threshold < 0 disables the model entirely (the reference's
+    # default, options.c:81-82). The reference charges each event the
+    # plugin's MEASURED wall time x frequency ratio — nondeterministic
+    # across machines; here the charge is a configured deterministic
+    # per-event cost, scaled per host by cpu_raw_freq_khz /
+    # host cpufrequency and rounded to cpu_precision_ns (half-up).
+    cpu_threshold_ns: int = -1
+    cpu_precision_ns: int = 200_000   # 200 us (ref: options.c:82)
+    cpu_event_cost_ns: int = 30_000   # deterministic per-event charge
+    cpu_raw_freq_khz: int = 3_000_000  # the "physical" CPU baseline
     qdisc: int = QDisc.FIFO
+    autotune: bool = True        # TCP buffer autotuning (ref:
+                                 # CONFIG_TCPAUTOTUNE, definitions.h:101).
+                                 # Pinning sndbuf/rcvbuf away from the
+                                 # defaults disables that direction's
+                                 # autotuning (make_net_state), matching
+                                 # the reference's user-override rule
+                                 # (master.c:355-364)
     tcp: bool = True             # False skips building TcpState and
                                  # inlining the TCP machine into the
                                  # device program (UDP-only workloads
@@ -81,8 +105,8 @@ class NetConfig:
     seed: int = 1
     emit_capacity: int = 6       # max emissions per host per micro-step
     # default socket buffer byte limits (ref: definitions.h:153-159)
-    sndbuf: int = 131072
-    rcvbuf: int = 174760
+    sndbuf: int = DEFAULT_SNDBUF
+    rcvbuf: int = DEFAULT_RCVBUF
 
 
 # NetState fields that are *global lookup tables*: replicated across
@@ -92,7 +116,7 @@ class NetConfig:
 # PartitionSpecs.)
 REPLICATED_FIELDS = frozenset({
     "host_ip", "ip_sorted", "host_of_ip_sorted", "vertex_of_host",
-    "latency_ns", "reliability",
+    "latency_ns", "reliability", "bw_up_kibps", "bw_down_kibps",
 })
 
 
@@ -105,6 +129,11 @@ class NetState:
     vertex_of_host: jax.Array    # [H] i32 topology attachment (global)
     latency_ns: jax.Array        # [V,V] i64
     reliability: jax.Array       # [V,V] f32
+    # per-host bandwidths, replicated: TCP buffer autotuning sizes
+    # buffers from the *bottleneck* of local and peer bandwidth
+    # (ref: _tcp_tuneInitialBufferSizes, tcp.c:441-533)
+    bw_up_kibps: jax.Array       # [H] i64 (global table)
+    bw_down_kibps: jax.Array     # [H] i64 (global table)
     # --- per-host (sharded) state -------------------------------------
     # Global host id of each local row. Single-shard: arange(H). Under
     # shard_map each shard sees its own slice — handlers use this (not
@@ -131,6 +160,19 @@ class NetState:
     # micro-step; host-side syscall paths must flush it explicitly
     # (vproc flush_wants_send).
     nic_send_now: jax.Array      # [H] bool
+    # TCP buffer autotuning enabled per host+direction (off when the
+    # user pinned explicit buffer sizes — ref: master.c:355-364,
+    # options --socket-send/recv-buffer)
+    autotune_snd: jax.Array      # [H] bool
+    autotune_rcv: jax.Array      # [H] bool
+    # --- virtual CPU (ref: cpu.c timeCPUAvailable) -------------------
+    cpu_avail: jax.Array         # [H] i64 absolute time the CPU frees up
+    cpu_cost: jax.Array          # [H] i64 per-event charge, pre-scaled
+                                 # by the host's frequency ratio and
+                                 # pre-rounded to precision
+    ctr_cpu_blocked: jax.Array   # [H] i64 events delayed by the CPU
+    ctr_cpu_delay_ns: jax.Array  # [H] i64 total virtual processing delay
+                                 # (ref: tracker_addVirtualProcessingDelay)
     rr_ptr: jax.Array            # [H] i32 round-robin qdisc cursor
     port_ctr: jax.Array          # [H] i32 ephemeral port allocator
                                  # (counter analog of host.c:1058-1110)
@@ -218,6 +260,7 @@ def make_net_state(
     vertex_of_host: np.ndarray,  # [H] i32
     latency_ns: np.ndarray,     # [V,V] i64
     reliability: np.ndarray,    # [V,V] f32
+    cpu_freq_khz: np.ndarray | None = None,  # [H] (0 = unspecified)
 ) -> NetState:
     H, S = cfg.num_hosts, cfg.sockets_per_host
     BI, BO, R, T = cfg.in_ring, cfg.out_ring, cfg.router_ring, cfg.timers_per_host
@@ -231,6 +274,20 @@ def make_net_state(
     z_h = jnp.zeros((H,), I64)
     zi_h = jnp.zeros((H,), I32)
 
+    # per-event CPU charge: cost x (rawFreq / hostFreq), rounded
+    # half-up to precision (ref: cpu.c:85-110 cpu_addDelay); constant
+    # per host, so rounding once at build == rounding per event
+    if cpu_freq_khz is None:
+        freq = np.zeros(H, np.int64)
+    else:
+        freq = np.asarray(cpu_freq_khz, np.int64)
+    freq = np.where(freq > 0, freq, cfg.cpu_raw_freq_khz)
+    cost = np.asarray(cfg.cpu_event_cost_ns, np.int64) \
+        * cfg.cpu_raw_freq_khz // np.maximum(freq, 1)
+    p = cfg.cpu_precision_ns
+    if p > 0:
+        cost = (cost + p // 2) // p * p
+
     return NetState(
         host_ip=jnp.asarray(host_ips, I64),
         ip_sorted=jnp.asarray(np.sort(host_ips), I64),
@@ -238,6 +295,16 @@ def make_net_state(
         vertex_of_host=jnp.asarray(vertex_of_host, I32),
         latency_ns=jnp.asarray(latency_ns, I64),
         reliability=jnp.asarray(reliability, jnp.float32),
+        bw_up_kibps=jnp.asarray(bw_up_kibps, I64),
+        bw_down_kibps=jnp.asarray(bw_down_kibps, I64),
+        autotune_snd=jnp.full((H,), bool(
+            cfg.autotune and cfg.sndbuf == DEFAULT_SNDBUF)),
+        autotune_rcv=jnp.full((H,), bool(
+            cfg.autotune and cfg.rcvbuf == DEFAULT_RCVBUF)),
+        cpu_avail=z_h,
+        cpu_cost=jnp.asarray(cost, I64),
+        ctr_cpu_blocked=z_h,
+        ctr_cpu_delay_ns=z_h,
         lane_id=jnp.arange(H, dtype=I32),
         rng_keys=rng.host_streams(cfg.seed, H),
         rng_ctr=jnp.zeros((H,), jnp.uint32),

@@ -26,6 +26,8 @@ _PRE_APP = (
     nic.handle_nic_recv,       # PACKET + NIC_RECV + PACKET_LOCAL, fused
     timers.handle_timer,
     tcp.handle_tcp_rtx,
+    tcp.handle_tcp_dack,
+    tcp.handle_tcp_flush,
     tcp.handle_tcp_close,
 )
 _POST_APP = (
@@ -33,16 +35,55 @@ _POST_APP = (
 )
 
 
+def _cpu_gate(cfg: NetConfig, sim, popped, buf):
+    """Virtual-CPU admission check (ref: event_execute, event.c:71-89
+    + cpu.c:56-110): a host whose accumulated processing delay exceeds
+    the threshold does not execute this event — it is rescheduled at
+    now + delay with a fresh identity (the reference's
+    worker_scheduleTask re-queue). Executed events charge the host's
+    per-event cost against its CPU availability time."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.events import push_rows
+
+    net = sim.net
+    # cpu_updateTime: availability never lags the present
+    avail = jnp.maximum(net.cpu_avail, popped.time)
+    delay = avail - popped.time
+    blocked = popped.valid & (delay > cfg.cpu_threshold_ns)
+    # re-queue the event at now + delay, PRESERVING its identity
+    # (src/seq/words — the reference re-schedules the same task with
+    # its original closure arguments)
+    sim = sim.replace(events=push_rows(
+        sim.events, blocked, popped.time + delay, popped.kind,
+        popped.src, popped.seq, popped.words))
+    executed = popped.valid & ~blocked
+    net = net.replace(
+        cpu_avail=jnp.where(executed, avail + net.cpu_cost,
+                            jnp.where(popped.valid, avail, net.cpu_avail)),
+        ctr_cpu_blocked=net.ctr_cpu_blocked
+        + blocked.astype(jnp.int64),
+        ctr_cpu_delay_ns=net.ctr_cpu_delay_ns
+        + jnp.where(blocked, delay, 0),
+    )
+    return sim.replace(net=net), popped._replace(valid=executed), buf
+
+
 def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
     """Build the engine step_fn: netstack receive/timer handlers, then
     app handlers, then the send drain. TCP timer handlers are included
     only when the config carries TCP state (cfg.tcp) — UDP-only device
-    programs stay small."""
+    programs stay small. A non-negative cfg.cpu_threshold_ns inserts
+    the virtual-CPU admission gate ahead of everything."""
     pre = _PRE_APP if cfg.tcp else tuple(
         h for h in _PRE_APP
-        if h not in (tcp.handle_tcp_rtx, tcp.handle_tcp_close))
+        if h not in (tcp.handle_tcp_rtx, tcp.handle_tcp_dack,
+                     tcp.handle_tcp_flush, tcp.handle_tcp_close))
+    cpu_on = cfg.cpu_threshold_ns >= 0
 
     def step(sim, popped, buf):
+        if cpu_on:
+            sim, popped, buf = _cpu_gate(cfg, sim, popped, buf)
         for h in pre:
             sim, buf = h(cfg, sim, popped, buf)
         for h in app_handlers:

@@ -20,19 +20,36 @@ Design choices vs the reference, called out explicitly:
 - The receiver's reassembly queue (ref: unorderedInput PQ,
   tcp.c:222-230) is a bounded set of OO_RANGES byte ranges; segments
   that would need a 5th disjoint range are dropped (the sender
-  retransmits). SACK advertises the first (lowest) range only, vs the
-  reference's full sack list (packet.h:52,77); the sender's
-  interval-set scoreboard (tcp_retransmit_tally.cc) is reduced to
-  that single range.
+  retransmits). SACK advertises the SACK_RANGES lowest parked ranges
+  (the reference carries a full sack list, packet.h:52,77; three
+  ranges is Linux's practical SACK-option budget); the sender stores
+  the advertised list as its scoreboard (the receiver re-advertises
+  its full parked set on every ACK, so replacing is equivalent to the
+  reference's tally merge) and clips retransmissions at the first
+  sacked edge.
 - Server sockets multiplex children as separate socket slots with a
   peer-specific association instead of sub-objects keyed by
   hash(peerIP,peerPort) (ref: tcp.c:91-113,1822-1852); the accept
   queue holds child slot indices.
 - cwnd/ssthresh count packets exactly like the reference
   (tcp_cong_reno.c), not bytes.
-- No zero-window probe events: a window-limited sender recovers via
-  the window update ACK sent when the app drains the receive buffer,
-  plus the RTO as backstop.
+- Zero-window persist probes: when the peer's window closes with data
+  still buffered and nothing in flight, the RTO timer doubles as a
+  persist timer — each expiry sends one byte past the window (with
+  the usual exponential backoff), whose ACK re-reveals the window.
+  (The reference has NO probe; its senders rely on the drain-time
+  window-update ACK alone and stall if that ACK is lost. The probe is
+  a deliberate robustness improvement, not a parity deviation.)
+- Delayed ACKs per the reference's scheme (tcp.c:2066-2091): plain
+  ACKs for in-order data coalesce behind one scheduled send — 1 ms
+  for the first 1000 "quick" ACKs of a connection, 5 ms after —
+  while dup-ACKs, handshake ACKs, and anything with SYN/FIN send
+  immediately; any departing ACK-carrying packet cancels the pending
+  delayed ACK (tcp.c:1105-1108).
+- Buffer autotuning per tcp.c:407-592: initial sizes from the
+  topology bandwidth-delay product on the first RTT sample, the
+  receive buffer grows with app-copy rate (Linux DRS), the send
+  buffer with cwnd; pinning explicit buffer sizes disables it.
 
 Volatile header fields (ack, advertised window, timestamps) are
 stamped when the NIC actually emits the packet — stamp_at_wire() —
@@ -61,13 +78,31 @@ ACCEPT_QUEUE = 4                   # pending-children ring per listener
 FLUSH_SEGMENTS = 2                 # max segments packetized per flush call
                                    # (2 sustains slow-start doubling: each
                                    # ACK may admit two new segments)
-INIT_CWND = 10                     # packets (ref: definitions.h initial cwnd)
+INIT_CWND = 1                      # packets: tcp_cong_reno_init overrides
+                                   # its own IW10 to 1 (tcp_cong_reno.c:176-180)
+RESTART_CWND = 10                  # after RTO the reference restarts at 10
+                                   # (tcp_cong_reno_timeout_ev_)
 INIT_SSTHRESH = 0x7FFFFFFF
 RTO_MIN_MS = 200                   # Linux-like floor
 RTO_MAX_MS = 60_000
 RTO_INIT_MS = 1_000
 MAX_BACKOFF = 8                    # cap exponential backoff shift
 TIMEWAIT_NS = 60 * simtime.ONE_SECOND  # ref: definitions.h:198, tcp.c:604-699
+
+SACK_RANGES = 3                    # advertised SACK list length
+
+# delayed-ACK scheme (ref: tcp.c:2066-2091)
+DACK_QUICK_LIMIT = 1000            # quick ACKs at connection start
+DACK_QUICK_NS = 1 * simtime.ONE_MILLISECOND
+DACK_SLOW_NS = 5 * simtime.ONE_MILLISECOND
+
+# buffer autotuning bounds (ref: definitions.h:101-147)
+TCP_WMEM_MAX = 4194304
+TCP_RMEM_MAX = 6291456
+SEND_BUFFER_MIN = 16384
+RECV_BUFFER_MIN = 87380
+SNDMEM_SKB = 2404                  # ref: _tcp_autotuneSendBuffer's
+                                   # sampled per-skb memory constant
 
 
 class TcpSt:
@@ -109,8 +144,10 @@ class TcpState:
     ca_acc: jax.Array      # [H,S] i32 congestion-avoidance accumulator
     in_recovery: jax.Array  # [H,S] bool fast recovery
     recover: jax.Array     # [H,S] i32 recovery point
-    sack_l: jax.Array      # [H,S] i32 peer-sacked range (0,0 = none)
-    sack_r: jax.Array      # [H,S] i32
+    # peer-sacked ranges (scoreboard = the advertised list; r<=l =
+    # empty slot). Ref: tcp_retransmit_tally.cc interval sets.
+    sack_l: jax.Array      # [H,S,SACK_RANGES] i32
+    sack_r: jax.Array      # [H,S,SACK_RANGES] i32
     # receive side
     rcv_nxt: jax.Array     # [H,S] i32
     app_rbytes: jax.Array  # [H,S] i32 in-order bytes awaiting app recv
@@ -141,11 +178,24 @@ class TcpState:
     aq: jax.Array          # [H,S,ACCEPT_QUEUE] i32 ready child slots
     aq_head: jax.Array     # [H,S] i32
     aq_count: jax.Array    # [H,S] i32
+    # same-time flush continuation chain (see EventKind.TCP_FLUSH)
+    flush_pending: jax.Array   # [H,S] bool a TCP_FLUSH event is queued
+    # delayed ACK (ref: tcp.c:166-170,2066-2091)
+    dack_scheduled: jax.Array  # [H,S] bool a DACK timer is in flight
+    dack_counter: jax.Array    # [H,S] i32 ACK-worthy arrivals pending
+    dack_gen: jax.Array        # [H,S] i32 stale-event guard (slot reuse)
+    quick_acks: jax.Array      # [H,S] i32 quick ACKs sent so far
+    # buffer autotuning (ref: tcp.c:407-592)
+    at_init_done: jax.Array    # [H,S] bool initial BDP sizing done
+    at_copied: jax.Array       # [H,S] i32 app bytes copied this RTT
+    at_space: jax.Array        # [H,S] i32 DRS space watermark
+    at_last: jax.Array         # [H,S] i64 last DRS reset time
     # counters (tracker parity: retransmission tally)
     retx_segs: jax.Array   # [H] i64 segments retransmitted
     fr_entries: jax.Array  # [H] i64 fast-recovery entries (3 dup ACKs)
     drop_oo_full: jax.Array  # [H] i64 segs dropped, reassembly full
     drop_rwin: jax.Array   # [H] i64 segs dropped, recv buffer full
+    probes_sent: jax.Array  # [H] i64 zero-window persist probes
 
     @staticmethod
     def create(num_hosts: int, sockets_per_host: int) -> "TcpState":
@@ -160,7 +210,8 @@ class TcpState:
             cwnd=jnp.full((H, S), INIT_CWND, I32),
             ssthresh=jnp.full((H, S), INIT_SSTHRESH, I32),
             ca_acc=zi, in_recovery=zb, recover=zi,
-            sack_l=zi, sack_r=zi,
+            sack_l=jnp.zeros((H, S, SACK_RANGES), I32),
+            sack_r=jnp.zeros((H, S, SACK_RANGES), I32),
             rcv_nxt=zi, app_rbytes=zi, fin_rcvd=zb, fin_rseq=zi,
             oo_l=jnp.zeros((H, S, OO_RANGES), I32),
             oo_r=jnp.zeros((H, S, OO_RANGES), I32),
@@ -176,7 +227,13 @@ class TcpState:
             parent=jnp.full((H, S), -1, I32),
             aq=jnp.zeros((H, S, ACCEPT_QUEUE), I32),
             aq_head=zi, aq_count=zi,
+            flush_pending=zb,
+            dack_scheduled=zb, dack_counter=zi, dack_gen=zi,
+            quick_acks=zi,
+            at_init_done=zb, at_copied=zi, at_space=zi,
+            at_last=jnp.zeros((H, S), I64),
             retx_segs=zh, fr_entries=zh, drop_oo_full=zh, drop_rwin=zh,
+            probes_sent=zh,
         )
 
 
@@ -236,15 +293,6 @@ def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
     ack = gather_hs(tcp.rcv_nxt, slot)
     win = _adv_window(net, tcp, slot)
     tse = gather_hs(tcp.ts_recent, slot)
-    # first OO range (lowest l) advertises the single SACK block
-    oo_valid = tcp.oo_r > tcp.oo_l                      # [H,S,NR]
-    key = jnp.where(oo_valid, tcp.oo_l, jnp.iinfo(I32).max)
-    first = jnp.argmin(key, axis=2)                     # [H,S]
-    has_oo = jnp.any(oo_valid, axis=2)
-    sl = jnp.take_along_axis(tcp.oo_l, first[..., None], axis=2)[..., 0]
-    sr = jnp.take_along_axis(tcp.oo_r, first[..., None], axis=2)[..., 0]
-    sackl = jnp.where(gather_hs(has_oo, slot), gather_hs(sl, slot), 0)
-    sackr = jnp.where(gather_hs(has_oo, slot), gather_hs(sr, slot), 0)
 
     def put(w, col, val):
         return w.at[:, col].set(jnp.where(mask, val, w[:, col]))
@@ -253,8 +301,26 @@ def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
     words = put(words, pf.W_WIN, win)
     words = put(words, pf.W_TSVAL, _ms(now))
     words = put(words, pf.W_TSECHO, tse)
-    words = put(words, pf.W_SACKL, sackl)
-    words = put(words, pf.W_SACKR, sackr)
+    # advertise the SACK_RANGES lowest parked reassembly ranges
+    # (ascending by left edge — the full sack list of packet.h:52,77
+    # up to the 3-range budget)
+    oo_valid = tcp.oo_r > tcp.oo_l                      # [H,S,NR]
+    key = jnp.where(oo_valid, tcp.oo_l, jnp.iinfo(I32).max)
+    cols = ((pf.W_SACKL, pf.W_SACKR), (pf.W_SACKL2, pf.W_SACKR2),
+            (pf.W_SACKL3, pf.W_SACKR3))
+    for cl, cr in cols:
+        pick = jnp.argmin(key, axis=2)                  # [H,S]
+        have = key[jnp.arange(key.shape[0])[:, None],
+                   jnp.arange(key.shape[1])[None, :],
+                   pick] != jnp.iinfo(I32).max
+        sl = jnp.take_along_axis(tcp.oo_l, pick[..., None], axis=2)[..., 0]
+        sr = jnp.take_along_axis(tcp.oo_r, pick[..., None], axis=2)[..., 0]
+        hv = gather_hs(have, slot)
+        words = put(words, cl, jnp.where(hv, gather_hs(sl, slot), 0))
+        words = put(words, cr, jnp.where(hv, gather_hs(sr, slot), 0))
+        # exclude the picked range from the next round
+        taken = jnp.arange(key.shape[2])[None, None, :] == pick[..., None]
+        key = jnp.where(taken, jnp.iinfo(I32).max, key)
     return words
 
 
@@ -396,10 +462,37 @@ def tcp_recv(sim, mask, slot, maxbytes, now, buf):
     unchanged window is indistinguishable from a loss-signalling
     duplicate ACK at the sender."""
     tcp = sim.tcp
-    win_before = _adv_window(sim.net, tcp, slot)
+    net = sim.net
+    win_before = _adv_window(net, tcp, slot)
     avail = gather_hs(tcp.app_rbytes, slot)
     nread = jnp.where(mask, jnp.minimum(jnp.asarray(maxbytes, I32), avail), 0)
     tcp = _set(tcp, "app_rbytes", mask, slot, avail - nread)
+
+    # ---- receive-buffer autotuning (Linux DRS; ref:
+    # _tcp_autotuneReceiveBuffer, tcp.c:535-564, called per app copy,
+    # tcp.c:2303): track bytes copied per smoothed-RTT interval, grow
+    # the buffer toward 2x the copy rate, capped by bw_down * srtt.
+    at_on = mask & net.autotune_rcv & (nread > 0)
+    copied = gather_hs(tcp.at_copied, slot) + nread
+    space = jnp.maximum(2 * copied, gather_hs(tcp.at_space, slot))
+    cur = gather_hs(net.sk_rcvbuf, slot)
+    srtt = gather_hs(tcp.srtt_ms, slot)
+    my_down = net.bw_down_kibps[net.lane_id]
+    max_rmem = jnp.clip(my_down * 1024 * jnp.maximum(srtt, 0).astype(I64)
+                        // 1000, TCP_RMEM_MAX, 10 * TCP_RMEM_MAX)
+    growing = at_on & (space > cur)
+    tcp = _set(tcp, "at_space", growing, slot, space)
+    new_size = jnp.minimum(space.astype(I64), max_rmem).astype(I32)
+    net = net.replace(sk_rcvbuf=set_hs(
+        net.sk_rcvbuf, growing & (new_size > cur), slot, new_size))
+    tcp = _set(tcp, "at_copied", at_on, slot, copied)
+    last = gather_hs(tcp.at_last, slot)
+    tcp = _set(tcp, "at_last", at_on & (last == 0), slot, now)
+    rtt_ns = jnp.maximum(srtt, 0).astype(I64) * simtime.ONE_MILLISECOND
+    reset = at_on & (last > 0) & (srtt > 0) & (now - last > rtt_ns)
+    tcp = _set(tcp, "at_last", reset, slot, now)
+    tcp = _set(tcp, "at_copied", reset, slot, jnp.zeros(mask.shape, I32))
+    sim = sim.replace(net=net)
     eof = mask & gather_hs(tcp.fin_rcvd, slot) & (avail - nread == 0) & (
         gather_hs(tcp.rcv_nxt, slot) > gather_hs(tcp.fin_rseq, slot))
     drained = mask & (avail - nread == 0) & ~eof
@@ -439,11 +532,11 @@ def tcp_close(cfg: NetConfig, sim, mask, slot, now, buf):
     tcp = _set(tcp, "fin_pending", to_finwait | to_lastack | deferred,
                slot, True)
     sim = sim.replace(tcp=tcp)
-    sim = _free_socket(sim, direct, slot)
+    sim = _free_socket(cfg, sim, direct, slot)
     return tcp_flush(cfg, sim, mask & ~direct, slot, now, buf)
 
 
-def _free_socket(sim, mask, slot):
+def _free_socket(cfg, sim, mask, slot):
     """Release a socket slot for reuse (ref: descriptor close +
     handle recycling, host.c:696-767)."""
     net = sim.net
@@ -457,6 +550,12 @@ def _free_socket(sim, mask, slot):
         sk_peer_ip=set_hs(net.sk_peer_ip, mask, slot,
                           jnp.zeros(mask.shape, I64)),
         sk_peer_port=set_hs(net.sk_peer_port, mask, slot, zero),
+        # autotune may have grown the buffers; a recycled slot starts
+        # from the configured defaults again
+        sk_sndbuf=set_hs(net.sk_sndbuf, mask, slot,
+                         jnp.full(mask.shape, cfg.sndbuf, I32)),
+        sk_rcvbuf=set_hs(net.sk_rcvbuf, mask, slot,
+                         jnp.full(mask.shape, cfg.rcvbuf, I32)),
     )
     tcp = sim.tcp
     tcp = _set(tcp, "st", mask, slot, zero)
@@ -472,8 +571,6 @@ def _free_socket(sim, mask, slot):
                jnp.full(mask.shape, INIT_SSTHRESH, I32))
     tcp = _set(tcp, "ca_acc", mask, slot, zero)
     tcp = _set(tcp, "in_recovery", mask, slot, False)
-    tcp = _set(tcp, "sack_l", mask, slot, zero)
-    tcp = _set(tcp, "sack_r", mask, slot, zero)
     tcp = _set(tcp, "rcv_nxt", mask, slot, zero)
     tcp = _set(tcp, "app_rbytes", mask, slot, zero)
     tcp = _set(tcp, "fin_rcvd", mask, slot, False)
@@ -491,7 +588,20 @@ def _free_socket(sim, mask, slot):
     tcp = tcp.replace(
         oo_l=jnp.where(sel[..., None], 0, tcp.oo_l),
         oo_r=jnp.where(sel[..., None], 0, tcp.oo_r),
+        sack_l=jnp.where(sel[..., None], 0, tcp.sack_l),
+        sack_r=jnp.where(sel[..., None], 0, tcp.sack_r),
     )
+    tcp = _set(tcp, "flush_pending", mask, slot, False)
+    tcp = _set(tcp, "dack_scheduled", mask, slot, False)
+    tcp = _set(tcp, "dack_counter", mask, slot, zero)
+    # stale DACK events for a reused slot die on generation mismatch
+    tcp = _set(tcp, "dack_gen", mask, slot,
+               gather_hs(tcp.dack_gen, slot) + 1)
+    tcp = _set(tcp, "quick_acks", mask, slot, zero)
+    tcp = _set(tcp, "at_init_done", mask, slot, False)
+    tcp = _set(tcp, "at_copied", mask, slot, zero)
+    tcp = _set(tcp, "at_space", mask, slot, zero)
+    tcp = _set(tcp, "at_last", mask, slot, jnp.zeros(mask.shape, I64))
     return sim.replace(net=net, tcp=tcp)
 
 
@@ -536,11 +646,43 @@ def tcp_flush(cfg: NetConfig, sim, mask, slot, now, buf):
     tcp = _set(tcp, "snd_max", fsent, slot,
                jnp.maximum(gather_hs(tcp.snd_max, slot), nxt + 1))
     sim = sim.replace(tcp=tcp)
-    # outstanding data must be covered by a retransmission deadline
+    # outstanding data must be covered by a retransmission deadline;
+    # a zero peer window with data waiting and nothing in flight arms
+    # the same timer as a persist timer (zero-window probe — see
+    # module docstring; the reference has no probe)
     tcp = sim.tcp
-    outstanding = mask & (gather_hs(tcp.snd_una, slot)
-                          < gather_hs(tcp.snd_nxt, slot))
-    need = outstanding & (gather_hs(tcp.rtx_expire, slot) == simtime.INVALID)
+    una = gather_hs(tcp.snd_una, slot)
+    nxt = gather_hs(tcp.snd_nxt, slot)
+    outstanding = mask & (una < nxt)
+    persist = mask & (una == nxt) & (gather_hs(tcp.snd_end, slot) > nxt) \
+        & (gather_hs(tcp.snd_wnd, slot) == 0)
+    need = (outstanding | persist) & (
+        gather_hs(tcp.rtx_expire, slot) == simtime.INVALID)
+
+    # more admissible data than this pass packetized (one coalesced
+    # ACK can open many segments' worth of window): chain a same-time
+    # TCP_FLUSH event, unwound by the window fixpoint — the device
+    # form of _tcp_flush's drain-while-sendable loop (tcp.c:1121-...)
+    st2 = gather_hs(tcp.st, slot)
+    can2 = mask & (
+        (st2 == TcpSt.ESTABLISHED) | (st2 == TcpSt.CLOSE_WAIT)
+        | (st2 == TcpSt.FIN_WAIT_1) | (st2 == TcpSt.LAST_ACK))
+    wnd2 = jnp.minimum(gather_hs(tcp.cwnd, slot) * MSS,
+                       gather_hs(tcp.snd_wnd, slot))
+    seg2 = jnp.minimum(
+        jnp.minimum(gather_hs(tcp.snd_end, slot) - nxt, MSS),
+        una + wnd2 - nxt)
+    BO2 = sim.net.out_words.shape[2]
+    room2 = (gather_hs(sim.net.out_count, slot) < BO2) & (
+        gather_hs(sim.net.out_bytes, slot) + seg2
+        <= gather_hs(sim.net.sk_sndbuf, slot))
+    chain = can2 & (seg2 > 0) & room2 \
+        & ~gather_hs(tcp.flush_pending, slot)
+    tcp = _set(tcp, "flush_pending", chain, slot, True)
+    sim = sim.replace(tcp=tcp)
+    H2 = mask.shape[0]
+    cw = jnp.zeros((H2, NWORDS), I32).at[:, 0].set(slot.astype(I32))
+    buf = emit(buf, chain, sim.net.lane_id, now, EventKind.TCP_FLUSH, cw)
     return _arm_rtx(sim, buf, need, slot, now)
 
 
@@ -572,11 +714,25 @@ def _retransmit_one(cfg, sim, mask, slot, now, buf):
     sim, buf, _ = _enqueue_seg(sim, buf, is_fin, slot,
                             pf.TCPF_FIN | pf.TCPF_ACK, una, 0, now)
     seg = jnp.minimum(end - una, MSS)
+    # clip the retransmission at the first peer-sacked edge above una:
+    # sacked bytes need no resend (ref: the tally's lost-range
+    # computation excludes sacked intervals)
+    H = mask.shape[0]
+    lane = jnp.arange(H)
+    S = tcp.sack_l.shape[1]
+    sc = jnp.clip(slot, 0, S - 1)
+    sll = tcp.sack_l[lane, sc]                         # [H, SACK_RANGES]
+    srr = tcp.sack_r[lane, sc]
+    above = (srr > sll) & (sll > una[:, None])
+    big = jnp.iinfo(I32).max
+    first_sacked = jnp.min(jnp.where(above, sll, big), axis=1)
+    seg = jnp.minimum(seg, jnp.maximum(first_sacked - una, 1))
     sim, buf, _ = _enqueue_seg(sim, buf, is_data, slot, pf.TCPF_ACK, una, seg, now)
     sent = is_syn | is_synack | is_fin | is_data
+    resent_end = jnp.where(is_data, una + seg, una + 1)
     tcp = sim.tcp
     tcp = tcp.replace(retx_segs=tcp.retx_segs + sent.astype(I64))
-    return sim.replace(tcp=tcp), buf, sent
+    return sim.replace(tcp=tcp), buf, sent, resent_end
 
 
 # ---------------------------------------------------------------------
@@ -611,7 +767,7 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     # ---- RST tears the connection down (ref: tcp.c RST handling) ----
     rst = mask & f_rst & (st != TcpSt.CLOSED) & (st != TcpSt.LISTEN)
     sim = sim.replace(tcp=tcp)
-    sim = _free_socket(sim, rst, slot)
+    sim = _free_socket(cfg, sim, rst, slot)
     tcp, net = sim.tcp, sim.net
     mask = mask & ~rst
     st = gather_hs(tcp.st, slot)
@@ -732,8 +888,19 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     nxt = gather_hs(tcp.snd_nxt, slot)
     wnd_prev = gather_hs(tcp.snd_wnd, slot)
     tcp = _set(tcp, "snd_wnd", conn, slot, peer_win)
-    tcp = _set(tcp, "sack_l", conn & (sackr > sackl), slot, sackl)
-    tcp = _set(tcp, "sack_r", conn & (sackr > sackl), slot, sackr)
+    # scoreboard = the advertised SACK list (the receiver re-sends its
+    # full parked set each ACK, so replacement == the reference's
+    # tally merge, tcp_retransmit_tally.cc); an empty list clears it
+    sack_l3 = jnp.stack(
+        [sackl, words[:, pf.W_SACKL2], words[:, pf.W_SACKL3]], axis=1)
+    sack_r3 = jnp.stack(
+        [sackr, words[:, pf.W_SACKR2], words[:, pf.W_SACKR3]], axis=1)
+    S_ = tcp.sack_l.shape[1]
+    sel_sk = conn[:, None] & (jnp.arange(S_)[None, :] == slot[:, None])
+    tcp = tcp.replace(
+        sack_l=jnp.where(sel_sk[..., None], sack_l3[:, None, :], tcp.sack_l),
+        sack_r=jnp.where(sel_sk[..., None], sack_r3[:, None, :], tcp.sack_r),
+    )
 
     smax = gather_hs(tcp.snd_max, slot)
     new_ack = conn & (ack > una) & (ack <= smax)
@@ -763,30 +930,99 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "rto_ms", new_ack & (tsecho > 0), slot, rto_n)
     tcp = _set(tcp, "backoff", new_ack, slot, jnp.zeros((H,), I32))
 
-    # Reno new-ack (ref: tcp_cong_reno.c slow start / CA)
+    # Reno new-ack (ref: tcp_cong_reno.c). The hooks are fed the
+    # NUMBER OF PACKETS the ACK covers (ref: tcp.c:1710-1717
+    # nPacketsAcked) — essential under delayed-ACK coalescing, where
+    # one ACK may cover many segments.
     in_rec = gather_hs(tcp.in_recovery, slot)
     recover = gather_hs(tcp.recover, slot)
     cwnd = gather_hs(tcp.cwnd, slot)
     ssth = gather_hs(tcp.ssthresh, slot)
     ca = gather_hs(tcp.ca_acc, slot)
+    n_acked = jnp.where(new_ack, (ack - una + MSS - 1) // MSS, 0)
 
     full_rec = new_ack & in_rec & (ack >= recover)
     partial = new_ack & in_rec & (ack < recover)
     normal = new_ack & ~in_rec
 
+    # slow start: cwnd += n, spilling leftover acks into congestion
+    # avoidance at ssthresh (ref: ca_reno_slow_start_new_ack_ev_)
     ss = normal & (cwnd < ssth)
-    cwnd1 = jnp.where(ss, cwnd + 1, cwnd)
-    ca1 = jnp.where(normal & ~ss, ca + 1, ca)
-    bump = normal & ~ss & (ca1 >= cwnd1)
-    cwnd1 = jnp.where(bump, cwnd1 + 1, cwnd1)
-    ca1 = jnp.where(bump, 0, ca1)
-    # leaving recovery deflates to ssthresh (ref: reno fast recovery)
+    grown = cwnd + n_acked
+    spill = ss & (grown >= ssth)
+    cwnd1 = jnp.where(ss, jnp.minimum(grown, ssth), cwnd)
+    # leaving fast recovery deflates to ssthresh and continues in CA
+    # with this ACK's packet count (ref: ca_reno_fast_recovery_new_ack_ev_)
     cwnd1 = jnp.where(full_rec, ssth, cwnd1)
+    ca_in = jnp.where(spill, grown - ssth,
+                      jnp.where(full_rec | (normal & ~ss), n_acked, 0))
+    in_ca = (normal & ~ss) | spill | full_rec
+    # transitions reset the CA accumulator (transition_to_cong_avoid)
+    ca_base = jnp.where(spill | full_rec, 0, ca)
+    ca1 = jnp.where(in_ca, ca_base + ca_in, ca)
+    # +1 cwnd per full window of acked packets (bounded unroll of the
+    # reference's while loop; any residue carries to the next ACK)
+    for _ in range(4):
+        inc = in_ca & (ca1 >= cwnd1)
+        ca1 = jnp.where(inc, ca1 - cwnd1, ca1)
+        cwnd1 = jnp.where(inc, cwnd1 + 1, cwnd1)
     tcp = _set(tcp, "cwnd", new_ack, slot, cwnd1)
     tcp = _set(tcp, "ca_acc", new_ack, slot, ca1)
     tcp = _set(tcp, "in_recovery", full_rec, slot, False)
     tcp = _set(tcp, "dup_acks", new_ack, slot, jnp.zeros((H,), I32))
     tcp = _set(tcp, "snd_una", new_ack, slot, ack)
+
+    # ---- buffer autotuning (ref: tcp.c:407-592) ----------------------
+    # Initial sizing on the FIRST RTT sample (ref: tcp.c:1007-1009):
+    # bandwidth-delay product from the topology's true latencies and
+    # the bottleneck of local and peer interface bandwidth, x1.25.
+    lane = jnp.arange(H)
+    from shadow_tpu.net.state import host_of_ip
+
+    sample = new_ack & (tsecho > 0)
+    at_init = sample & first & ~gather_hs(tcp.at_init_done, slot)
+    peer_ip = gather_hs(net.sk_peer_ip, slot)
+    self_ip = net.host_ip[net.lane_id]
+    is_loop = (peer_ip == self_ip) | ((peer_ip >> 24) == 127)
+    peer_h = host_of_ip(net, peer_ip)
+    GHn = net.host_ip.shape[0]
+    ph = jnp.clip(peer_h, 0, GHn - 1)
+    vsrc = net.vertex_of_host[net.lane_id]
+    vdst = net.vertex_of_host[ph]
+    rtt_topo_ms = jnp.maximum(
+        (net.latency_ns[vsrc, vdst] + net.latency_ns[vdst, vsrc])
+        // simtime.ONE_MILLISECOND, 1)
+    my_up = net.bw_up_kibps[net.lane_id]
+    my_down = net.bw_down_kibps[net.lane_id]
+    peer_up = net.bw_up_kibps[ph]
+    peer_down = net.bw_down_kibps[ph]
+    # KiBps * ms * 1.25 / 1000 -> bytes (the delay-bandwidth product)
+    bdp_snd = rtt_topo_ms * jnp.minimum(my_up, peer_down) * 1280 // 1000
+    bdp_rcv = rtt_topo_ms * jnp.minimum(my_down, peer_up) * 1280 // 1000
+    init_snd = jnp.where(
+        is_loop, TCP_WMEM_MAX,
+        jnp.clip(bdp_snd, SEND_BUFFER_MIN, TCP_WMEM_MAX)).astype(I32)
+    init_rcv = jnp.where(
+        is_loop, TCP_RMEM_MAX,
+        jnp.clip(bdp_rcv, RECV_BUFFER_MIN, TCP_RMEM_MAX)).astype(I32)
+    net = net.replace(
+        sk_sndbuf=set_hs(net.sk_sndbuf, at_init & net.autotune_snd, slot,
+                         init_snd),
+        sk_rcvbuf=set_hs(net.sk_rcvbuf, at_init & net.autotune_rcv, slot,
+                         init_rcv),
+    )
+    tcp = _set(tcp, "at_init_done", at_init, slot, True)
+    # Runtime send-buffer growth with cwnd (ref: _tcp_autotuneSendBuffer
+    # tcp.c:566-592, called per data ACK, tcp.c:1715-1723). Grow-only.
+    srtt_now = jnp.maximum(jnp.where(sample, srtt_n, srtt), 0).astype(I64)
+    max_wmem = jnp.clip(my_up * 1024 * srtt_now // 1000,
+                        TCP_WMEM_MAX, 10 * TCP_WMEM_MAX)
+    want_snd = jnp.minimum(
+        I64(SNDMEM_SKB) * 2 * cwnd1.astype(I64), max_wmem).astype(I32)
+    cur_snd = gather_hs(net.sk_sndbuf, slot)
+    net = net.replace(sk_sndbuf=set_hs(
+        net.sk_sndbuf, new_ack & net.autotune_snd & (want_snd > cur_snd),
+        slot, want_snd))
     # ACK progress reopened stream-buffer room: restore WRITABLE
     # (ref: descriptor_adjustStatus on buffer drain -> epoll wakeup)
     wroom = new_ack & (
@@ -798,7 +1034,7 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     da = gather_hs(tcp.dup_acks, slot) + 1
     tcp = _set(tcp, "dup_acks", dup_ack, slot, da)
     enter_fr = dup_ack & (da == 3) & ~in_rec
-    ssth_fr = jnp.maximum(cwnd // 2, 2)
+    ssth_fr = cwnd // 2 + 1        # ref: ssthresh_halve
     tcp = _set(tcp, "ssthresh", enter_fr, slot, ssth_fr)
     tcp = _set(tcp, "cwnd", enter_fr, slot, ssth_fr + 3)
     tcp = _set(tcp, "in_recovery", enter_fr, slot, True)
@@ -809,7 +1045,7 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "cwnd", inflate, slot, gather_hs(tcp.cwnd, slot) + 1)
 
     sim = sim.replace(net=net, tcp=tcp)
-    sim, buf, _ = _retransmit_one(cfg, sim, enter_fr | partial, slot, now, buf)
+    sim, buf, _, _ = _retransmit_one(cfg, sim, enter_fr | partial, slot, now, buf)
     tcp = sim.tcp
 
     # re-arm / disarm the RTO deadline after progress
@@ -820,9 +1056,14 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _disarm_rtx(tcp, done, slot)
     sim = sim.replace(tcp=tcp)
 
-    # window may have opened (new_ack) or the connection just
+    # window may have opened (new_ack), a pure window-update ACK may
+    # have reopened a closed window (the receiver-drain ACK a stalled
+    # sender is waiting for — without this, resumption would wait for
+    # the backed-off persist timer), or the connection just
     # established with buffered data (synack): push more data
-    sim, buf = tcp_flush(cfg, sim, new_ack | synack, slot, now, buf)
+    reopened = conn & (wnd_prev == 0) & (peer_win > 0)
+    sim, buf = tcp_flush(cfg, sim, new_ack | synack | reopened, slot, now,
+                         buf)
     tcp, net = sim.tcp, sim.net
     st = gather_hs(tcp.st, slot)
 
@@ -837,7 +1078,7 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
                jnp.full((H,), TcpSt.TIME_WAIT, I32))
     closed_now = fin_acked & (st == TcpSt.LAST_ACK)
     sim = sim.replace(net=net, tcp=tcp)
-    sim = _free_socket(sim, closed_now, slot)
+    sim = _free_socket(cfg, sim, closed_now, slot)
     tcp, net = sim.tcp, sim.net
     # TIME_WAIT entered via CLOSING: arm the 60 s reaper
     tw1 = fin_acked & (st == TcpSt.CLOSING)
@@ -951,21 +1192,40 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
                          gather_hs(net.sk_in_gen, slot) + 1),
     )
 
-    # ---- ACK generation ----------------------------------------------
-    # every data/FIN segment is acknowledged immediately (the
-    # reference's quick-ACK path; delayed ACKs are a tuning TODO).
-    # synack lanes send the handshake-completing ACK here. A SYN|ACK
-    # retransmitted to an already-ESTABLISHED peer (its completing ACK
-    # was dropped by a then-full accept backlog) also elicits a pure
-    # ACK — RFC 793 out-of-window behavior — so the handshake retries
-    # even on a connection that never sends data.
+    # ---- ACK generation (ref: tcp.c:2050-2091) -----------------------
+    # Loss-signalling ACKs (old/out-of-order/dropped data -> dup ACKs
+    # with SACK) and handshake ACKs go out immediately; plain ACKs for
+    # in-order data (and the FIN's ACK) coalesce behind one scheduled
+    # delayed-ACK send — 1 ms while the connection's first
+    # DACK_QUICK_LIMIT "quick ACKs" last, then 5 ms. resynack: a
+    # SYN|ACK retransmitted to an already-ESTABLISHED peer (its
+    # completing ACK was dropped by a then-full accept backlog)
+    # elicits an immediate pure ACK — RFC 793 out-of-window behavior —
+    # so the handshake retries even on a dataless connection.
     resynack = mask & f_syn & f_ack & (st >= TcpSt.ESTABLISHED)
-    send_ack = (has_data | fin_now | old | synack | resynack) \
-        & (st != TcpSt.CLOSED)
+    ooseg_ack = fits & (seq > rcv_nxt)
+    dropped_ack = fresh & ~fits
+    alive = st != TcpSt.CLOSED
+    immediate = (old | ooseg_ack | dropped_ack | synack | resynack) & alive
+    delayed = (inorder | fin_now) & ~immediate & alive
     sim = sim.replace(net=net, tcp=tcp)
-    sim, buf, _ = _enqueue_seg(sim, buf, send_ack, slot, pf.TCPF_ACK,
+    sim, buf, _ = _enqueue_seg(sim, buf, immediate, slot, pf.TCPF_ACK,
                             gather_hs(tcp.snd_nxt, slot), 0, now)
-    return sim, buf
+    tcp = sim.tcp
+    cnt = gather_hs(tcp.dack_counter, slot) + 1
+    tcp = _set(tcp, "dack_counter", delayed, slot, cnt)
+    sched = delayed & ~gather_hs(tcp.dack_scheduled, slot)
+    nq = gather_hs(tcp.quick_acks, slot)
+    quick = nq < DACK_QUICK_LIMIT
+    delay = jnp.where(quick, DACK_QUICK_NS, DACK_SLOW_NS)
+    tcp = _set(tcp, "quick_acks", sched & quick, slot, nq + 1)
+    tcp = _set(tcp, "dack_scheduled", sched, slot, True)
+    dw = (jnp.zeros((H, NWORDS), I32)
+          .at[:, 0].set(slot.astype(I32))
+          .at[:, 1].set(gather_hs(tcp.dack_gen, slot)))
+    buf = emit(buf, sched, sim.net.lane_id, now + delay,
+               EventKind.TCP_DACK_TIMER, dw)
+    return sim.replace(tcp=tcp), buf
 
 
 # ---------------------------------------------------------------------
@@ -1007,9 +1267,29 @@ def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
     una = gather_hs(tcp.snd_una, slot)
     nxt = gather_hs(tcp.snd_nxt, slot)
     live = due & (una < nxt)
+
+    # persist expiry: zero window, data waiting, nothing in flight —
+    # send one byte past the window; its (dup-)ACK re-reveals the
+    # peer's window. Backoff caps the probe rate.
+    probe = due & (una == nxt) & (gather_hs(tcp.snd_end, slot) > nxt) \
+        & (gather_hs(tcp.snd_wnd, slot) == 0)
+    sim2 = sim.replace(tcp=tcp)
+    sim2, buf, psent = _enqueue_seg(sim2, buf, probe, slot, pf.TCPF_ACK,
+                                    nxt, 1, now)
+    tcp = sim2.tcp
+    tcp = _set(tcp, "snd_nxt", psent, slot, nxt + 1)
+    tcp = _set(tcp, "snd_max", psent, slot,
+               jnp.maximum(gather_hs(tcp.snd_max, slot), nxt + 1))
+    tcp = _set(tcp, "backoff", psent, slot,
+               jnp.minimum(gather_hs(tcp.backoff, slot) + 1, MAX_BACKOFF))
+    tcp = tcp.replace(probes_sent=tcp.probes_sent + psent.astype(I64))
+    sim = sim2.replace(tcp=tcp)
+    # (the due-lane disarm below clears this fire's event; the final
+    # _arm_rtx re-arms both the loss retransmit and the probe)
     cwnd = gather_hs(tcp.cwnd, slot)
-    tcp = _set(tcp, "ssthresh", live, slot, jnp.maximum(cwnd // 2, 2))
-    tcp = _set(tcp, "cwnd", live, slot, jnp.ones((H,), I32))
+    tcp = _set(tcp, "ssthresh", live, slot, cwnd // 2 + 1)
+    tcp = _set(tcp, "cwnd", live, slot,
+               jnp.full((H,), RESTART_CWND, I32))
     tcp = _set(tcp, "ca_acc", live, slot, jnp.zeros((H,), I32))
     tcp = _set(tcp, "in_recovery", live, slot, False)
     tcp = _set(tcp, "dup_acks", live, slot, jnp.zeros((H,), I32))
@@ -1018,21 +1298,61 @@ def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
     tcp = _set(tcp, "rtx_event", due, slot, False)
     tcp = _disarm_rtx(tcp, due, slot)
     sim = sim.replace(tcp=tcp)
-    sim, buf, _ = _retransmit_one(cfg, sim, live, slot, now, buf)
+    sim, buf, _, resent_end = _retransmit_one(cfg, sim, live, slot, now, buf)
     # go-back-N: snd_nxt rewinds to just past the retransmitted
-    # segment; later ACK arrivals flush the rest of the range again.
+    # segment (as actually sent, including any SACK clip); later ACK
+    # arrivals flush the rest of the range again.
     tcp = sim.tcp
-    end = gather_hs(tcp.snd_end, slot)
-    fin_ever = gather_hs(tcp.fin_pending, slot) & (
-        gather_hs(tcp.snd_max, slot) == end + 1)
-    is_ctl = (una == 0) | (fin_ever & (una == end))
-    resent_end = jnp.where(is_ctl, una + 1,
-                           una + jnp.minimum(end - una, MSS))
     rewind = live & (resent_end < nxt)
     tcp = _set(tcp, "snd_nxt", rewind, slot, resent_end)
     sim = sim.replace(tcp=tcp)
-    sim, buf = _arm_rtx(sim, buf, live, slot, now)
+    sim, buf = _arm_rtx(sim, buf, live | probe, slot, now)
     return sim, buf
+
+
+def handle_tcp_flush(cfg: NetConfig, sim, popped, buf):
+    """kind=TCP_FLUSH: continue packetizing admissible stream data
+    (the unwound remainder of one logical _tcp_flush call)."""
+    if sim.tcp is None:
+        return sim, buf
+    mask = popped.valid & (popped.kind == EventKind.TCP_FLUSH)
+    slot = popped.word(0)
+    tcp = _set(sim.tcp, "flush_pending", mask, slot, False)
+    sim = sim.replace(tcp=tcp)
+    return tcp_flush(cfg, sim, mask, slot, popped.time, buf)
+
+
+def handle_tcp_dack(cfg: NetConfig, sim, popped, buf):
+    """kind=TCP_DACK_TIMER: the delayed-ACK send task (ref:
+    _tcp_sendACKTaskCallback, tcp.c:1767-1775): clear the scheduled
+    flag and send one pure ACK if any ACK-worthy arrival is still
+    unacknowledged (a departing ACK-carrying packet zeroes the counter
+    at wire time, cancelling us)."""
+    if sim.tcp is None:
+        return sim, buf
+    mask = popped.valid & (popped.kind == EventKind.TCP_DACK_TIMER)
+    slot = popped.word(0)
+    egen = popped.word(1)
+    now = popped.time
+    tcp = sim.tcp
+    # stale events for recycled slots die on generation mismatch
+    mask = mask & (egen == gather_hs(tcp.dack_gen, slot))
+    tcp = _set(tcp, "dack_scheduled", mask, slot, False)
+    fire = mask & (gather_hs(tcp.dack_counter, slot) > 0)
+    tcp = _set(tcp, "dack_counter", fire, slot, jnp.zeros(mask.shape, I32))
+    sim = sim.replace(tcp=tcp)
+    sim, buf, _ = _enqueue_seg(sim, buf, fire, slot, pf.TCPF_ACK,
+                               gather_hs(tcp.snd_nxt, slot), 0, now)
+    return sim, buf
+
+
+def wire_ack_departed(tcp: TcpState, mask, slot):
+    """A packet carrying an ACK just hit the wire for (lane, slot):
+    cancel any pending delayed ACK (ref: tcp.c:1105-1108 resets
+    delayedACKCounter whenever an outgoing header has ACK set).
+    Called by the NIC send drain after stamp_at_wire."""
+    return _set(tcp, "dack_counter", mask, slot,
+                jnp.zeros(mask.shape, I32))
 
 
 def handle_tcp_close(cfg: NetConfig, sim, popped, buf):
@@ -1044,4 +1364,4 @@ def handle_tcp_close(cfg: NetConfig, sim, popped, buf):
     slot = popped.word(0)
     st = gather_hs(sim.tcp.st, slot)
     reap = mask & (st == TcpSt.TIME_WAIT)
-    return _free_socket(sim, reap, slot), buf
+    return _free_socket(cfg, sim, reap, slot), buf

@@ -1,0 +1,81 @@
+"""Virtual CPU model (ref: cpu.c:56-110 + event.c:71-89): per-event
+processing charges accumulate against a host's CPU availability; past
+the threshold, events are rescheduled instead of executed — so a slow
+host deterministically lags a fast one."""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.apps import pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">10.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _build(cpu_threshold_ns, slow_freq_khz, count=20):
+    """Two ping clients -> two servers; server1 runs on a slow CPU."""
+    cfg = NetConfig(num_hosts=4, tcp=False,
+                    end_time=8 * simtime.ONE_SECOND, seed=1,
+                    cpu_threshold_ns=cpu_threshold_ns,
+                    cpu_event_cost_ns=1_000_000,   # 1 ms per event
+                    cpu_precision_ns=200_000)
+    hosts = [
+        HostSpec(name="client0", proc_start_time=simtime.ONE_SECOND),
+        HostSpec(name="client1", proc_start_time=simtime.ONE_SECOND),
+        HostSpec(name="server0"),
+        HostSpec(name="server1", cpufrequency_khz=slow_freq_khz),
+    ]
+    b = build(cfg, GRAPH, hosts)
+    client = jnp.asarray(np.arange(4) < 2)
+    server = jnp.asarray(np.arange(4) >= 2)
+    server_ip = np.zeros(4, np.int64)
+    server_ip[0] = b.ip_of("server0")
+    server_ip[1] = b.ip_of("server1")
+    b.sim = pingpong.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=jnp.asarray(server_ip), server_port=7000,
+        count=count, size=64,
+    )
+    return b
+
+
+def test_slow_host_lags_deterministically():
+    # a 100x-slower CPU charges 100 ms per event vs 1 ms — more than
+    # the ~20 ms ping cadence, so its processing backlog grows past the
+    # 2 ms threshold and events get rescheduled (the blocked path)
+    b = _build(cpu_threshold_ns=2_000_000, slow_freq_khz=30_000)
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    rcvd = np.asarray(sim.app.rcvd)
+    assert int(sim.net.ctr_cpu_blocked.sum()) > 0
+    # both eventually complete (blocked events are delayed, not lost)
+    assert rcvd[0] == 20 and rcvd[1] == 20, rcvd.tolist()
+
+    # determinism: identical second run
+    b2 = _build(cpu_threshold_ns=2_000_000, slow_freq_khz=30_000)
+    sim2, _ = run(b2, app_handlers=(pingpong.handler,))
+    np.testing.assert_array_equal(np.asarray(sim.net.cpu_avail),
+                                  np.asarray(sim2.net.cpu_avail))
+    np.testing.assert_array_equal(np.asarray(sim.net.ctr_cpu_blocked),
+                                  np.asarray(sim2.net.ctr_cpu_blocked))
+
+    # the slow server accumulated (much) more blocking than the fast
+    s0, s1 = b.host_of("server0"), b.host_of("server1")
+    blocked = np.asarray(sim.net.ctr_cpu_blocked)
+    assert blocked[s1] > blocked[s0], blocked.tolist()
+
+
+def test_disabled_by_default_costs_nothing():
+    b = _build(cpu_threshold_ns=-1, slow_freq_khz=300_000)
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    assert int(sim.net.ctr_cpu_blocked.sum()) == 0
+    assert int(sim.net.cpu_avail.max()) == 0

@@ -142,9 +142,10 @@ def test_epoll_writable_block_and_wake():
     buffer (WRITABLE drops), blocks in an EPOLLOUT wait, and wakes
     only after the receiver drains enough that ACK progress reopens
     buffer room (ref: tcp.c send-buffer status + epoll notify)."""
-    # small send buffer so it fills quickly
-    b = _bundle(seconds=60, sndbuf=8192, event_capacity=128,
-                outbox_capacity=128, router_ring=128)
+    # small send buffer so it fills quickly; pinning an explicit size
+    # disables autotuning, matching the reference (master.c:355-364)
+    b = _bundle(seconds=60, sndbuf=8192, autotune=False,
+                event_capacity=128, outbox_capacity=128, router_ring=128)
     server_ip = b.ip_of("server")
     log = []
     total = 40_000
