@@ -11,11 +11,14 @@ rides out behind the data). Server: accepts children off the listener
 and drains them until EOF, counting received bytes.
 
 Each host can be client, server, or both (distinct sockets). Servers
-drain one child at a time: accept a child, read it to EOF, close it,
-then accept the next — later connections wait in the listener's accept
-queue (SYN-retry backpressure once that fills). `rcvd` accumulates
-across children; `eof` is sticky ("saw at least one EOF") and
-`done_at` tracks the latest EOF time.
+handle children CONCURRENTLY, like the reference's epoll-driven bulk
+server: every wakeup accepts one queued connection (if any) and drains
+one readable child, cyclic-fair across the accepted set — since the
+server wakes on every arriving packet, throughput scales with event
+rate, not with a single serial drain. Concurrency is bounded by the
+socket table (sockets_per_host); beyond that, SYN-retry backpressure
+applies. `rcvd` accumulates across children; `eof` is sticky ("saw at
+least one EOF") and `done_at` tracks the latest EOF time.
 """
 
 from __future__ import annotations
@@ -42,7 +45,8 @@ class BulkApp:
     is_server: jax.Array    # [H] bool
     lsock: jax.Array        # [H] i32 server listener slot (-1)
     csock: jax.Array        # [H] i32 client connection slot (-1)
-    child: jax.Array        # [H] i32 server-side accepted child (-1)
+    children: jax.Array     # [H,S] bool accepted children in flight
+    child_rr: jax.Array     # [H] i32 drain-fairness cursor
     server_ip: jax.Array    # [H] i64
     server_port: jax.Array  # [H] i32
     to_send: jax.Array      # [H] i32 bytes not yet submitted
@@ -74,7 +78,8 @@ def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
         is_server=server_mask,
         lsock=jnp.where(server_mask, lsock, -1),
         csock=jnp.where(client_mask, csock, -1),
-        child=jnp.full((H,), -1, I32),
+        children=jnp.zeros((H, sim.net.sk_type.shape[1]), bool),
+        child_rr=jnp.zeros((H,), I32),
         server_ip=jnp.broadcast_to(jnp.asarray(server_ip, I64), (H,)),
         server_port=jnp.full((H,), server_port, I32),
         to_send=jnp.where(client_mask, total_bytes, 0).astype(I32),
@@ -117,27 +122,39 @@ def handler(cfg: NetConfig, sim, popped, buf):
     sim = sim.replace(app=app)
 
     # ---- server: accept one pending child per wakeup -----------------
+    # (concurrent children, the epoll-server shape: accept whenever
+    # the listener is readable; the accepted set is tracked as a
+    # [H,S] bitmask bounded by the socket table)
+    S = sim.net.sk_type.shape[1]
     lready = (gather_hs(sim.net.sk_flags, app.lsock)
               & SocketFlags.READABLE) != 0
-    acc = woke & app.is_server & (app.child < 0) & lready
+    acc = woke & app.is_server & lready
     sim, got, child = tcp.tcp_accept(sim, acc, app.lsock)
-    app = app.replace(child=jnp.where(got, child, app.child))
+    sel = got[:, None] & (jnp.arange(S)[None, :] == child[:, None])
+    app = app.replace(children=app.children | sel)
     sim = sim.replace(app=app)
 
-    # ---- server: drain the child -------------------------------------
-    drain = woke & app.is_server & (app.child >= 0)
+    # ---- server: drain one readable child, cyclic-fair ---------------
+    readable = (sim.net.sk_flags & SocketFlags.READABLE) != 0
+    cand = app.children & readable
+    key = (jnp.arange(S)[None, :] - app.child_rr[:, None]) % S
+    key = jnp.where(cand, key, S + 1)
+    slot = jnp.argmin(key, axis=1).astype(I32)
+    have = jnp.any(cand, axis=1)
+    drain = woke & app.is_server & have
+    slot = jnp.where(drain, slot, -1)
     chunk = jnp.where(now >= app.drain_after, app.recv_chunk, 0)
-    sim, buf, nread, eof = tcp.tcp_recv(sim, drain, app.child,
-                                        chunk, now, buf)
+    sim, buf, nread, eof = tcp.tcp_recv(sim, drain, slot, chunk, now, buf)
     app = app.replace(
         rcvd=app.rcvd + nread.astype(I64),
         eof=app.eof | eof,
         done_at=jnp.where(eof, now, app.done_at),
+        child_rr=jnp.where(drain, (slot + 1) % S, app.child_rr),
     )
     sim = sim.replace(app=app)
-    # close our side in response to EOF (server-side passive close),
-    # then release the child slot so the next queued connection can be
-    # accepted on a later wakeup
-    sim, buf = tcp.tcp_close(cfg, sim, eof, app.child, now, buf)
-    app = sim.app.replace(child=jnp.where(eof, -1, sim.app.child))
+    # close our side in response to EOF (server-side passive close)
+    # and release the child from the accepted set
+    sim, buf = tcp.tcp_close(cfg, sim, eof, slot, now, buf)
+    clear = eof[:, None] & (jnp.arange(S)[None, :] == slot[:, None])
+    app = sim.app.replace(children=sim.app.children & ~clear)
     return sim.replace(app=app), buf

@@ -21,6 +21,8 @@ import json
 import sys
 import time
 
+import numpy as np
+
 
 def make_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(
@@ -46,6 +48,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="unlimited-bandwidth bootstrap period (s)")
     p.add_argument("--interface-qdisc", default="fifo",
                    choices=["fifo", "rr"])
+    p.add_argument("--router-qdisc", default="codel",
+                   choices=["codel", "single", "static"],
+                   help="upstream router queue manager (ref: the "
+                        "QueueManagerHooks vtable, router.c; CoDel "
+                        "default per host.c:205)")
     p.add_argument("--socket-recv-buffer", type=int, default=174760)
     p.add_argument("--socket-send-buffer", type=int, default=131072)
     p.add_argument("--tcp-congestion-control", default="reno",
@@ -57,8 +64,11 @@ def make_parser() -> argparse.ArgumentParser:
                    help="tracker heartbeat interval (s)")
     p.add_argument("--heartbeat-log-level", default="message")
     p.add_argument("-d", "--data-directory", default="shadow.data")
-    p.add_argument("--sockets-per-host", type=int, default=4)
-    p.add_argument("--event-capacity", type=int, default=32)
+    # default None = let the plugin capacity hints size these
+    # (loader.py hints; an explicit value always wins, matching the
+    # reference's Options-beats-everything precedence)
+    p.add_argument("--sockets-per-host", type=int, default=None)
+    p.add_argument("--event-capacity", type=int, default=None)
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
     return p
@@ -66,6 +76,24 @@ def make_parser() -> argparse.ArgumentParser:
 
 def main(argv=None) -> int:
     args = make_parser().parse_args(argv)
+
+    # persist compiled device programs across CLI invocations (the
+    # netstack step compiles in minutes cold; seconds warm)
+    import pathlib
+
+    import jax
+
+    cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+    jax.config.update("jax_compilation_cache_dir", str(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    # honor JAX_PLATFORMS through jax.config: an out-of-tree platform
+    # plugin's get_backend hook can ignore the env var but the lazy
+    # backend init honors the config (must run before backend touch)
+    import os
+
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        jax.config.update("jax_platforms", plat)
 
     from shadow_tpu.config.examples import example_config
     from shadow_tpu.config.loader import load
@@ -83,23 +111,45 @@ def main(argv=None) -> int:
 
     logger = SimLogger(level=level_from_name(args.log_level))
     cfg = parse_config(text)
-    loaded = load(cfg, seed=args.seed, overrides={
+    overrides = {
         "interface_qdisc": args.interface_qdisc,
+        "router_qdisc": args.router_qdisc,
         "socket_recv_buffer": args.socket_recv_buffer,
         "socket_send_buffer": args.socket_send_buffer,
         "runahead": args.runahead,
         "sockets_per_host": args.sockets_per_host,
         "event_capacity": args.event_capacity,
-    })
+    }
+    loaded = load(cfg, seed=args.seed, overrides={
+        k: v for k, v in overrides.items() if v is not None})
     b = loaded.bundle
     logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
                    f"min window {b.min_jump} ns, "
                    f"end {b.cfg.end_time} ns")
 
     t0 = time.time()
-    if args.workers > 1:
-        import jax
-        import numpy as np
+    if b.cfg.pcap:
+        # pcap capture needs the host window loop to drain the ring
+        # (ref: per-interface PCapWriter, pcap_writer.c)
+        from shadow_tpu.utils import checkpoint as ckpt
+        from shadow_tpu.utils.pcap import CaptureSession
+
+        if args.workers > 1:
+            logger.warning(0, "shadow-tpu",
+                           f"logpcap forces the serial window loop; "
+                           f"--workers {args.workers} ignored")
+
+        cap = CaptureSession(b, args.data_directory)
+        sim, stats, _ = ckpt.run_windows(
+            b, app_handlers=loaded.handlers,
+            on_window=lambda s, wend: cap.drain(s))
+        cap.drain(sim)
+        cap.close()
+        if cap.dropped:
+            logger.warning(b.cfg.end_time, "shadow-tpu",
+                           f"pcap ring overran: {cap.dropped} records "
+                           f"lost (raise NetConfig.pcap_ring)")
+    elif args.workers > 1:
         from jax.sharding import Mesh
 
         from shadow_tpu.parallel.shard import run_sharded
@@ -113,11 +163,31 @@ def main(argv=None) -> int:
         sim, stats = run(b, app_handlers=loaded.handlers)
     wall = time.time() - t0
 
+    # end-of-run heartbeat + object accounting (ref: the tracker
+    # heartbeat subsystem, tracker.c:419-607, and the shutdown object
+    # counter dump, slave.c:237-241)
+    from shadow_tpu.utils import objcount
+    from shadow_tpu.utils.tracker import Tracker
+
+    tracker = Tracker(logger, b.host_names,
+                      interval_s=args.heartbeat_frequency,
+                      level=level_from_name(args.heartbeat_log_level))
+    tracker.heartbeat(sim, b.cfg.end_time)
+    oc = objcount.gather(sim, stats=stats)
+    logger.message(b.cfg.end_time, "shadow-tpu", oc.format())
+    logger.message(b.cfg.end_time, "shadow-tpu", oc.format_diff())
+
     ev = int(stats.events_processed)
     sim_s = b.cfg.end_time / 1e9
     report = {
         "events": ev,
         "windows": int(stats.windows),
+        # verification hook (ref: the reference's example config
+        # downloads are verified by their sizes): the app's own rcvd
+        # units — bytes for bulk, replies for pingpong
+        **({"app_rcvd": int(np.asarray(sim.app.rcvd).sum())}
+           if getattr(sim, "app", None) is not None
+           and hasattr(sim.app, "rcvd") else {}),
         "wall_seconds": round(wall, 3),
         "events_per_second": round(ev / wall, 1) if wall > 0 else None,
         "simulated_seconds_per_wall_second":

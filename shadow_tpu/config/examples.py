@@ -21,9 +21,10 @@ EXAMPLE_GRAPHML = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
-def example_config(clients: int = 100, kib: int = 330) -> str:
+def example_config(clients: int = 100, kib: int = 330,
+                   stoptime: int = 60) -> str:
     """ref: example_getTestContents (examples.c:10-30)."""
-    return f"""<shadow stoptime="60">
+    return f"""<shadow stoptime="{stoptime}">
   <topology><![CDATA[{EXAMPLE_GRAPHML}]]></topology>
   <plugin id="filex" path="bulk"/>
   <host id="server" bandwidthdown="102400" bandwidthup="102400">

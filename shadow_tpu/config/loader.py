@@ -14,7 +14,7 @@ import numpy as np
 from shadow_tpu.config.xmlconfig import ShadowConfig, kv_arguments
 from shadow_tpu.core import simtime
 from shadow_tpu.net.build import HostSpec, SimBundle, build
-from shadow_tpu.net.state import NetConfig, QDisc
+from shadow_tpu.net.state import NetConfig, QDisc, RouterQ
 
 # plugin name -> configure(bundle, assignments) -> handlers tuple.
 # assignments: list of (host_index, ProcessSpec). configure must set
@@ -132,12 +132,26 @@ def _tcp_stream_hints(assignments):
     # a conservative window can deliver a full receive window of
     # in-flight segments at once (rcvbuf/MSS ~ 122 at the default
     # 174760 B buffer); provision the event rows / outbox / router
-    # ring for that burst (SURVEY.md §7.4.6 capacity policy)
+    # ring for that burst (SURVEY.md §7.4.6 capacity policy).
+    # sockets_per_host: a many-client server needs listener + active
+    # child + a full accept backlog of spawned children at once
+    # (ACCEPT_QUEUE=4); 8 slots covers that with headroom, and SYN
+    # retry backpressure handles anything beyond it.
+    # tcp True: in a mixed config (e.g. bulk + pingpong) the
+    # max-merge over plugin hints must keep the TCP machine
     return {"event_capacity": 256, "outbox_capacity": 256,
-            "router_ring": 256}
+            "router_ring": 256, "sockets_per_host": 8, "tcp": True}
 
 
 _configure_bulk.hints = _tcp_stream_hints
+
+def _udp_only_hints(assignments):
+    # pingpong is UDP-only: skip building + inlining the TCP machine
+    # (an order-of-magnitude smaller device program)
+    return {"tcp": False}
+
+
+_configure_pingpong.hints = _udp_only_hints
 
 register_plugin("pingpong", _configure_pingpong)
 register_plugin("tgen-ping", _configure_pingpong)
@@ -172,6 +186,12 @@ def load(config: ShadowConfig, *, seed: int = 1,
     rcvbuf = overrides.get("socket_recv_buffer", 174760)
     for idx, (name, he) in enumerate(config.expanded_hosts()):
         start = min((p.starttime for p in he.processes), default=None)
+        stops = [p.stoptime for p in he.processes if p.stoptime]
+        # one device app per host: it stops when the last of the
+        # host's processes stops (ref: <process stoptime>,
+        # process.c:1286-1324); no stoptime = runs to sim end
+        stop = max(stops) if stops and len(stops) == len(he.processes) \
+            else None
         host_specs.append(HostSpec(
             name=name,
             ip=he.iphint if he.quantity == 1 else None,
@@ -182,6 +202,7 @@ def load(config: ShadowConfig, *, seed: int = 1,
             bandwidthdown=he.bandwidthdown,
             bandwidthup=he.bandwidthup,
             proc_start_time=start,
+            proc_stop_time=stop,
         ))
         if he.socketsendbuffer:
             sndbuf = he.socketsendbuffer
@@ -205,12 +226,21 @@ def load(config: ShadowConfig, *, seed: int = 1,
         overrides.setdefault(k, v)
 
     qdisc_name = overrides.get("interface_qdisc", "fifo")
+    rq_name = overrides.get("router_qdisc", "codel")
+    # any <host logpcap="true"> turns the capture ring on
+    # (ref: configuration logpcap attr -> pcap hooks,
+    # network_interface.c:337-373)
+    want_pcap = bool(overrides.get("pcap", False)) or any(
+        he.logpcap for _, he in config.expanded_hosts())
     cfg = NetConfig(
         num_hosts=len(host_specs),
         end_time=config.stoptime,
         bootstrap_end=config.bootstraptime,
         seed=seed,
         qdisc=QDisc.RR if qdisc_name == "rr" else QDisc.FIFO,
+        router_qdisc={"codel": RouterQ.CODEL, "single": RouterQ.SINGLE,
+                      "static": RouterQ.STATIC}[rq_name],
+        pcap=want_pcap,
         sndbuf=sndbuf,
         rcvbuf=rcvbuf,
         **{k: v for k, v in overrides.items()

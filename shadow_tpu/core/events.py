@@ -30,8 +30,9 @@ I32 = jnp.int32
 # Number of generic int32 payload words carried by every event. Wide
 # enough for a simulated TCP header (ref: packet.h:66-86): src/dst
 # ports, seq, ack, flags, window, timestamp, ts-echo, a 3-range
-# selective-ack list, payload ref+len.
-NWORDS = 16
+# selective-ack list, payload ref+len, plus the delivery-status audit
+# word (packetfmt.W_STATUS; ref: packet.h:18-40).
+NWORDS = 17
 
 
 class EventKind:

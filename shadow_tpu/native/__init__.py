@@ -87,6 +87,10 @@ def load() -> ctypes.CDLL | None:
     lib.payload_pool_live_bytes.argtypes = [vp]
     lib.payload_pool_total_allocs.restype = i64
     lib.payload_pool_total_allocs.argtypes = [vp]
+    lib.payload_pool_live_count.restype = i64
+    lib.payload_pool_live_count.argtypes = [vp]
+    lib.payload_pool_live_ids.restype = i64
+    lib.payload_pool_live_ids.argtypes = [vp, ctypes.POINTER(i32), i64]
 
     lib.logsort_argsort.argtypes = [p_i64, p_i64, i64, p_i64]
     _lib = lib

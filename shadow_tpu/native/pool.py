@@ -76,6 +76,23 @@ class PayloadPool:
             return self._live
         return int(self._lib.payload_pool_live_bytes(self._h))
 
+    def live_refs(self) -> int:
+        """Entries still held (object-counter leak accounting)."""
+        if self._py is not None:
+            return len(self._py)
+        return int(self._lib.payload_pool_live_count(self._h))
+
+    def live_ids(self) -> list:
+        """Ids of entries still held (mark-sweep GC support)."""
+        if self._py is not None:
+            return sorted(self._py)
+        n = self.live_refs()
+        if n == 0:
+            return []
+        buf = (ctypes.c_int32 * n)()
+        got = int(self._lib.payload_pool_live_ids(self._h, buf, n))
+        return sorted(buf[i] for i in range(got))
+
     def total_allocs(self) -> int:
         if self._py is not None:
             return self._allocs

@@ -106,4 +106,22 @@ int64_t payload_pool_total_allocs(void* p) {
   return pool->total_allocs;
 }
 
+int64_t payload_pool_live_count(void* p) {
+  Pool* pool = static_cast<Pool*>(p);
+  std::lock_guard<std::mutex> lock(pool->mu);
+  int64_t n = 0;
+  for (const Slot& s : pool->slots) n += (s.refs > 0);
+  return n;
+}
+
+int64_t payload_pool_live_ids(void* p, int32_t* out, int64_t cap) {
+  Pool* pool = static_cast<Pool*>(p);
+  std::lock_guard<std::mutex> lock(pool->mu);
+  int64_t n = 0;
+  for (size_t i = 0; i < pool->slots.size() && n < cap; ++i) {
+    if (pool->slots[i].refs > 0) out[n++] = static_cast<int32_t>(i);
+  }
+  return n;
+}
+
 }  // extern "C"

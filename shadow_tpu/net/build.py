@@ -48,6 +48,9 @@ class HostSpec:
     cpufrequency_khz: int | None = None  # virtual CPU speed (ref:
                                          # host cpufrequency attr)
     proc_start_time: int | None = None  # PROC_START event time (ns)
+    proc_stop_time: int | None = None   # PROC_STOP event time (ns)
+                                        # (ref: <process stoptime>,
+                                        # process.c:1286-1324)
 
     def hints(self) -> dict:
         out: dict = {}
@@ -110,25 +113,29 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
     )
     sim = make_sim(cfg, net, app=app)
 
-    # seed PROC_START events (ref: host_boot -> process_schedule)
-    starts = np.full(cfg.num_hosts, -1, dtype=np.int64)
-    for i, h in enumerate(hosts):
-        if h.proc_start_time is not None:
-            starts[i] = h.proc_start_time
-    m = starts >= 0
-    if m.any():
-        H = cfg.num_hosts
-        q = push_rows(
-            sim.events,
-            jnp.asarray(m),
-            jnp.asarray(np.where(m, starts, 0), simtime.DTYPE),
-            jnp.full((H,), EventKind.PROC_START, jnp.int32),
-            jnp.arange(H, dtype=jnp.int32),
-            jnp.zeros((H,), jnp.int32),
-            emit_words(0, num_hosts=H),
-        )
-        q = q.replace(next_seq=q.next_seq + jnp.asarray(m, jnp.int32))
-        sim = sim.replace(events=q)
+    # seed PROC_START / PROC_STOP events (ref: host_boot ->
+    # process_schedule, process.c:1326-1360)
+    H = cfg.num_hosts
+    for attr, kind in ((lambda h: h.proc_start_time, EventKind.PROC_START),
+                       (lambda h: h.proc_stop_time, EventKind.PROC_STOP)):
+        times = np.full(cfg.num_hosts, -1, dtype=np.int64)
+        for i, h in enumerate(hosts):
+            t = attr(h)
+            if t is not None:
+                times[i] = t
+        m = times >= 0
+        if m.any():
+            q = push_rows(
+                sim.events,
+                jnp.asarray(m),
+                jnp.asarray(np.where(m, times, 0), simtime.DTYPE),
+                jnp.full((H,), kind, jnp.int32),
+                jnp.arange(H, dtype=jnp.int32),
+                sim.events.next_seq,
+                emit_words(0, num_hosts=H),
+            )
+            q = q.replace(next_seq=q.next_seq + jnp.asarray(m, jnp.int32))
+            sim = sim.replace(events=q)
 
     return SimBundle(
         cfg=cfg, sim=sim, topology=top, dns=dns, min_jump=min_jump,

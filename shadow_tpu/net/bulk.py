@@ -62,6 +62,7 @@ from shadow_tpu.net.state import (
     TB_REFILL_INTERVAL,
     NetConfig,
     QDisc,
+    RouterQ,
     SocketFlags,
     SocketType,
     host_of_ip,
@@ -155,6 +156,9 @@ def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok):
     net = sim.net
     q = sim.events
     kind_ok = jnp.all(~inwin | (q.kind == EventKind.PACKET), axis=1)
+    # a stopped process's app is masked off in the serial path
+    # (step.py PROC_STOP); stopped hosts must take that path
+    kind_ok = kind_ok & ~net.proc_stopped
     proto = q.words[:, :, pf.W_PROTO] & 0xFF
     udp_ok = jnp.all(~inwin | (proto == pf.PROTO_UDP), axis=1)
     # remote arrivals only (loopback PACKET_LOCAL is a different kind;
@@ -214,6 +218,13 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
     if cfg.tcp:
         return None
     if cfg.qdisc != QDisc.FIFO:
+        return None
+    if cfg.router_qdisc != RouterQ.CODEL:
+        # single/static managers drop at enqueue when occupied; the
+        # bulk closed form assumes every window arrival is admitted
+        return None
+    if cfg.pcap:
+        # capture-ring appends are per-event; keep the serial path
         return None
     if cfg.out_ring < 2:
         return None
@@ -320,6 +331,29 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
             lat = net.latency_ns[vsrc, vdst]
         drop = known & nonboot & (sends.length > 0) & (u2 > rel)
         emit_ok = known & ~drop
+
+        # ---- audit parity: last_drop_status (serial order) -----------
+        # Per event column at most one drop occurs: a no-socket arrival
+        # (which generates no reply) or a reliability-dropped reply.
+        # The serial engine records the status of the LAST drop in
+        # event order; reproduce by ranking drops with `before`.
+        nosock_status = (
+            q.words[:, :, pf.W_STATUS]
+            | pf.PDS_ROUTER_ENQUEUED | pf.PDS_ROUTER_DEQUEUED
+            | pf.PDS_RCV_INTERFACE_RECEIVED | pf.PDS_RCV_SOCKET_DROPPED)
+        reply_drop_status = jnp.full(
+            (H, K),
+            pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED
+            | pf.PDS_SND_INTERFACE_SENT | pf.PDS_INET_DROPPED, I32)
+        drop_any = nosock | drop
+        drop_status = jnp.where(nosock, nosock_status, reply_drop_status)
+        n_drop = jnp.sum(drop_any, axis=1, dtype=I32)
+        drop_rank = rank_in_order(before, drop_any)
+        last_col = drop_any & (drop_rank == (n_drop[:, None] - 1))
+        picked_drop = jnp.sum(jnp.where(last_col, drop_status, 0), axis=1,
+                              dtype=I32)
+        new_last_drop = jnp.where(elig & (n_drop > 0), picked_drop,
+                                  net.last_drop_status)
         swl = jnp.where(smask, pf.wire_length(
             jnp.full((H, K), pf.PROTO_UDP, I32), sends.length), 0).astype(I64)
 
@@ -383,6 +417,11 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         wds = wds.at[:, :, pf.W_PAYREF].set(sends.payref)
         wds = wds.at[:, :, pf.W_DSTIP].set(
             sends.dst_ip.astype(jnp.uint32).astype(I32))
+        # same audit bits the micro-step path accumulates by wire time
+        # (udp_enqueue_send + handle_nic_send) — bit-identity contract
+        wds = wds.at[:, :, pf.W_STATUS].set(
+            pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED
+            | pf.PDS_SND_INTERFACE_SENT | pf.PDS_INET_SENT)
         o_words = jnp.sum(
             jnp.where(colsel[:, :, :, None], wds[:, :, None, :], 0), axis=1,
             dtype=I32)
@@ -430,6 +469,11 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
             + jnp.sum(matched, axis=1, dtype=I64),
             ctr_rx_bytes=net.ctr_rx_bytes
             + jnp.sum(jnp.where(matched, wl, 0), axis=1),
+            ctr_rx_data_bytes=net.ctr_rx_data_bytes
+            + jnp.sum(jnp.where(matched, length, 0), axis=1, dtype=I64),
+            ctr_tx_data_bytes=net.ctr_tx_data_bytes
+            + jnp.sum(jnp.where(smask, sends.length, 0), axis=1, dtype=I64),
+            last_drop_status=new_last_drop,
             ctr_drop_nosocket=net.ctr_drop_nosocket
             + jnp.sum(nosock, axis=1, dtype=I64)
             + jnp.sum(smask & (dsth < 0), axis=1, dtype=I64),

@@ -43,6 +43,7 @@ from shadow_tpu.net.state import (
     NetConfig,
     NetState,
     QDisc,
+    RouterQ,
     SocketFlags,
     SocketType,
 )
@@ -86,6 +87,31 @@ def _empty_words(H):
     return jnp.zeros((H, NWORDS), I32)
 
 
+def _capture(cfg: NetConfig, net: NetState, mask, src_host, words, now,
+             direction: int):
+    """Append packets to the per-host pcap capture ring (ref: the
+    sent/received pcap hooks, network_interface.c:337-373,414-415).
+    No-op (and no device cost) unless cfg.pcap."""
+    if not cfg.pcap:
+        return net
+    C = net.cap_time.shape[1]
+    lane = jnp.arange(mask.shape[0])
+    pos = net.cap_count % C
+    meta = (jnp.clip(src_host, 0, (1 << 24) - 1).astype(I32)
+            | I32(direction << 24))
+    sel = mask
+    return net.replace(
+        cap_time=net.cap_time.at[lane, pos].set(
+            jnp.where(sel, jnp.broadcast_to(now, sel.shape),
+                      net.cap_time[lane, pos])),
+        cap_words=net.cap_words.at[lane, pos].set(
+            jnp.where(sel[:, None], words, net.cap_words[lane, pos])),
+        cap_meta=net.cap_meta.at[lane, pos].set(
+            jnp.where(sel, meta, net.cap_meta[lane, pos])),
+        cap_count=net.cap_count + sel.astype(I32),
+    )
+
+
 def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
     """Hand one arrived packet per masked lane to the bound socket
     (ref: _networkinterface_receivePacket, network_interface.c:375-419).
@@ -104,14 +130,21 @@ def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
     # loopback packets keep their loopback src address
     src_ip = jnp.where(dst_ip >> 24 == 127, dst_ip, src_ip)
 
+    net = _capture(cfg, net, mask, src_host, words, now, direction=1)
     slot = lookup_socket(net, mask, proto, dst_ip, dst_port, src_ip, src_port)
     found = mask & (slot >= 0)
+    words = words.at[:, pf.W_STATUS].set(jnp.where(
+        found, words[:, pf.W_STATUS] | pf.PDS_RCV_SOCKET_PROCESSED,
+        words[:, pf.W_STATUS]))
     is_udp = found & (proto == pf.PROTO_UDP)
     net = udp_deliver(
         net, is_udp, slot, src_ip, src_port, words[:, pf.W_LEN],
-        words[:, pf.W_PAYREF],
+        words[:, pf.W_PAYREF], status=words[:, pf.W_STATUS],
     )
     nosock = mask & (slot < 0)
+    net = net.replace(last_drop_status=jnp.where(
+        nosock, words[:, pf.W_STATUS] | pf.PDS_RCV_SOCKET_DROPPED,
+        net.last_drop_status))
     # TCP segment matching no socket: answer with RST so an active
     # open to a dead port fails promptly instead of retransmitting
     # SYNs forever (ref: the reference's RST-on-closed path in
@@ -147,6 +180,8 @@ def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
         ctr_rx_packets=net.ctr_rx_packets + found.astype(I64),
         ctr_rx_bytes=net.ctr_rx_bytes
         + jnp.where(found, pf.wire_length(proto, words[:, pf.W_LEN]), 0).astype(I64),
+        ctr_rx_data_bytes=net.ctr_rx_data_bytes
+        + jnp.where(found, words[:, pf.W_LEN], 0).astype(I64),
     )
     sim = sim.replace(net=net)
     if getattr(sim, "tcp", None) is not None:
@@ -185,16 +220,33 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
     # -- arrival enqueue (ref: router_enqueue, router.c:104-125) ------
     arr = popped.valid & (popped.kind == EventKind.PACKET)
     was_empty = net.rq_count == 0
-    aok = arr & (net.rq_count < R)
+    # queue-manager admission (ref: QueueManagerHooks enqueue):
+    # CODEL admits to ring capacity (a full ring is an honest overflow
+    # error — CoDel itself drops at dequeue); SINGLE holds one packet
+    # (router_queue_single.c); STATIC drop-tails at capacity
+    # (router_queue_static.c) — both drop the arrival, counted, with
+    # the audit trail recorded.
+    cap = {RouterQ.CODEL: R, RouterQ.SINGLE: 1,
+           RouterQ.STATIC: R}[cfg.router_qdisc]
+    aok = arr & (net.rq_count < cap)
+    qdrop = arr & ~aok if cfg.router_qdisc != RouterQ.CODEL else (
+        jnp.zeros_like(arr))
     apos = (net.rq_head + net.rq_count) % R
     awl = pf.wire_length(pf.proto_of(popped.words), popped.words[:, pf.W_LEN])
+    arr_words = popped.words.at[:, pf.W_STATUS].set(jnp.where(
+        aok, popped.words[:, pf.W_STATUS] | pf.PDS_ROUTER_ENQUEUED,
+        popped.words[:, pf.W_STATUS]))
     net = net.replace(
         rq_src=set_row(net.rq_src, aok, apos, popped.src),
         rq_enq_ts=set_row(net.rq_enq_ts, aok, apos, popped.time),
-        rq_words=set_row(net.rq_words, aok, apos, popped.words),
+        rq_words=set_row(net.rq_words, aok, apos, arr_words),
         rq_count=net.rq_count + aok.astype(I32),
         rq_bytes=net.rq_bytes + jnp.where(aok, awl, 0).astype(I64),
-        rq_overflow=net.rq_overflow + jnp.sum(arr & ~aok, dtype=I32),
+        rq_overflow=net.rq_overflow + jnp.sum(arr & ~aok & ~qdrop, dtype=I32),
+        ctr_drop_codel=net.ctr_drop_codel + qdrop.astype(I64),
+        last_drop_status=jnp.where(
+            qdrop, popped.words[:, pf.W_STATUS] | pf.PDS_ROUTER_DROPPED,
+            net.last_drop_status),
     )
     # fused drain: idle queue served immediately; a busy queue already
     # has a drain in flight (nic_recv_pending invariant)
@@ -224,6 +276,15 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
         rq_count=net.rq_count - active.astype(I32),
         rq_bytes=bytes_after,
     )
+
+    if cfg.router_qdisc != RouterQ.CODEL:
+        # single/static managers dequeue without AQM
+        # (ref: router_queue_single.c / router_queue_static.c)
+        drop_now = jnp.zeros_like(active)
+        delivered = active
+        return _finish_recv_common(
+            cfg, sim.replace(net=net), popped, buf, mask, active,
+            delivered, drop_now, e_src, e_words, wl, now, H)
 
     # CoDel good/bad state (ref: router_queue_codel.c:161-196)
     sojourn = now - e_ts
@@ -282,6 +343,20 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
     )
 
     delivered = active & ~drop_now
+    return _finish_recv_common(
+        cfg, sim.replace(net=net), popped, buf, mask, active,
+        delivered, drop_now, e_src, e_words, wl, now, H)
+
+
+def _finish_recv_common(cfg, sim, popped, buf, mask, active, delivered,
+                        drop_now, e_src, e_words, wl, now, H):
+    """Tail of the receive handler shared by all router queue
+    managers: delivery merge, token consumption, drain chaining."""
+    net = sim.net
+    bootstrap = now < cfg.bootstrap_end
+    net = net.replace(last_drop_status=jnp.where(
+        drop_now, e_words[:, pf.W_STATUS] | pf.PDS_ROUTER_DROPPED,
+        net.last_drop_status))
     # merge loopback deliveries (kind=PACKET_LOCAL, disjoint lanes —
     # one popped event per host) into one deliver_packet call so the
     # TCP state machine is materialized once per micro-step, not twice
@@ -289,6 +364,12 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
     d_mask = delivered | local
     d_src = jnp.where(local, popped.src, e_src)
     d_words = jnp.where(local[:, None], popped.words, e_words)
+    # audit: dequeued from the router and received by the interface
+    d_words = d_words.at[:, pf.W_STATUS].set(jnp.where(
+        delivered,
+        d_words[:, pf.W_STATUS] | pf.PDS_ROUTER_DEQUEUED
+        | pf.PDS_RCV_INTERFACE_RECEIVED,
+        d_words[:, pf.W_STATUS]))
     sim = sim.replace(net=net)
     sim, buf = deliver_packet(cfg, sim, d_mask, d_src, d_words, now, buf)
     net = sim.net
@@ -413,6 +494,12 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     local = active & ((dst_ip == my_ip) | (dst_ip >> 24 == 127))
     remote = active & ~local
 
+    # audit: the packet left the interface (packet.h PDS trail)
+    words = words.at[:, pf.W_STATUS].set(jnp.where(
+        active, words[:, pf.W_STATUS] | pf.PDS_SND_INTERFACE_SENT,
+        words[:, pf.W_STATUS]))
+    net = _capture(cfg, net, active, net.lane_id, words, now, direction=0)
+
     # loopback: 1ns self delivery, no tokens
     # (network_interface.c:546-554)
     buf = emit(buf, local, net.lane_id, now + 1, EventKind.PACKET_LOCAL,
@@ -431,13 +518,26 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     lat = net.latency_ns[vsrc, vdst]
     drop = known & ~bootstrap & (length > 0) & (u > rel)
     send = known & ~drop
+    words = words.at[:, pf.W_STATUS].set(jnp.where(
+        send, words[:, pf.W_STATUS] | pf.PDS_INET_SENT,
+        words[:, pf.W_STATUS]))
     buf = emit(buf, send, dsth, now + lat, EventKind.PACKET, words)
 
+    # tracker byte split (ref: tracker.c:51-99): data vs retransmit,
+    # classified by the packet's own audit trail
+    is_retx = (words[:, pf.W_STATUS] & pf.PDS_SND_TCP_RETRANSMITTED) != 0
     net = net.replace(
+        last_drop_status=jnp.where(
+            drop, words[:, pf.W_STATUS] | pf.PDS_INET_DROPPED,
+            net.last_drop_status),
         ctr_drop_reliability=net.ctr_drop_reliability + drop.astype(I64),
         ctr_drop_nosocket=net.ctr_drop_nosocket + (remote & ~known).astype(I64),
         ctr_tx_packets=net.ctr_tx_packets + active.astype(I64),
         ctr_tx_bytes=net.ctr_tx_bytes + jnp.where(active, wl, 0),
+        ctr_tx_data_bytes=net.ctr_tx_data_bytes
+        + jnp.where(active, length, 0).astype(I64),
+        ctr_tx_retx_bytes=net.ctr_tx_retx_bytes
+        + jnp.where(active & is_retx, wl, 0),
         tb_send_tokens=jnp.maximum(
             net.tb_send_tokens - jnp.where(remote & ~bootstrap, wl, 0), 0
         ),

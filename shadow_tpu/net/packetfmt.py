@@ -33,8 +33,61 @@ W_SACKL2 = 12
 W_SACKR2 = 13
 W_SACKL3 = 14
 W_SACKR3 = 15
+# Delivery-status audit trail: a bitmask ORed at every pipeline stage
+# the packet passes (the device form of the reference's append-only
+# PacketDeliveryStatusFlags trail, packet.h:18-40 /
+# packet_addDeliveryStatus). Decode host-side with pds_decode().
+W_STATUS = 16
 
 PAYREF_NONE = -1
+
+# --- delivery-status bits (ref: packet.h:18-40 PDS_* enum) -----------
+PDS_SND_CREATED = 1 << 0
+PDS_SND_TCP_ENQUEUE_THROTTLED = 1 << 1
+PDS_SND_TCP_ENQUEUE_RETRANSMIT = 1 << 2
+PDS_SND_TCP_DEQUEUE_RETRANSMIT = 1 << 3
+PDS_SND_TCP_RETRANSMITTED = 1 << 4
+PDS_SND_SOCKET_BUFFERED = 1 << 5
+PDS_SND_INTERFACE_SENT = 1 << 6
+PDS_INET_SENT = 1 << 7
+PDS_INET_DROPPED = 1 << 8          # reliability (path loss) drop
+PDS_ROUTER_ENQUEUED = 1 << 9
+PDS_ROUTER_DEQUEUED = 1 << 10
+PDS_ROUTER_DROPPED = 1 << 11       # CoDel AQM drop
+PDS_RCV_INTERFACE_RECEIVED = 1 << 12
+PDS_RCV_INTERFACE_DROPPED = 1 << 13
+PDS_RCV_SOCKET_PROCESSED = 1 << 14
+PDS_RCV_SOCKET_DROPPED = 1 << 15   # no bound socket / rcvbuf full
+PDS_RCV_SOCKET_BUFFERED = 1 << 16
+PDS_RCV_SOCKET_DELIVERED = 1 << 17
+
+PDS_NAMES = {
+    PDS_SND_CREATED: "SND_CREATED",
+    PDS_SND_TCP_ENQUEUE_THROTTLED: "SND_TCP_ENQUEUE_THROTTLED",
+    PDS_SND_TCP_ENQUEUE_RETRANSMIT: "SND_TCP_ENQUEUE_RETRANSMIT",
+    PDS_SND_TCP_DEQUEUE_RETRANSMIT: "SND_TCP_DEQUEUE_RETRANSMIT",
+    PDS_SND_TCP_RETRANSMITTED: "SND_TCP_RETRANSMITTED",
+    PDS_SND_SOCKET_BUFFERED: "SND_SOCKET_BUFFERED",
+    PDS_SND_INTERFACE_SENT: "SND_INTERFACE_SENT",
+    PDS_INET_SENT: "INET_SENT",
+    PDS_INET_DROPPED: "INET_DROPPED",
+    PDS_ROUTER_ENQUEUED: "ROUTER_ENQUEUED",
+    PDS_ROUTER_DEQUEUED: "ROUTER_DEQUEUED",
+    PDS_ROUTER_DROPPED: "ROUTER_DROPPED",
+    PDS_RCV_INTERFACE_RECEIVED: "RCV_INTERFACE_RECEIVED",
+    PDS_RCV_INTERFACE_DROPPED: "RCV_INTERFACE_DROPPED",
+    PDS_RCV_SOCKET_PROCESSED: "RCV_SOCKET_PROCESSED",
+    PDS_RCV_SOCKET_DROPPED: "RCV_SOCKET_DROPPED",
+    PDS_RCV_SOCKET_BUFFERED: "RCV_SOCKET_BUFFERED",
+    PDS_RCV_SOCKET_DELIVERED: "RCV_SOCKET_DELIVERED",
+}
+
+
+def pds_decode(status: int) -> list:
+    """Host-side decoder: status word -> ordered stage names (the
+    analog of packet_toString's trail dump)."""
+    return [name for bit, name in sorted(PDS_NAMES.items())
+            if status & bit]
 
 # protocols (ref: packet.h protocol enum {LOCAL, UDP, TCP})
 PROTO_LOCAL = 0

@@ -106,6 +106,8 @@ def sk_create(net: NetState, mask, stype):
             net.sk_flags, ok, slot,
             jnp.full(mask.shape, SocketFlags.ACTIVE | SocketFlags.WRITABLE, I32),
         ),
+        # object accounting (ref: object_counter.c new counts)
+        ctr_sk_alloc=net.ctr_sk_alloc + ok.astype(jnp.int64),
     )
     return net, slot
 

@@ -53,6 +53,15 @@ class QDisc:
     RR = 1
 
 
+class RouterQ:
+    """Upstream-router queue manager (ref: QueueManagerHooks vtable,
+    router.c; CoDel is the reference default, host.c:205)."""
+
+    CODEL = 0    # RFC-8289 AQM (ref: router_queue_codel.c)
+    SINGLE = 1   # one-packet queue (ref: router_queue_single.c)
+    STATIC = 2   # drop-tail at ring capacity (ref: router_queue_static.c)
+
+
 # token-bucket refill interval (ref: network_interface.c:93-95)
 TB_REFILL_INTERVAL = simtime.ONE_MILLISECOND
 
@@ -87,6 +96,12 @@ class NetConfig:
     cpu_event_cost_ns: int = 30_000   # deterministic per-event charge
     cpu_raw_freq_khz: int = 3_000_000  # the "physical" CPU baseline
     qdisc: int = QDisc.FIFO
+    router_qdisc: int = RouterQ.CODEL  # upstream router queue manager
+    # pcap capture (ref: <host logpcap> + pcap_writer.c): when on, the
+    # NIC appends every sent/delivered packet to a per-host capture
+    # ring the host side drains into libpcap files each window
+    pcap: bool = False
+    pcap_ring: int = 64          # capture ring slots per host
     autotune: bool = True        # TCP buffer autotuning (ref:
                                  # CONFIG_TCPAUTOTUNE, definitions.h:101).
                                  # Pinning sndbuf/rcvbuf away from the
@@ -173,6 +188,13 @@ class NetState:
     ctr_cpu_blocked: jax.Array   # [H] i64 events delayed by the CPU
     ctr_cpu_delay_ns: jax.Array  # [H] i64 total virtual processing delay
                                  # (ref: tracker_addVirtualProcessingDelay)
+    # --- process lifetime (ref: process.c:1286-1360) ------------------
+    # True once the host's PROC_STOP event fired: app handlers are
+    # masked off from then on (the device analog of process_stop
+    # aborting the plugin main thread). The netstack keeps running —
+    # in-flight TCP state unwinds via its own timers, as the
+    # reference's descriptors do after plugin death.
+    proc_stopped: jax.Array      # [H] bool
     rr_ptr: jax.Array            # [H] i32 round-robin qdisc cursor
     port_ctr: jax.Array          # [H] i32 ephemeral port allocator
                                  # (counter analog of host.c:1058-1110)
@@ -212,6 +234,8 @@ class NetState:
     in_src_port: jax.Array       # [H,S,BI] i32
     in_len: jax.Array            # [H,S,BI] i32
     in_payref: jax.Array         # [H,S,BI] i32
+    in_status: jax.Array         # [H,S,BI] i32 delivery-status trail
+                                 # (ref: packet.h:18-40 audit)
     in_head: jax.Array           # [H,S] i32
     in_count: jax.Array          # [H,S] i32
     in_bytes: jax.Array          # [H,S] i32
@@ -238,6 +262,31 @@ class NetState:
     ctr_tx_bytes: jax.Array      # [H] i64
     ctr_rx_packets: jax.Array    # [H] i64
     ctr_tx_packets: jax.Array    # [H] i64
+    # data/control/retransmit byte split (ref: tracker.c:51-99 — the
+    # tracker accounts interface bytes by packet class): data = payload
+    # bytes, control = wire - data (headers + 0-len control packets),
+    # retransmit = wire bytes of segments whose audit trail carries
+    # PDS_SND_TCP_RETRANSMITTED
+    ctr_rx_data_bytes: jax.Array  # [H] i64
+    ctr_tx_data_bytes: jax.Array  # [H] i64
+    ctr_tx_retx_bytes: jax.Array  # [H] i64
+    # object accounting (ref: object_counter.c — new/free counts
+    # diffed at shutdown; a nonzero diff is a logical descriptor leak)
+    ctr_sk_alloc: jax.Array      # [H] i64 sockets allocated
+    ctr_sk_free: jax.Array       # [H] i64 sockets freed
+    # trail word of the host's most recently dropped packet, with the
+    # drop-stage bit set — the debugging hook the reference gets from
+    # dumping a dropped packet's status list (packet_toString)
+    last_drop_status: jax.Array  # [H] i32
+    # --- pcap capture ring (ref: network_interface.c:337-373) ---------
+    # Shapes are [H,1,...] when cfg.pcap is off (dead weight ~0).
+    # cap_count is a monotonic write counter; slot = count % C. The
+    # host drains between windows (utils/pcap.py); count jumping by
+    # more than C since the last drain = dropped capture records.
+    cap_time: jax.Array          # [H,C] i64 capture timestamp
+    cap_words: jax.Array         # [H,C,NWORDS] i32 packet words
+    cap_meta: jax.Array          # [H,C] i32: src_host | dir<<24 (1=in)
+    cap_count: jax.Array         # [H] i32 monotonic
     rq_overflow: jax.Array       # [] i32 router ring overflow (grow R!)
 
 
@@ -318,6 +367,7 @@ def make_net_state(
         nic_send_pending=jnp.zeros((H,), bool),
         nic_recv_pending=jnp.zeros((H,), bool),
         nic_send_now=jnp.zeros((H,), bool),
+        proc_stopped=jnp.zeros((H,), bool),
         rr_ptr=zi_h,
         port_ctr=zi_h,
         priority_ctr=z_h,
@@ -346,6 +396,7 @@ def make_net_state(
         in_src_port=jnp.zeros((H, S, BI), I32),
         in_len=jnp.zeros((H, S, BI), I32),
         in_payref=jnp.zeros((H, S, BI), I32),
+        in_status=jnp.zeros((H, S, BI), I32),
         in_head=jnp.zeros((H, S), I32),
         in_count=jnp.zeros((H, S), I32),
         in_bytes=jnp.zeros((H, S), I32),
@@ -366,6 +417,17 @@ def make_net_state(
         ctr_tx_bytes=z_h,
         ctr_rx_packets=z_h,
         ctr_tx_packets=z_h,
+        ctr_rx_data_bytes=z_h,
+        ctr_tx_data_bytes=z_h,
+        ctr_tx_retx_bytes=z_h,
+        ctr_sk_alloc=z_h,
+        ctr_sk_free=z_h,
+        last_drop_status=zi_h,
+        cap_time=jnp.zeros((H, cfg.pcap_ring if cfg.pcap else 1), I64),
+        cap_words=jnp.zeros(
+            (H, cfg.pcap_ring if cfg.pcap else 1, NWORDS), I32),
+        cap_meta=jnp.zeros((H, cfg.pcap_ring if cfg.pcap else 1), I32),
+        cap_count=zi_h,
         rq_overflow=jnp.zeros((), I32),
     )
 

@@ -69,6 +69,20 @@ def _cpu_gate(cfg: NetConfig, sim, popped, buf):
     return sim.replace(net=net), popped._replace(valid=executed), buf
 
 
+def _handle_proc_stop(cfg: NetConfig, sim, popped, buf):
+    """PROC_STOP enforcement (ref: _process_runStopTask -> process_stop,
+    process.c:1286-1324): latch the host's stopped flag; app handlers
+    are masked off for this and all later events."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.events import EventKind
+
+    stop = popped.valid & (popped.kind == EventKind.PROC_STOP)
+    net = sim.net
+    return sim.replace(net=net.replace(
+        proc_stopped=net.proc_stopped | stop)), buf
+
+
 def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
     """Build the engine step_fn: netstack receive/timer handlers, then
     app handlers, then the send drain. TCP timer handlers are included
@@ -84,10 +98,15 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
     def step(sim, popped, buf):
         if cpu_on:
             sim, popped, buf = _cpu_gate(cfg, sim, popped, buf)
+        sim, buf = _handle_proc_stop(cfg, sim, popped, buf)
         for h in pre:
             sim, buf = h(cfg, sim, popped, buf)
+        # a stopped host's app no longer sees events (the plugin is
+        # dead); the netstack handlers above still ran for it
+        app_popped = popped._replace(
+            valid=popped.valid & ~sim.net.proc_stopped)
         for h in app_handlers:
-            sim, buf = h(cfg, sim, popped, buf)
+            sim, buf = h(cfg, sim, app_popped, buf)
         for h in _POST_APP:
             sim, buf = h(cfg, sim, popped, buf)
         return sim, buf

@@ -268,6 +268,11 @@ def _seg_words(net: NetState, mask, slot, flags, seq, length, payref=None):
         payref = jnp.full((H,), pf.PAYREF_NONE, I32)
     words = words.at[:, pf.W_PAYREF].set(payref)
     words = words.at[:, pf.W_DSTIP].set(dst_ip.astype(jnp.uint32).astype(I32))
+    # audit trail: every TCP segment is created and throttled-queued
+    # (ref: packet.h PDS trail; throttledOutput, tcp.c:222-230)
+    words = words.at[:, pf.W_STATUS].set(
+        pf.PDS_SND_CREATED | pf.PDS_SND_TCP_ENQUEUE_THROTTLED
+        | pf.PDS_SND_SOCKET_BUFFERED)
     return words
 
 
@@ -324,13 +329,21 @@ def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
     return words
 
 
-def _enqueue_seg(sim, buf, mask, slot, flags, seq, length, now):
+def _enqueue_seg(sim, buf, mask, slot, flags, seq, length, now,
+                 retransmit=False):
     """Push one segment on the socket output ring + kick the NIC.
     Returns (sim, buf, ok[H]); ok False when the ring/sndbuf was full
-    (the segment was NOT queued — callers must not advance snd_nxt)."""
+    (the segment was NOT queued — callers must not advance snd_nxt).
+    `retransmit` marks the audit trail's retransmission stages
+    (ref: PDS_SND_TCP_*RETRANSMIT*, packet.h:18-40)."""
     from shadow_tpu.net import nic
 
     words = _seg_words(sim.net, mask, slot, flags, seq, length)
+    if retransmit:
+        words = words.at[:, pf.W_STATUS].set(
+            words[:, pf.W_STATUS] | pf.PDS_SND_TCP_ENQUEUE_RETRANSMIT
+            | pf.PDS_SND_TCP_DEQUEUE_RETRANSMIT
+            | pf.PDS_SND_TCP_RETRANSMITTED)
     net, ok = sk_enqueue_out(sim.net, mask, slot, words)
     sim = sim.replace(net=net)
     sim, buf = nic.notify_wants_send(sim, buf, ok, now)
@@ -556,6 +569,8 @@ def _free_socket(cfg, sim, mask, slot):
                          jnp.full(mask.shape, cfg.sndbuf, I32)),
         sk_rcvbuf=set_hs(net.sk_rcvbuf, mask, slot,
                          jnp.full(mask.shape, cfg.rcvbuf, I32)),
+        # object accounting (ref: object_counter.c free counts)
+        ctr_sk_free=net.ctr_sk_free + mask.astype(I64),
     )
     tcp = sim.tcp
     tcp = _set(tcp, "st", mask, slot, zero)
@@ -707,12 +722,15 @@ def _retransmit_one(cfg, sim, mask, slot, now, buf):
     is_data = mask & ~is_syn & ~is_synack & ~is_fin & (una < end)
 
     sim, buf, _ = _enqueue_seg(sim, buf, is_syn, slot, pf.TCPF_SYN,
-                            jnp.zeros(mask.shape, I32), 0, now)
+                            jnp.zeros(mask.shape, I32), 0, now,
+                            retransmit=True)
     sim, buf, _ = _enqueue_seg(sim, buf, is_synack, slot,
                             pf.TCPF_SYN | pf.TCPF_ACK,
-                            jnp.zeros(mask.shape, I32), 0, now)
+                            jnp.zeros(mask.shape, I32), 0, now,
+                            retransmit=True)
     sim, buf, _ = _enqueue_seg(sim, buf, is_fin, slot,
-                            pf.TCPF_FIN | pf.TCPF_ACK, una, 0, now)
+                            pf.TCPF_FIN | pf.TCPF_ACK, una, 0, now,
+                            retransmit=True)
     seg = jnp.minimum(end - una, MSS)
     # clip the retransmission at the first peer-sacked edge above una:
     # sacked bytes need no resend (ref: the tally's lost-range
@@ -727,7 +745,8 @@ def _retransmit_one(cfg, sim, mask, slot, now, buf):
     big = jnp.iinfo(I32).max
     first_sacked = jnp.min(jnp.where(above, sll, big), axis=1)
     seg = jnp.minimum(seg, jnp.maximum(first_sacked - una, 1))
-    sim, buf, _ = _enqueue_seg(sim, buf, is_data, slot, pf.TCPF_ACK, una, seg, now)
+    sim, buf, _ = _enqueue_seg(sim, buf, is_data, slot, pf.TCPF_ACK, una, seg,
+                               now, retransmit=True)
     sent = is_syn | is_synack | is_fin | is_data
     resent_end = jnp.where(is_data, una + seg, una + 1)
     tcp = sim.tcp

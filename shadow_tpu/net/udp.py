@@ -46,14 +46,22 @@ def udp_enqueue_send(net: NetState, mask, slot, dst_ip, dst_port, length, payref
     words = words.at[:, pf.W_DSTIP].set(
         jnp.broadcast_to(
             jnp.asarray(dst_ip).astype(jnp.uint32).astype(I32), (H,)))
+    words = words.at[:, pf.W_STATUS].set(
+        pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED)
     return sk_enqueue_out(net, mask, slot, words)
 
 
-def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
+def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref,
+                status=None):
     """Push one received datagram into (lane, slot)'s input ring; drop
-    (counted) when the receive buffer is full. Returns net."""
+    (counted) when the receive buffer is full. Returns net. `status`
+    is the packet's delivery-status trail word (audit, packet.h:18-40);
+    buffered packets keep their trail in in_status."""
+    H = mask.shape[0]
     length = jnp.asarray(length, I32)
     BI = net.in_src_ip.shape[2]
+    if status is None:
+        status = jnp.zeros((H,), I32)
 
     space_ok = (gather_hs(net.in_bytes, slot) + length) <= gather_hs(
         net.sk_rcvbuf, slot
@@ -67,6 +75,8 @@ def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
         in_len=set_ring(net.in_len, ok, slot, pos, length),
         in_payref=set_ring(net.in_payref, ok, slot, pos,
                            jnp.asarray(payref, I32)),
+        in_status=set_ring(net.in_status, ok, slot, pos,
+                           status | pf.PDS_RCV_SOCKET_BUFFERED),
     )
     _, count = ring_advance_push(net.in_head, net.in_count, mask, slot, ok)
     net = net.replace(in_count=count)
@@ -82,7 +92,10 @@ def udp_deliver(net: NetState, mask, slot, src_ip, src_port, length, payref):
     )
     dropped = mask & ~space_ok
     net = net.replace(
-        ctr_drop_bufferfull=net.ctr_drop_bufferfull + dropped.astype(jnp.int64)
+        ctr_drop_bufferfull=net.ctr_drop_bufferfull + dropped.astype(jnp.int64),
+        last_drop_status=jnp.where(
+            dropped, status | pf.PDS_RCV_SOCKET_DROPPED,
+            net.last_drop_status),
     )
     return net
 

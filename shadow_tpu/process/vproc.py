@@ -110,6 +110,36 @@ def recvfrom(fd):
     return Sys("recvfrom", (fd,))
 
 
+def send_data(fd, data: bytes):
+    """TCP stream send carrying REAL content (ref: the reference's
+    plugins send actual buffers; payload bytes live host-side in the
+    payload pool / stream store, payload.c:17-30). Blocks until >0
+    bytes are accepted, returns that count; resend data[count:] for
+    the remainder."""
+    return Sys("send_data", (fd, data))
+
+
+def recv_data(fd, maxbytes=1 << 30):
+    """TCP stream receive returning actual bytes. Blocks until data
+    (returns non-empty bytes) or EOF (returns b"")."""
+    return Sys("recv_data", (fd, maxbytes))
+
+
+def sendto_data(fd, ip, port, data: bytes):
+    """UDP datagram send with real content: bytes go into the payload
+    pool, the device packet carries the pool ref (W_PAYREF,
+    packetfmt.py; mirrors Payload sharing, payload.c:17-30).
+    Non-blocking, returns True if queued."""
+    return Sys("sendto_data", (fd, ip, port, data))
+
+
+def recvfrom_data(fd):
+    """UDP receive with content; blocks until a datagram arrives,
+    returns (src_ip, src_port, data). Datagrams sent without content
+    (sendto) yield zero bytes of the advertised length."""
+    return Sys("recvfrom_data", (fd,))
+
+
 def close(fd):
     return Sys("close", (fd,))
 
@@ -164,6 +194,35 @@ class EPOLL:
 
 
 EPOLL_FD_BASE = 1 << 16   # epoll fds live above the socket-slot space
+PIPE_FD_BASE = 1 << 17    # pipe/socketpair fds above the epoll space
+
+
+def pipe():
+    """Unidirectional intra-host byte conduit; returns (rfd, wfd)
+    (ref: Channel, channel.c:22-60 — two linked descriptors over a
+    ByteQueue). Fds are per-HOST: another process on the same host may
+    use them (the fork-inherited-descriptor analog)."""
+    return Sys("pipe", ())
+
+
+def socketpair():
+    """Bidirectional intra-host conduit; returns (fd1, fd2) — two
+    cross-linked channels (ref: channel_new CT_NONE pair +
+    channel_setLinkedChannel, channel.c:147-180)."""
+    return Sys("socketpair", ())
+
+
+def write(fd, data: bytes):
+    """Write bytes to a pipe/socketpair fd; blocks while the channel
+    buffer is full, returns the count accepted (partial writes
+    happen); returns -1 when the read side is closed (EPIPE)."""
+    return Sys("write", (fd, data))
+
+
+def read(fd, maxbytes=1 << 30):
+    """Read from a pipe/socketpair fd; blocks until data (returns
+    bytes) or writer-closed EOF (returns b"")."""
+    return Sys("read", (fd, maxbytes))
 
 
 def epoll_create():
@@ -205,6 +264,36 @@ class _Epoll:
 
 
 # ---------------------------------------------------------------------
+# channels: pipe / socketpair (ref: descriptor/channel.c)
+# ---------------------------------------------------------------------
+
+CHANNEL_CAP = 65536   # per-direction buffer limit (ref: the ByteQueue
+                      # capacity channels enforce, channel.c:22-60)
+
+
+@dataclass
+class _ByteQ:
+    """One direction of a channel — the ByteQueue the two linked
+    descriptors share (ref: channel.c:22-60). Host-side only: channel
+    traffic never touches the simulated network, matching the
+    reference where Channel bypasses the NIC entirely."""
+    buf: bytearray = field(default_factory=bytearray)
+    cap: int = CHANNEL_CAP
+    writers: int = 1
+    readers: int = 1
+    in_gen: int = 0    # bumped on write/writer-close (readability edge)
+    out_gen: int = 0   # bumped on read/reader-close (writability edge)
+
+
+@dataclass
+class _ChanEnd:
+    """What one pipe/socketpair fd can do: read from recv_q, write to
+    send_q (pipe ends have one of the two, socketpair ends both)."""
+    recv_q: "Optional[_ByteQ]" = None
+    send_q: "Optional[_ByteQ]" = None
+
+
+# ---------------------------------------------------------------------
 # runtime
 # ---------------------------------------------------------------------
 
@@ -216,6 +305,7 @@ class _Proc:
     host: int
     gen: Generator
     start_time: int = 0
+    stop_time: int = -1            # -1 = run until completion
     started: bool = False
     done: bool = False
     # blocking state
@@ -243,14 +333,39 @@ class ProcessRuntime:
         # state mutations (readiness polls would otherwise do one
         # device->host transfer per watch per resume)
         self._flags_cache = None
+        # --- payload content (ref: payload.c) -------------------------
+        # UDP datagram bytes live in the refcounted pool; the device
+        # packet carries the pool id (W_PAYREF). TCP stream bytes live
+        # in per-direction FIFOs keyed by (srcHost, srcPort, dstHost,
+        # dstPort) — the device models timing/windows/retransmission
+        # and tells us how many in-order bytes each recv delivered, so
+        # content follows by popping that many bytes off the FIFO.
+        from shadow_tpu.native.pool import PayloadPool
+        self.pool = PayloadPool()
+        self._streams: dict[tuple, bytearray] = {}
+        # channels (pipe/socketpair) are per-HOST like the device
+        # socket table: keyed (host, fd) so same-host processes share
+        # them (the fork-inherited-descriptor analog, channel.c)
+        self._channels: dict[tuple, _ChanEnd] = {}
+        self._next_pipe_fd: dict[int, int] = {}
+        # host-side copy of the (static) IP tables for addr -> host id
+        self._ip_sorted = np.asarray(self.sim.net.ip_sorted)
+        self._host_of_ip_sorted = np.asarray(self.sim.net.host_of_ip_sorted)
 
     # -- process registration -----------------------------------------
 
-    def spawn(self, host: int, proc_fn: ProcFn, start_time: int = 0):
+    def spawn(self, host: int, proc_fn: ProcFn, start_time: int = 0,
+              stop_time: int = -1):
         """Register proc_fn(host) to start at sim time start_time
-        (ref: <process starttime>, configuration.h:96-101)."""
+        (ref: <process starttime>, configuration.h:96-101). A
+        non-negative stop_time kills the coroutine at that sim time
+        (GeneratorExit at its blocked yield — the analog of
+        process_stop aborting the plugin main thread,
+        process.c:1286-1324; use try/finally in the coroutine for
+        cleanup)."""
         self.procs.append(_Proc(host=host, gen=proc_fn(host),
-                                start_time=start_time))
+                                start_time=start_time,
+                                stop_time=stop_time))
 
     # -- device side ----------------------------------------------------
 
@@ -285,6 +400,31 @@ class ProcessRuntime:
         self.sim = sim.replace(events=q, outbox=out)
         self._flags_cache = None
 
+    # -- payload content helpers ----------------------------------------
+
+    def _host_of(self, ip: int, default: int) -> int:
+        """Map an IP to its host index host-side (the np mirror of
+        net.host_of_ip); loopback / unknown falls back to `default`
+        (the caller's own host)."""
+        if (ip >> 24) == 127:
+            return default
+        i = int(np.searchsorted(self._ip_sorted, ip))
+        if i < len(self._ip_sorted) and int(self._ip_sorted[i]) == ip:
+            return int(self._host_of_ip_sorted[i])
+        return default
+
+    def _stream_key(self, p: _Proc, fd: int, sending: bool) -> tuple:
+        """Direction key of the TCP content FIFO for (p.host, fd)."""
+        net = self.sim.net
+        h = p.host
+        my_port = int(net.sk_bound_port[h, fd])
+        peer_ip = int(net.sk_peer_ip[h, fd])
+        peer_port = int(net.sk_peer_port[h, fd])
+        peer_h = self._host_of(peer_ip, default=h)
+        if sending:
+            return (h, my_port, peer_h, peer_port)
+        return (peer_h, peer_port, h, my_port)
+
     # -- readiness (the epoll.c status engine, host side) ---------------
 
     def _net_rows(self):
@@ -304,6 +444,12 @@ class ProcessRuntime:
         """(in_gen, out_gen) of a socket fd; for a nested epoll, the
         sum of its watches' generations (monotonic — any child edge
         advances the parent's)."""
+        if fd >= PIPE_FD_BASE:
+            ep = self._channels.get((p.host, fd))
+            if ep is None:
+                return (0, 0)
+            return (ep.recv_q.in_gen if ep.recv_q else 0,
+                    ep.send_q.out_gen if ep.send_q else 0)
         if fd >= EPOLL_FD_BASE:
             ep = p.epolls.get(fd)
             if ep is None or _depth > 8:
@@ -332,9 +478,21 @@ class ProcessRuntime:
         return report
 
     def _fd_ready(self, p: _Proc, fd: int, _depth: int = 0) -> int:
-        """Current EPOLL.IN|OUT readiness of a socket fd or a nested
-        epoll fd (an epoll is readable when it would report at least
-        one event — epoll-as-descriptor, ref: epoll.c:96-98)."""
+        """Current EPOLL.IN|OUT readiness of a socket fd, pipe fd, or
+        a nested epoll fd (an epoll is readable when it would report
+        at least one event — epoll-as-descriptor, ref: epoll.c:96-98)."""
+        if fd >= PIPE_FD_BASE:
+            # channel status bits (ref: channel.c:22-60,147-180 flips)
+            ep = self._channels.get((p.host, fd))
+            if ep is None:
+                return 0
+            m = 0
+            if ep.recv_q and (ep.recv_q.buf or ep.recv_q.writers == 0):
+                m |= EPOLL.IN
+            if ep.send_q and (len(ep.send_q.buf) < ep.send_q.cap
+                              or ep.send_q.readers == 0):
+                m |= EPOLL.OUT
+            return m
         if fd >= EPOLL_FD_BASE:
             if _depth > 8:       # nesting depth guard (cycles)
                 return 0
@@ -481,6 +639,143 @@ class ProcessRuntime:
             if acc and acc > 0:
                 return True, acc
             return False, None
+        if op == "pipe":
+            base = self._next_pipe_fd.setdefault(h, PIPE_FD_BASE)
+            rfd, wfd = base, base + 1
+            self._next_pipe_fd[h] = base + 2
+            q = _ByteQ()
+            self._channels[(h, rfd)] = _ChanEnd(recv_q=q)
+            self._channels[(h, wfd)] = _ChanEnd(send_q=q)
+            return True, (rfd, wfd)
+        if op == "socketpair":
+            base = self._next_pipe_fd.setdefault(h, PIPE_FD_BASE)
+            fd1, fd2 = base, base + 1
+            self._next_pipe_fd[h] = base + 2
+            qa, qb = _ByteQ(), _ByteQ()
+            self._channels[(h, fd1)] = _ChanEnd(recv_q=qa, send_q=qb)
+            self._channels[(h, fd2)] = _ChanEnd(recv_q=qb, send_q=qa)
+            return True, (fd1, fd2)
+        if op == "write":
+            fd, data = a
+            ep = self._channels.get((h, fd))
+            if ep is None or ep.send_q is None:
+                return True, -1          # EBADF
+            q = ep.send_q
+            if q.readers == 0:
+                return True, -1          # EPIPE (ref: channel write to
+                                         # a closed read end)
+            space = q.cap - len(q.buf)
+            if space <= 0:
+                return False, None       # block until a reader drains
+            n = min(space, len(data))
+            q.buf.extend(data[:n])
+            q.in_gen += 1
+            return True, n
+        if op == "read":
+            fd, maxb = a
+            ep = self._channels.get((h, fd))
+            if ep is None or ep.recv_q is None:
+                return True, b""         # EBADF-ish: nothing to read
+            q = ep.recv_q
+            if q.buf:
+                n = min(maxb, len(q.buf))
+                out = bytes(q.buf[:n])
+                del q.buf[:n]
+                q.out_gen += 1
+                return True, out
+            if q.writers == 0:
+                return True, b""         # EOF: all write ends closed
+            return False, None
+        if op == "send_data":
+            fd, data = a
+            key = self._stream_key(p, fd, sending=True)
+            acc = None
+
+            def do(sim, buf):
+                nonlocal acc
+                sim, buf, accepted = tcpmod.tcp_send(
+                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
+                    len(data), now, buf)
+                acc = int(accepted[h])
+                return sim, buf
+
+            self._apply(do, now)
+            if acc and acc > 0:
+                self._streams.setdefault(key, bytearray()).extend(data[:acc])
+                return True, acc
+            return False, None
+        if op == "recv_data":
+            fd, maxb = a
+            key = self._stream_key(p, fd, sending=False)
+            nread = eof = None
+
+            def do(sim, buf):
+                nonlocal nread, eof
+                sim, buf, nr, ef = tcpmod.tcp_recv(
+                    sim, mask, jnp.full_like(mask, fd, I32),
+                    maxb, now, buf)
+                nread, eof = int(nr[h]), bool(ef[h])
+                return sim, buf
+
+            self._apply(do, now)
+            if nread and nread > 0:
+                fifo = self._streams.get(key)
+                if fifo is None or len(fifo) < nread:
+                    # peer sent length-only traffic (send/sendto):
+                    # deliver zero bytes for the missing content
+                    have = bytes(fifo[:nread]) if fifo else b""
+                    out = have + b"\x00" * (nread - len(have))
+                    if fifo:
+                        del fifo[:len(have)]
+                else:
+                    out = bytes(fifo[:nread])
+                    del fifo[:nread]
+                return True, out
+            if eof:
+                return True, b""   # EOF
+            return False, None
+        if op == "sendto_data":
+            fd, ip, port, data = a
+            payref = self.pool.put(bytes(data))
+            ok = None
+
+            def do(sim, buf):
+                nonlocal ok
+                net, okk = udpmod.udp_enqueue_send(
+                    sim.net, mask, jnp.full_like(mask, fd, I32), ip, port,
+                    len(data), payref)
+                ok = okk
+                from shadow_tpu.net import nic
+                return nic.notify_wants_send(sim.replace(net=net), buf, okk, now)
+
+            self._apply(do, now)
+            queued = bool(ok[h])
+            if not queued:
+                self.pool.unref(payref)   # EWOULDBLOCK: nothing holds it
+            return True, queued
+        if op == "recvfrom_data":
+            fd = a[0]
+            res = None
+            got_any = False
+
+            def do(sim, buf):
+                nonlocal res, got_any
+                net, got, sip, spt, ln, pref = udpmod.udp_recv(
+                    sim.net, mask, jnp.full_like(mask, fd, I32))
+                res = (int(sip[h]), int(spt[h]), int(ln[h]), int(pref[h]))
+                got_any = bool(got[h])
+                return sim.replace(net=net), buf
+
+            self._apply(do, now)
+            if got_any:
+                sip, spt, ln, pref = res
+                if pref >= 0:
+                    data = self.pool.get(pref)
+                    self.pool.unref(pref)
+                else:
+                    data = b"\x00" * ln   # synthetic (length-only) sender
+                return True, (sip, spt, data)
+            return False, None
         if op == "recv":
             fd, maxb = a
             is_tcp = self.sim.tcp is not None and (
@@ -506,37 +801,62 @@ class ProcessRuntime:
             # UDP fd: byte-count of one datagram
             res = None
             got_any = False
+            pref = -1
 
             def do(sim, buf):
-                nonlocal res, got_any
-                net, got, sip, spt, ln, _ = udpmod.udp_recv(
+                nonlocal res, got_any, pref
+                net, got, sip, spt, ln, pr = udpmod.udp_recv(
                     sim.net, mask, jnp.full_like(mask, fd, I32))
                 res, got_any = int(ln[h]), bool(got[h])
+                pref = int(pr[h])
                 return sim.replace(net=net), buf
 
             self._apply(do, now)
             if got_any:
+                if pref >= 0:
+                    self.pool.unref(pref)  # content discarded by the
+                    # length-only API; drop the pool ref (payload.c)
                 return True, res
             return False, None
         if op == "recvfrom":
             fd = a[0]
             res = None
             got_any = False
+            pref = -1
 
             def do(sim, buf):
-                nonlocal res, got_any
-                net, got, sip, spt, ln, _ = udpmod.udp_recv(
+                nonlocal res, got_any, pref
+                net, got, sip, spt, ln, pr = udpmod.udp_recv(
                     sim.net, mask, jnp.full_like(mask, fd, I32))
                 res = (int(sip[h]), int(spt[h]), int(ln[h]))
                 got_any = bool(got[h])
+                pref = int(pr[h])
                 return sim.replace(net=net), buf
 
             self._apply(do, now)
             if got_any:
+                if pref >= 0:
+                    self.pool.unref(pref)  # see recv: length-only API
                 return True, res
             return False, None
         if op == "close":
             fd = a[0]
+            if fd >= PIPE_FD_BASE:
+                ep = self._channels.pop((h, fd), None)
+                for epl in p.epolls.values():
+                    epl.watches.pop(fd, None)
+                if ep is not None:
+                    # closing an end flips the peer's status: last
+                    # writer gone -> reader sees EOF (readable); last
+                    # reader gone -> writer sees EPIPE (writable)
+                    # (ref: channel.c close/free status flips)
+                    if ep.recv_q is not None:
+                        ep.recv_q.readers -= 1
+                        ep.recv_q.out_gen += 1
+                    if ep.send_q is not None:
+                        ep.send_q.writers -= 1
+                        ep.send_q.in_gen += 1
+                return True, 0
             if fd >= EPOLL_FD_BASE:
                 p.epolls.pop(fd, None)
                 return True, 0
@@ -556,6 +876,7 @@ class ProcessRuntime:
                 from shadow_tpu.net.rings import set_hs
 
                 slot = jnp.full_like(mask, fd, I32)
+                was_live = sel & (net.sk_type[:, fd] != SocketType.NONE)
                 net = net.replace(
                     sk_type=set_hs(net.sk_type, sel, slot,
                                    jnp.zeros_like(slot)),
@@ -563,6 +884,9 @@ class ProcessRuntime:
                                     jnp.zeros_like(slot)),
                     sk_bound_port=set_hs(net.sk_bound_port, sel, slot,
                                          jnp.zeros_like(slot)),
+                    # object accounting (ref: object_counter.c)
+                    ctr_sk_free=net.ctr_sk_free
+                    + was_live.astype(jnp.int64),
                 )
                 self.sim = self.sim.replace(net=net)
                 self._flags_cache = None
@@ -586,34 +910,104 @@ class ProcessRuntime:
     def _resume_all(self, now: int) -> None:
         """Advance every runnable coroutine until all are blocked
         (the pth_yield loop, process.c:1227-1229). Processes run in
-        spawn order — deterministic."""
-        for p in self.procs:
-            if p.done or now < p.start_time:
-                continue
-            if not p.started:
-                p.started = True
-                try:
-                    p.pending = next(p.gen)
-                except StopIteration:
-                    p.done = True
+        spawn order — deterministic. Sweeps repeat while channel
+        activity occurred: a pipe write/read/close by a later process
+        can unblock an earlier one at the same instant (the
+        reference's status-change notify re-enters process_continue
+        within the same sim time, epoll.c:583-680). Only channels
+        need this — every other cross-process path rides device
+        events, which land in a later window."""
+        chan_ops = ("pipe", "socketpair", "write", "read")
+        # syscalls whose blocking state channel activity can change;
+        # later sweeps retry ONLY processes blocked on these (cheap,
+        # host-side) — re-running device-side blocked ops (tcp_send,
+        # accept, ...) every sweep would cost a device dispatch per
+        # blocked process per sweep for state that cannot have changed
+        retry_ops = ("read", "write", "wait_readable", "epoll_wait")
+        sweep = 0
+        while True:
+            chan_activity = False
+            for p in self.procs:
+                if p.done or now < p.start_time:
                     continue
-                p.block = None
-            # run until this process blocks
-            while True:
-                call = getattr(p, "pending", None)
-                if call is None:
-                    break
-                ready, result = self._exec(p, call, now)
-                if not ready:
-                    p.block = call
-                    break
-                p.block = None
-                try:
-                    p.pending = p.gen.send(result)
-                except StopIteration:
-                    p.done = True
-                    p.pending = None
-                    break
+                if sweep > 0 and p.block is not None \
+                        and p.block.op not in retry_ops:
+                    continue
+                if not p.started:
+                    p.started = True
+                    try:
+                        p.pending = next(p.gen)
+                    except StopIteration:
+                        p.done = True
+                        continue
+                    p.block = None
+                # run until this process blocks
+                while True:
+                    call = getattr(p, "pending", None)
+                    if call is None:
+                        break
+                    ready, result = self._exec(p, call, now)
+                    if not ready:
+                        p.block = call
+                        break
+                    if call.op in chan_ops or (
+                            call.op == "close" and call.args
+                            and call.args[0] >= PIPE_FD_BASE):
+                        chan_activity = True
+                    p.block = None
+                    try:
+                        p.pending = p.gen.send(result)
+                    except StopIteration:
+                        p.done = True
+                        p.pending = None
+                        break
+            sweep += 1
+            if not chan_activity:
+                break
+
+    def gc_pool(self) -> int:
+        """Mark-sweep the payload pool against the device state: a
+        pool entry is live iff its id appears in any in-flight packet
+        location (event queue words, outbox words, router ring, socket
+        output rings, or input rings). Entries dropped inside the
+        simulated network (reliability/CoDel/no-socket/rcvbuf drops
+        destroy the packet on device, where the host cannot observe
+        the unref — the reference unrefs in packet_unref, packet.c)
+        are collected here. Returns the number of entries released."""
+        from shadow_tpu.core import simtime as st
+        from shadow_tpu.net import packetfmt as pfm
+
+        sim = self.sim
+        live: set[int] = set()
+
+        def ring_live(payref, head, count):
+            """payrefs at live ring positions [head, head+count)."""
+            B = payref.shape[-1]
+            idx = np.arange(B)
+            mask = ((idx - head[..., None]) % B) < count[..., None]
+            return payref[mask]
+
+        def mark(vals):
+            live.update(int(x) for x in np.unique(vals) if x >= 0)
+
+        mark(np.asarray(sim.events.words)[..., pfm.W_PAYREF][
+            np.asarray(sim.events.time) != st.INVALID])
+        mark(np.asarray(sim.outbox.words)[..., pfm.W_PAYREF][
+            np.asarray(sim.outbox.dst) >= 0])
+        net = sim.net
+        mark(ring_live(np.asarray(net.rq_words)[..., pfm.W_PAYREF],
+                       np.asarray(net.rq_head), np.asarray(net.rq_count)))
+        mark(ring_live(np.asarray(net.out_words)[..., pfm.W_PAYREF],
+                       np.asarray(net.out_head), np.asarray(net.out_count)))
+        mark(ring_live(np.asarray(net.in_payref),
+                       np.asarray(net.in_head), np.asarray(net.in_count)))
+        freed = 0
+        for pid in self.pool.live_ids():
+            if pid not in live:
+                while self.pool.unref(pid) > 0:
+                    pass
+                freed += 1
+        return freed
 
     def run(self, end_time: int | None = None):
         """The master window loop (ref: master.c:450-480 +
@@ -624,16 +1018,28 @@ class ProcessRuntime:
         total = EngineStats.create()
         now = 0
         while now <= end:
+            # stoptime enforcement (ref: process_stop,
+            # process.c:1286-1324): kill before resuming, so a
+            # stopped process never runs at or past its stop time
+            for p in self.procs:
+                if not p.done and 0 <= p.stop_time <= now:
+                    p.gen.close()
+                    p.done = True
+                    p.pending = None
+                    p.block = None
             self._resume_all(now)
 
             # next window start: earliest of device events, sleep
-            # deadlines, and not-yet-started process start times
+            # deadlines, not-yet-started process start times, and
+            # pending stop deadlines
             cands = [int(jnp.min(self.sim.events.min_time()))]
             cands += [p.wake_time for p in self.procs
                       if not p.done and p.block is not None
                       and p.block.op == "sleep"]
             cands += [p.start_time for p in self.procs
                       if not p.done and not p.started]
+            cands += [p.stop_time for p in self.procs
+                      if not p.done and p.stop_time >= 0]
             wstart = min(c for c in cands if c >= 0)
             if wstart > end or wstart >= simtime.INVALID:
                 break
@@ -651,4 +1057,8 @@ class ProcessRuntime:
                 windows=total.windows + 1,
             )
             now = int(wend)
+        # collect payload-pool entries whose packets died on device
+        # (drops destroy packets where the host cannot unref —
+        # the packet_unref analog, packet.c)
+        self.gc_pool()
         return self.sim, total

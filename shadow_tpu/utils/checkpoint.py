@@ -1,0 +1,122 @@
+"""Window-boundary checkpoint / resume (SURVEY.md §5.4 — the
+reference has no checkpointing; the survey calls device-state
+snapshots out as cheap and worth adding. The device state is a pytree
+of fixed-shape arrays, so a snapshot is jax.device_get + np.savez and
+resume is exact: the window-advance rule restarts from the recorded
+next window start and the counter-based RNG (core/rng.py) needs no
+stream state beyond what the arrays already hold).
+
+Determinism contract: run(0 -> T) == run(0 -> C) + save + load +
+run(C -> T), bit for bit — proven by tests/test_checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import jax
+import numpy as np
+
+
+def _leaf_dict(sim) -> dict:
+    flat = jax.tree_util.tree_flatten_with_path(sim)[0]
+    out = {}
+    for path, leaf in flat:
+        out[jax.tree_util.keystr(path)] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def save(path: str, sim, *, time_ns: int, extra: dict | None = None):
+    """Snapshot a Sim pytree at a window boundary. `time_ns` is the
+    next window start (resume point)."""
+    leaves = _leaf_dict(sim)
+    meta = {"time_ns": int(time_ns), "extra": extra or {},
+            "keys": sorted(leaves)}
+    np.savez_compressed(path, __meta__=json.dumps(meta),
+                        **{k: v for k, v in leaves.items()})
+
+
+def load(path: str, template_sim):
+    """Rebuild a Sim from a snapshot. `template_sim` supplies the
+    pytree structure (build the bundle with the SAME config first);
+    every array is checked against the template's shape and dtype."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        flat, treedef = jax.tree_util.tree_flatten_with_path(template_sim)
+        leaves = []
+        for pth, tleaf in flat:
+            key = jax.tree_util.keystr(pth)
+            if key not in z:
+                raise ValueError(f"snapshot missing leaf {key} "
+                                 f"(config mismatch?)")
+            arr = z[key]
+            t = np.asarray(tleaf)
+            if arr.shape != t.shape or arr.dtype != t.dtype:
+                raise ValueError(
+                    f"snapshot leaf {key} is {arr.shape}/{arr.dtype}, "
+                    f"template expects {t.shape}/{t.dtype} "
+                    f"(config mismatch)")
+            leaves.append(jax.numpy.asarray(arr))
+        treedef = jax.tree_util.tree_structure(template_sim)
+        sim = jax.tree_util.tree_unflatten(treedef, leaves)
+    return sim, meta["time_ns"], meta["extra"]
+
+
+def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
+                start_time: int = 0, sim=None,
+                checkpoint_every_ns: int | None = None,
+                checkpoint_path: str | None = None,
+                on_window=None):
+    """Host-driven window loop with optional periodic snapshots —
+    the checkpointing twin of engine.run (same advance rule,
+    master.c:450-480; one jitted step_window per round so the host
+    regains control at every barrier). Returns (sim, stats,
+    checkpoints) where checkpoints lists the saved (path, time_ns).
+    `on_window(sim, wend)` runs after every round — pcap drains,
+    heartbeats, progress hooks.
+    """
+    import jax.numpy as jnp
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.engine import EngineStats, step_window
+    from shadow_tpu.net.step import make_step_fn
+
+    cfg = bundle.cfg
+    step = make_step_fn(cfg, app_handlers)
+    end = end_time if end_time is not None else cfg.end_time
+    min_jump = max(int(bundle.min_jump), 1)
+    sim = sim if sim is not None else bundle.sim
+
+    @jax.jit
+    def one_window(sim, wend):
+        stats = EngineStats.create()
+        return step_window(sim, stats, step, wend,
+                           emit_capacity=cfg.emit_capacity,
+                           lane_id=sim.net.lane_id)
+
+    total = EngineStats.create()
+    saved = []
+    next_ckpt = (start_time + checkpoint_every_ns
+                 if checkpoint_every_ns else None)
+    wstart = max(int(jnp.min(sim.events.min_time())), start_time)
+    while wstart <= end:
+        if (next_ckpt is not None and wstart >= next_ckpt
+                and checkpoint_path is not None):
+            p = f"{checkpoint_path}.{wstart}.npz"
+            save(p, sim, time_ns=wstart)
+            saved.append((p, wstart))
+            next_ckpt += checkpoint_every_ns
+        wend = min(wstart + min_jump, end + 1)
+        sim, stats, next_min = one_window(sim, wend)
+        total = EngineStats(
+            events_processed=total.events_processed + stats.events_processed,
+            micro_steps=total.micro_steps + stats.micro_steps,
+            windows=total.windows + 1,
+        )
+        if on_window is not None:
+            on_window(sim, wend)
+        nm = int(next_min)
+        if nm >= simtime.INVALID:
+            break
+        wstart = nm
+    return sim, total, saved

@@ -1,0 +1,145 @@
+"""libpcap-format capture files from the device capture ring
+(ref: pcap_writer.c — the reference writes per-interface pcap files
+with fabricated ethernet/IP/TCP headers when <host logpcap> is set;
+hooks at network_interface.c:337-373).
+
+The device side appends (time, packet words, src/dir meta) to a
+per-host ring (nic._capture, cfg.pcap); CaptureSession.drain() is
+called between windows, converts new records to wire-format frames,
+and appends them to one pcap file per host. Payload bytes come from
+the payload pool when the packet carries a payref; synthetic
+(length-only) traffic is written as zeros of the advertised length,
+truncated to SNAPLEN like any real capture."""
+
+from __future__ import annotations
+
+import pathlib
+import struct
+
+import numpy as np
+
+from shadow_tpu.net import packetfmt as pf
+
+SNAPLEN = 65535
+LINKTYPE_EN10MB = 1
+
+_GLOBAL_HDR = struct.pack("<IHHiIII", 0xA1B2C3D4, 2, 4, 0, 0,
+                          SNAPLEN, LINKTYPE_EN10MB)
+
+
+def _mac(host: int) -> bytes:
+    """Fabricated unique MAC (ref: address.c uniqueMAC)."""
+    return bytes([0x02, 0, (host >> 16) & 0xFF, (host >> 8) & 0xFF,
+                  host & 0xFF, 0x01])
+
+
+def _frame(src_host: int, dst_ip: int, src_ip: int, words: np.ndarray,
+           payload: bytes) -> bytes:
+    """Ethernet + IPv4 + UDP/TCP frame from packet words (the
+    reference fabricates the same layering, pcap_writer.c)."""
+    proto = int(words[pf.W_PROTO]) & 0xFF
+    flags = (int(words[pf.W_PROTO]) >> 8) & 0xFF
+    ports = int(words[pf.W_PORTS])
+    sport, dport = ports & 0xFFFF, (ports >> 16) & 0xFFFF
+    if proto == pf.PROTO_TCP:
+        tcpflags = 0x10 if (flags & pf.TCPF_ACK) else 0
+        if flags & pf.TCPF_SYN:
+            tcpflags |= 0x02
+        if flags & pf.TCPF_FIN:
+            tcpflags |= 0x01
+        if flags & pf.TCPF_RST:
+            tcpflags |= 0x04
+        l4 = struct.pack(">HHIIBBHHH", sport, dport,
+                         int(words[pf.W_SEQ]) & 0xFFFFFFFF,
+                         int(words[pf.W_ACK]) & 0xFFFFFFFF,
+                         5 << 4, tcpflags,
+                         min(int(words[pf.W_WIN]), 0xFFFF), 0, 0)
+        ipproto = 6
+    else:
+        l4 = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0)
+        ipproto = 17
+    total = 20 + len(l4) + len(payload)
+    ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, ipproto, 0,
+                     src_ip & 0xFFFFFFFF, dst_ip & 0xFFFFFFFF)
+    eth = _mac(src_host) + _mac(0) + struct.pack(">H", 0x0800)
+    return eth + ip + l4 + payload
+
+
+class CaptureSession:
+    """One pcap file per host, drained from the device ring between
+    windows (the per-interface PCapWriter of the reference)."""
+
+    def __init__(self, bundle, directory: str, pool=None):
+        if not bundle.cfg.pcap:
+            raise ValueError("build the bundle with NetConfig(pcap=True)")
+        self.dir = pathlib.Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.names = bundle.host_names
+        self.host_ip = np.asarray(bundle.sim.net.host_ip)
+        self.pool = pool
+        self._last = np.zeros(len(self.names), np.int64)
+        self.dropped = 0
+        self._files = {}
+
+    def _file(self, h: int):
+        f = self._files.get(h)
+        if f is None:
+            p = self.dir / f"{self.names[h]}-eth.pcap"
+            f = open(p, "wb")
+            f.write(_GLOBAL_HDR)
+            self._files[h] = f
+        return f
+
+    def drain(self, sim) -> int:
+        """Write records appended since the last drain; returns how
+        many. Ring overruns (more than C new records on one host) are
+        counted in self.dropped — never silent."""
+        net = sim.net
+        cap_time = np.asarray(net.cap_time)
+        cap_words = np.asarray(net.cap_words)
+        cap_meta = np.asarray(net.cap_meta)
+        cap_count = np.asarray(net.cap_count, dtype=np.int64)
+        C = cap_time.shape[1]
+        written = 0
+        for h in range(len(self.names)):
+            new = int(cap_count[h] - self._last[h])
+            if new <= 0:
+                continue
+            if new > C:
+                self.dropped += new - C
+                new = C
+            start = int(cap_count[h]) - new
+            f = self._file(h)
+            for i in range(start, start + new):
+                slot = i % C
+                words = cap_words[h, slot]
+                meta = int(cap_meta[h, slot])
+                src_host = meta & 0xFFFFFF
+                direction = meta >> 24
+                dst_ip = int(np.uint32(words[pf.W_DSTIP]))
+                src_ip = (int(self.host_ip[h]) if direction == 0
+                          else int(self.host_ip[src_host])
+                          if 0 <= src_host < len(self.host_ip) else 0)
+                length = int(words[pf.W_LEN])
+                payref = int(words[pf.W_PAYREF])
+                if payref >= 0 and self.pool is not None:
+                    try:
+                        payload = self.pool.get(payref)[:SNAPLEN]
+                    except KeyError:
+                        payload = b"\x00" * min(length, SNAPLEN)
+                else:
+                    payload = b"\x00" * min(length, SNAPLEN)
+                frame = _frame(src_host, dst_ip, src_ip, words, payload)
+                t = int(cap_time[h, slot])
+                f.write(struct.pack("<IIII", t // 1_000_000_000,
+                                    (t % 1_000_000_000) // 1000,
+                                    len(frame), len(frame)))
+                f.write(frame)
+                written += 1
+            self._last[h] = cap_count[h]
+        return written
+
+    def close(self):
+        for f in self._files.values():
+            f.close()
+        self._files.clear()

@@ -1,0 +1,108 @@
+"""Packet delivery-status audit trail (ref: packet.h:18-40 — the
+reference appends a PDS_* status at every pipeline stage and can dump
+the trail per packet; here the trail is a bitmask word riding the
+packet (W_STATUS), kept in in_status for buffered datagrams and in
+last_drop_status for the most recent drop)."""
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import ProcessRuntime
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="pl" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">client</data></node>
+    <node id="b"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">server</data></node>
+    <edge source="a" target="a"><data key="lat">5.0</data></edge>
+    <edge source="a" target="b"><data key="lat">25.0</data>
+      <data key="pl">{loss}</data></edge>
+    <edge source="b" target="b"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+PORT = 7000
+
+FULL_UDP_TRAIL = (
+    pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED
+    | pf.PDS_SND_INTERFACE_SENT | pf.PDS_INET_SENT
+    | pf.PDS_ROUTER_ENQUEUED | pf.PDS_ROUTER_DEQUEUED
+    | pf.PDS_RCV_INTERFACE_RECEIVED | pf.PDS_RCV_SOCKET_PROCESSED
+    | pf.PDS_RCV_SOCKET_BUFFERED
+)
+
+
+def _bundle(loss=0.0):
+    cfg = NetConfig(num_hosts=2, end_time=5 * simtime.ONE_SECOND, tcp=False)
+    return build(cfg, GRAPH.format(loss=loss),
+                 [HostSpec(name="a", type="client"),
+                  HostSpec(name="b", type="server")])
+
+
+def test_udp_delivery_trail_complete():
+    """A delivered datagram's in_status carries every pipeline stage
+    it passed, in the reference's trail order."""
+    b = _bundle()
+    b_ip = b.ip_of("b")
+    sk = {}
+
+    def sender(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto(fd, b_ip, PORT, 64)
+
+    def receiver(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        sk["fd"] = fd
+        yield vproc.bind(fd, PORT)
+        # deliberately never recv: the datagram stays buffered with
+        # its trail in in_status
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, sender)
+    rt.spawn(1, receiver)
+    rt.run()
+    status = int(np.asarray(rt.sim.net.in_status)[1, sk["fd"], 0])
+    assert status == FULL_UDP_TRAIL, pf.pds_decode(status)
+    names = pf.pds_decode(status)
+    assert "SND_CREATED" in names and "RCV_SOCKET_BUFFERED" in names
+    assert "INET_DROPPED" not in names
+
+
+def test_reliability_drop_records_trail():
+    """With a fully lossy edge the packet's last act is INET_DROPPED,
+    recorded host-side in the sender's last_drop_status."""
+    b = _bundle(loss=1.0)
+    b_ip = b.ip_of("b")
+
+    def sender(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto(fd, b_ip, PORT, 64)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, sender)
+    rt.run()
+    status = int(np.asarray(rt.sim.net.last_drop_status)[0])
+    names = pf.pds_decode(status)
+    assert "INET_DROPPED" in names
+    assert "SND_INTERFACE_SENT" in names
+    assert "INET_SENT" not in names
+    # receiver saw nothing
+    assert int(np.asarray(rt.sim.net.ctr_rx_packets)[1]) == 0
+
+
+def test_pds_decode_roundtrip():
+    for bit, name in pf.PDS_NAMES.items():
+        assert pf.pds_decode(bit) == [name]
+    assert pf.pds_decode(0) == []

@@ -34,7 +34,7 @@ GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 
 # state arrays whose consumed-slot contents are dead storage
 DEAD = {
-    "in_src_ip", "in_src_port", "in_len", "in_payref",
+    "in_src_ip", "in_src_port", "in_len", "in_payref", "in_status",
     "out_words", "out_priority",
     "rq_src", "rq_enq_ts", "rq_words",
 }

@@ -1,0 +1,189 @@
+"""Pipe/socketpair channel semantics (ref: descriptor/channel.c) and
+process stoptime enforcement (ref: process.c:1286-1324).
+
+Channels are intra-host conduits shared by same-host processes — the
+fork-inherited-descriptor shape of the reference's pipe tests. Status
+flips (readable on write/EOF, writable on drain/EPIPE) must drive
+blocking read/write, wait_readable, and the epoll engine.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import CHANNEL_CAP, EPOLL, ProcessRuntime
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="up">10240</data><data key="dn">10240</data></node>
+    <node id="b"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="a" target="a"><data key="lat">5.0</data></edge>
+    <edge source="a" target="b"><data key="lat">25.0</data></edge>
+    <edge source="b" target="b"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _bundle(seconds=10):
+    cfg = NetConfig(num_hosts=2, end_time=seconds * simtime.ONE_SECOND,
+                    tcp=False)
+    return build(cfg, GRAPH, [HostSpec(name="a"), HostSpec(name="b")])
+
+
+def test_pipe_blocking_and_eof():
+    """Reader blocks until the writer writes; EOF (b'') after the
+    write end closes (channel.c readable/EOF status flips)."""
+    b = _bundle()
+    fds = {}
+    got = []
+
+    def writer(host):
+        rfd, wfd = yield vproc.pipe()
+        fds["r"] = rfd
+        yield vproc.sleep(100 * simtime.ONE_MILLISECOND)
+        n = yield vproc.write(wfd, b"through the pipe")
+        assert n == 16
+        yield vproc.sleep(100 * simtime.ONE_MILLISECOND)
+        yield vproc.close(wfd)
+
+    def reader(host):
+        yield vproc.sleep(10 * simtime.ONE_MILLISECOND)  # after pipe()
+        data = yield vproc.read(fds["r"])
+        got.append(data)
+        data = yield vproc.read(fds["r"])     # blocks until writer close
+        got.append(data)
+        yield vproc.close(fds["r"])
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, writer)
+    rt.spawn(0, reader)
+    rt.run()
+    assert got == [b"through the pipe", b""]
+    assert all(p.done for p in rt.procs)
+
+
+def test_pipe_full_buffer_blocks_writer_epipe():
+    """A writer blocks when the channel is full and resumes when the
+    reader drains; writing after the read end closes returns -1
+    (EPIPE). Exercises the WRITABLE status flip (channel.c:147-180)."""
+    b = _bundle()
+    log = []
+
+    hidden = {}
+    box = {}
+
+    def duo(host):
+        rfd, wfd = yield vproc.pipe()
+        box["rfd"] = rfd
+        # fill the channel to capacity: next write must block
+        n = yield vproc.write(wfd, b"x" * CHANNEL_CAP)
+        assert n == CHANNEL_CAP
+        # this write blocks until the drainer frees space
+        n = yield vproc.write(wfd, b"y" * 100)
+        log.append(("late-write", n))
+        yield vproc.close(rfd)
+        r = yield vproc.write(wfd, b"z")
+        log.append(("epipe", r))
+        yield vproc.close(wfd)
+        hidden["done"] = True
+
+    def drainer(host):
+        yield vproc.sleep(50 * simtime.ONE_MILLISECOND)
+        data = yield vproc.read(box["rfd"], CHANNEL_CAP)
+        log.append(("drained", len(data)))
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, duo)
+    rt.spawn(0, drainer)
+    rt.run()
+    assert ("drained", CHANNEL_CAP) in log
+    assert ("late-write", 100) in log
+    assert ("epipe", -1) in log
+    assert hidden.get("done")
+
+
+def test_socketpair_bidirectional_and_epoll():
+    """socketpair carries bytes both ways; epoll reports IN on a
+    channel fd (epoll-on-channel, the reference's Channel is a
+    descriptor like any other)."""
+    b = _bundle()
+    out = {}
+    box = {}
+
+    def left(host):
+        fd1, fd2 = yield vproc.socketpair()
+        box["fd2"] = fd2
+        yield vproc.write(fd1, b"ping")
+        data = yield vproc.read(fd1)
+        out["left"] = data
+        yield vproc.close(fd1)
+
+    def right(host):
+        yield vproc.sleep(simtime.ONE_MILLISECOND)
+        fd2 = box["fd2"]
+        epfd = yield vproc.epoll_create()
+        yield vproc.epoll_ctl(epfd, EPOLL.CTL_ADD, fd2, EPOLL.IN)
+        events = yield vproc.epoll_wait(epfd)
+        assert any(fd == fd2 and (m & EPOLL.IN) for fd, m in events)
+        data = yield vproc.read(fd2)
+        out["right"] = data
+        yield vproc.write(fd2, data[::-1])
+        yield vproc.close(fd2)
+        yield vproc.close(epfd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, left)
+    rt.spawn(0, right)
+    rt.run()
+    assert out["right"] == b"ping"
+    assert out["left"] == b"gnip"
+
+
+def test_vproc_stoptime_kills_coroutine():
+    """A coroutine that would run forever is killed at stop_time;
+    GeneratorExit runs its finally block (the process_stop abort,
+    process.c:1286-1324)."""
+    b = _bundle(seconds=10)
+    trace = []
+
+    def immortal(host):
+        try:
+            while True:
+                t = yield vproc.gettime()
+                trace.append(t)
+                yield vproc.sleep(simtime.ONE_SECOND)
+        finally:
+            trace.append("killed")
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, immortal, stop_time=3 * simtime.ONE_SECOND)
+    rt.run()
+    assert trace[-1] == "killed"
+    ticks = [t for t in trace if t != "killed"]
+    # started at 0, ticks at ~0,1,2,(3)s; nothing at or past 3 s + one window
+    assert all(t <= 3 * simtime.ONE_SECOND for t in ticks)
+    assert len(ticks) >= 3
+
+
+def test_device_proc_stop_masks_app(  ):
+    """Device-side PROC_STOP: a phold-style host stops emitting after
+    its stoptime; the flag latches in net.proc_stopped."""
+    from shadow_tpu.apps import phold
+    from shadow_tpu.net.build import run
+
+    cfg = NetConfig(num_hosts=2, end_time=2 * simtime.ONE_SECOND, tcp=False,
+                    event_capacity=64, outbox_capacity=64)
+    hosts = [HostSpec(name="h0", proc_start_time=0,
+                      proc_stop_time=simtime.ONE_SECOND),
+             HostSpec(name="h1", proc_start_time=0)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=2)
+    sim, stats = run(b, app_handlers=(phold.handler,))
+    stopped = np.asarray(sim.net.proc_stopped)
+    assert stopped[0] and not stopped[1]

@@ -1,0 +1,96 @@
+"""Checkpoint/resume determinism (SURVEY.md §5.4): a run split at a
+window-boundary snapshot must be bit-identical to the straight run —
+including RNG draws (counter-based streams), TCP timers, and queue
+contents. Also guards config-mismatch detection on load."""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.utils import checkpoint
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _build(H=16, load=4, sim_s=2, seed=7):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _assert_sims_equal(sa, sb):
+    import jax
+
+    fa = jax.tree_util.tree_flatten_with_path(sa)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sb)[0]
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        key = jax.tree_util.keystr(pa)
+        a, b = np.asarray(la), np.asarray(lb)
+        # consumed event slots are dead storage; live slots must match
+        np.testing.assert_array_equal(a, b, err_msg=f"{key} diverged")
+
+
+def test_checkpoint_resume_bit_identical(tmp_path):
+    # straight run through the host window loop
+    b1 = _build()
+    sim_a, stats_a, _ = checkpoint.run_windows(
+        b1, app_handlers=(phold.handler,))
+
+    # split run: checkpoint at ~1 s, reload into a FRESH bundle, resume
+    b2 = _build()
+    ck = str(tmp_path / "snap")
+    sim_h, stats_h, saved = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,),
+        end_time=simtime.ONE_SECOND, checkpoint_every_ns=simtime.ONE_SECOND,
+        checkpoint_path=ck)
+    assert saved, "no snapshot was written"
+    path, t_ck = saved[-1]
+
+    b3 = _build()   # fresh template (same config) for the load
+    sim_r, t_resume, _extra = checkpoint.load(path, b3.sim)
+    assert t_resume == t_ck
+    sim_b, stats_b, _ = checkpoint.run_windows(
+        b3, app_handlers=(phold.handler,), sim=sim_r,
+        start_time=t_resume)
+
+    _assert_sims_equal(sim_a, sim_b)
+    assert int(sim_a.events.overflow) == 0
+
+
+def test_checkpoint_matches_device_runner(tmp_path):
+    """The host window loop (checkpointing twin) produces the same
+    final state as the all-on-device engine.run fast path."""
+    b1 = _build(H=8, load=2, sim_s=1)
+    sim_a, _, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+    b2 = _build(H=8, load=2, sim_s=1)
+    fn = make_runner(b2, app_handlers=(phold.handler,))
+    sim_b, _ = fn(b2.sim)
+    _assert_sims_equal(sim_a, sim_b)
+
+
+def test_load_rejects_config_mismatch(tmp_path):
+    b = _build(H=8, load=2, sim_s=1)
+    p = str(tmp_path / "snap.npz")
+    checkpoint.save(p, b.sim, time_ns=0)
+    other = _build(H=16, load=2, sim_s=1)   # different shapes
+    with pytest.raises(ValueError, match="config mismatch"):
+        checkpoint.load(p, other.sim)

@@ -1,0 +1,180 @@
+"""Real payload bytes end-to-end (VERDICT Missing #10).
+
+The reference's filetransfer-style tests verify *content*, not just
+byte counts (its packets share refcounted Payload buffers,
+payload.c:17-30). Here UDP datagrams carry pool refs on device
+(W_PAYREF) with bytes in the host-side PayloadPool, and TCP stream
+content rides per-direction FIFOs advanced by the device's in-order
+delivery counts — so content must round-trip exactly, including over
+a lossy link where the device reorders/retransmits segments.
+"""
+
+import hashlib
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import ProcessRuntime
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="pl" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="a"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">client</data></node>
+    <node id="b"><data key="up">10240</data><data key="dn">10240</data>
+      <data key="ty">server</data></node>
+    <edge source="a" target="a"><data key="lat">5.0</data></edge>
+    <edge source="a" target="b"><data key="lat">25.0</data>
+      <data key="pl">{loss}</data></edge>
+    <edge source="b" target="b"><data key="lat">5.0</data></edge>
+  </graph>
+</graphml>"""
+
+PORT = 7000
+
+
+def _bundle(seconds=20, loss=0.0, **kw):
+    cfg = NetConfig(num_hosts=2, end_time=seconds * simtime.ONE_SECOND, **kw)
+    hosts = [HostSpec(name="client", type="client"),
+             HostSpec(name="server", type="server")]
+    return build(cfg, GRAPH.format(loss=loss), hosts)
+
+
+def test_udp_content_roundtrip():
+    b = _bundle()
+    server_ip = b.ip_of("server")
+    got = {}
+
+    def server(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        sip, spt, data = yield vproc.recvfrom_data(fd)
+        got["server"] = data
+        yield vproc.sendto_data(fd, sip, spt, data[::-1])
+        yield vproc.close(fd)
+
+    def client(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto_data(fd, server_ip, PORT, b"hello, payload pool!")
+        _, _, data = yield vproc.recvfrom_data(fd)
+        got["client"] = data
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(b.host_of("server"), server)
+    rt.spawn(b.host_of("client"), client, start_time=simtime.ONE_SECOND)
+    rt.run()
+    assert got["server"] == b"hello, payload pool!"
+    assert got["client"] == b"!loop daolyap ,olleh"
+    # the pool must not leak: both datagrams were consumed
+    assert rt.pool.live_bytes() == 0
+    assert all(p.done for p in rt.procs)
+
+
+def test_udp_mixed_content_and_synthetic():
+    """A content datagram and a length-only datagram interleave; the
+    synthetic one reads back as zeros of the advertised length."""
+    b = _bundle()
+    server_ip = b.ip_of("server")
+    got = []
+
+    def server(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        for _ in range(2):
+            _, _, data = yield vproc.recvfrom_data(fd)
+            got.append(data)
+        yield vproc.close(fd)
+
+    def client(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto_data(fd, server_ip, PORT, b"real bytes")
+        yield vproc.sleep(100 * simtime.ONE_MILLISECOND)
+        yield vproc.sendto(fd, server_ip, PORT, 7)   # length-only
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(b.host_of("server"), server)
+    rt.spawn(b.host_of("client"), client, start_time=simtime.ONE_SECOND)
+    rt.run()
+    assert got == [b"real bytes", b"\x00" * 7]
+
+
+def _tcp_content_run(loss: float, payload: bytes):
+    b = _bundle(seconds=60, loss=loss)
+    server_ip = b.ip_of("server")
+    out = {}
+
+    def server(host):
+        fd = yield vproc.socket(SocketType.TCP)
+        yield vproc.bind(fd, PORT)
+        yield vproc.listen(fd)
+        child = yield vproc.accept(fd)
+        chunks = []
+        while True:
+            data = yield vproc.recv_data(child)
+            if data == b"":
+                break
+            chunks.append(data)
+        out["data"] = b"".join(chunks)
+        yield vproc.close(child)
+        yield vproc.close(fd)
+
+    def client(host):
+        fd = yield vproc.socket(SocketType.TCP)
+        yield vproc.connect(fd, server_ip, PORT)
+        view = memoryview(payload)
+        off = 0
+        while off < len(view):
+            sent = yield vproc.send_data(fd, bytes(view[off:off + 16384]))
+            off += sent
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(b.host_of("server"), server)
+    rt.spawn(b.host_of("client"), client, start_time=simtime.ONE_SECOND)
+    rt.run()
+    return out.get("data", b"")
+
+
+def test_tcp_content_lossless():
+    payload = bytes(range(256)) * 64   # 16 KiB patterned
+    got = _tcp_content_run(0.0, payload)
+    assert len(got) == len(payload)
+    assert hashlib.sha256(got).digest() == hashlib.sha256(payload).digest()
+
+
+def test_dropped_payload_collected():
+    """A content datagram dropped inside the simulated network (the
+    host cannot observe the device-side drop) is released by the
+    end-of-run pool mark-sweep (the packet_unref analog)."""
+    b = _bundle(loss=1.0)
+    server_ip = b.ip_of("server")
+
+    def sender(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto_data(fd, server_ip, PORT, b"doomed bytes")
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(b.host_of("client"), sender)
+    rt.run()
+    assert rt.pool.live_bytes() == 0
+    assert rt.pool.total_allocs() == 1
+
+
+def test_tcp_content_lossy():
+    """Content must survive loss: the device retransmits/reorders, but
+    delivered-in-order counts drive the FIFO, so bytes match exactly."""
+    payload = hashlib.sha256(b"seed").digest() * 512   # 16 KiB pseudo-random
+    got = _tcp_content_run(0.05, payload)
+    assert len(got) == len(payload)
+    assert got == payload

@@ -1,0 +1,93 @@
+"""Router queue-manager variants (ref: QueueManagerHooks vtable,
+router.c; router_queue_single.c one-packet queue; router_queue_static.c
+drop-tail). CoDel is the default (host.c:205); `single` drops every
+arrival that finds the queue occupied, `static` drop-tails at ring
+capacity — both count drops and record the audit trail instead of
+flagging overflow."""
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig, RouterQ
+from shadow_tpu.apps import pingpong
+
+import jax.numpy as jnp
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="type" attr.type="string" for="node" id="ty" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="c"><data key="up">102400</data><data key="dn">102400</data>
+      <data key="ty">client</data></node>
+    <node id="s"><data key="up">102400</data><data key="dn">1</data>
+      <data key="ty">server</data></node>
+    <edge source="c" target="c"><data key="lat">1.0</data></edge>
+    <edge source="c" target="s"><data key="lat">1.0</data></edge>
+    <edge source="s" target="s"><data key="lat">1.0</data></edge>
+  </graph>
+</graphml>"""
+
+PORT = 7000
+
+
+def _run(router_qdisc, clients=8):
+    """Many clients blast one throttled server (1 KiB/s down): its
+    router queue backs up, so the managers' drop policies separate."""
+    H = clients + 1
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=2 * simtime.ONE_SECOND,
+                    router_qdisc=router_qdisc,
+                    event_capacity=64, outbox_capacity=64, router_ring=4)
+    hosts = [HostSpec(name=f"c{i}", type="client",
+                      proc_start_time=simtime.ONE_MILLISECOND)
+             for i in range(clients)]
+    hosts.append(HostSpec(name="server", type="server"))
+    b = build(cfg, GRAPH, hosts)
+    client = jnp.asarray(np.arange(H) < clients)
+    server = jnp.asarray(np.arange(H) >= clients)
+    sip = np.zeros(H, np.int64)
+    sip[:clients] = b.ip_of("server")
+    b.sim = pingpong.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=jnp.asarray(sip), server_port=PORT, count=8, size=1000)
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    net = sim.net
+    return {
+        "qdrop": int(np.asarray(net.ctr_drop_codel)[H - 1]),
+        "overflow": int(np.asarray(net.rq_overflow)),
+        "rx": int(np.asarray(net.ctr_rx_packets)[H - 1]),
+        "last_drop": int(np.asarray(net.last_drop_status)[H - 1]),
+        "events_overflow": int(np.asarray(sim.events.overflow)),
+    }
+
+
+def test_single_queue_drops_when_occupied():
+    r = _run(RouterQ.SINGLE)
+    assert r["events_overflow"] == 0
+    assert r["qdrop"] > 0          # burst arrivals found the slot taken
+    assert r["overflow"] == 0      # drops are policy, not overflow
+    assert r["rx"] > 0             # yet traffic still flows
+    assert "ROUTER_DROPPED" in pf.pds_decode(r["last_drop"])
+
+
+def test_static_drop_tail_at_capacity():
+    r = _run(RouterQ.STATIC)
+    assert r["events_overflow"] == 0
+    assert r["qdrop"] > 0          # ring capacity 4 overruns under burst
+    assert r["overflow"] == 0
+    assert r["rx"] > 0
+    assert "ROUTER_DROPPED" in pf.pds_decode(r["last_drop"])
+
+
+def test_codel_default_keeps_ring_admission():
+    r = _run(RouterQ.CODEL)
+    assert r["events_overflow"] == 0
+    assert r["rx"] > 0
+    # a static-capacity overrun in CODEL mode surfaces as overflow,
+    # never as a silent drop — may or may not trigger at this load;
+    # the variants above prove the admission policies differ
+    assert r["qdrop"] >= 0

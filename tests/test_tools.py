@@ -1,0 +1,49 @@
+"""Log-analysis tool parity (ref: src/tools/parse-shadow.py /
+plot-shadow.py): heartbeat node lines (with the byte split), [ram]
+lines, and completion ticks parse into stats.shadow.json."""
+
+import importlib.util
+import pathlib
+
+TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def _load(name):
+    spec = importlib.util.spec_from_file_location(name, TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+LOG = """\
+00:00:10.000000000 [message] [alpha] [shadow-heartbeat] [node] 10,1000,900,800,700,200,200,0,5,5,0,0
+00:00:10.000000000 [message] [alpha] [shadow-heartbeat] [ram] 4096
+00:00:20.000000000 [message] [alpha] [shadow-heartbeat] [node] 10,1100,950,900,760,200,190,64,6,6,1,0
+00:00:30.000000000 [message] [beta] [shadow-heartbeat] [node] 10,5,6,1,2,4,4,0,1,1,0,0
+00:00:20.000000000 [message] [shadow-tpu] simulation complete {"events": 12, "simulated_seconds_per_wall_second": 3.5}
+"""
+
+LOG_V1 = """\
+00:00:10.000000000 [message] [gamma] [shadow-heartbeat] [node] 10,1000,900,5,5,0,0
+"""
+
+
+def test_parse_shadow_fields():
+    ps = _load("parse_shadow")
+    stats = ps.parse(LOG.splitlines(True))
+    a = stats["nodes"]["alpha"]
+    assert a["recv_bytes_by_second"][10] == 1000
+    assert a["send_bytes_by_second"][20] == 950
+    assert a["ram_bytes_by_second"][10] == 4096
+    assert a["retransmit_bytes_by_second"][20] == 64
+    assert a["retransmits_by_second"][20] == 1
+    assert "beta" in stats["nodes"]
+    assert stats["ticks"][0]["events"] == 12
+
+
+def test_parse_shadow_v1_format_back_compat():
+    ps = _load("parse_shadow")
+    stats = ps.parse(LOG_V1.splitlines(True))
+    g = stats["nodes"]["gamma"]
+    assert g["recv_bytes_by_second"][10] == 1000
+    assert g["drops_by_second"][10] == 0

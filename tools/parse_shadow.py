@@ -20,8 +20,18 @@ HEARTBEAT_RE = re.compile(
     r"\[(?P<host>[^\]]+)\] \[shadow-heartbeat\] \[node\] "
     r"(?P<fields>[\d,\-]+)")
 NODE_FIELDS = ["interval_seconds", "recv_bytes", "send_bytes",
+               "recv_data_bytes", "send_data_bytes",
+               "recv_control_bytes", "send_control_bytes",
+               "send_retransmit_bytes",
                "recv_packets", "send_packets", "retransmitted_segments",
                "dropped_packets"]
+# pre-byte-split logs (round-1 format) carried 7 fields
+NODE_FIELDS_V1 = ["interval_seconds", "recv_bytes", "send_bytes",
+                  "recv_packets", "send_packets",
+                  "retransmitted_segments", "dropped_packets"]
+RAM_RE = re.compile(
+    r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) \[\w+\] "
+    r"\[(?P<host>[^\]]+)\] \[shadow-heartbeat\] \[ram\] (?P<bytes>\d+)")
 TICK_RE = re.compile(
     r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) .*simulation complete "
     r"(?P<json>\{.*\})")
@@ -49,7 +59,9 @@ def parse(stream):
         if m:
             t = (int(m["h"]) * 3600 + int(m["m"]) * 60 + int(m["s"]))
             vals = [int(x) for x in m["fields"].split(",")]
-            rec = dict(zip(NODE_FIELDS, vals))
+            fields = NODE_FIELDS if len(vals) >= len(NODE_FIELDS) \
+                else NODE_FIELDS_V1
+            rec = dict(zip(fields, vals))
             node = nodes.setdefault(m["host"], {
                 "recv_bytes_by_second": {}, "send_bytes_by_second": {},
                 "retransmits_by_second": {}, "drops_by_second": {}})
@@ -57,6 +69,17 @@ def parse(stream):
             node["send_bytes_by_second"][t] = rec["send_bytes"]
             node["retransmits_by_second"][t] = rec["retransmitted_segments"]
             node["drops_by_second"][t] = rec["dropped_packets"]
+            if "send_retransmit_bytes" in rec:
+                node.setdefault("retransmit_bytes_by_second", {})[t] = \
+                    rec["send_retransmit_bytes"]
+            continue
+        m = RAM_RE.match(line)
+        if m:
+            t = (int(m["h"]) * 3600 + int(m["m"]) * 60 + int(m["s"]))
+            node = nodes.setdefault(m["host"], {
+                "recv_bytes_by_second": {}, "send_bytes_by_second": {},
+                "retransmits_by_second": {}, "drops_by_second": {}})
+            node.setdefault("ram_bytes_by_second", {})[t] = int(m["bytes"])
             continue
         m = TICK_RE.match(line)
         if m:

@@ -37,8 +37,8 @@ def main(argv=None) -> int:
         print("matplotlib unavailable; install it to plot", file=sys.stderr)
         return 1
 
-    fig, axes = plt.subplots(2, 2, figsize=(11, 7))
-    (ax_rx, ax_tx), (ax_cdf, ax_retx) = axes
+    fig, axes = plt.subplots(2, 3, figsize=(15, 7))
+    (ax_rx, ax_tx, ax_ram), (ax_cdf, ax_retx, ax_prog) = axes
 
     for path, label in args.data:
         with open(path) as f:
@@ -72,6 +72,27 @@ def main(argv=None) -> int:
                         [(i + 1) / n for i in range(n)], label=label)
             ax_cdf.set_xlabel("total recv MiB per node")
             ax_cdf.set_ylabel("CDF")
+        # RAM held in simulated buffers (ref: plot-shadow's RAM panel)
+        ram_tot: dict[int, int] = {}
+        for node, blk in stats["nodes"].items():
+            xs, ys = _series(blk, "ram_bytes_by_second")
+            for x, y in zip(xs, ys):
+                ram_tot[x] = ram_tot.get(x, 0) + y
+        if ram_tot:
+            xs = sorted(ram_tot)
+            ax_ram.plot(xs, [ram_tot[x] / (1 << 20) for x in xs],
+                        label=label)
+        ax_ram.set_xlabel("sim time (s)")
+        ax_ram.set_ylabel("buffered MiB (all nodes)")
+        # run-time progress (ref: plot-shadow's "tick" real-time
+        # panel); the LAST tick is the whole-run figure
+        sw = next((t["simulated_seconds_per_wall_second"]
+                   for t in reversed(stats.get("ticks", []))
+                   if t.get("simulated_seconds_per_wall_second")
+                   is not None), None)
+        if sw is not None:
+            ax_prog.bar([label], [sw], alpha=0.7)
+        ax_prog.set_ylabel("simulated-sec per wall-sec")
 
     for ax in axes.flat:
         ax.legend(fontsize=8)
