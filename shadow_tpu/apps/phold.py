@@ -103,7 +103,7 @@ class PholdBulk:
         H, K = d.mask.shape
         lane = net.lane_id
 
-        rc = bulkmod.rank_in_order(d.before, d.mask)   # consumed rank
+        rc = bulkmod.rank_in_order(d.order, d.mask)    # consumed rank
         app_ctr = net.rng_ctr[:, None] + 2 * rc.astype(jnp.uint32)
         u = rng.uniform_at(net.rng_keys, app_ctr)
         peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)

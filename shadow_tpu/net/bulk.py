@@ -1,13 +1,15 @@
 """Bulk window pass: process a host's whole window of UDP packet
 arrivals in ONE vectorized pass instead of one micro-step per event.
 
-This is SURVEY.md §7.2's sort+segment design, implemented without any
-sort: every order-dependent quantity is computed with masked
-compare-reduces over the [H, K, K] "event i precedes event j" relation
-(XLA fuses the broadcast compare into the reduce, so the cube is never
-materialized), and the token-bucket evolution — a chain of
-refill-then-consume steps f_i(x) = min(cap, x + dq_i*refill) - w_i —
-telescopes into the closed form
+This is SURVEY.md §7.2's sort+segment design: every order-dependent
+quantity is computed through ONE per-row lexsort of the window's
+events by the deterministic total order (EventOrder), giving
+O(K log K) ranks/prefix-sums in [H,K] working memory (an earlier
+revision used [H,K,K] compare-reduce cubes — at 100k hosts x K=64
+those are 400M-element temporaries, the scale limiter), and the
+token-bucket evolution — a chain of refill-then-consume steps
+f_i(x) = min(cap, x + dq_i*refill) - w_i — telescopes into the
+closed form
 
     F(s0) = min(s0 + (q_K - q_0)*refill - sum(w),
                 min_i [cap - w_i + (q_K - q_i)*refill - suffw_i])
@@ -72,28 +74,48 @@ I32 = jnp.int32
 I64 = jnp.int64
 
 
-def precedes(t, tie):
-    """[H,K,K] bool: in-row strict event order 'i precedes j' under the
+@dataclass(frozen=True)
+class EventOrder:
+    """Per-row sorted view of the window's event slots under the
     deterministic total order (time, then (src, seq) tie key — the
-    reference's event.c:110-153 comparator; dst is the row). Returned
-    as a broadcastable expression; use inside a single reduce so XLA
-    fuses it instead of materializing the cube."""
-    ti, tj = t[:, :, None], t[:, None, :]
-    ki, kj = tie[:, :, None], tie[:, None, :]
-    return (ti < tj) | ((ti == tj) & (ki < kj))
+    reference's event.c:110-153 comparator; dst is the row).
+
+    perm[h, p] = the slot at sorted position p (ascending);
+    inv[h, k]  = the sorted position of slot k.
+    Ties in (time, tie) cannot occur (the tie key is unique per
+    (src, seq)), so the order is total and sort stability is moot.
+    """
+
+    perm: Any   # [H,K] i32
+    inv: Any    # [H,K] i32
+
+    def _sorted(self, value):
+        return jnp.take_along_axis(value, self.perm, axis=1)
+
+    def _unsorted(self, value):
+        return jnp.take_along_axis(value, self.inv, axis=1)
 
 
-def rank_in_order(before, weight):
-    """[H,K] number of weighted events strictly preceding each slot:
-    rank_j = sum_i weight_i * before[i,j]."""
-    return jnp.sum(weight[:, :, None] & before, axis=1, dtype=I32)
+def make_order(t, tie) -> EventOrder:
+    perm = jnp.lexsort((tie, t), axis=-1).astype(I32)
+    inv = jnp.argsort(perm, axis=1).astype(I32)
+    return EventOrder(perm=perm, inv=inv)
 
 
-def suffix_sum(before, value):
-    """[H,K] sum of value_i over events strictly AFTER each slot:
-    suff_j = sum_i value_i * before[j,i]."""
-    return jnp.sum(jnp.where(before, value[:, None, :], 0), axis=2,
-                   dtype=value.dtype)
+def rank_in_order(order: EventOrder, weight):
+    """[H,K] number of weighted events strictly preceding each slot
+    under the total order (exclusive prefix count)."""
+    w = order._sorted(weight.astype(I32))
+    pref = jnp.cumsum(w, axis=1) - w
+    return order._unsorted(pref)
+
+
+def suffix_sum(order: EventOrder, value):
+    """[H,K] sum of value_i over events strictly AFTER each slot."""
+    v = order._sorted(value)
+    incl = jnp.cumsum(v, axis=1)
+    total = incl[:, -1:]
+    return order._unsorted(total - incl)
 
 
 @dataclass(frozen=True)
@@ -105,7 +127,7 @@ class BulkDeliveries:
     mask: Any       # [H,K] bool — matched, delivered-to-app arrivals
     time: Any       # [H,K] i64
     tie: Any        # [H,K] i64 order tie key
-    before: Any     # broadcastable [H,K,K] precedence (fused use only)
+    order: Any      # EventOrder over the row's slots (rank helpers)
     slot: Any       # [H,K] i32 receiving socket
     src_ip: Any     # [H,K] i64
     src_port: Any   # [H,K] i32
@@ -283,7 +305,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
 
         ev = inwin & elig[:, None]                     # events we consume
         n_ev = jnp.sum(ev, axis=1, dtype=I32)          # [H]
-        before = precedes(t, tie) & ev[:, :, None] & ev[:, None, :]
+        order = make_order(t, tie)
 
         matched = ev & (slot >= 0)
         nosock = ev & (slot < 0)
@@ -297,7 +319,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
 
         # ---- app: consume every matched delivery, stage replies ------
         d = BulkDeliveries(
-            mask=matched, time=t, tie=tie, before=before, slot=slot,
+            mask=matched, time=t, tie=tie, order=order, slot=slot,
             src_ip=src_ip, src_port=src_port, length=length, payref=payref,
         )
         sim2, sends = app_bulk.run(cfg, sim, d)
@@ -336,7 +358,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         # Per event column at most one drop occurs: a no-socket arrival
         # (which generates no reply) or a reliability-dropped reply.
         # The serial engine records the status of the LAST drop in
-        # event order; reproduce by ranking drops with `before`.
+        # event order; reproduce by ranking drops in the total order.
         nosock_status = (
             q.words[:, :, pf.W_STATUS]
             | pf.PDS_ROUTER_ENQUEUED | pf.PDS_ROUTER_DEQUEUED
@@ -348,7 +370,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         drop_any = nosock | drop
         drop_status = jnp.where(nosock, nosock_status, reply_drop_status)
         n_drop = jnp.sum(drop_any, axis=1, dtype=I32)
-        drop_rank = rank_in_order(before, drop_any)
+        drop_rank = rank_in_order(order, drop_any)
         last_col = drop_any & (drop_rank == (n_drop[:, None] - 1))
         picked_drop = jnp.sum(jnp.where(last_col, drop_status, 0), axis=1,
                               dtype=I32)
@@ -365,8 +387,8 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         w_recv = jnp.where(nonboot, wl, 0)
         w_send = jnp.where(nonboot & smask, swl, 0)
         # suffix sums in time order
-        suff_recv = suffix_sum(before, w_recv)
-        suff_send = suffix_sum(before, w_send)
+        suff_recv = suffix_sum(order, w_recv)
+        suff_send = suffix_sum(order, w_send)
         cap_r = net.tb_recv_refill + pf.MTU
         cap_s = net.tb_send_refill + pf.MTU
         big = jnp.iinfo(jnp.int64).max // 2
@@ -388,25 +410,28 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
                                     net.tb_send_refill, w_send, suff_send)
 
         # ---- outbox entries at the event's time-order column ----------
-        ord_col = rank_in_order(before, ev)            # [H,K] rank < K <= M
-        send_rank = rank_in_order(before, emit_ok)
+        ord_col = rank_in_order(order, ev)             # [H,K] rank < K <= M
+        send_rank = rank_in_order(order, emit_ok)
         seq = q.next_seq[:, None] + send_rank
         M = sim.outbox.capacity
-        colsel = emit_ok[:, :, None] & (
-            ord_col[:, :, None] == jnp.arange(M)[None, None, :])
+        # scatter each emitted reply to its time-order outbox column
+        # (ranks are unique among emit_ok, so no index collides;
+        # non-emitting events target column M and are dropped)
+        lane_h = jnp.arange(H)[:, None]
+        col = jnp.where(emit_ok, ord_col, M)
 
         def place(val, fill, dtype):
-            v = jnp.asarray(val, dtype)
-            got = jnp.any(colsel, axis=1)
-            picked = jnp.sum(jnp.where(colsel, v[:, :, None], 0), axis=1,
-                             dtype=dtype)
-            return got, jnp.where(got, picked, fill).astype(dtype)
+            base = jnp.full((H, M), fill, dtype)
+            return base.at[lane_h, col].set(
+                jnp.asarray(val, dtype), mode="drop")
 
         out = sim.outbox
-        got_col, o_dst = place(dsth, -1, I32)
-        _, o_time = place(t + lat, simtime.INVALID, I64)
-        _, o_src = place(jnp.broadcast_to(lane[:, None], (H, K)), 0, I32)
-        _, o_seq = place(seq, 0, I32)
+        got_col = jnp.zeros((H, M), bool).at[lane_h, col].set(
+            True, mode="drop")
+        o_dst = place(dsth, -1, I32)
+        o_time = place(t + lat, simtime.INVALID, I64)
+        o_src = place(jnp.broadcast_to(lane[:, None], (H, K)), 0, I32)
+        o_seq = place(seq, 0, I32)
         o_kind = jnp.where(got_col, EventKind.PACKET, 0).astype(I32)
         # reply packet words (udp_enqueue_send layout)
         wds = jnp.zeros((H, K, q.words.shape[2]), I32)
@@ -422,9 +447,8 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         wds = wds.at[:, :, pf.W_STATUS].set(
             pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED
             | pf.PDS_SND_INTERFACE_SENT | pf.PDS_INET_SENT)
-        o_words = jnp.sum(
-            jnp.where(colsel[:, :, :, None], wds[:, :, None, :], 0), axis=1,
-            dtype=I32)
+        o_words = jnp.zeros((H, M, q.words.shape[2]), I32).at[
+            lane_h, col].set(wds, mode="drop")
         keep = ~got_col
         out = out.replace(
             dst=jnp.where(keep, out.dst, o_dst),

@@ -34,9 +34,12 @@ def _mac(host: int) -> bytes:
 
 
 def _frame(src_host: int, dst_ip: int, src_ip: int, words: np.ndarray,
-           payload: bytes) -> bytes:
+           payload: bytes, true_len: int) -> tuple:
     """Ethernet + IPv4 + UDP/TCP frame from packet words (the
-    reference fabricates the same layering, pcap_writer.c)."""
+    reference fabricates the same layering, pcap_writer.c). `payload`
+    may be truncated; `true_len` is the full payload size and drives
+    the IP/UDP length fields (clamped to their 16-bit range) and the
+    record's orig_len. Returns (frame_bytes, orig_len)."""
     proto = int(words[pf.W_PROTO]) & 0xFF
     flags = (int(words[pf.W_PROTO]) >> 8) & 0xFF
     ports = int(words[pf.W_PORTS])
@@ -56,13 +59,16 @@ def _frame(src_host: int, dst_ip: int, src_ip: int, words: np.ndarray,
                          min(int(words[pf.W_WIN]), 0xFFFF), 0, 0)
         ipproto = 6
     else:
-        l4 = struct.pack(">HHHH", sport, dport, 8 + len(payload), 0)
+        l4 = struct.pack(">HHHH", sport, dport,
+                         min(8 + true_len, 0xFFFF), 0)
         ipproto = 17
-    total = 20 + len(l4) + len(payload)
+    total = min(20 + len(l4) + true_len, 0xFFFF)
     ip = struct.pack(">BBHHHBBHII", 0x45, 0, total, 0, 0, 64, ipproto, 0,
                      src_ip & 0xFFFFFFFF, dst_ip & 0xFFFFFFFF)
     eth = _mac(src_host) + _mac(0) + struct.pack(">H", 0x0800)
-    return eth + ip + l4 + payload
+    frame = eth + ip + l4 + payload
+    orig = len(eth) + len(ip) + len(l4) + true_len
+    return frame, orig
 
 
 class CaptureSession:
@@ -122,18 +128,22 @@ class CaptureSession:
                           if 0 <= src_host < len(self.host_ip) else 0)
                 length = int(words[pf.W_LEN])
                 payref = int(words[pf.W_PAYREF])
+                # keep the whole RECORD within SNAPLEN (54 bytes of
+                # fabricated eth+ip+tcp headers is the worst case)
+                max_pay = SNAPLEN - 54
                 if payref >= 0 and self.pool is not None:
                     try:
-                        payload = self.pool.get(payref)[:SNAPLEN]
+                        payload = self.pool.get(payref)[:max_pay]
                     except KeyError:
-                        payload = b"\x00" * min(length, SNAPLEN)
+                        payload = b"\x00" * min(length, max_pay)
                 else:
-                    payload = b"\x00" * min(length, SNAPLEN)
-                frame = _frame(src_host, dst_ip, src_ip, words, payload)
+                    payload = b"\x00" * min(length, max_pay)
+                frame, orig = _frame(src_host, dst_ip, src_ip, words,
+                                     payload, length)
                 t = int(cap_time[h, slot])
                 f.write(struct.pack("<IIII", t // 1_000_000_000,
                                     (t % 1_000_000_000) // 1000,
-                                    len(frame), len(frame)))
+                                    len(frame), orig))
                 f.write(frame)
                 written += 1
             self._last[h] = cap_count[h]

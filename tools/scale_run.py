@@ -1,0 +1,92 @@
+#!/usr/bin/env python3
+"""Scale harness: run PHOLD at BASELINE.json shapes (10k/100k hosts)
+and report events/s, device memory, and compile time — the evidence
+for the reference's "thousands of nodes on a single machine" claim
+(README.md:5-8) and the 100k north star.
+
+Usage:
+  PYTHONPATH=/root/repo:/root/.axon_site python tools/scale_run.py \
+      --hosts 10240 --load 8 --sim-seconds 2 [--cpu]
+
+Prints one JSON line:
+  {"hosts", "events", "wall_s", "events_per_sec", "compile_s",
+   "device_bytes", "overflow"}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=10240)
+    ap.add_argument("--load", type=int, default=8)
+    ap.add_argument("--sim-seconds", type=int, default=2)
+    ap.add_argument("--seed", type=int, default=1)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    ap.add_argument("--no-bulk", action="store_true",
+                    help="disable the bulk window pass")
+    args = ap.parse_args()
+
+    import jax
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    import pathlib
+
+    cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
+    jax.config.update("jax_compilation_cache_dir", str(cache))
+
+    import sys
+
+    import numpy as np
+
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    import bench
+    from shadow_tpu.apps import phold
+    from shadow_tpu.net.build import make_runner
+
+    b = bench._build_phold(args.hosts, args.load, args.sim_seconds,
+                           args.seed)
+    fn = make_runner(b, app_handlers=(phold.handler,),
+                     app_bulk=None if args.no_bulk else phold.BULK)
+
+    t0 = time.perf_counter()
+    sim, stats = fn(b.sim)
+    jax.block_until_ready(stats.events_processed)
+    compile_and_first = time.perf_counter() - t0
+
+    # timed run on a distinct seed (see bench.py on result caching)
+    b2 = bench._build_phold(args.hosts, args.load, args.sim_seconds,
+                            args.seed + 1)
+    jax.block_until_ready(b2.sim.net.rng_keys)
+    t0 = time.perf_counter()
+    sim, stats = fn(b2.sim)
+    ev = int(jax.device_get(stats.events_processed))
+    wall = time.perf_counter() - t0
+
+    dev_bytes = sum(a.nbytes for a in jax.live_arrays())
+    ovf = (int(jax.device_get(sim.events.overflow))
+           + int(jax.device_get(sim.outbox.overflow))
+           + int(jax.device_get(sim.net.rq_overflow)))
+    print(json.dumps({
+        "hosts": args.hosts,
+        "platform": jax.devices()[0].platform,
+        "events": ev,
+        "wall_s": round(wall, 3),
+        "events_per_sec": round(ev / wall, 1),
+        "sim_sec_per_wall_sec": round(args.sim_seconds / wall, 3),
+        "compile_s": round(compile_and_first - wall, 1),
+        "device_bytes": dev_bytes,
+        "overflow": ovf,
+    }))
+    assert int(np.asarray(sim.app.rcvd).sum()) > 0
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
