@@ -56,7 +56,10 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--socket-recv-buffer", type=int, default=174760)
     p.add_argument("--socket-send-buffer", type=int, default=131072)
     p.add_argument("--tcp-congestion-control", default="reno",
-                   choices=["reno"])
+                   choices=["reno", "aimd", "cubic"],
+                   help="congestion algorithm (ref: the tcp_cong.h "
+                        "hook vtable; the reference implements only "
+                        "reno, the vtable was designed for all three)")
     p.add_argument("-l", "--log-level", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
@@ -116,6 +119,7 @@ def main(argv=None) -> int:
         "router_qdisc": args.router_qdisc,
         "socket_recv_buffer": args.socket_recv_buffer,
         "socket_send_buffer": args.socket_send_buffer,
+        "tcp_congestion_control": args.tcp_congestion_control,
         "runahead": args.runahead,
         "sockets_per_host": args.sockets_per_host,
         "event_capacity": args.event_capacity,

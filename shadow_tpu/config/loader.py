@@ -14,6 +14,7 @@ import numpy as np
 from shadow_tpu.config.xmlconfig import ShadowConfig, kv_arguments
 from shadow_tpu.core import simtime
 from shadow_tpu.net.build import HostSpec, SimBundle, build
+from shadow_tpu.net import tcp_cong
 from shadow_tpu.net.state import NetConfig, QDisc, RouterQ
 
 # plugin name -> configure(bundle, assignments) -> handlers tuple.
@@ -131,16 +132,24 @@ register_plugin("shadow-plugin-test-phold", _configure_phold)
 def _tcp_stream_hints(assignments):
     # a conservative window can deliver a full receive window of
     # in-flight segments at once (rcvbuf/MSS ~ 122 at the default
-    # 174760 B buffer); provision the event rows / outbox / router
-    # ring for that burst (SURVEY.md §7.4.6 capacity policy).
+    # 174760 B buffer), and a fan-in server absorbs bursts from MANY
+    # concurrent senders (whose windows autotune toward the path BDP
+    # and, under cubic, overshoot reno's growth) — provision the event
+    # rows / outbox / router ring for the aggregate burst
+    # (SURVEY.md §7.4.6 capacity policy; overflow is counted, never
+    # silent, if these still prove small).
     # sockets_per_host: a many-client server needs listener + active
     # child + a full accept backlog of spawned children at once
     # (ACCEPT_QUEUE=4); 8 slots covers that with headroom, and SYN
     # retry backpressure handles anything beyond it.
     # tcp True: in a mixed config (e.g. bulk + pingpong) the
     # max-merge over plugin hints must keep the TCP machine
-    return {"event_capacity": 256, "outbox_capacity": 256,
-            "router_ring": 256, "sockets_per_host": 8, "tcp": True}
+    n_clients = sum(
+        1 for _, spec in assignments
+        if kv_arguments(spec.arguments).get("mode", "client") != "server")
+    cap = min(4096, max(256, 64 * max(n_clients, 1)))
+    return {"event_capacity": cap, "outbox_capacity": cap,
+            "router_ring": cap, "sockets_per_host": 8, "tcp": True}
 
 
 _configure_bulk.hints = _tcp_stream_hints
@@ -241,6 +250,8 @@ def load(config: ShadowConfig, *, seed: int = 1,
         router_qdisc={"codel": RouterQ.CODEL, "single": RouterQ.SINGLE,
                       "static": RouterQ.STATIC}[rq_name],
         pcap=want_pcap,
+        tcp_cong=tcp_cong.NAMES[
+            overrides.get("tcp_congestion_control", "reno")],
         sndbuf=sndbuf,
         rcvbuf=rcvbuf,
         **{k: v for k, v in overrides.items()

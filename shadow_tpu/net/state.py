@@ -109,6 +109,10 @@ class NetConfig:
                                  # autotuning (make_net_state), matching
                                  # the reference's user-override rule
                                  # (master.c:355-364)
+    tcp_cong: int = 0            # congestion algorithm (tcp_cong.NAMES:
+                                 # reno/aimd/cubic — the reference's
+                                 # --tcp-congestion-control knob backed
+                                 # by the tcp_cong.h vtable design)
     tcp: bool = True             # False skips building TcpState and
                                  # inlining the TCP machine into the
                                  # device program (UDP-only workloads

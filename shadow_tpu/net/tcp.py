@@ -65,6 +65,7 @@ from flax import struct
 from shadow_tpu.core import simtime
 from shadow_tpu.core.events import NWORDS, EventKind, emit
 from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net import tcp_cong as cong
 from shadow_tpu.net.rings import gather_hs, set_hs, set_ring
 from shadow_tpu.net.sockets import sk_bind, sk_enqueue_out, set_writable
 from shadow_tpu.net.state import NetConfig, NetState, SocketFlags, SocketType
@@ -144,6 +145,10 @@ class TcpState:
     ca_acc: jax.Array      # [H,S] i32 congestion-avoidance accumulator
     in_recovery: jax.Array  # [H,S] bool fast recovery
     recover: jax.Array     # [H,S] i32 recovery point
+    # cubic curve state (tcp_cong.py; unused under reno/aimd —
+    # the reference's per-algorithm `ca` blob, tcp_cong.h:28)
+    cub_wmax: jax.Array     # [H,S] i32 window before last loss
+    cub_epoch_ms: jax.Array  # [H,S] i32 epoch start (-1 = unset)
     # peer-sacked ranges (scoreboard = the advertised list; r<=l =
     # empty slot). Ref: tcp_retransmit_tally.cc interval sets.
     sack_l: jax.Array      # [H,S,SACK_RANGES] i32
@@ -210,6 +215,7 @@ class TcpState:
             cwnd=jnp.full((H, S), INIT_CWND, I32),
             ssthresh=jnp.full((H, S), INIT_SSTHRESH, I32),
             ca_acc=zi, in_recovery=zb, recover=zi,
+            cub_wmax=zi, cub_epoch_ms=jnp.full((H, S), -1, I32),
             sack_l=jnp.zeros((H, S, SACK_RANGES), I32),
             sack_r=jnp.zeros((H, S, SACK_RANGES), I32),
             rcv_nxt=zi, app_rbytes=zi, fin_rcvd=zb, fin_rseq=zi,
@@ -586,6 +592,9 @@ def _free_socket(cfg, sim, mask, slot):
                jnp.full(mask.shape, INIT_SSTHRESH, I32))
     tcp = _set(tcp, "ca_acc", mask, slot, zero)
     tcp = _set(tcp, "in_recovery", mask, slot, False)
+    tcp = _set(tcp, "cub_wmax", mask, slot, zero)
+    tcp = _set(tcp, "cub_epoch_ms", mask, slot,
+               jnp.full(mask.shape, -1, I32))
     tcp = _set(tcp, "rcv_nxt", mask, slot, zero)
     tcp = _set(tcp, "app_rbytes", mask, slot, zero)
     tcp = _set(tcp, "fin_rcvd", mask, slot, False)
@@ -949,10 +958,13 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     tcp = _set(tcp, "rto_ms", new_ack & (tsecho > 0), slot, rto_n)
     tcp = _set(tcp, "backoff", new_ack, slot, jnp.zeros((H,), I32))
 
-    # Reno new-ack (ref: tcp_cong_reno.c). The hooks are fed the
-    # NUMBER OF PACKETS the ACK covers (ref: tcp.c:1710-1717
-    # nPacketsAcked) — essential under delayed-ACK coalescing, where
-    # one ACK may cover many segments.
+    # New-ack congestion hooks (ref: tcp_cong.h vtable; reno in
+    # tcp_cong_reno.c — algorithm chosen by cfg.tcp_cong at build
+    # time, see net/tcp_cong.py). The hooks are fed the NUMBER OF
+    # PACKETS the ACK covers (ref: tcp.c:1710-1717 nPacketsAcked) —
+    # essential under delayed-ACK coalescing, where one ACK may cover
+    # many segments.
+    alg = cfg.tcp_cong
     in_rec = gather_hs(tcp.in_recovery, slot)
     recover = gather_hs(tcp.recover, slot)
     cwnd = gather_hs(tcp.cwnd, slot)
@@ -964,8 +976,9 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     partial = new_ack & in_rec & (ack < recover)
     normal = new_ack & ~in_rec
 
-    # slow start: cwnd += n, spilling leftover acks into congestion
-    # avoidance at ssthresh (ref: ca_reno_slow_start_new_ack_ev_)
+    # slow start (common to all algorithms): cwnd += n, spilling
+    # leftover acks into congestion avoidance at ssthresh
+    # (ref: ca_reno_slow_start_new_ack_ev_)
     ss = normal & (cwnd < ssth)
     grown = cwnd + n_acked
     spill = ss & (grown >= ssth)
@@ -978,15 +991,13 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
     in_ca = (normal & ~ss) | spill | full_rec
     # transitions reset the CA accumulator (transition_to_cong_avoid)
     ca_base = jnp.where(spill | full_rec, 0, ca)
-    ca1 = jnp.where(in_ca, ca_base + ca_in, ca)
-    # +1 cwnd per full window of acked packets (bounded unroll of the
-    # reference's while loop; any residue carries to the next ACK)
-    for _ in range(4):
-        inc = in_ca & (ca1 >= cwnd1)
-        ca1 = jnp.where(inc, ca1 - cwnd1, ca1)
-        cwnd1 = jnp.where(inc, cwnd1 + 1, cwnd1)
+    cwnd1, ca1, epoch1 = cong.ca_update(
+        alg, in_ca, cwnd1, jnp.where(in_ca, ca_base, ca), ca_in,
+        gather_hs(tcp.cub_wmax, slot),
+        gather_hs(tcp.cub_epoch_ms, slot), _ms(now))
     tcp = _set(tcp, "cwnd", new_ack, slot, cwnd1)
     tcp = _set(tcp, "ca_acc", new_ack, slot, ca1)
+    tcp = _set(tcp, "cub_epoch_ms", in_ca, slot, epoch1)
     tcp = _set(tcp, "in_recovery", full_rec, slot, False)
     tcp = _set(tcp, "dup_acks", new_ack, slot, jnp.zeros((H,), I32))
     tcp = _set(tcp, "snd_una", new_ack, slot, ack)
@@ -1049,19 +1060,29 @@ def tcp_packet_in(cfg: NetConfig, sim, mask, slot, words, src_ip, src_port,
         - (gather_hs(tcp.snd_end, slot) - ack) > 0)
     net = set_writable(net, wroom, slot, True)
 
-    # dup-ack counting / fast retransmit (ref: reno dupack_ev)
+    # dup-ack counting / fast retransmit (ref: the dup-ack hook,
+    # tcp_cong.h; reno dupack_ev — ssthresh/entry cwnd come from the
+    # configured algorithm)
     da = gather_hs(tcp.dup_acks, slot) + 1
     tcp = _set(tcp, "dup_acks", dup_ack, slot, da)
     enter_fr = dup_ack & (da == 3) & ~in_rec
-    ssth_fr = cwnd // 2 + 1        # ref: ssthresh_halve
+    ssth_fr = cong.ssthresh_on_loss(alg, cwnd)
     tcp = _set(tcp, "ssthresh", enter_fr, slot, ssth_fr)
-    tcp = _set(tcp, "cwnd", enter_fr, slot, ssth_fr + 3)
+    tcp = _set(tcp, "cwnd", enter_fr, slot,
+               cong.cwnd_on_recovery_entry(alg, ssth_fr))
+    wmax1, ep1 = cong.on_loss_event(
+        alg, enter_fr, cwnd, gather_hs(tcp.cub_wmax, slot),
+        gather_hs(tcp.cub_epoch_ms, slot))
+    tcp = _set(tcp, "cub_wmax", enter_fr, slot, wmax1)
+    tcp = _set(tcp, "cub_epoch_ms", enter_fr, slot, ep1)
     tcp = _set(tcp, "in_recovery", enter_fr, slot, True)
     tcp = _set(tcp, "recover", enter_fr, slot, nxt)
     tcp = tcp.replace(fr_entries=tcp.fr_entries + enter_fr.astype(I64))
-    # window inflation while in recovery
-    inflate = dup_ack & in_rec
-    tcp = _set(tcp, "cwnd", inflate, slot, gather_hs(tcp.cwnd, slot) + 1)
+    # window inflation while in recovery (classic AIMD forgoes it)
+    if alg != cong.AIMD:
+        inflate = dup_ack & in_rec
+        tcp = _set(tcp, "cwnd", inflate, slot,
+                   gather_hs(tcp.cwnd, slot) + 1)
 
     sim = sim.replace(net=net, tcp=tcp)
     sim, buf, _, _ = _retransmit_one(cfg, sim, enter_fr | partial, slot, now, buf)
@@ -1306,9 +1327,17 @@ def handle_tcp_rtx(cfg: NetConfig, sim, popped, buf):
     # (the due-lane disarm below clears this fire's event; the final
     # _arm_rtx re-arms both the loss retransmit and the probe)
     cwnd = gather_hs(tcp.cwnd, slot)
-    tcp = _set(tcp, "ssthresh", live, slot, cwnd // 2 + 1)
+    # timeout hook (ref: reno timeout_ev): ssthresh from the
+    # configured algorithm, restart from RESTART_CWND
+    tcp = _set(tcp, "ssthresh", live, slot,
+               cong.ssthresh_on_loss(cfg.tcp_cong, cwnd))
     tcp = _set(tcp, "cwnd", live, slot,
                jnp.full((H,), RESTART_CWND, I32))
+    wmax_t, ep_t = cong.on_loss_event(
+        cfg.tcp_cong, live, cwnd, gather_hs(tcp.cub_wmax, slot),
+        gather_hs(tcp.cub_epoch_ms, slot))
+    tcp = _set(tcp, "cub_wmax", live, slot, wmax_t)
+    tcp = _set(tcp, "cub_epoch_ms", live, slot, ep_t)
     tcp = _set(tcp, "ca_acc", live, slot, jnp.zeros((H,), I32))
     tcp = _set(tcp, "in_recovery", live, slot, False)
     tcp = _set(tcp, "dup_acks", live, slot, jnp.zeros((H,), I32))

@@ -1,0 +1,101 @@
+"""Pluggable congestion control — the device form of the reference's
+hook vtable (ref: tcp_cong.h:10-32 {duplicate_ack_ev, fast_recovery,
+new_ack_ev, timeout_ev, ssthresh} + {cwnd, ca} state, designed for
+aimd/reno/cubic with only reno implemented there; tcp_cong_reno.c).
+
+Here an algorithm is a namespace of pure masked-update functions
+chosen at build time by NetConfig.tcp_cong (one algorithm per run —
+the vtable's per-socket indirection costs nothing to add later since
+dispatch is a trace-time Python branch). The recovery MECHANICS
+(dup-ack counting, recovery point, partial-ack retransmit, window
+inflation) stay in tcp.py exactly as the reference keeps them in
+tcp.c; the hooks only decide cwnd/ssthresh arithmetic:
+
+- reno  (ref: tcp_cong_reno.c): slow start cwnd+=1/ACK; CA +1 per
+  cwnd of acked packets; loss ssthresh = cwnd/2+1, enter recovery at
+  ssthresh+3 with dup-ack inflation.
+- aimd: classic AIMD — same slow start/CA, but recovery entry
+  deflates straight to ssthresh (no +3 or inflation credit).
+- cubic: concave/convex window curve W(t) = C*(t-K)^3 + W_max with
+  C=0.4, beta=0.7 (RFC 9438 shapes, packet units; the TCP-friendly
+  region and HyStart are omitted — documented deviation). Growth per
+  ACK is clamped to the acked-packet count, so the curve is chased at
+  most one packet per delivered packet.
+
+All cubic arithmetic is f32-on-device; runs are deterministic per
+platform (like the reference's doubles).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+I32 = jnp.int32
+F32 = jnp.float32
+
+RENO = 0
+AIMD = 1
+CUBIC = 2
+
+NAMES = {"reno": RENO, "aimd": AIMD, "cubic": CUBIC}
+
+CUBIC_C = 0.4
+CUBIC_BETA = 0.7
+
+
+def ssthresh_on_loss(alg: int, cwnd):
+    """New ssthresh when loss is detected (fast-recovery entry and
+    RTO timeout; ref: reno ssthresh_halve = cwnd/2+1)."""
+    if alg == CUBIC:
+        return jnp.maximum((cwnd.astype(F32) * CUBIC_BETA).astype(I32), 2)
+    return cwnd // 2 + 1
+
+
+def cwnd_on_recovery_entry(alg: int, ssth):
+    """cwnd on entering fast recovery (ref: reno fast_recovery:
+    ssthresh + 3 dup-acked segments)."""
+    if alg == AIMD:
+        return ssth
+    return ssth + 3
+
+
+def ca_update(alg: int, mask, cwnd, ca_acc, n_acked, cub_wmax,
+              cub_epoch_ms, now_ms):
+    """Congestion-avoidance growth for ACKs covering n_acked packets.
+    Returns (cwnd', ca_acc', cub_epoch_ms') — only `mask` lanes
+    change. For reno/aimd this is the accumulator form of +1 cwnd per
+    full window acked (ref: ca_reno_cong_avoid_new_ack_ev_); cubic
+    chases its time-based curve instead."""
+    if alg in (RENO, AIMD):
+        ca1 = ca_acc + jnp.where(mask, n_acked, 0)
+        cwnd1 = cwnd
+        for _ in range(4):
+            inc = mask & (ca1 >= cwnd1)
+            ca1 = jnp.where(inc, ca1 - cwnd1, ca1)
+            cwnd1 = jnp.where(inc, cwnd1 + 1, cwnd1)
+        return cwnd1, ca1, cub_epoch_ms
+
+    # ---- cubic ------------------------------------------------------
+    # epoch starts at the first CA ack after a loss (epoch_ms < 0)
+    fresh = mask & (cub_epoch_ms < 0)
+    epoch = jnp.where(fresh, now_ms, cub_epoch_ms)
+    wmax = jnp.maximum(cub_wmax, 2).astype(F32)
+    # K = cbrt(W_max * (1-beta) / C) seconds
+    k_s = jnp.cbrt(wmax * (1.0 - CUBIC_BETA) / CUBIC_C)
+    t_s = jnp.maximum(now_ms - epoch, 0).astype(F32) / 1000.0
+    target = CUBIC_C * (t_s - k_s) ** 3 + wmax
+    target_i = jnp.maximum(target, 2.0).astype(I32)
+    # chase the curve, at most one packet per acked packet, never shrink
+    cwnd1 = jnp.clip(target_i, cwnd, cwnd + n_acked)
+    cwnd1 = jnp.where(mask, cwnd1, cwnd)
+    return cwnd1, ca_acc, jnp.where(mask, epoch, cub_epoch_ms)
+
+
+def on_loss_event(alg: int, mask, cwnd, cub_wmax, cub_epoch_ms):
+    """Algorithm state updates shared by fast-recovery entry and RTO
+    (cubic records W_max and resets its epoch; reno/aimd keep no
+    extra state). Returns (cub_wmax', cub_epoch_ms')."""
+    if alg != CUBIC:
+        return cub_wmax, cub_epoch_ms
+    return (jnp.where(mask, cwnd, cub_wmax),
+            jnp.where(mask, -1, cub_epoch_ms))

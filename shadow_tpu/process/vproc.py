@@ -329,10 +329,12 @@ class ProcessRuntime:
         self.procs: list[_Proc] = []
         self._step = make_step_fn(self.cfg, app_handlers)
         self._jit_window = jax.jit(self._window)
-        # host-side snapshot of sk_flags, fetched at most once between
-        # state mutations (readiness polls would otherwise do one
-        # device->host transfer per watch per resume)
+        # host-side snapshots of sk_flags / tcp.st, fetched at most
+        # once between state mutations (readiness polls and blocked-
+        # syscall retries would otherwise do one device->host transfer
+        # per process per window)
         self._flags_cache = None
+        self._tcp_st_cache = None
         # --- payload content (ref: payload.c) -------------------------
         # UDP datagram bytes live in the refcounted pool; the device
         # packet carries the pool id (W_PAYREF). TCP stream bytes live
@@ -399,6 +401,7 @@ class ProcessRuntime:
                                  sim.net.lane_id)
         self.sim = sim.replace(events=q, outbox=out)
         self._flags_cache = None
+        self._tcp_st_cache = None
 
     # -- payload content helpers ----------------------------------------
 
@@ -439,6 +442,17 @@ class ProcessRuntime:
 
     def _flags_row(self, host):
         return self._net_rows()[0][host]
+
+    def _tcp_st(self, host, fd) -> int:
+        """TCP state read through the per-window host-side cache (one
+        device fetch per invalidation instead of one per blocked
+        connect per window)."""
+        if self._tcp_st_cache is None:
+            self._tcp_st_cache = np.asarray(self.sim.tcp.st)
+        return int(self._tcp_st_cache[host, fd])
+
+    def _sk_flag(self, host, fd, bit) -> bool:
+        return bool(int(self._flags_row(host)[fd]) & bit)
 
     def _fd_gens(self, p: _Proc, fd: int, _depth: int = 0):
         """(in_gen, out_gen) of a socket fd; for a nested epoll, the
@@ -525,6 +539,7 @@ class ProcessRuntime:
             net, slot = sk_create(self.sim.net, mask, a[0])
             self.sim = self.sim.replace(net=net)
             self._flags_cache = None
+            self._tcp_st_cache = None
             return True, int(slot[h])
         if op == "epoll_create":
             epfd = p.next_epfd
@@ -577,6 +592,8 @@ class ProcessRuntime:
         if op == "listen":
             self.sim = tcpmod.tcp_listen(self.sim, mask,
                                          jnp.full_like(mask, a[0], I32))
+            self._flags_cache = None
+            self._tcp_st_cache = None
             return True, 0
         if op == "gettime":
             return True, now
@@ -594,9 +611,15 @@ class ProcessRuntime:
 
             self._apply(do, now)
             return True, bool(ok[h])
+        # Blocking-syscall retries are gated on host-side cached
+        # readiness, so a blocked process costs NO device dispatch per
+        # window unless its call can actually progress (the batching
+        # SURVEY.md §7.4.4 requires; the readiness bits are exactly
+        # what the reference's epoll notify would check before
+        # process_continue, epoll.c:583-680).
         if op == "connect":
             fd, ip, port = a
-            st = int(self.sim.tcp.st[h, fd])
+            st = self._tcp_st(h, fd)
             if p.block is None:
                 # issue the SYN, then block until established
                 self._apply(lambda sim, buf: tcpmod.tcp_connect(
@@ -610,6 +633,10 @@ class ProcessRuntime:
             return False, None
         if op == "accept":
             fd = a[0]
+            # listener readable iff children are queued (tcp_accept
+            # maintains the bit) — skip the device pop otherwise
+            if not self._sk_flag(h, fd, SocketFlags.READABLE):
+                return False, None
             child = None
 
             def do(sim, buf):
@@ -625,6 +652,10 @@ class ProcessRuntime:
             return False, None
         if op == "send":
             fd, n = a
+            # WRITABLE is cleared when the stream buffer fills and
+            # restored by ACK progress (tcp_send / the ACK path)
+            if not self._sk_flag(h, fd, SocketFlags.WRITABLE):
+                return False, None
             acc = None
 
             def do(sim, buf):
@@ -688,6 +719,8 @@ class ProcessRuntime:
             return False, None
         if op == "send_data":
             fd, data = a
+            if not self._sk_flag(h, fd, SocketFlags.WRITABLE):
+                return False, None    # see "send": retry gating
             key = self._stream_key(p, fd, sending=True)
             acc = None
 
@@ -706,6 +739,10 @@ class ProcessRuntime:
             return False, None
         if op == "recv_data":
             fd, maxb = a
+            # READABLE covers both pending data and a consumed FIN
+            # (EOF keeps it set; tcp_recv clears only drained-not-eof)
+            if not self._sk_flag(h, fd, SocketFlags.READABLE):
+                return False, None
             key = self._stream_key(p, fd, sending=False)
             nread = eof = None
 
@@ -755,6 +792,8 @@ class ProcessRuntime:
             return True, queued
         if op == "recvfrom_data":
             fd = a[0]
+            if not self._sk_flag(h, fd, SocketFlags.READABLE):
+                return False, None
             res = None
             got_any = False
 
@@ -778,9 +817,11 @@ class ProcessRuntime:
             return False, None
         if op == "recv":
             fd, maxb = a
+            if not self._sk_flag(h, fd, SocketFlags.READABLE):
+                return False, None    # retry gating: no data, no EOF
             is_tcp = self.sim.tcp is not None and (
                 int(self.sim.net.sk_type[h, fd]) == SocketType.TCP
-                or int(self.sim.tcp.st[h, fd]) != 0)
+                or self._tcp_st(h, fd) != 0)
             if is_tcp:
                 nread = eof = None
 
@@ -820,6 +861,8 @@ class ProcessRuntime:
             return False, None
         if op == "recvfrom":
             fd = a[0]
+            if not self._sk_flag(h, fd, SocketFlags.READABLE):
+                return False, None
             res = None
             got_any = False
             pref = -1
@@ -890,6 +933,7 @@ class ProcessRuntime:
                 )
                 self.sim = self.sim.replace(net=net)
                 self._flags_cache = None
+                self._tcp_st_cache = None
             return True, 0
         if op == "sleep":
             if p.block is None:
@@ -1050,6 +1094,7 @@ class ProcessRuntime:
             # drop the host-side snapshot or blocked epoll_wait /
             # wait_readable polls read stale readiness forever
             self._flags_cache = None
+            self._tcp_st_cache = None
             total = EngineStats(
                 events_processed=total.events_processed
                 + stats.events_processed,

@@ -69,7 +69,11 @@ def main() -> int:
     ev = int(jax.device_get(stats.events_processed))
     wall = time.perf_counter() - t0
 
-    dev_bytes = sum(a.nbytes for a in jax.live_arrays())
+    # ONE resident sim state's device footprint (summing all live
+    # arrays would also count the warmup build + inputs, ~3x over)
+    dev_bytes = sum(
+        leaf.nbytes for leaf in jax.tree_util.tree_leaves(sim)
+        if hasattr(leaf, "nbytes"))
     ovf = (int(jax.device_get(sim.events.overflow))
            + int(jax.device_get(sim.outbox.overflow))
            + int(jax.device_get(sim.net.rq_overflow)))
