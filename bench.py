@@ -112,7 +112,33 @@ def _pingpong_runner(H, sim_s):
     return go
 
 
+def _probe_backend() -> None:
+    """The axon TPU tunnel can wedge (backend init hangs forever, no
+    error). Probe device init in a subprocess with a timeout; if it
+    hangs or dies, force the CPU backend via jax.config BEFORE this
+    process touches a backend — a slow benchmark beats a hung one."""
+    import subprocess
+    import sys
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            env=dict(os.environ), capture_output=True, text=True,
+            timeout=180)
+        if r.returncode == 0 and "ok" in r.stdout:
+            return
+    except subprocess.TimeoutExpired:
+        pass
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    print("WARNING: device backend unresponsive; benchmarking on CPU",
+          file=sys.stderr)
+
+
 def main() -> None:
+    _probe_backend()
     workload = os.environ.get("BENCH_WORKLOAD", "phold")
     H = int(os.environ.get("BENCH_HOSTS", "1024"))
     sim_s = int(os.environ.get("BENCH_SIM_SECONDS", "5"))

@@ -182,6 +182,31 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     return sim, stats
 
 
+def _harness_specs(mesh: Mesh, axis: str, sim):
+    """Shared shard_map harness pieces: divisibility check + Sim and
+    stats PartitionSpecs (used by both the whole-run and per-window
+    wrappers — keep them identical)."""
+    num_shards = mesh.shape[axis]
+    H = sim.events.num_hosts
+    if H % num_shards != 0:
+        raise ValueError(f"num_hosts={H} not divisible by {num_shards} shards")
+    specs = sim_specs(sim, axis)
+    stats_specs = EngineStats(
+        events_processed=P(), micro_steps=P(), windows=P()
+    )
+    return num_shards, specs, stats_specs
+
+
+def _sharded_route_fn(axis: str, num_shards: int, lane,
+                      exchange_capacity: int | None):
+    """The window-boundary all-to-all as an engine route_fn."""
+    def route(s):
+        q, out = route_outbox_sharded(s.events, s.outbox, axis, num_shards,
+                                      lane, exchange_capacity)
+        return s.replace(events=q, outbox=out)
+    return route
+
+
 def sharded_engine_run(
     mesh: Mesh,
     axis: str,
@@ -201,14 +226,7 @@ def sharded_engine_run(
     global host ids of the shard's rows (defaults to sim.net.lane_id).
 
     Returns (sim, stats) with global arrays reassembled."""
-    num_shards = mesh.shape[axis]
-    H = sim.events.num_hosts
-    if H % num_shards != 0:
-        raise ValueError(f"num_hosts={H} not divisible by {num_shards} shards")
-    specs = sim_specs(sim, axis)
-    stats_specs = EngineStats(
-        events_processed=P(), micro_steps=P(), windows=P()
-    )
+    num_shards, specs, stats_specs = _harness_specs(mesh, axis, sim)
 
     def _body(local_sim):
         lane = (lane_id_fn(local_sim) if lane_id_fn is not None
@@ -220,11 +238,8 @@ def sharded_engine_run(
             min_jump=min_jump,
             emit_capacity=emit_capacity,
             lane_id=lane,
-            route_fn=lambda s: s.replace(**dict(zip(
-                ("events", "outbox"),
-                route_outbox_sharded(s.events, s.outbox, axis, num_shards,
-                                     lane, exchange_capacity),
-            ))),
+            route_fn=_sharded_route_fn(axis, num_shards, lane,
+                                       exchange_capacity),
             min_fn=lambda x: lax.pmin(x, axis),
             bulk_fn=bulk_fn,
         )
@@ -243,6 +258,38 @@ def sharded_engine_run(
                                 is_leaf=lambda x: isinstance(x, P))
     sim = jax.device_put(sim, in_shardings)
     return jax.jit(shmapped)(sim)
+
+
+def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
+                        exchange_capacity: int | None = None):
+    """A jitted (sim, wend) -> (sim, stats, next_min) running ONE
+    window round under shard_map — the building block for host-driven
+    window loops (ProcessRuntime, checkpoint.run_windows) on a mesh.
+    next_min is replicated by the pmin barrier; `sim` may be passed
+    unsharded on first call (jit reshards per sim_specs)."""
+    from shadow_tpu.core.engine import step_window
+
+    num_shards, specs, stats_specs = _harness_specs(mesh, axis,
+                                                    sim_template)
+
+    def _body(local_sim, wend):
+        lane = local_sim.net.lane_id
+        stats = EngineStats.create()
+        out_sim, stats, next_min = step_window(
+            local_sim, stats, step_fn, wend,
+            emit_capacity=cfg.emit_capacity, lane_id=lane,
+            route_fn=_sharded_route_fn(axis, num_shards, lane,
+                                       exchange_capacity),
+            min_fn=lambda x: lax.pmin(x, axis),
+        )
+        out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
+        return out_sim, stats, next_min
+
+    shmapped = jax.shard_map(
+        _body, mesh=mesh, in_specs=(specs, P()),
+        out_specs=(specs, stats_specs, P()), check_vma=False,
+    )
+    return jax.jit(shmapped)
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),

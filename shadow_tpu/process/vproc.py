@@ -322,13 +322,25 @@ class ProcessRuntime:
     of the reference, slave.c:413-466, with coroutine continuation in
     place of pth scheduling)."""
 
-    def __init__(self, bundle, app_handlers=()):
+    def __init__(self, bundle, app_handlers=(), mesh=None, axis="hosts"):
+        """`mesh`: optional jax.sharding.Mesh — the window loop then
+        runs under shard_map with the all-to-all exchange + pmin
+        barrier (parallel/shard.py), hosts sharded over `axis`.
+        Syscall application stays host-driven; its array updates
+        operate on the sharded state transparently."""
         self.bundle = bundle
         self.cfg: NetConfig = bundle.cfg
         self.sim = bundle.sim
         self.procs: list[_Proc] = []
         self._step = make_step_fn(self.cfg, app_handlers)
-        self._jit_window = jax.jit(self._window)
+        if mesh is not None:
+            from shadow_tpu.parallel.shard import make_sharded_window
+
+            win = make_sharded_window(mesh, axis, bundle.sim, self.cfg,
+                                      self._step)
+            self._jit_window = lambda sim, wstart, wend: win(sim, wend)
+        else:
+            self._jit_window = jax.jit(self._window)
         # host-side snapshots of sk_flags / tcp.st, fetched at most
         # once between state mutations (readiness polls and blocked-
         # syscall retries would otherwise do one device->host transfer
