@@ -114,6 +114,16 @@ def main(argv=None) -> int:
 
     logger = SimLogger(level=level_from_name(args.log_level))
     cfg = parse_config(text)
+    # a relative <topology path> is relative to the CONFIG FILE, not
+    # the cwd (so `shadow-tpu some/dir/shadow.config.xml` works from
+    # anywhere — the reference resolves the same way)
+    if args.config and cfg.topology_path \
+            and not os.path.isabs(cfg.topology_path):
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, topology_path=os.path.join(
+            os.path.dirname(os.path.abspath(args.config)),
+            cfg.topology_path))
     overrides = {
         "interface_qdisc": args.interface_qdisc,
         "router_qdisc": args.router_qdisc,

@@ -21,17 +21,26 @@ EXAMPLE_GRAPHML = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
+def example_body(clients: int, kib: int, server_attrs: str = "",
+                 client_attrs: str = "") -> str:
+    """The plugin + hosts of the canonical bulk-download example —
+    the single source of truth shared by `--test` (inline topology)
+    and tools/generate_example_config.py (path topology +
+    attachment-hint attrs)."""
+    return f"""  <plugin id="filex" path="bulk"/>
+  <host id="server" bandwidthdown="102400" bandwidthup="102400"{server_attrs}>
+    <process plugin="filex" starttime="1" arguments="mode=server port=80"/>
+  </host>
+  <host id="client" quantity="{clients}"{client_attrs}>
+    <process plugin="filex" starttime="2"
+      arguments="mode=client server=server port=80 bytes={kib * 1024}"/>
+  </host>"""
+
+
 def example_config(clients: int = 100, kib: int = 330,
                    stoptime: int = 60) -> str:
     """ref: example_getTestContents (examples.c:10-30)."""
     return f"""<shadow stoptime="{stoptime}">
   <topology><![CDATA[{EXAMPLE_GRAPHML}]]></topology>
-  <plugin id="filex" path="bulk"/>
-  <host id="server" bandwidthdown="102400" bandwidthup="102400">
-    <process plugin="filex" starttime="1" arguments="mode=server port=80"/>
-  </host>
-  <host id="client" quantity="{clients}">
-    <process plugin="filex" starttime="2"
-      arguments="mode=client server=server port=80 bytes={kib * 1024}"/>
-  </host>
+{example_body(clients, kib)}
 </shadow>"""

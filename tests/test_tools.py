@@ -47,3 +47,95 @@ def test_parse_shadow_v1_format_back_compat():
     g = stats["nodes"]["gamma"]
     assert g["recv_bytes_by_second"][10] == 1000
     assert g["drops_by_second"][10] == 0
+
+
+def test_strip_log_for_compare():
+    """Wall-time fields and address-like tokens are canonicalized;
+    sim-time determinism content is preserved (ref:
+    strip_log_for_compare.py + determinism1_compare.cmake)."""
+    st = _load("strip_log_for_compare")
+    a = ('00:00:20.000000000 [message] [shadow-tpu] simulation complete '
+         '{"events": 12, "wall_seconds": 53.47, "events_per_second": '
+         '157.7, "simulated_seconds_per_wall_second": 1.122, '
+         '"overflow": 0}\n')
+    b = a.replace("53.47", "99.9").replace("157.7", "3.3").replace(
+        "1.122", "0.5")
+    assert st.strip_line(a) == st.strip_line(b)
+    assert '"events": 12' in st.strip_line(a)
+    assert st.strip_line("obj at 0xDEADBEEF ok\n") == "obj at 0xX ok\n"
+    # heartbeat counters are NOT stripped (determinism contract)
+    hb = "00:00:10.0 [message] [a] [shadow-heartbeat] [node] 10,1,2\n"
+    assert st.strip_line(hb) == hb
+
+
+def test_convert_legacy_config_runs_through_loader():
+    """node/application + kill-time configs convert to host/process
+    and the result builds (ref: convert_multi_app.py migration)."""
+    cv = _load("convert_legacy_config")
+    old = """<shadow>
+  <kill time="30"/>
+  <topology><![CDATA[x]]></topology>
+  <plugin id="png" path="pingpong"/>
+  <node id="server"><application plugin="png" starttime="1"
+    arguments="mode=server port=5000"/></node>
+  <node id="client" quantity="2"><application plugin="png" time="2"
+    arguments="mode=client server=server port=5000 count=2"/></node>
+</shadow>"""
+    new = cv.convert(old)
+    from shadow_tpu.config.xmlconfig import parse_config
+
+    cfg = parse_config(new)
+    assert cfg.stoptime == 30_000_000_000
+    names = dict(cfg.expanded_hosts())
+    # quantity expansion follows the reference: name, name2, ...
+    assert set(names) == {"server", "client", "client2"}
+    procs = names["client"].processes
+    assert procs[0].plugin == "png"
+    assert procs[0].starttime == 2_000_000_000
+
+
+def test_convert_software_reference_nodes():
+    """Oldest-generation nodes referencing a <software> element by id
+    get their process synthesized from it (no silent app loss)."""
+    cv = _load("convert_legacy_config")
+    old = """<shadow>
+  <kill time="10"/>
+  <topology><![CDATA[x]]></topology>
+  <software id="fx" plugin="filetransfer" time="3"
+            arguments="mode=client server=s port=80 bytes=100"/>
+  <node id="c" software="fx"/>
+</shadow>"""
+    new = cv.convert(old)
+    from shadow_tpu.config.xmlconfig import parse_config
+
+    cfg = parse_config(new)
+    host = dict(cfg.expanded_hosts())["c"]
+    assert len(host.processes) == 1
+    p = host.processes[0]
+    assert p.plugin == "fx"
+    assert p.starttime == 3_000_000_000
+    assert "bytes=100" in p.arguments
+
+
+def test_generate_example_config_builds(tmp_path):
+    gen = _load("generate_example_config")
+    gen.main(["-o", str(tmp_path), "--clients", "3", "--kib", "10",
+              "--vertices", "2"])
+    from shadow_tpu.config.loader import load
+    from shadow_tpu.config.xmlconfig import parse_config
+
+    text = (tmp_path / "shadow.config.xml").read_text()
+    cfg = parse_config(text)
+    # loader takes absolute paths; the CLI resolves a relative
+    # <topology path> against the config file's directory (cli.py)
+    cfg = cfg.__class__(**{**cfg.__dict__, "topology_path":
+                           str(tmp_path / "topology.graphml.xml")})
+    loaded = load(cfg)
+    assert loaded.bundle.cfg.num_hosts == 4
+    # typehints attach clients and server to their own vertices
+    import numpy as np
+
+    v = np.asarray(loaded.bundle.sim.net.vertex_of_host)
+    names = loaded.bundle.host_names
+    sv = v[names.index("server")]
+    assert all(v[i] != sv for i, n in enumerate(names) if n != "server")

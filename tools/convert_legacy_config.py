@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Convert old-generation Shadow configs (<node>/<application>,
+<software>, <kill time>) into the current <host>/<process> schema —
+the analog of the reference's src/tools/convert_multi_app.py config
+migration. shadow-tpu's parser accepts BOTH generations directly
+(config/xmlconfig.py); this tool exists to normalize files for
+editing and diffing.
+
+Usage: convert_legacy_config.py old.xml new.xml
+"""
+
+from __future__ import annotations
+
+import sys
+import xml.etree.ElementTree as ET
+
+
+def convert(text: str) -> str:
+    root = ET.fromstring(text)
+    out = ET.Element("shadow")
+
+    # <kill time="N"/> -> stoptime attribute
+    kill = root.find("kill")
+    stop = kill.get("time") if kill is not None else root.get("stoptime")
+    if stop:
+        out.set("stoptime", stop)
+    for attr in ("bootstraptime", "preload", "environment"):
+        if root.get(attr):
+            out.set(attr, root.get(attr))
+
+    topo = root.find("topology")
+    if topo is not None:
+        out.append(topo)
+
+    # <software>/<plugin> -> <plugin>. The oldest schema's <software>
+    # also carries the launch parameters (plugin/time/arguments) that
+    # nodes reference by id — keep the elements for process synthesis.
+    software: dict = {}
+    for el in list(root.iter("software")) + list(root.iter("plugin")):
+        software[el.get("id", "")] = el
+        p = ET.SubElement(out, "plugin")
+        p.set("id", el.get("id", ""))
+        p.set("path", el.get("path", el.get("plugin", "")))
+
+    # <node> -> <host>; <application> -> <process>. A node with a
+    # `software` reference and no application children gets its
+    # process synthesized from the referenced <software> element.
+    for node in list(root.iter("node")) + list(root.iter("host")):
+        h = ET.SubElement(out, "host")
+        for k, v in node.attrib.items():
+            if k != "software":
+                h.set(k, v)
+        apps = list(node.iter("application")) + list(node.iter("process"))
+        if not apps and node.get("software") in software:
+            apps = [software[node.get("software")]]
+        for app in apps:
+            pr = ET.SubElement(h, "process")
+            pr.set("plugin", app.get("plugin") if app.tag != "software"
+                   else app.get("id", ""))
+            if app.get("starttime") or app.get("time"):
+                pr.set("starttime", app.get("starttime", app.get("time")))
+            if app.get("stoptime"):
+                pr.set("stoptime", app.get("stoptime"))
+            pr.set("arguments", app.get("arguments", ""))
+
+    ET.indent(out)
+    return ET.tostring(out, encoding="unicode")
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print(f"USAGE: {sys.argv[0]} old.xml new.xml", file=sys.stderr)
+        return 1
+    with open(argv[0]) as f:
+        converted = convert(f.read())
+    with open(argv[1], "w") as f:
+        f.write(converted + "\n")
+    print(f"wrote {argv[1]}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,55 @@
+#!/usr/bin/env python3
+"""Canonicalize shadow-tpu logs for determinism diffing — the analog
+of the reference's src/tools/strip_log_for_compare.py: strip the
+parts of a log that legitimately differ between repeated identical
+experiments (wall-clock timings, memory-address-like tokens, rate
+fields), so two runs can be byte-compared (the reference's
+determinism gate, determinism1_compare.cmake).
+
+What is stripped:
+- `wall_seconds` / `events_per_second` /
+  `simulated_seconds_per_wall_second` values inside the completion
+  JSON (wall-time dependent);
+- any 0x-prefixed token (address-like);
+- trailing whitespace.
+
+Everything else — sim timestamps, hosts, heartbeat counters, event
+counts — is part of the determinism contract and is kept.
+
+Usage: strip_log_for_compare.py logfile outputfile
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+
+WALL_RE = re.compile(
+    r'"(wall_seconds|events_per_second|simulated_seconds_per_wall_second)"'
+    r":\s*[0-9.eE+-]+")
+ADDR_RE = re.compile(r"\b0x[0-9a-fA-F]+\b")
+
+
+def strip_line(line: str) -> str:
+    line = WALL_RE.sub(r'"\1": X', line)
+    line = ADDR_RE.sub("0xX", line)
+    return line.rstrip() + "\n"
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print(f"USAGE: {sys.argv[0]} logfile outputfile",
+              file=sys.stderr)
+        return 1
+    n = 0
+    with open(argv[0]) as inf, open(argv[1], "w") as outf:
+        for line in inf:
+            outf.write(strip_line(line))
+            n += 1
+    print(f"Done! Processed {n} lines.", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
