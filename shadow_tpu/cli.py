@@ -113,106 +113,113 @@ def main(argv=None) -> int:
         return 1
 
     logger = SimLogger(level=level_from_name(args.log_level))
-    cfg = parse_config(text)
-    # a relative <topology path> is relative to the CONFIG FILE, not
-    # the cwd (so `shadow-tpu some/dir/shadow.config.xml` works from
-    # anywhere — the reference resolves the same way)
-    if args.config and cfg.topology_path \
-            and not os.path.isabs(cfg.topology_path):
-        import dataclasses
+    # flush on every exit path so a mid-run failure still
+    # surfaces the buffered sim log (the reference flushes
+    # each round, slave.c:446-450)
+    try:
+        cfg = parse_config(text)
+        # a relative <topology path> is relative to the CONFIG FILE, not
+        # the cwd (so `shadow-tpu some/dir/shadow.config.xml` works from
+        # anywhere — the reference resolves the same way)
+        if args.config and cfg.topology_path \
+                and not os.path.isabs(cfg.topology_path):
+            import dataclasses
 
-        cfg = dataclasses.replace(cfg, topology_path=os.path.join(
-            os.path.dirname(os.path.abspath(args.config)),
-            cfg.topology_path))
-    overrides = {
-        "interface_qdisc": args.interface_qdisc,
-        "router_qdisc": args.router_qdisc,
-        "socket_recv_buffer": args.socket_recv_buffer,
-        "socket_send_buffer": args.socket_send_buffer,
-        "tcp_congestion_control": args.tcp_congestion_control,
-        "runahead": args.runahead,
-        "sockets_per_host": args.sockets_per_host,
-        "event_capacity": args.event_capacity,
-    }
-    loaded = load(cfg, seed=args.seed, overrides={
-        k: v for k, v in overrides.items() if v is not None})
-    b = loaded.bundle
-    logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
-                   f"min window {b.min_jump} ns, "
-                   f"end {b.cfg.end_time} ns")
+            cfg = dataclasses.replace(cfg, topology_path=os.path.join(
+                os.path.dirname(os.path.abspath(args.config)),
+                cfg.topology_path))
+        overrides = {
+            "interface_qdisc": args.interface_qdisc,
+            "router_qdisc": args.router_qdisc,
+            "socket_recv_buffer": args.socket_recv_buffer,
+            "socket_send_buffer": args.socket_send_buffer,
+            "tcp_congestion_control": args.tcp_congestion_control,
+            "runahead": args.runahead,
+            "sockets_per_host": args.sockets_per_host,
+            "event_capacity": args.event_capacity,
+        }
+        loaded = load(cfg, seed=args.seed, overrides={
+            k: v for k, v in overrides.items() if v is not None})
+        b = loaded.bundle
+        logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
+                       f"min window {b.min_jump} ns, "
+                       f"end {b.cfg.end_time} ns")
 
-    t0 = time.time()
-    if b.cfg.pcap:
-        # pcap capture needs the host window loop to drain the ring
-        # (ref: per-interface PCapWriter, pcap_writer.c)
-        from shadow_tpu.utils import checkpoint as ckpt
-        from shadow_tpu.utils.pcap import CaptureSession
+        t0 = time.time()
+        if b.cfg.pcap:
+            # pcap capture needs the host window loop to drain the ring
+            # (ref: per-interface PCapWriter, pcap_writer.c)
+            from shadow_tpu.utils import checkpoint as ckpt
+            from shadow_tpu.utils.pcap import CaptureSession
 
-        if args.workers > 1:
-            logger.warning(0, "shadow-tpu",
-                           f"logpcap forces the serial window loop; "
-                           f"--workers {args.workers} ignored")
+            if args.workers > 1:
+                logger.warning(0, "shadow-tpu",
+                               f"logpcap forces the serial window loop; "
+                               f"--workers {args.workers} ignored")
 
-        cap = CaptureSession(b, args.data_directory)
-        sim, stats, _ = ckpt.run_windows(
-            b, app_handlers=loaded.handlers,
-            on_window=lambda s, wend: cap.drain(s))
-        cap.drain(sim)
-        cap.close()
-        if cap.dropped:
-            logger.warning(b.cfg.end_time, "shadow-tpu",
-                           f"pcap ring overran: {cap.dropped} records "
-                           f"lost (raise NetConfig.pcap_ring)")
-    elif args.workers > 1:
-        from jax.sharding import Mesh
+            cap = CaptureSession(b, args.data_directory)
+            sim, stats, _ = ckpt.run_windows(
+                b, app_handlers=loaded.handlers,
+                on_window=lambda s, wend: cap.drain(s))
+            cap.drain(sim)
+            cap.close()
+            if cap.dropped:
+                logger.warning(b.cfg.end_time, "shadow-tpu",
+                               f"pcap ring overran: {cap.dropped} records "
+                               f"lost (raise NetConfig.pcap_ring)")
+        elif args.workers > 1:
+            from jax.sharding import Mesh
 
-        from shadow_tpu.parallel.shard import run_sharded
+            from shadow_tpu.parallel.shard import run_sharded
 
-        devs = jax.devices()[:args.workers]
-        mesh = Mesh(np.array(devs), ("hosts",))
-        sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers)
-    else:
-        from shadow_tpu.net.build import run
+            devs = jax.devices()[:args.workers]
+            mesh = Mesh(np.array(devs), ("hosts",))
+            sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers)
+        else:
+            from shadow_tpu.net.build import run
 
-        sim, stats = run(b, app_handlers=loaded.handlers)
-    wall = time.time() - t0
+            sim, stats = run(b, app_handlers=loaded.handlers)
+        wall = time.time() - t0
 
-    # end-of-run heartbeat + object accounting (ref: the tracker
-    # heartbeat subsystem, tracker.c:419-607, and the shutdown object
-    # counter dump, slave.c:237-241)
-    from shadow_tpu.utils import objcount
-    from shadow_tpu.utils.tracker import Tracker
+        # end-of-run heartbeat + object accounting (ref: the tracker
+        # heartbeat subsystem, tracker.c:419-607, and the shutdown object
+        # counter dump, slave.c:237-241)
+        from shadow_tpu.utils import objcount
+        from shadow_tpu.utils.tracker import Tracker
 
-    tracker = Tracker(logger, b.host_names,
-                      interval_s=args.heartbeat_frequency,
-                      level=level_from_name(args.heartbeat_log_level))
-    tracker.heartbeat(sim, b.cfg.end_time)
-    oc = objcount.gather(sim, stats=stats)
-    logger.message(b.cfg.end_time, "shadow-tpu", oc.format())
-    logger.message(b.cfg.end_time, "shadow-tpu", oc.format_diff())
+        tracker = Tracker(logger, b.host_names,
+                          interval_s=args.heartbeat_frequency,
+                          level=level_from_name(args.heartbeat_log_level))
+        tracker.heartbeat(sim, b.cfg.end_time)
+        oc = objcount.gather(sim, stats=stats)
+        logger.message(b.cfg.end_time, "shadow-tpu", oc.format())
+        logger.message(b.cfg.end_time, "shadow-tpu", oc.format_diff())
 
-    ev = int(stats.events_processed)
-    sim_s = b.cfg.end_time / 1e9
-    report = {
-        "events": ev,
-        "windows": int(stats.windows),
-        # verification hook (ref: the reference's example config
-        # downloads are verified by their sizes): the app's own rcvd
-        # units — bytes for bulk, replies for pingpong
-        **({"app_rcvd": int(np.asarray(sim.app.rcvd).sum())}
-           if getattr(sim, "app", None) is not None
-           and hasattr(sim.app, "rcvd") else {}),
-        "wall_seconds": round(wall, 3),
-        "events_per_second": round(ev / wall, 1) if wall > 0 else None,
-        "simulated_seconds_per_wall_second":
-            round(sim_s / wall, 3) if wall > 0 else None,
-        "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
-        + int(sim.net.rq_overflow),
-    }
-    logger.message(b.cfg.end_time, "shadow-tpu", "simulation complete "
-                   + json.dumps(report))
-    print(json.dumps(report))
-    return 0
+        ev = int(stats.events_processed)
+        sim_s = b.cfg.end_time / 1e9
+        report = {
+            "events": ev,
+            "windows": int(stats.windows),
+            # verification hook (ref: the reference's example config
+            # downloads are verified by their sizes): the app's own rcvd
+            # units — bytes for bulk, replies for pingpong
+            **({"app_rcvd": int(np.asarray(sim.app.rcvd).sum())}
+               if getattr(sim, "app", None) is not None
+               and hasattr(sim.app, "rcvd") else {}),
+            "wall_seconds": round(wall, 3),
+            "events_per_second": round(ev / wall, 1) if wall > 0 else None,
+            "simulated_seconds_per_wall_second":
+                round(sim_s / wall, 3) if wall > 0 else None,
+            "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
+            + int(sim.net.rq_overflow),
+        }
+        logger.message(b.cfg.end_time, "shadow-tpu", "simulation complete "
+                       + json.dumps(report))
+        logger.flush()
+        print(json.dumps(report))
+        return 0
+    finally:
+        logger.flush()
 
 
 if __name__ == "__main__":

@@ -8,9 +8,21 @@ device use) takes effect. TPU coverage comes from examples/ and
 bench.py.
 """
 
+import importlib.util
 import pathlib
 
 import jax
+
+TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
+
+
+def load_tool(name):
+    """Import a script from tools/ by file path (they are not a
+    package; the reference's tools are standalone scripts too)."""
+    spec = importlib.util.spec_from_file_location(name, TOOLS / f"{name}.py")
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,35 @@
+"""Log-level determinism gate — the reference's actual regression
+shape (determinism/: two identical runs, canonicalize with
+strip_log_for_compare, byte-compare; determinism1_compare.cmake).
+State-level determinism is covered elsewhere (test_parallel,
+test_checkpoint); this proves the USER-VISIBLE artifact — the log —
+is reproducible through the whole CLI stack."""
+
+import contextlib
+import io
+
+from conftest import load_tool
+
+
+def _run_cli_capture():
+    from shadow_tpu.cli import main
+
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = main(["--test", "--test-clients", "2", "-l", "info",
+                   "--heartbeat-frequency", "10"])
+    assert rc == 0
+    return out.getvalue()
+
+
+def test_two_runs_byte_identical_after_strip():
+    st = load_tool("strip_log_for_compare")
+    a = _run_cli_capture()
+    b = _run_cli_capture()
+    ca = "".join(st.strip_line(l) for l in a.splitlines(True))
+    cb = "".join(st.strip_line(l) for l in b.splitlines(True))
+    assert ca == cb
+    # the canonicalized log still carries real simulation content
+    assert "[shadow-heartbeat]" in ca
+    assert "simulation complete" in ca
+    assert '"overflow": 0' in ca

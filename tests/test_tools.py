@@ -2,17 +2,7 @@
 plot-shadow.py): heartbeat node lines (with the byte split), [ram]
 lines, and completion ticks parse into stats.shadow.json."""
 
-import importlib.util
-import pathlib
-
-TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
-
-
-def _load(name):
-    spec = importlib.util.spec_from_file_location(name, TOOLS / f"{name}.py")
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
+from conftest import load_tool as _load
 
 
 LOG = """\
