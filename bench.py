@@ -35,13 +35,18 @@ ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
-def _build_phold(H: int, load: int, sim_s: int, seed: int = 1):
+def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
+                 cap: int | None = None):
     from shadow_tpu.apps import phold
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
     from shadow_tpu.net.state import NetConfig
 
-    cap = max(64, 4 * load)
+    # Tight capacity: per-host in-window arrivals are ~Poisson(load),
+    # and the window cost is linear in capacity (every pass moves the
+    # whole [H,K] SoA), so oversizing K directly divides events/s.
+    # _phold_runner escalates on overflow, so the tight default is safe.
+    cap = cap if cap is not None else max(16, 3 * load)
     cfg = NetConfig(num_hosts=H, tcp=False,
                     end_time=sim_s * simtime.ONE_SECOND, seed=seed,
                     event_capacity=cap, outbox_capacity=cap,
@@ -58,29 +63,47 @@ def _phold_runner(H, load, sim_s, seed=1):
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
     seed: re-executing a jitted program on bit-identical inputs can be
     served from an execution-result cache by the device runtime, which
-    would make the timed iteration measure nothing."""
+    would make the timed iteration measure nothing.
+
+    Queue capacity starts tight (3*load) and doubles on overflow —
+    events are counted when dropped, never silently lost, so a clean
+    overflow==0 run at a tight capacity is sound AND fast."""
     from shadow_tpu.apps import phold
     from shadow_tpu.net.build import make_runner
 
-    b = _build_phold(H, load, sim_s, seed)
-    fn = make_runner(b, app_handlers=(phold.handler,), app_bulk=phold.BULK)
-    # pre-build distinct-seed inputs so the timed call measures only
-    # the device program, not host-side setup
-    sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i).sim
-                      for i in (1, 2)]
-    for s in sims:
-        jax.block_until_ready(s.net.rng_keys)
-    state = {"n": 0}
+    state = {"n": 0, "cap": None, "fn": None, "sims": None}
+
+    def build_at(cap):
+        b = _build_phold(H, load, sim_s, seed, cap)
+        fn = make_runner(b, app_handlers=(phold.handler,),
+                         app_bulk=phold.BULK)
+        # pre-build distinct-seed inputs so the timed call measures
+        # only the device program, not host-side setup
+        sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap).sim
+                          for i in (1, 2)]
+        for s in sims:
+            jax.block_until_ready(s.net.rng_keys)
+        state.update(cap=cap, fn=fn, sims=sims)
+
+    build_at(max(16, 3 * load))
 
     def go():
-        sim0 = sims[state["n"] % len(sims)]
-        state["n"] += 1
-        sim, stats = fn(sim0)
-        stats = jax.device_get(stats)
-        assert int(jax.device_get(sim.events.overflow)) == 0
-        assert int(jax.device_get(sim.app.rcvd.sum())) > 0
-        return int(stats.events_processed)
+        go.escalated = False
+        while True:
+            sim0 = state["sims"][state["n"] % len(state["sims"])]
+            state["n"] += 1
+            sim, stats = state["fn"](sim0)
+            stats = jax.device_get(stats)
+            overflow = (int(jax.device_get(sim.events.overflow))
+                        + int(jax.device_get(sim.outbox.overflow)))
+            if overflow:
+                build_at(state["cap"] * 2)   # recompile, re-run clean
+                go.escalated = True
+                continue
+            assert int(jax.device_get(sim.app.rcvd.sum())) > 0
+            return int(stats.events_processed)
 
+    go.escalated = False
     return go
 
 
@@ -140,7 +163,14 @@ def _probe_backend() -> None:
 def main() -> None:
     _probe_backend()
     workload = os.environ.get("BENCH_WORKLOAD", "phold")
-    H = int(os.environ.get("BENCH_HOSTS", "1024"))
+    # Default scale per backend, each compared against the measured
+    # baseline AT THAT SCALE (below): the accelerator streams the
+    # [H,K] state from HBM and wants lanes, so bigger is better; the
+    # 1-core CPU fallback is cache-bound and 1k's working set fits L3.
+    import jax as _jax
+
+    default_h = "1024" if _jax.default_backend() == "cpu" else "10240"
+    H = int(os.environ.get("BENCH_HOSTS", default_h))
     sim_s = int(os.environ.get("BENCH_SIM_SECONDS", "5"))
     load = int(os.environ.get("BENCH_LOAD", "8"))
 
@@ -151,18 +181,31 @@ def main() -> None:
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
 
-    runner()                      # compile + warm
-    t0 = time.perf_counter()
-    events = runner()             # timed (compile cached)
-    wall = time.perf_counter() - t0
+    runner()                      # compile + warm (may escalate capacity)
+    while True:
+        t0 = time.perf_counter()
+        events = runner()         # timed (compile cached)
+        wall = time.perf_counter() - t0
+        if not getattr(runner, "escalated", False):
+            break                 # a recompile polluted the timing; redo
     value = events / wall
 
+    # compare against the measured baseline AT THE SAME SCALE (the
+    # C pthread heap-skeleton upper bound, BASELINE.md): the published
+    # block carries per-scale numbers because the heap baseline slows
+    # as hosts grow (cache misses) while the device engine speeds up
+    # (more lanes).
     baseline = 0.0
     try:
         with open(os.path.join(os.path.dirname(__file__),
                                "BASELINE.json")) as f:
-            baseline = float(
-                json.load(f)["published"].get("events_per_sec", 0.0))
+            pub = json.load(f)["published"]
+        if H >= 100_000:
+            baseline = float(pub.get("events_per_sec_at_100k_hosts", 0.0))
+        elif H >= 10_000:
+            baseline = float(pub.get("events_per_sec_at_10k_hosts", 0.0))
+        else:
+            baseline = float(pub.get("events_per_sec", 0.0))
     except Exception:
         pass
     vs = value / baseline if baseline else 0.0

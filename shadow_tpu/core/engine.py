@@ -94,7 +94,8 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
         sim, stats = carry
         q, popped = pop_earliest(sim.events, wend)
         sim = sim.replace(events=q)
-        buf = EmitBuffer.create(H, emit_capacity)
+        buf = EmitBuffer.create(H, emit_capacity,
+                                nwords=sim.events.words.shape[-1])
         # events_processed counts EXECUTED events: pops the CPU
         # admission gate re-queues (step._cpu_gate) are excluded via
         # the blocked-counter delta, so a repeatedly deferred event

@@ -33,6 +33,27 @@ I32 = jnp.int32
 # selective-ack list, payload ref+len, plus the delivery-status audit
 # word (packetfmt.W_STATUS; ref: packet.h:18-40).
 NWORDS = 17
+# Narrow width for configs without TCP state: just the
+# protocol-independent words (packetfmt indices 0..5). Every pass of
+# the window loop moves the whole words tensor, so UDP-only workloads
+# carrying 6 instead of 17 words nearly halve per-event bytes.
+# Producers may build NWORDS-wide rows; sinks fit_words() them to the
+# allocated width (trailing TCP words are zero in non-TCP configs).
+NWORDS_BASE = 6
+
+
+def fit_words(words: jax.Array, width: int) -> jax.Array:
+    """Pad (zeros) or slice the trailing word dim to `width`. Slicing
+    is only sound when the dropped columns are zero — guaranteed
+    because narrow queues exist only in non-TCP configs, where nothing
+    writes the TCP header words."""
+    w = words.shape[-1]
+    if w == width:
+        return words
+    if w > width:
+        return words[..., :width]
+    pad = [(0, 0)] * (words.ndim - 1) + [(0, width - w)]
+    return jnp.pad(words, pad)
 
 
 class EventKind:
@@ -89,13 +110,14 @@ class EventQueue:
         return self.time.shape[1]
 
     @staticmethod
-    def create(num_hosts: int, capacity: int) -> "EventQueue":
+    def create(num_hosts: int, capacity: int,
+               nwords: int = NWORDS) -> "EventQueue":
         return EventQueue(
             time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
             kind=jnp.zeros((num_hosts, capacity), I32),
             src=jnp.zeros((num_hosts, capacity), I32),
             seq=jnp.zeros((num_hosts, capacity), I32),
-            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            words=jnp.zeros((num_hosts, capacity, nwords), I32),
             next_seq=jnp.zeros((num_hosts,), I32),
             overflow=jnp.zeros((), I32),
         )
@@ -195,6 +217,7 @@ def push_rows(
     words: jax.Array,  # [H, NWORDS] i32
 ) -> EventQueue:
     """Insert one event into each masked host row (first free slot)."""
+    words = fit_words(words, q.words.shape[-1])
     free = ~q.valid()                                     # [H, K]
     has_free = jnp.any(free, axis=1)
     slot = jnp.argmax(free, axis=1)                       # first free slot
@@ -237,14 +260,15 @@ class Outbox:
         return self.dst.shape[1]
 
     @staticmethod
-    def create(num_hosts: int, capacity: int) -> "Outbox":
+    def create(num_hosts: int, capacity: int,
+               nwords: int = NWORDS) -> "Outbox":
         return Outbox(
             dst=jnp.full((num_hosts, capacity), -1, I32),
             time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
             kind=jnp.zeros((num_hosts, capacity), I32),
             src=jnp.zeros((num_hosts, capacity), I32),
             seq=jnp.zeros((num_hosts, capacity), I32),
-            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            words=jnp.zeros((num_hosts, capacity, nwords), I32),
             count=jnp.zeros((num_hosts,), I32),
             overflow=jnp.zeros((), I32),
         )
@@ -260,6 +284,7 @@ def outbox_append(
     seq: jax.Array,    # [H] i32
     words: jax.Array,  # [H, NWORDS] i32
 ) -> Outbox:
+    words = fit_words(words, out.words.shape[-1])
     ok = mask & (out.count < out.capacity)
     sel = _onehot(ok, out.count, out.capacity)
     return out.replace(
@@ -369,7 +394,7 @@ def route_outbox(q: EventQueue, out: Outbox) -> tuple[EventQueue, Outbox]:
     q = insert_flat(
         q, valid, dst,
         out.time.reshape(n), out.kind.reshape(n), out.src.reshape(n),
-        out.seq.reshape(n), out.words.reshape(n, NWORDS),
+        out.seq.reshape(n), out.words.reshape(n, out.words.shape[-1]),
     )
     q = q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
     return q, clear_outbox(out)
@@ -400,12 +425,13 @@ class EmitBuffer:
         return self.dst.shape[1]
 
     @staticmethod
-    def create(num_hosts: int, capacity: int = 4) -> "EmitBuffer":
+    def create(num_hosts: int, capacity: int = 4,
+               nwords: int = NWORDS) -> "EmitBuffer":
         return EmitBuffer(
             dst=jnp.full((num_hosts, capacity), -1, I32),
             time=jnp.full((num_hosts, capacity), simtime.INVALID, simtime.DTYPE),
             kind=jnp.zeros((num_hosts, capacity), I32),
-            words=jnp.zeros((num_hosts, capacity, NWORDS), I32),
+            words=jnp.zeros((num_hosts, capacity, nwords), I32),
             count=jnp.zeros((num_hosts,), I32),
             overflow=jnp.zeros((), I32),
         )
@@ -420,6 +446,7 @@ def emit(
     words: jax.Array,         # [H, NWORDS] i32
 ) -> EmitBuffer:
     H = buf.num_hosts
+    words = fit_words(words, buf.words.shape[-1])
     kind = jnp.broadcast_to(jnp.asarray(kind, I32), (H,))
     ok = mask & (buf.count < buf.capacity)
     sel = _onehot(ok, buf.count, buf.capacity)

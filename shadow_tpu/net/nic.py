@@ -151,30 +151,33 @@ def deliver_packet(cfg: NetConfig, sim, mask, src_host, words, now, buf):
     # tcp_processPacket; never RST a RST). The RST bypasses the NIC
     # rings — it belongs to no socket — and rides the event fabric
     # directly; 0-length control packets are exempt from reliability
-    # drops either way.
-    flags = pf.tcp_flags_of(words)
-    need_rst = nosock & (proto == pf.PROTO_TCP) & ((flags & pf.TCPF_RST) == 0)
-    f_ack = (flags & pf.TCPF_ACK) != 0
-    f_syn = (flags & pf.TCPF_SYN) != 0
-    rseq = jnp.where(f_ack, words[:, pf.W_ACK], 0)
-    rack = words[:, pf.W_SEQ] + words[:, pf.W_LEN] + f_syn.astype(I32)
-    rst = jnp.zeros_like(words)
-    rst = rst.at[:, pf.W_PROTO].set(
-        pf.PROTO_TCP | ((pf.TCPF_RST | pf.TCPF_ACK) << 8))
-    rst = rst.at[:, pf.W_PORTS].set(pf.pack_ports(dst_port, src_port))
-    rst = rst.at[:, pf.W_SEQ].set(rseq)
-    rst = rst.at[:, pf.W_ACK].set(rack)
-    rst = rst.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
-    rst = rst.at[:, pf.W_DSTIP].set(src_ip.astype(jnp.uint32).astype(I32))
-    srch = jnp.clip(src_host, 0, GH - 1)
-    rst_local = need_rst & (src_host == net.lane_id)
-    vme = net.vertex_of_host[net.lane_id]
-    vsrc = net.vertex_of_host[srch]
-    lat = net.latency_ns[vme, vsrc]
-    buf = emit(buf, rst_local, net.lane_id, now + 1,
-               EventKind.PACKET_LOCAL, rst)
-    buf = emit(buf, need_rst & ~rst_local & (src_host >= 0), src_host,
-               now + lat, EventKind.PACKET, rst)
+    # drops either way. Gated on cfg.tcp: no TCP packets can exist in
+    # a UDP-only config, and its narrow words carry no TCP header.
+    if cfg.tcp:
+        flags = pf.tcp_flags_of(words)
+        need_rst = nosock & (proto == pf.PROTO_TCP) \
+            & ((flags & pf.TCPF_RST) == 0)
+        f_ack = (flags & pf.TCPF_ACK) != 0
+        f_syn = (flags & pf.TCPF_SYN) != 0
+        rseq = jnp.where(f_ack, words[:, pf.W_ACK], 0)
+        rack = words[:, pf.W_SEQ] + words[:, pf.W_LEN] + f_syn.astype(I32)
+        rst = jnp.zeros_like(words)
+        rst = rst.at[:, pf.W_PROTO].set(
+            pf.PROTO_TCP | ((pf.TCPF_RST | pf.TCPF_ACK) << 8))
+        rst = rst.at[:, pf.W_PORTS].set(pf.pack_ports(dst_port, src_port))
+        rst = rst.at[:, pf.W_SEQ].set(rseq)
+        rst = rst.at[:, pf.W_ACK].set(rack)
+        rst = rst.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
+        rst = rst.at[:, pf.W_DSTIP].set(src_ip.astype(jnp.uint32).astype(I32))
+        srch = jnp.clip(src_host, 0, GH - 1)
+        rst_local = need_rst & (src_host == net.lane_id)
+        vme = net.vertex_of_host[net.lane_id]
+        vsrc = net.vertex_of_host[srch]
+        lat = net.latency_ns[vme, vsrc]
+        buf = emit(buf, rst_local, net.lane_id, now + 1,
+                   EventKind.PACKET_LOCAL, rst)
+        buf = emit(buf, need_rst & ~rst_local & (src_host >= 0), src_host,
+                   now + lat, EventKind.PACKET, rst)
     net = net.replace(
         ctr_drop_nosocket=net.ctr_drop_nosocket + nosock.astype(I64),
         ctr_rx_packets=net.ctr_rx_packets + found.astype(I64),

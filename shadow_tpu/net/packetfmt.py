@@ -13,31 +13,38 @@ derived via the host IP table when needed.
 
 import jax.numpy as jnp
 
-# word indices
+# word indices. Protocol-independent words come FIRST so UDP-only
+# configs can carry narrow events (events.NWORDS_BASE = 6 words)
+# instead of the full TCP-header width (events.NWORDS = 17) — the
+# window cost is linear in bytes moved, so dead header words divide
+# throughput directly. Code touching an index >= NWORDS_BASE must be
+# gated on cfg.tcp (a static out-of-range index fails at trace time,
+# never silently).
 W_PROTO = 0    # protocol | tcp-flags<<8  (see below)
 W_LEN = 1      # payload length in bytes
 W_PORTS = 2    # src_port | dst_port<<16
 W_PAYREF = 3   # host-side payload pool index, PAYREF_NONE = synthetic
-W_SEQ = 4      # TCP sequence number
-W_ACK = 5      # TCP acknowledgment
-W_WIN = 6      # TCP advertised window
-W_TSVAL = 7    # TCP timestamp value (ms)
-W_TSECHO = 8   # TCP timestamp echo (ms)
-W_SACKL = 9    # TCP selective-ack range 1 left edge
-W_SACKR = 10   # TCP selective-ack range 1 right edge
-W_DSTIP = 11   # destination IP (distinguishes loopback vs eth delivery)
-# full SACK list: ranges 2 and 3 (the reference carries a full
-# selective-ack list in its TCP header, packet.h:52,77; three ranges
-# cover Linux's practical SACK option limit)
-W_SACKL2 = 12
-W_SACKR2 = 13
-W_SACKL3 = 14
-W_SACKR3 = 15
+W_DSTIP = 4    # destination IP (distinguishes loopback vs eth delivery)
 # Delivery-status audit trail: a bitmask ORed at every pipeline stage
 # the packet passes (the device form of the reference's append-only
 # PacketDeliveryStatusFlags trail, packet.h:18-40 /
 # packet_addDeliveryStatus). Decode host-side with pds_decode().
-W_STATUS = 16
+W_STATUS = 5
+# --- TCP header words (indices >= events.NWORDS_BASE) ----------------
+W_SEQ = 6      # TCP sequence number
+W_ACK = 7      # TCP acknowledgment
+W_WIN = 8      # TCP advertised window
+W_TSVAL = 9    # TCP timestamp value (ms)
+W_TSECHO = 10  # TCP timestamp echo (ms)
+W_SACKL = 11   # TCP selective-ack range 1 left edge
+W_SACKR = 12   # TCP selective-ack range 1 right edge
+# full SACK list: ranges 2 and 3 (the reference carries a full
+# selective-ack list in its TCP header, packet.h:52,77; three ranges
+# cover Linux's practical SACK option limit)
+W_SACKL2 = 13
+W_SACKR2 = 14
+W_SACKL3 = 15
+W_SACKR3 = 16
 
 PAYREF_NONE = -1
 

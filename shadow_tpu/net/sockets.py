@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from shadow_tpu.core.events import fit_words
 from shadow_tpu.net import packetfmt as pf
 from shadow_tpu.net.rings import (
     gather_hs,
@@ -55,6 +56,7 @@ def sk_enqueue_out(net: NetState, mask, slot, words):
     H = mask.shape[0]
     lane = jnp.arange(H)
     BO = net.out_words.shape[2]
+    words = fit_words(words, net.out_words.shape[-1])
     length = words[:, pf.W_LEN]
 
     space_ok = (gather_hs(net.out_bytes, slot) + length) <= gather_hs(

@@ -22,7 +22,7 @@ import numpy as np
 from flax import struct
 
 from shadow_tpu.core import rng, simtime
-from shadow_tpu.core.events import NWORDS, EventQueue, Outbox
+from shadow_tpu.core.events import NWORDS, NWORDS_BASE, EventQueue, Outbox
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -126,6 +126,28 @@ class NetConfig:
     # default socket buffer byte limits (ref: definitions.h:153-159)
     sndbuf: int = DEFAULT_SNDBUF
     rcvbuf: int = DEFAULT_RCVBUF
+    # Packet-word width carried by events/rings. None = derive:
+    # full TCP-header width when cfg.tcp, else the narrow
+    # protocol-independent prefix (see core.events.NWORDS_BASE).
+    nwords: int | None = None
+
+    @property
+    def words_width(self) -> int:
+        if self.nwords is not None:
+            # the TCP machine reads/writes header words up to index
+            # NWORDS-1; a narrower override would be silently sliced
+            # by fit_words at enqueue and then fail opaquely at trace
+            if self.tcp and self.nwords < NWORDS:
+                raise ValueError(
+                    f"nwords={self.nwords} < {NWORDS} requires tcp=False "
+                    f"(TCP packets carry header words up to index "
+                    f"{NWORDS - 1})")
+            if self.nwords < NWORDS_BASE:
+                raise ValueError(
+                    f"nwords={self.nwords} < NWORDS_BASE={NWORDS_BASE}: "
+                    f"every packet needs the protocol-independent words")
+            return self.nwords
+        return NWORDS if self.tcp else NWORDS_BASE
 
 
 # NetState fields that are *global lookup tables*: replicated across
@@ -377,7 +399,7 @@ def make_net_state(
         priority_ctr=z_h,
         rq_src=jnp.zeros((H, R), I32),
         rq_enq_ts=jnp.zeros((H, R), I64),
-        rq_words=jnp.zeros((H, R, NWORDS), I32),
+        rq_words=jnp.zeros((H, R, cfg.words_width), I32),
         rq_head=zi_h,
         rq_count=zi_h,
         rq_bytes=z_h,
@@ -404,7 +426,7 @@ def make_net_state(
         in_head=jnp.zeros((H, S), I32),
         in_count=jnp.zeros((H, S), I32),
         in_bytes=jnp.zeros((H, S), I32),
-        out_words=jnp.zeros((H, S, BO, NWORDS), I32),
+        out_words=jnp.zeros((H, S, BO, cfg.words_width), I32),
         out_priority=jnp.zeros((H, S, BO), I64),
         out_head=jnp.zeros((H, S), I32),
         out_count=jnp.zeros((H, S), I32),
@@ -429,7 +451,7 @@ def make_net_state(
         last_drop_status=zi_h,
         cap_time=jnp.zeros((H, cfg.pcap_ring if cfg.pcap else 1), I64),
         cap_words=jnp.zeros(
-            (H, cfg.pcap_ring if cfg.pcap else 1, NWORDS), I32),
+            (H, cfg.pcap_ring if cfg.pcap else 1, cfg.words_width), I32),
         cap_meta=jnp.zeros((H, cfg.pcap_ring if cfg.pcap else 1), I32),
         cap_count=zi_h,
         rq_overflow=jnp.zeros((), I32),
@@ -443,8 +465,10 @@ def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
 
         tcp = TcpState.create(cfg.num_hosts, cfg.sockets_per_host)
     return Sim(
-        events=EventQueue.create(cfg.num_hosts, cfg.event_capacity),
-        outbox=Outbox.create(cfg.num_hosts, cfg.outbox_capacity),
+        events=EventQueue.create(cfg.num_hosts, cfg.event_capacity,
+                                 cfg.words_width),
+        outbox=Outbox.create(cfg.num_hosts, cfg.outbox_capacity,
+                             cfg.words_width),
         net=net,
         app=app,
         tcp=tcp,

@@ -36,7 +36,6 @@ from jax.tree_util import tree_map_with_path
 from shadow_tpu.core import simtime
 from shadow_tpu.core.engine import EngineStats, run as engine_run
 from shadow_tpu.core.events import (
-    NWORDS,
     EventQueue,
     Outbox,
     clear_outbox,
@@ -125,12 +124,13 @@ def route_outbox_sharded(
     # is exactly two collectives (one i32, one i64) instead of six —
     # each all_to_all pays ICI launch latency once per window. Unwritten
     # slots must read dst == -1 (empty), so the dst plane's fill is -1.
+    W = out.words.shape[-1]
     packed = jnp.concatenate(
         [out.dst[..., None], out.kind[..., None], out.src[..., None],
          out.seq[..., None], out.words], axis=2,
-    )  # [Hl, M, 4+NWORDS]
-    flat = packed.reshape(n, 4 + NWORDS)[order]
-    sb_i32 = jnp.zeros((num_shards, C, 4 + NWORDS), I32).at[..., 0].set(-1)
+    )  # [Hl, M, 4+W]
+    flat = packed.reshape(n, 4 + W)[order]
+    sb_i32 = jnp.zeros((num_shards, C, 4 + W), I32).at[..., 0].set(-1)
     sb_i32 = sb_i32.at[row, slot].set(flat, mode="drop")
     sb_time = to_sendbuf(out.time, simtime.INVALID)
 
@@ -139,7 +139,7 @@ def route_outbox_sharded(
     rb_time = a2a(sb_time)
 
     nn = num_shards * C
-    ri32 = rb_i32.reshape(nn, 4 + NWORDS)
+    ri32 = rb_i32.reshape(nn, 4 + W)
     rdst = ri32[:, 0]
     occupied_r = rdst >= 0
     local_row = rdst - base

@@ -406,7 +406,8 @@ class ProcessRuntime:
         events — no pipeline send drain runs out here."""
         from shadow_tpu.net import nic
 
-        buf = EmitBuffer.create(self.cfg.num_hosts, self.cfg.emit_capacity)
+        buf = EmitBuffer.create(self.cfg.num_hosts, self.cfg.emit_capacity,
+                                nwords=self.cfg.words_width)
         sim, buf = fn(self.sim, buf)
         sim, buf = nic.flush_wants_send(sim, buf, now)
         q, out = apply_emissions(sim.events, sim.outbox, buf,

@@ -17,6 +17,13 @@ import json
 import jax
 import numpy as np
 
+# Bumped whenever the on-device byte layout changes meaning without
+# changing shape/dtype (e.g. the packetfmt word reindex): shape checks
+# alone cannot catch a reinterpretation, so load() refuses snapshots
+# from a different layout generation instead of resuming into garbage.
+LAYOUT_VERSION = 2  # v2: protocol-independent packet words 0..5,
+                    # TCP header words 6..16 (packetfmt.py)
+
 
 def _leaf_dict(sim) -> dict:
     flat = jax.tree_util.tree_flatten_with_path(sim)[0]
@@ -31,7 +38,7 @@ def save(path: str, sim, *, time_ns: int, extra: dict | None = None):
     next window start (resume point)."""
     leaves = _leaf_dict(sim)
     meta = {"time_ns": int(time_ns), "extra": extra or {},
-            "keys": sorted(leaves)}
+            "layout": LAYOUT_VERSION, "keys": sorted(leaves)}
     np.savez_compressed(path, __meta__=json.dumps(meta),
                         **{k: v for k, v in leaves.items()})
 
@@ -42,6 +49,12 @@ def load(path: str, template_sim):
     every array is checked against the template's shape and dtype."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
+        layout = meta.get("layout", 1)
+        if layout != LAYOUT_VERSION:
+            raise ValueError(
+                f"snapshot uses packet-word layout v{layout}, this "
+                f"build reads v{LAYOUT_VERSION} — resuming would "
+                f"reinterpret header words; re-run from config")
         flat, treedef = jax.tree_util.tree_flatten_with_path(template_sim)
         leaves = []
         for pth, tleaf in flat:

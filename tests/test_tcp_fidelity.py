@@ -159,7 +159,9 @@ def test_sack_advertises_multiple_ranges():
         oo_r=t.oo_r.at[0, 0, :].set(
             jnp.array([800, 200, 600, 400], jnp.int32)),
     )
-    words = jnp.zeros((1, 16), jnp.int32)
+    from shadow_tpu.core.events import NWORDS
+
+    words = jnp.zeros((1, NWORDS), jnp.int32)
     mask = jnp.array([True])
     slot = jnp.zeros((1,), jnp.int32)
     out = tcp.stamp_at_wire(net, t, mask, slot, words, jnp.zeros((1,), jnp.int64))
