@@ -427,19 +427,21 @@ def _qdisc_select(cfg: NetConfig, net: NetState):
 
 
 def handle_nic_send(cfg: NetConfig, sim, popped, buf):
-    """Send one packet chosen by the qdisc; chain at the same sim time
-    while sendable (ref: _networkinterface_sendPackets,
-    network_interface.c:519-579).
+    """Drain up to cfg.nic_drain packets chosen by the qdisc; chain a
+    same-time NIC_SEND event if more remain sendable (ref:
+    _networkinterface_sendPackets, network_interface.c:519-579 — the
+    reference drains its ring in a while loop inside ONE event; the
+    lax.fori_loop below is the device form, and the chained event only
+    covers bursts longer than the loop bound).
 
     Runs LAST in the handler pipeline and acts on kind=NIC_SEND events
     *plus* lanes whose nic_send_now bit was set earlier in this
     micro-step (data enqueued by TCP/app handlers) — the fused form of
     the reference's synchronous networkinterface_wantsSend call.
     NIC_SEND events exist only for deferred sends (refill waits,
-    multi-packet chains)."""
+    over-long bursts)."""
     net = sim.net
     H = net.rq_head.shape[0]
-    lane = jnp.arange(H)
     ev = popped.valid & (popped.kind == EventKind.NIC_SEND)
     mask = ev | net.nic_send_now
     now = popped.time
@@ -447,8 +449,39 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     net = net.replace(nic_send_pending=net.nic_send_pending & ~ev,
                       nic_send_now=jnp.zeros((H,), bool))
     net = refill_tokens(net, mask, now)
+    sim = sim.replace(net=net)
 
     bootstrap = now < cfg.bootstrap_end
+    if cfg.nic_drain <= 1:
+        sim, buf = _drain_one(cfg, sim, buf, mask, now, bootstrap)
+    else:
+        sim, buf = jax.lax.fori_loop(
+            0, cfg.nic_drain,
+            lambda i, c: _drain_one(cfg, c[0], c[1], mask, now, bootstrap),
+            (sim, buf))
+
+    # continue or re-arm (guard against lanes that already have a
+    # deferred NIC_SEND in flight — fused fresh lanes can overlap one)
+    net = sim.net
+    more = jnp.any(net.out_count > 0, axis=1)
+    can_next = bootstrap | (net.tb_send_tokens >= pf.MTU)
+    chain = mask & more & can_next & ~net.nic_send_pending
+    wait = mask & more & ~can_next & ~net.nic_send_pending
+    buf = emit(buf, chain, net.lane_id, now, EventKind.NIC_SEND,
+               _empty_words(H))
+    buf = emit(buf, wait, net.lane_id, next_refill_time(now),
+               EventKind.NIC_SEND, _empty_words(H))
+    net = net.replace(nic_send_pending=net.nic_send_pending | chain | wait)
+    return sim.replace(net=net), buf
+
+
+def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
+    """One qdisc selection + wire transmission across all lanes (the
+    loop body of the reference's send loop). Lanes with no sendable
+    packet (or no tokens) are masked off and unchanged."""
+    net = sim.net
+    H = net.rq_head.shape[0]
+    lane = jnp.arange(H)
     can = bootstrap | (net.tb_send_tokens >= pf.MTU)
     sel = _qdisc_select(cfg, net)
     active = mask & can & (sel >= 0)
@@ -545,18 +578,6 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
             net.tb_send_tokens - jnp.where(remote & ~bootstrap, wl, 0), 0
         ),
     )
-
-    # continue or re-arm (guard against lanes that already have a
-    # deferred NIC_SEND in flight — fused fresh lanes can overlap one)
-    more = jnp.any(net.out_count > 0, axis=1)
-    can_next = bootstrap | (net.tb_send_tokens >= pf.MTU)
-    chain = mask & more & can_next & ~net.nic_send_pending
-    wait = mask & more & ~can_next & ~net.nic_send_pending
-    buf = emit(buf, chain, net.lane_id, now, EventKind.NIC_SEND,
-               _empty_words(H))
-    buf = emit(buf, wait, net.lane_id, next_refill_time(now),
-               EventKind.NIC_SEND, _empty_words(H))
-    net = net.replace(nic_send_pending=net.nic_send_pending | chain | wait)
     return sim.replace(net=net), buf
 
 

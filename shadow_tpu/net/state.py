@@ -122,7 +122,33 @@ class NetConfig:
     end_time: int = simtime.ONE_SECOND
     min_jump: int = 10 * simtime.ONE_MILLISECOND
     seed: int = 1
-    emit_capacity: int = 6       # max emissions per host per micro-step
+    # Packets drained per micro-step by the NIC send pass (the device
+    # form of the reference's drain-while-sendable loop,
+    # network_interface.c:519-579, as a lax.fori_loop). 1 = a separate
+    # micro-step per wire packet (pre-r2 behavior); bursts longer than
+    # nic_drain chain a same-time NIC_SEND event.
+    nic_drain: int = 4
+    # Max emissions per host per micro-step. None = derived: the wire
+    # packets one drain pass can emit plus headroom for the chain /
+    # timer / app / (TCP: rtx + dack + flush) emissions that can
+    # coincide. Overflow is counted, never silent.
+    emit_capacity: int | None = None
+
+    def __post_init__(self):
+        if self.emit_capacity is None:
+            object.__setattr__(
+                self, "emit_capacity",
+                self.nic_drain + (6 if self.tcp else 4))
+        elif self.emit_capacity < self.nic_drain + 2:
+            # one drain pass alone can emit nic_drain wire packets
+            # plus a chain/wait event; a pinned emit_capacity below
+            # that would overflow (counted, but on configs that never
+            # overflowed before this knob existed) — fail loudly at
+            # build instead
+            raise ValueError(
+                f"emit_capacity={self.emit_capacity} < nic_drain"
+                f"={self.nic_drain} + 2: raise emit_capacity or lower "
+                f"nic_drain")
     # default socket buffer byte limits (ref: definitions.h:153-159)
     sndbuf: int = DEFAULT_SNDBUF
     rcvbuf: int = DEFAULT_RCVBUF

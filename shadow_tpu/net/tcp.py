@@ -76,9 +76,11 @@ I64 = jnp.int64
 MSS = pf.MTU - pf.HDR_TCP          # 1434 payload bytes per segment
 OO_RANGES = 4                      # receiver reassembly ranges
 ACCEPT_QUEUE = 4                   # pending-children ring per listener
-FLUSH_SEGMENTS = 2                 # max segments packetized per flush call
-                                   # (2 sustains slow-start doubling: each
-                                   # ACK may admit two new segments)
+FLUSH_SEGMENTS = 4                 # max segments packetized per flush call
+                                   # (a lax.fori_loop in tcp_flush, so the
+                                   # program carries one body copy; paired
+                                   # with cfg.nic_drain=4 so one micro-step
+                                   # packetizes AND wires a 4-segment burst)
 INIT_CWND = 1                      # packets: tcp_cong_reno_init overrides
                                    # its own IW10 to 1 (tcp_cong_reno.c:176-180)
 RESTART_CWND = 10                  # after RTO the reference restarts at 10
@@ -634,29 +636,40 @@ def _free_socket(cfg, sim, mask, slot):
 # (ref: _tcp_flush, tcp.c:1121-...)
 # ---------------------------------------------------------------------
 
+def _flush_one_segment(cfg, sim, buf, mask, slot, now):
+    """Packetize one admissible MSS-bounded segment per masked lane
+    (one iteration of _tcp_flush's drain-while-sendable loop)."""
+    tcp = sim.tcp
+    st = gather_hs(tcp.st, slot)
+    can_data = mask & (
+        (st == TcpSt.ESTABLISHED) | (st == TcpSt.CLOSE_WAIT)
+        | (st == TcpSt.FIN_WAIT_1) | (st == TcpSt.LAST_ACK))
+    una = gather_hs(tcp.snd_una, slot)
+    nxt = gather_hs(tcp.snd_nxt, slot)
+    end = gather_hs(tcp.snd_end, slot)
+    cwnd_b = gather_hs(tcp.cwnd, slot) * MSS
+    wnd = jnp.minimum(cwnd_b, gather_hs(tcp.snd_wnd, slot))
+    usable = una + wnd - nxt
+    seg = jnp.minimum(jnp.minimum(end - nxt, MSS), usable)
+    do = can_data & (seg > 0)
+    sim, buf, sent = _enqueue_seg(sim, buf, do, slot, pf.TCPF_ACK, nxt,
+                                  seg, now)
+    tcp = _set(sim.tcp, "snd_nxt", sent, slot,
+               nxt + jnp.where(sent, seg, 0))
+    tcp = _set(tcp, "snd_max", sent, slot,
+               jnp.maximum(gather_hs(tcp.snd_max, slot),
+                           nxt + jnp.where(sent, seg, 0)))
+    return sim.replace(tcp=tcp), buf
+
+
 def tcp_flush(cfg: NetConfig, sim, mask, slot, now, buf):
-    for _ in range(FLUSH_SEGMENTS):
-        tcp = sim.tcp
-        st = gather_hs(tcp.st, slot)
-        can_data = mask & (
-            (st == TcpSt.ESTABLISHED) | (st == TcpSt.CLOSE_WAIT)
-            | (st == TcpSt.FIN_WAIT_1) | (st == TcpSt.LAST_ACK))
-        una = gather_hs(tcp.snd_una, slot)
-        nxt = gather_hs(tcp.snd_nxt, slot)
-        end = gather_hs(tcp.snd_end, slot)
-        cwnd_b = gather_hs(tcp.cwnd, slot) * MSS
-        wnd = jnp.minimum(cwnd_b, gather_hs(tcp.snd_wnd, slot))
-        usable = una + wnd - nxt
-        seg = jnp.minimum(jnp.minimum(end - nxt, MSS), usable)
-        do = can_data & (seg > 0)
-        sim, buf, sent = _enqueue_seg(sim, buf, do, slot, pf.TCPF_ACK, nxt,
-                                      seg, now)
-        tcp = _set(sim.tcp, "snd_nxt", sent, slot,
-                   nxt + jnp.where(sent, seg, 0))
-        tcp = _set(tcp, "snd_max", sent, slot,
-                   jnp.maximum(gather_hs(tcp.snd_max, slot),
-                               nxt + jnp.where(sent, seg, 0)))
-        sim = sim.replace(tcp=tcp)
+    # fori_loop keeps ONE copy of the packetize body in the program
+    # (compile time) while letting a single flush call emit several
+    # segments (fewer same-time TCP_FLUSH continuation micro-steps)
+    sim, buf = jax.lax.fori_loop(
+        0, FLUSH_SEGMENTS,
+        lambda i, c: _flush_one_segment(cfg, c[0], c[1], mask, slot, now),
+        (sim, buf))
     # FIN rides once all data is packetized (FIN seq == snd_end)
     tcp = sim.tcp
     nxt = gather_hs(tcp.snd_nxt, slot)
