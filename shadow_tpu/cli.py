@@ -60,12 +60,41 @@ def make_parser() -> argparse.ArgumentParser:
                    help="congestion algorithm (ref: the tcp_cong.h "
                         "hook vtable; the reference implements only "
                         "reno, the vtable was designed for all three)")
+    p.add_argument("--tcp-ssthresh", type=int, default=0,
+                   help="initial slow-start threshold in packets, "
+                        "0 = discover via loss (ref: options.c:137)")
+    p.add_argument("--tcp-windows", type=int, default=0,
+                   help="pin the initial congestion window in packets, "
+                        "0 = protocol default (ref: options.c:138)")
+    p.add_argument("--cpu-threshold", type=int, default=-1,
+                   help="virtual-CPU blocking threshold in microseconds, "
+                        "negative disables the CPU model "
+                        "(ref: options.c:130)")
+    p.add_argument("--cpu-precision", type=int, default=200,
+                   help="round CPU delays to this many microseconds "
+                        "(ref: options.c:129)")
     p.add_argument("-l", "--log-level", default="message",
                    choices=["error", "critical", "warning", "message",
                             "info", "debug"])
     p.add_argument("--heartbeat-frequency", type=int, default=60,
                    help="tracker heartbeat interval (s)")
     p.add_argument("--heartbeat-log-level", default="message")
+    p.add_argument("-i", "--heartbeat-log-info",
+                   default="node,socket,ram",
+                   help="comma list of heartbeat sections "
+                        "('node','socket','ram'); the reference "
+                        "defaults to 'node' alone (options.c:92)")
+    # Accepted for reference-invocation compatibility; their mechanism
+    # has no analog here (no native binaries to preload or debug, no
+    # data template tree, interface batching is the fixed 1 ms
+    # token-bucket refill) — see the module docstring.
+    for flag in ("--preload", "--data-template"):
+        p.add_argument(flag, default=None, help=argparse.SUPPRESS)
+    for flag in ("--gdb", "--valgrind"):
+        p.add_argument(flag, action="store_true", help=argparse.SUPPRESS)
+    for flag in ("--interface-batch", "--interface-buffer"):
+        p.add_argument(flag, type=int, default=None,
+                       help=argparse.SUPPRESS)
     p.add_argument("-d", "--data-directory", default="shadow.data")
     # default None = let the plugin capacity hints size these
     # (loader.py hints; an explicit value always wins, matching the
@@ -75,6 +104,30 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
     return p
+
+
+def overrides_from_args(args) -> dict:
+    """Map parsed CLI flags onto config-loader overrides (None values
+    mean "keep the config/default"). Reference units: the CPU knobs
+    are microseconds (options.c:129-130), negative threshold = CPU
+    model disabled."""
+    overrides = {
+        "tcp_ssthresh": args.tcp_ssthresh or None,
+        "tcp_windows": args.tcp_windows or None,
+        "cpu_threshold_ns": (args.cpu_threshold * 1000
+                             if args.cpu_threshold >= 0 else None),
+        "cpu_precision_ns": (args.cpu_precision * 1000
+                             if args.cpu_precision >= 0 else None),
+        "interface_qdisc": args.interface_qdisc,
+        "router_qdisc": args.router_qdisc,
+        "socket_recv_buffer": args.socket_recv_buffer,
+        "socket_send_buffer": args.socket_send_buffer,
+        "tcp_congestion_control": args.tcp_congestion_control,
+        "runahead": args.runahead,
+        "sockets_per_host": args.sockets_per_host,
+        "event_capacity": args.event_capacity,
+    }
+    return {k: v for k, v in overrides.items() if v is not None}
 
 
 def main(argv=None) -> int:
@@ -128,18 +181,8 @@ def main(argv=None) -> int:
             cfg = dataclasses.replace(cfg, topology_path=os.path.join(
                 os.path.dirname(os.path.abspath(args.config)),
                 cfg.topology_path))
-        overrides = {
-            "interface_qdisc": args.interface_qdisc,
-            "router_qdisc": args.router_qdisc,
-            "socket_recv_buffer": args.socket_recv_buffer,
-            "socket_send_buffer": args.socket_send_buffer,
-            "tcp_congestion_control": args.tcp_congestion_control,
-            "runahead": args.runahead,
-            "sockets_per_host": args.sockets_per_host,
-            "event_capacity": args.event_capacity,
-        }
-        loaded = load(cfg, seed=args.seed, overrides={
-            k: v for k, v in overrides.items() if v is not None})
+        loaded = load(cfg, seed=args.seed,
+                      overrides=overrides_from_args(args))
         b = loaded.bundle
         logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
                        f"min window {b.min_jump} ns, "
@@ -187,9 +230,13 @@ def main(argv=None) -> int:
         from shadow_tpu.utils import objcount
         from shadow_tpu.utils.tracker import Tracker
 
-        tracker = Tracker(logger, b.host_names,
-                          interval_s=args.heartbeat_frequency,
-                          level=level_from_name(args.heartbeat_log_level))
+        tracker = Tracker(
+            logger, b.host_names,
+            interval_s=args.heartbeat_frequency,
+            level=level_from_name(args.heartbeat_log_level),
+            sections=tuple(
+                x.strip() for x in args.heartbeat_log_info.split(",")
+                if x.strip()))
         tracker.heartbeat(sim, b.cfg.end_time)
         oc = objcount.gather(sim, stats=stats)
         logger.message(b.cfg.end_time, "shadow-tpu", oc.format())

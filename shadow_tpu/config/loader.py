@@ -257,7 +257,9 @@ def load(config: ShadowConfig, *, seed: int = 1,
         **{k: v for k, v in overrides.items()
            if k in ("sockets_per_host", "event_capacity", "outbox_capacity",
                     "router_ring", "in_ring", "out_ring", "timers_per_host",
-                    "emit_capacity", "nic_drain", "tcp")},
+                    "emit_capacity", "nic_drain", "tcp", "tcp_ssthresh",
+                    "tcp_windows", "cpu_threshold_ns",
+                    "cpu_precision_ns")},
     )
     bundle = build(cfg, graphml, host_specs)
     if "runahead" in overrides and overrides["runahead"]:

@@ -113,6 +113,13 @@ class NetConfig:
                                  # reno/aimd/cubic — the reference's
                                  # --tcp-congestion-control knob backed
                                  # by the tcp_cong.h vtable design)
+    # --tcp-ssthresh (ref: options.c:137): initial slow-start
+    # threshold in packets; 0 = discover via loss (the default)
+    tcp_ssthresh: int = 0
+    # --tcp-windows (ref: options.c:138): pin the initial congestion
+    # window; 0 = the reference's effective behavior (reno init
+    # resets to 1, tcp_cong_reno.c:176-180)
+    tcp_windows: int = 0
     tcp: bool = True             # False skips building TcpState and
                                  # inlining the TCP machine into the
                                  # device program (UDP-only workloads
@@ -487,9 +494,13 @@ def make_net_state(
 def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
     tcp = None
     if cfg.tcp:
-        from shadow_tpu.net.tcp import TcpState
+        from shadow_tpu.net.tcp import (
+            TcpState, initial_cwnd, initial_ssthresh)
 
-        tcp = TcpState.create(cfg.num_hosts, cfg.sockets_per_host)
+        tcp = TcpState.create(
+            cfg.num_hosts, cfg.sockets_per_host,
+            init_cwnd=initial_cwnd(cfg),
+            init_ssthresh=initial_ssthresh(cfg))
     return Sim(
         events=EventQueue.create(cfg.num_hosts, cfg.event_capacity,
                                  cfg.words_width),

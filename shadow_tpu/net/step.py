@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from typing import Callable, Sequence
 
+from shadow_tpu.core.events import EventKind
 from shadow_tpu.net import nic, tcp, timers
 from shadow_tpu.net.state import NetConfig
 
@@ -22,17 +23,33 @@ AppHandler = Callable  # (cfg, sim, popped, buf) -> (sim, buf)
 # data; the send drain runs LAST so packets enqueued anywhere in this
 # micro-step (TCP ACKs, app replies) hit the wire without a same-time
 # event round-trip (the nic_send_now fusion).
+#
+# Each netstack handler is paired with the event kinds it acts on:
+# the pipeline wraps it in lax.cond so a micro-step where NO lane
+# popped a matching kind skips the handler's whole subgraph (each
+# handler is a masked batch update — all-false mask == identity — so
+# skipping is value-identical and saves the execution cost; the TCP
+# receive machine inside handle_nic_recv is by far the largest).
 _PRE_APP = (
-    nic.handle_nic_recv,       # PACKET + NIC_RECV + PACKET_LOCAL, fused
-    timers.handle_timer,
-    tcp.handle_tcp_rtx,
-    tcp.handle_tcp_dack,
-    tcp.handle_tcp_flush,
-    tcp.handle_tcp_close,
+    (nic.handle_nic_recv, (EventKind.PACKET, EventKind.NIC_RECV,
+                           EventKind.PACKET_LOCAL)),
+    (timers.handle_timer, (EventKind.TIMER,)),
+    (tcp.handle_tcp_rtx, (EventKind.TCP_RTX_TIMER,)),
+    (tcp.handle_tcp_dack, (EventKind.TCP_DACK_TIMER,)),
+    (tcp.handle_tcp_flush, (EventKind.TCP_FLUSH,)),
+    (tcp.handle_tcp_close, (EventKind.TCP_CLOSE_TIMER,)),
 )
-_POST_APP = (
-    nic.handle_nic_send,       # NIC_SEND + fused nic_send_now drain
-)
+_TCP_HANDLERS = (tcp.handle_tcp_rtx, tcp.handle_tcp_dack,
+                 tcp.handle_tcp_flush, tcp.handle_tcp_close)
+
+
+def _kind_pred(popped, kinds):
+    import jax.numpy as jnp
+
+    m = popped.valid & (popped.kind == kinds[0])
+    for k in kinds[1:]:
+        m = m | (popped.valid & (popped.kind == k))
+    return jnp.any(m)
 
 
 def _cpu_gate(cfg: NetConfig, sim, popped, buf):
@@ -89,26 +106,38 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
     only when the config carries TCP state (cfg.tcp) — UDP-only device
     programs stay small. A non-negative cfg.cpu_threshold_ns inserts
     the virtual-CPU admission gate ahead of everything."""
+    import jax
+    import jax.numpy as jnp
+
     pre = _PRE_APP if cfg.tcp else tuple(
-        h for h in _PRE_APP
-        if h not in (tcp.handle_tcp_rtx, tcp.handle_tcp_dack,
-                     tcp.handle_tcp_flush, tcp.handle_tcp_close))
+        (h, k) for h, k in _PRE_APP if h not in _TCP_HANDLERS)
     cpu_on = cfg.cpu_threshold_ns >= 0
 
     def step(sim, popped, buf):
         if cpu_on:
             sim, popped, buf = _cpu_gate(cfg, sim, popped, buf)
         sim, buf = _handle_proc_stop(cfg, sim, popped, buf)
-        for h in pre:
-            sim, buf = h(cfg, sim, popped, buf)
+        for h, kinds in pre:
+            sim, buf = jax.lax.cond(
+                _kind_pred(popped, kinds),
+                lambda op, h=h: h(cfg, op[0], popped, op[1]),
+                lambda op: op,
+                (sim, buf))
         # a stopped host's app no longer sees events (the plugin is
         # dead); the netstack handlers above still ran for it
         app_popped = popped._replace(
             valid=popped.valid & ~sim.net.proc_stopped)
         for h in app_handlers:
             sim, buf = h(cfg, sim, app_popped, buf)
-        for h in _POST_APP:
-            sim, buf = h(cfg, sim, popped, buf)
+        # the send drain also serves lanes whose nic_send_now bit was
+        # set by handlers above, not just popped NIC_SEND events
+        send_pred = _kind_pred(popped, (EventKind.NIC_SEND,)) \
+            | jnp.any(sim.net.nic_send_now)
+        sim, buf = jax.lax.cond(
+            send_pred,
+            lambda op: nic.handle_nic_send(cfg, op[0], popped, op[1]),
+            lambda op: op,
+            (sim, buf))
         return sim, buf
 
     return step

@@ -86,6 +86,20 @@ INIT_CWND = 1                      # packets: tcp_cong_reno_init overrides
 RESTART_CWND = 10                  # after RTO the reference restarts at 10
                                    # (tcp_cong_reno_timeout_ev_)
 INIT_SSTHRESH = 0x7FFFFFFF
+
+
+def initial_cwnd(cfg):
+    """Initial congestion window in packets (ref: --tcp-windows,
+    options.c:138, default honored only until tcp_cong_reno_init
+    resets to 1, tcp_cong_reno.c:176-180 — so 0 = keep that reference
+    behavior; a nonzero config pins the initial window)."""
+    return cfg.tcp_windows or INIT_CWND
+
+
+def initial_ssthresh(cfg):
+    """Initial slow-start threshold in packets (ref: --tcp-ssthresh,
+    options.c:137: 0 = discover via loss)."""
+    return cfg.tcp_ssthresh or INIT_SSTHRESH
 RTO_MIN_MS = 200                   # Linux-like floor
 RTO_MAX_MS = 60_000
 RTO_INIT_MS = 1_000
@@ -205,7 +219,9 @@ class TcpState:
     probes_sent: jax.Array  # [H] i64 zero-window persist probes
 
     @staticmethod
-    def create(num_hosts: int, sockets_per_host: int) -> "TcpState":
+    def create(num_hosts: int, sockets_per_host: int,
+           init_cwnd: int = INIT_CWND,
+           init_ssthresh: int = INIT_SSTHRESH) -> "TcpState":
         H, S = num_hosts, sockets_per_host
         zi = jnp.zeros((H, S), I32)
         zb = jnp.zeros((H, S), bool)
@@ -214,8 +230,8 @@ class TcpState:
             st=zi, snd_una=zi, snd_nxt=zi, snd_max=zi, snd_end=zi,
             snd_wnd=jnp.full((H, S), MSS, I32),
             fin_pending=zb, dup_acks=zi,
-            cwnd=jnp.full((H, S), INIT_CWND, I32),
-            ssthresh=jnp.full((H, S), INIT_SSTHRESH, I32),
+            cwnd=jnp.full((H, S), init_cwnd, I32),
+            ssthresh=jnp.full((H, S), init_ssthresh, I32),
             ca_acc=zi, in_recovery=zb, recover=zi,
             cub_wmax=zi, cub_epoch_ms=jnp.full((H, S), -1, I32),
             sack_l=jnp.zeros((H, S, SACK_RANGES), I32),
@@ -589,9 +605,10 @@ def _free_socket(cfg, sim, mask, slot):
     tcp = _set(tcp, "snd_wnd", mask, slot, jnp.full(mask.shape, MSS, I32))
     tcp = _set(tcp, "fin_pending", mask, slot, False)
     tcp = _set(tcp, "dup_acks", mask, slot, zero)
-    tcp = _set(tcp, "cwnd", mask, slot, jnp.full(mask.shape, INIT_CWND, I32))
+    tcp = _set(tcp, "cwnd", mask, slot,
+               jnp.full(mask.shape, initial_cwnd(cfg), I32))
     tcp = _set(tcp, "ssthresh", mask, slot,
-               jnp.full(mask.shape, INIT_SSTHRESH, I32))
+               jnp.full(mask.shape, initial_ssthresh(cfg), I32))
     tcp = _set(tcp, "ca_acc", mask, slot, zero)
     tcp = _set(tcp, "in_recovery", mask, slot, False)
     tcp = _set(tcp, "cub_wmax", mask, slot, zero)

@@ -62,11 +62,20 @@ class Tracker:
     """Formats reference-style heartbeat lines from counter deltas."""
 
     def __init__(self, logger: SimLogger, host_names: list[str],
-                 interval_s: int = 60, level: int = LogLevel.MESSAGE):
+                 interval_s: int = 60, level: int = LogLevel.MESSAGE,
+                 sections: tuple = ("node", "socket", "ram")):
         self.logger = logger
         self.host_names = host_names
         self.interval_s = interval_s
         self.level = level
+        # which heartbeat sections to emit (ref: --heartbeat-log-info,
+        # options.c:92: comma list of 'node','socket','ram')
+        self.sections = frozenset(sections)
+        unknown = self.sections - {"node", "socket", "ram"}
+        if unknown:
+            raise ValueError(
+                f"unknown heartbeat section(s) {sorted(unknown)}; "
+                f"valid: node, socket, ram")
         self._prev: _Snap | None = None
         self._did_node_header = False
         self._did_socket_header = False
@@ -78,9 +87,12 @@ class Tracker:
         _tracker_logNode / _tracker_logSocket / _tracker_logRAM,
         tracker.c:419-607; counters reduced to the fields this build
         tracks)."""
-        self._node_lines(sim, now_ns)
-        self._socket_lines(sim, now_ns)
-        self._ram_lines(sim, now_ns)
+        if "node" in self.sections:
+            self._node_lines(sim, now_ns)
+        if "socket" in self.sections:
+            self._socket_lines(sim, now_ns)
+        if "ram" in self.sections:
+            self._ram_lines(sim, now_ns)
         self.next_heartbeat_ns = now_ns + self.interval_s * 1_000_000_000
 
     def _node_lines(self, sim, now_ns: int):

@@ -98,3 +98,92 @@ def test_logger_sorts_by_simtime():
     assert lines[0].startswith("00:00:01.000000000 [info] [a] earlier")
     assert lines[1].endswith("earlier-second")
     assert lines[2].startswith("00:00:02.000000000")
+
+
+def test_cli_reference_compat_flags():
+    """Reference invocations using mechanism-less flags (--preload,
+    --gdb, --valgrind, --data-template, --interface-batch/-buffer;
+    options.c:89-132) must parse, and the sim-meaningful knobs
+    (--tcp-ssthresh/-windows, --cpu-threshold/-precision,
+    --heartbeat-log-info) must carry their reference units."""
+    p = make_parser()
+    a = p.parse_args([
+        "conf.xml", "--preload", "/usr/lib/libfoo.so", "--gdb",
+        "--valgrind", "--data-template", "shadow.data.template",
+        "--interface-batch", "5000", "--interface-buffer", "1024000",
+        "--tcp-ssthresh", "64", "--tcp-windows", "10",
+        "--cpu-threshold", "1000", "--cpu-precision", "200",
+        "-i", "node,ram",
+    ])
+    assert a.tcp_ssthresh == 64 and a.tcp_windows == 10
+    assert a.cpu_threshold == 1000 and a.cpu_precision == 200
+    assert a.heartbeat_log_info == "node,ram"
+
+
+def test_tcp_window_knobs_reach_state():
+    """--tcp-ssthresh / --tcp-windows initialize TcpState (ref:
+    options.c:137-138 -> tcp_new initial windows)."""
+    import numpy as np
+
+    from shadow_tpu.net.state import NetConfig, make_sim, make_net_state
+
+    cfg = NetConfig(num_hosts=1, tcp_ssthresh=64, tcp_windows=10)
+    net = make_net_state(
+        cfg, host_ips=np.array([0x0B000001], np.int64),
+        bw_up_kibps=np.array([1024]), bw_down_kibps=np.array([1024]),
+        vertex_of_host=np.array([0], np.int32),
+        latency_ns=np.array([[10**6]], np.int64),
+        reliability=np.array([[1.0]], np.float32),
+    )
+    sim = make_sim(cfg, net)
+    assert int(sim.tcp.cwnd[0, 0]) == 10
+    assert int(sim.tcp.ssthresh[0, 0]) == 64
+
+
+def test_tracker_sections_filter():
+    """--heartbeat-log-info gates which sections print (ref:
+    options.c:92, default 'node')."""
+    import io as _io
+
+    import numpy as np
+
+    from shadow_tpu.net.state import NetConfig, make_sim, make_net_state
+    from shadow_tpu.utils.shadowlog import SimLogger
+    from shadow_tpu.utils.tracker import Tracker
+
+    cfg = NetConfig(num_hosts=1, tcp=False)
+    net = make_net_state(
+        cfg, host_ips=np.array([0x0B000001], np.int64),
+        bw_up_kibps=np.array([1024]), bw_down_kibps=np.array([1024]),
+        vertex_of_host=np.array([0], np.int32),
+        latency_ns=np.array([[10**6]], np.int64),
+        reliability=np.array([[1.0]], np.float32),
+    )
+    sim = make_sim(cfg, net)
+    out = _io.StringIO()
+    lg = SimLogger(stream=out, buffered=False)
+    tr = Tracker(lg, ["h0"], interval_s=1, sections=("node",))
+    tr.heartbeat(sim, 10**9)
+    text = out.getvalue()
+    assert "[node-header]" in text
+    assert "[socket-header]" not in text and "[ram-header]" not in text
+
+
+def test_cli_knobs_reach_loader_overrides():
+    """The parsed flags must actually flow into the loader overrides
+    (units converted: CPU knobs are microseconds on the CLI,
+    nanoseconds in NetConfig)."""
+    from shadow_tpu.cli import overrides_from_args
+
+    p = make_parser()
+    a = p.parse_args(["conf.xml", "--tcp-ssthresh", "64",
+                      "--tcp-windows", "10", "--cpu-threshold", "1000"])
+    ov = overrides_from_args(a)
+    assert ov["tcp_ssthresh"] == 64 and ov["tcp_windows"] == 10
+    assert ov["cpu_threshold_ns"] == 1_000_000
+    assert ov["cpu_precision_ns"] == 200_000
+    # defaults stay out (loader keeps config/NetConfig values)
+    a2 = p.parse_args(["conf.xml"])
+    ov2 = overrides_from_args(a2)
+    assert "tcp_ssthresh" not in ov2 and "tcp_windows" not in ov2
+    assert "cpu_threshold_ns" not in ov2
