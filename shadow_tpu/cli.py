@@ -217,11 +217,13 @@ def main(argv=None) -> int:
 
             devs = jax.devices()[:args.workers]
             mesh = Mesh(np.array(devs), ("hosts",))
-            sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers)
+            sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers,
+                                     app_bulk=b.app_bulk)
         else:
             from shadow_tpu.net.build import run
 
-            sim, stats = run(b, app_handlers=loaded.handlers)
+            sim, stats = run(b, app_handlers=loaded.handlers,
+                             app_bulk=b.app_bulk)
         wall = time.time() - t0
 
         # end-of-run heartbeat + object accounting (ref: the tracker

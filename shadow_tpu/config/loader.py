@@ -47,6 +47,7 @@ def _configure_phold(bundle: SimBundle, assignments):
         load = int(kv.get("load", load))
         port = int(kv.get("port", port))
     bundle.sim = phold.setup(bundle.sim, load=load, port=port)
+    bundle.app_bulk = phold.BULK
     return (phold.handler,)
 
 

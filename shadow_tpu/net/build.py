@@ -74,6 +74,10 @@ class SimBundle:
     min_jump: int
     host_names: list[str]
     name_to_index: dict[str, int] = field(default_factory=dict)
+    # Optional net.bulk.AppBulk installed by the configured app model
+    # (config/loader.py): turns on the bulk window pass wherever the
+    # bundle is run (CLI serial, sharded, bench).
+    app_bulk: Any = None
 
     def ip_of(self, name: str) -> int:
         return self.dns.resolve_name(name).ip
@@ -176,6 +180,8 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     return jax.jit(_go)
 
 
-def run(bundle: SimBundle, app_handlers=(), end_time: int | None = None):
+def run(bundle: SimBundle, app_handlers=(), end_time: int | None = None,
+        app_bulk=None):
     """Run the whole simulation on device; returns (sim, stats)."""
-    return make_runner(bundle, app_handlers, end_time)(bundle.sim)
+    return make_runner(bundle, app_handlers, end_time,
+                       app_bulk=app_bulk)(bundle.sim)

@@ -187,3 +187,26 @@ def test_cli_knobs_reach_loader_overrides():
     ov2 = overrides_from_args(a2)
     assert "tcp_ssthresh" not in ov2 and "tcp_windows" not in ov2
     assert "cpu_threshold_ns" not in ov2
+
+
+def test_loader_installs_phold_bulk_and_matches_serial():
+    """The loader installs phold's bulk pass on the bundle
+    (bundle.app_bulk), and running WITH it is bit-identical to the
+    serial engine — the golden contract of net/bulk.py through the
+    config path."""
+    import numpy as np
+
+    cfg = parse_config(REFERENCE_PHOLD_XML)
+    loaded = load(cfg, seed=3)
+    assert loaded.bundle.app_bulk is not None
+    from shadow_tpu.net.build import run
+
+    sim_a, _ = run(loaded.bundle, app_handlers=loaded.handlers)
+    loaded_b = load(cfg, seed=3)
+    sim_b, stats_b = run(loaded_b.bundle, app_handlers=loaded_b.handlers,
+                         app_bulk=loaded_b.bundle.app_bulk)
+    assert int(sim_b.events.overflow) == 0
+    np.testing.assert_array_equal(np.asarray(sim_a.app.rcvd),
+                                  np.asarray(sim_b.app.rcvd))
+    np.testing.assert_array_equal(np.asarray(sim_a.events.time),
+                                  np.asarray(sim_b.events.time))
