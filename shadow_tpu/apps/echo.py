@@ -1,0 +1,211 @@
+"""On-device TCP echo application — the workload of the reference's
+dual-mode tcp tests (ref: src/test/tcp/test_tcp.c): the client
+connects, streams BUFFERSIZE (20,000) bytes, then receives the same
+number of bytes back and closes; the server accepts, drains the full
+message, echoes it, and closes after the client's EOF
+(test_tcp.c:713-806 _run_client/_run_server).
+
+The reference builds the same binary in four io modes (blocking /
+nonblocking-poll / nonblocking-epoll / nonblocking-select); the io
+mode changes how the PLUGIN waits, not what crosses the wire, so one
+device model covers all four — the config loader accepts any of them
+(config/loader.py _configure_testtcp). Content equality (the
+reference's memcmp) is represented by byte-count equality here;
+payload-content round-tripping is proven separately by the
+payload-pool tests (tests/test_payload.py).
+
+Servers handle children concurrently like apps/bulk.py: one accept
+plus one child operation (drain or echo-send) per wakeup,
+cyclic-fair.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net import tcp
+from shadow_tpu.net.rings import gather_hs
+from shadow_tpu.net.sockets import sk_bind, sk_create
+from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+BUFFERSIZE = 20_000   # ref: test_tcp.c:30
+CHUNK = 1 << 20
+
+
+@struct.dataclass
+class EchoApp:
+    is_client: jax.Array     # [H] bool
+    is_server: jax.Array     # [H] bool
+    lsock: jax.Array         # [H] i32 listener slot (-1)
+    csock: jax.Array         # [H] i32 client connection slot (-1)
+    server_ip: jax.Array     # [H] i64
+    server_port: jax.Array   # [H] i32
+    nbytes: jax.Array        # [H] i32 message size each direction
+    # client side
+    to_send: jax.Array       # [H] i32 bytes not yet submitted
+    connected: jax.Array     # [H] bool
+    c_rcvd: jax.Array        # [H] i64 echoed bytes received back
+    c_closed: jax.Array      # [H] bool
+    done_at: jax.Array       # [H] i64 client completion time (-1)
+    # server side (per accepted child)
+    children: jax.Array      # [H,S] bool
+    ch_rcvd: jax.Array       # [H,S] i32 bytes drained from this child
+    ch_to_echo: jax.Array    # [H,S] i32 echo bytes not yet submitted
+    ch_armed: jax.Array      # [H,S] bool echo phase started
+    child_rr: jax.Array      # [H] i32 fairness cursor
+    s_rcvd: jax.Array        # [H] i64 total server bytes drained
+    s_echoed: jax.Array      # [H] i64 total echo bytes submitted
+
+
+def setup(sim, *, client_mask, server_mask, server_ip, server_port: int,
+          nbytes: int = BUFFERSIZE):
+    H = sim.net.host_ip.shape[0]
+    S = sim.net.sk_type.shape[1]
+    net, lsock = sk_create(sim.net, server_mask, SocketType.TCP)
+    net, _ = sk_bind(net, server_mask, lsock, 0, server_port)
+    sim = sim.replace(net=net)
+    sim = tcp.tcp_listen(sim, server_mask, lsock)
+    net, csock = sk_create(sim.net, client_mask, SocketType.TCP)
+    sim = sim.replace(net=net)
+    app = EchoApp(
+        is_client=client_mask,
+        is_server=server_mask,
+        lsock=jnp.where(server_mask, lsock, -1),
+        csock=jnp.where(client_mask, csock, -1),
+        server_ip=jnp.broadcast_to(jnp.asarray(server_ip, I64), (H,)),
+        server_port=jnp.full((H,), server_port, I32),
+        nbytes=jnp.full((H,), nbytes, I32),
+        to_send=jnp.where(client_mask, nbytes, 0).astype(I32),
+        connected=jnp.zeros((H,), bool),
+        c_rcvd=jnp.zeros((H,), I64),
+        c_closed=jnp.zeros((H,), bool),
+        done_at=jnp.full((H,), -1, I64),
+        children=jnp.zeros((H, S), bool),
+        ch_rcvd=jnp.zeros((H, S), I32),
+        ch_to_echo=jnp.zeros((H, S), I32),
+        ch_armed=jnp.zeros((H, S), bool),
+        child_rr=jnp.zeros((H,), I32),
+        s_rcvd=jnp.zeros((H,), I64),
+        s_echoed=jnp.zeros((H,), I64),
+    )
+    return sim.replace(app=app)
+
+
+def _set_child(arr, mask, slot, val):
+    S = arr.shape[1]
+    sel = mask[:, None] & (jnp.arange(S)[None, :] == slot[:, None])
+    return jnp.where(sel, jnp.asarray(val, arr.dtype)[:, None]
+                     if jnp.ndim(val) == 1 else val, arr)
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    now = popped.time
+    woke = popped.valid
+    S = sim.net.sk_type.shape[1]
+
+    # ---- client: connect at PROC_START -------------------------------
+    start = woke & (popped.kind == EventKind.PROC_START) \
+        & app.is_client & ~app.connected
+    sim, buf = tcp.tcp_connect(cfg, sim, start, app.csock,
+                               app.server_ip, app.server_port, now, buf)
+    app = app.replace(connected=app.connected | start)
+    sim = sim.replace(app=app)
+
+    # ---- client: stream the outbound message -------------------------
+    feeding = woke & app.is_client & app.connected & (app.to_send > 0)
+    sim, buf, accepted = tcp.tcp_send(cfg, sim, feeding, app.csock,
+                                      jnp.minimum(app.to_send, CHUNK),
+                                      now, buf)
+    app = app.replace(to_send=app.to_send - accepted)
+    sim = sim.replace(app=app)
+
+    # ---- client: drain the echo, close when complete -----------------
+    # (ref: _run_client recv-then-close, test_tcp.c:744-764)
+    cready = (gather_hs(sim.net.sk_flags, app.csock)
+              & SocketFlags.READABLE) != 0
+    cdrain = woke & app.is_client & app.connected & cready & ~app.c_closed
+    sim, buf, nread, _eof = tcp.tcp_recv(sim, cdrain, app.csock,
+                                         jnp.full((app.csock.shape[0],),
+                                                  CHUNK, I32), now, buf)
+    app = sim.app.replace(c_rcvd=sim.app.c_rcvd + nread.astype(I64))
+    sim = sim.replace(app=app)
+    finish = woke & app.is_client & ~app.c_closed \
+        & (app.c_rcvd >= app.nbytes.astype(I64)) & (app.to_send == 0)
+    sim, buf = tcp.tcp_close(cfg, sim, finish, app.csock, now, buf)
+    app = app.replace(c_closed=app.c_closed | finish,
+                      done_at=jnp.where(finish, now, app.done_at))
+    sim = sim.replace(app=app)
+
+    # ---- server: accept one pending child per wakeup -----------------
+    lready = (gather_hs(sim.net.sk_flags, app.lsock)
+              & SocketFlags.READABLE) != 0
+    acc = woke & app.is_server & lready
+    sim, got, child = tcp.tcp_accept(sim, acc, app.lsock)
+    sel = got[:, None] & (jnp.arange(S)[None, :] == child[:, None])
+    app = app.replace(
+        children=app.children | sel,
+        ch_rcvd=jnp.where(sel, 0, app.ch_rcvd),
+        ch_to_echo=jnp.where(sel, 0, app.ch_to_echo),
+        ch_armed=jnp.where(sel, False, app.ch_armed),
+    )
+    sim = sim.replace(app=app)
+
+    # ---- server: operate one child (drain and/or echo), cyclic-fair --
+    readable = (sim.net.sk_flags & SocketFlags.READABLE) != 0
+    cand = app.children & (readable | (app.ch_to_echo > 0))
+    key = (jnp.arange(S)[None, :] - app.child_rr[:, None]) % S
+    key = jnp.where(cand, key, S + 1)
+    slot = jnp.argmin(key, axis=1).astype(I32)
+    have = jnp.any(cand, axis=1)
+    act = woke & app.is_server & have
+    slot = jnp.where(act, slot, -1)
+
+    # drain (ref: _run_server _do_recv, test_tcp.c:790-794)
+    sim, buf, nread, _eof2 = tcp.tcp_recv(
+        sim, act, slot, jnp.full((slot.shape[0],), CHUNK, I32), now, buf)
+    app = sim.app
+    rc = gather_hs(app.ch_rcvd, slot) + nread
+    app = app.replace(
+        ch_rcvd=_set_child(app.ch_rcvd, act, slot, rc),
+        s_rcvd=app.s_rcvd + nread.astype(I64),
+    )
+    # arm the echo once the whole message arrived
+    # (ref: _do_recv returns only at BUFFERSIZE, then _do_send)
+    arm = act & ~gather_hs(app.ch_armed, slot) \
+        & (gather_hs(app.ch_rcvd, slot) >= app.nbytes)
+    app = app.replace(
+        ch_armed=_set_child(app.ch_armed, arm, slot, jnp.ones_like(arm)),
+        ch_to_echo=_set_child(app.ch_to_echo, arm, slot, app.nbytes),
+    )
+    sim = sim.replace(app=app)
+
+    # echo-send
+    te = gather_hs(app.ch_to_echo, slot)
+    sending = act & (te > 0)
+    sim, buf, sent = tcp.tcp_send(cfg, sim, sending, slot,
+                                  jnp.minimum(te, CHUNK), now, buf)
+    app = sim.app
+    app = app.replace(
+        ch_to_echo=_set_child(app.ch_to_echo, sending, slot, te - sent),
+        s_echoed=app.s_echoed + sent.astype(I64),
+        child_rr=jnp.where(act, (slot + 1) % S, app.child_rr),
+    )
+    sim = sim.replace(app=app)
+
+    # close the child once the echo is fully submitted — the
+    # reference server closes right after _do_send, without waiting
+    # for the client's FIN (test_tcp.c:797-806); our FIN rides behind
+    # the queued echo data exactly like its close() does
+    done = act & gather_hs(app.ch_armed, slot) \
+        & (gather_hs(app.ch_to_echo, slot) == 0)
+    sim, buf = tcp.tcp_close(cfg, sim, done, slot, now, buf)
+    clear = done[:, None] & (jnp.arange(S)[None, :] == slot[:, None])
+    app = sim.app.replace(children=sim.app.children & ~clear)
+    return sim.replace(app=app), buf

@@ -130,7 +130,7 @@ _configure_phold.hints = _phold_hints
 
 register_plugin("phold", _configure_phold)
 register_plugin("shadow-plugin-test-phold", _configure_phold)
-def _tcp_stream_hints(assignments):
+def _tcp_stream_hints(assignments, n_clients=None):
     # a conservative window can deliver a full receive window of
     # in-flight segments at once (rcvbuf/MSS ~ 122 at the default
     # 174760 B buffer), and a fan-in server absorbs bursts from MANY
@@ -145,9 +145,11 @@ def _tcp_stream_hints(assignments):
     # retry backpressure handles anything beyond it.
     # tcp True: in a mixed config (e.g. bulk + pingpong) the
     # max-merge over plugin hints must keep the TCP machine
-    n_clients = sum(
-        1 for _, spec in assignments
-        if kv_arguments(spec.arguments).get("mode", "client") != "server")
+    if n_clients is None:
+        n_clients = sum(
+            1 for _, spec in assignments
+            if kv_arguments(spec.arguments).get("mode", "client")
+            != "server")
     cap = min(4096, max(256, 64 * max(n_clients, 1)))
     return {"event_capacity": cap, "outbox_capacity": cap,
             "router_ring": cap, "sockets_per_host": 8, "tcp": True}
@@ -163,6 +165,63 @@ def _udp_only_hints(assignments):
 
 _configure_pingpong.hints = _udp_only_hints
 
+def _configure_testtcp(bundle: SimBundle, assignments):
+    """The reference's dual-mode tcp test plugin (shd-test-tcp):
+    positional arguments `<iomode> server` / `<iomode> client
+    <server-hostname>` with iomode in blocking / nonblocking-poll /
+    nonblocking-epoll / nonblocking-select / iov (test_tcp.c:28
+    USAGE). All io modes share one wire behavior — a 20,000-byte
+    echo — so they map onto the one device model (apps/echo.py)."""
+    from shadow_tpu.apps import echo
+
+    H = bundle.cfg.num_hosts
+    client = np.zeros(H, bool)
+    server = np.zeros(H, bool)
+    server_name = None
+    for hi, spec in assignments:
+        args = list(spec.arguments)
+        mode = args[1] if len(args) > 1 else "server"
+        if mode == "server":
+            server[hi] = True
+        else:
+            client[hi] = True
+            if len(args) > 2:
+                server_name = args[2]
+    if server_name in ("localhost", "127.0.0.1"):
+        # the loopback configs run client and server on ONE host
+        # (tcp-*-loopback.test.shadow.config.xml); 127.0.0.1 rides the
+        # 1 ns loopback path (ref: network_interface.c:546-554)
+        server_ip = 0x7F000001
+    elif server_name is not None:
+        server_ip = bundle.ip_of(server_name)
+    else:
+        si = int(np.argmax(server))
+        server_ip = int(bundle.dns.host_ips(H)[si])
+    # the reference announces an ephemeral port over a message queue
+    # (test_tcp.c:197-206); a fixed well-known port is the same wire
+    port = 9999
+    bundle.sim = echo.setup(
+        bundle.sim, client_mask=jnp.asarray(client),
+        server_mask=jnp.asarray(server), server_ip=server_ip,
+        server_port=port)
+    return (echo.handler,)
+
+
+def _testtcp_hints(assignments):
+    # client/server is the SECOND positional argument here, not a kv
+    # "mode"; specs too short to say are servers, matching
+    # _configure_testtcp
+    n_clients = sum(1 for _, spec in assignments
+                    if (list(spec.arguments) + ["server", "server"])[1]
+                    != "server")
+    return _tcp_stream_hints(assignments, n_clients=n_clients)
+
+
+_configure_testtcp.hints = _testtcp_hints
+
+register_plugin("testtcp", _configure_testtcp)
+register_plugin("shadow-plugin-test-tcp", _configure_testtcp)
+register_plugin("libshadow-plugin-test-tcp.so", _configure_testtcp)
 register_plugin("pingpong", _configure_pingpong)
 register_plugin("tgen-ping", _configure_pingpong)
 register_plugin("bulk", _configure_bulk)
