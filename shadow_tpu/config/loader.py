@@ -222,6 +222,38 @@ _configure_testtcp.hints = _testtcp_hints
 register_plugin("testtcp", _configure_testtcp)
 register_plugin("shadow-plugin-test-tcp", _configure_testtcp)
 register_plugin("libshadow-plugin-test-tcp.so", _configure_testtcp)
+def _configure_testudp(bundle: SimBundle, assignments):
+    """The reference's udp test plugin (test-udp): positional
+    arguments `client <port>` / `server <port>`; the client sends one
+    datagram to the server's port and the server echoes it back
+    (test_udp.c test_sendto_one_byte) — the pingpong model with
+    count=1, size=1."""
+    from shadow_tpu.apps import pingpong
+
+    H = bundle.cfg.num_hosts
+    client = np.zeros(H, bool)
+    server = np.zeros(H, bool)
+    port = 5678
+    for hi, spec in assignments:
+        args = list(spec.arguments)
+        mode = args[0] if args else "server"
+        if len(args) > 1 and args[1].isdigit():
+            port = int(args[1])
+        if mode == "server":
+            server[hi] = True
+        else:
+            client[hi] = True
+    si = int(np.argmax(server))
+    server_ip = int(bundle.dns.host_ips(H)[si])
+    bundle.sim = pingpong.setup(
+        bundle.sim, client_mask=jnp.asarray(client),
+        server_mask=jnp.asarray(server), server_ip=server_ip,
+        server_port=port, count=1, size=1)
+    return (pingpong.handler,)
+
+
+register_plugin("testudp", _configure_testudp)
+register_plugin("test-udp", _configure_testudp)
 register_plugin("pingpong", _configure_pingpong)
 register_plugin("tgen-ping", _configure_pingpong)
 register_plugin("bulk", _configure_bulk)

@@ -63,3 +63,21 @@ def test_reference_tcp_epoll_loopback():
     sim = _run_config(
         "tcp-nonblocking-epoll-loopback.test.shadow.config.xml")
     _assert_echo_complete(sim)
+
+
+def test_reference_udp_echo():
+    """The reference's udp test config (udp.test.shadow.config.xml:
+    one client sends a datagram to testserver:5678 which echoes it,
+    test_udp.c test_sendto_one_byte)."""
+    text = (REF_TCP.parent / "udp" /
+            "udp.test.shadow.config.xml").read_text()
+    cfg = parse_config(text)
+    loaded = load(cfg, seed=7)
+    sim, stats = run(loaded.bundle, app_handlers=loaded.handlers)
+    from shadow_tpu.apps.pingpong import ROLE_CLIENT
+
+    app = sim.app
+    clients = np.asarray(app.role) == ROLE_CLIENT
+    assert clients.any()
+    assert int(np.asarray(app.rcvd)[clients].min()) == 1  # echo back
+    assert int(sim.events.overflow) == 0
