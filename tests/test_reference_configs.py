@@ -81,3 +81,12 @@ def test_reference_udp_echo():
     assert clients.any()
     assert int(np.asarray(app.rcvd)[clients].min()) == 1  # echo back
     assert int(sim.events.overflow) == 0
+
+
+def test_reference_tcp_iov():
+    """The iov config exercises the same echo through sendmsg/readv
+    paths in the reference (argument 'iov', test_tcp.c iov branch) —
+    wire-identical, and the positional-argument mapping must accept
+    the mode."""
+    sim = _run_config("tcp-iov.test.shadow.config.xml")
+    _assert_echo_complete(sim)
