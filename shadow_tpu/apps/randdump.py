@@ -1,0 +1,60 @@
+"""Random-source determinism probe — the workload of the reference's
+determinism fixture (ref: src/test/determinism/test_determinism.c:
+each of 50 hosts reads /dev/random, rand(), and the emulated clocks
+and prints the values; two runs of the simulation must produce
+byte-identical per-host output, determinism1_compare.cmake).
+
+The device analog: at PROC_START every host draws NSAMPLES values
+from its per-host counter-based random stream (core/rng.py — the
+seed-hierarchy replacement for the reference's /dev/random
+interposition) and records them, plus the virtual start time, in app
+state. tests/test_reference_configs.py runs the reference's
+determinism1 config twice and compares the recorded arrays
+bit-for-bit, and across shard counts via the sharded runner.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import rng
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net.state import NetConfig
+
+NSAMPLES = 8
+
+
+@struct.dataclass
+class RandDumpApp:
+    samples: jax.Array   # [H, NSAMPLES] f32 recorded draws
+    start_at: jax.Array  # [H] i64 virtual time of PROC_START (-1)
+
+
+def setup(sim):
+    H = sim.net.host_ip.shape[0]
+    return sim.replace(app=RandDumpApp(
+        samples=jnp.zeros((H, NSAMPLES), jnp.float32),
+        start_at=jnp.full((H,), -1, jnp.int64),
+    ))
+
+
+def handler(cfg: NetConfig, sim, popped, buf):
+    app = sim.app
+    start = popped.valid & (popped.kind == EventKind.PROC_START) \
+        & (app.start_at < 0)
+    net = sim.net
+    samples = app.samples
+    ctr = net.rng_ctr
+    for i in range(NSAMPLES):
+        v, ctr2 = rng.uniform(net.rng_keys, ctr)
+        samples = samples.at[:, i].set(
+            jnp.where(start, v, samples[:, i]))
+        ctr = jnp.where(start, ctr2, ctr)
+    net = net.replace(rng_ctr=ctr)
+    app = app.replace(
+        samples=samples,
+        start_at=jnp.where(start, popped.time, app.start_at),
+    )
+    return sim.replace(net=net, app=app), buf

@@ -252,6 +252,21 @@ def _configure_testudp(bundle: SimBundle, assignments):
     return (pingpong.handler,)
 
 
+def _configure_testdeterminism(bundle: SimBundle, assignments):
+    """The reference's determinism fixture plugin
+    (shadow-plugin-test-determinism): every host dumps values from
+    the simulated random sources and clocks; two runs must be
+    byte-identical (determinism1_compare.cmake). Maps to the
+    randdump model over the per-host counter streams."""
+    from shadow_tpu.apps import randdump
+
+    bundle.sim = randdump.setup(bundle.sim)
+    return (randdump.handler,)
+
+
+register_plugin("testdeterminism", _configure_testdeterminism)
+register_plugin("shadow-plugin-test-determinism",
+                _configure_testdeterminism)
 register_plugin("testudp", _configure_testudp)
 register_plugin("test-udp", _configure_testudp)
 register_plugin("pingpong", _configure_pingpong)

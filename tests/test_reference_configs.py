@@ -90,3 +90,37 @@ def test_reference_tcp_iov():
     the mode."""
     sim = _run_config("tcp-iov.test.shadow.config.xml")
     _assert_echo_complete(sim)
+
+
+def test_reference_determinism1_two_runs_and_shardings():
+    """The reference's determinism fixture verbatim: 50 hosts dump
+    random-source values; two runs must match bit-for-bit
+    (determinism1_compare.cmake), and — stronger than the reference's
+    gate — the same holds across shard counts."""
+    import jax
+    from jax.sharding import Mesh
+
+    from shadow_tpu.parallel.shard import run_sharded
+
+    text = (REF_TCP.parent / "determinism" /
+            "determinism1.test.shadow.config.xml").read_text()
+    cfg = parse_config(text)
+
+    def one_run():
+        loaded = load(cfg, seed=11)
+        sim, _ = run(loaded.bundle, app_handlers=loaded.handlers)
+        return (np.asarray(sim.app.samples).copy(),
+                np.asarray(sim.app.start_at).copy())
+
+    s1, t1 = one_run()
+    s2, t2 = one_run()
+    assert (t1 >= 0).all()
+    np.testing.assert_array_equal(s1, s2)
+    np.testing.assert_array_equal(t1, t2)
+
+    # across shard counts (50 hosts pad? 50 % 2 == 0): 2-way mesh
+    loaded = load(cfg, seed=11)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("hosts",))
+    sim, _ = run_sharded(loaded.bundle, mesh,
+                         app_handlers=loaded.handlers)
+    np.testing.assert_array_equal(np.asarray(sim.app.samples), s1)
