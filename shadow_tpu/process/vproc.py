@@ -44,6 +44,7 @@ from shadow_tpu.core.engine import EngineStats, step_window
 from shadow_tpu.core.events import EmitBuffer, apply_emissions
 from shadow_tpu.net import tcp as tcpmod
 from shadow_tpu.net import udp as udpmod
+from shadow_tpu.net.rings import set_hs
 from shadow_tpu.net.sockets import sk_bind, sk_create
 from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
 from shadow_tpu.net.step import make_step_fn
@@ -155,6 +156,37 @@ def gettime():
     """gettimeofday/clock_gettime analog: the current sim time in ns
     (ref: worker_getEmulatedTime, worker.c:385-390)."""
     return Sys("gettime", ())
+
+
+class SO:
+    """setsockopt/getsockopt option names (the SOL_SOCKET subset the
+    reference's sockbuf test exercises, test_sockbuf.c:57-88)."""
+
+    SNDBUF = 7   # Linux SO_SNDBUF
+    RCVBUF = 8   # Linux SO_RCVBUF
+
+
+def setsockopt(fd, opt, value):
+    """Set SO_SNDBUF/SO_RCVBUF. Like the reference, pinning a buffer
+    size disables that direction's TCP autotuning (the user-override
+    rule, master.c:355-364 / tcp.c:407-592)."""
+    return Sys("setsockopt", (fd, opt, value))
+
+
+def getsockopt(fd, opt):
+    return Sys("getsockopt", (fd, opt))
+
+
+def ioctl_inq(fd):
+    """ioctl(FIONREAD/SIOCINQ): bytes available to read (TCP: in-order
+    stream bytes awaiting recv; UDP: buffered datagram bytes)."""
+    return Sys("ioctl_inq", (fd,))
+
+
+def ioctl_outq(fd):
+    """ioctl(SIOCOUTQ/TIOCOUTQ): unsent+unacked output bytes (TCP) or
+    queued datagram bytes (UDP)."""
+    return Sys("ioctl_outq", (fd,))
 
 
 def wait_readable(fds):
@@ -610,6 +642,46 @@ class ProcessRuntime:
             return True, 0
         if op == "gettime":
             return True, now
+        if op == "setsockopt":
+            fd, opt, val = a
+            net = self.sim.net
+            slot = jnp.full_like(mask, fd, I32)
+            v = jnp.full(mask.shape, int(val), I32)
+            if opt == SO.SNDBUF:
+                net = net.replace(
+                    sk_sndbuf=set_hs(net.sk_sndbuf, mask, slot, v),
+                    autotune_snd=net.autotune_snd & ~mask)
+            elif opt == SO.RCVBUF:
+                net = net.replace(
+                    sk_rcvbuf=set_hs(net.sk_rcvbuf, mask, slot, v),
+                    autotune_rcv=net.autotune_rcv & ~mask)
+            else:
+                return True, -1
+            self.sim = self.sim.replace(net=net)
+            return True, 0
+        if op == "getsockopt":
+            fd, opt = a
+            net = self.sim.net
+            if opt == SO.SNDBUF:
+                return True, int(net.sk_sndbuf[h, fd])
+            if opt == SO.RCVBUF:
+                return True, int(net.sk_rcvbuf[h, fd])
+            return True, -1
+        if op == "ioctl_inq":
+            fd = a[0]
+            net = self.sim.net
+            if (int(net.sk_type[h, fd]) == SocketType.TCP
+                    and self.sim.tcp is not None):
+                return True, int(self.sim.tcp.app_rbytes[h, fd])
+            return True, int(net.in_bytes[h, fd])
+        if op == "ioctl_outq":
+            fd = a[0]
+            net = self.sim.net
+            if (int(net.sk_type[h, fd]) == SocketType.TCP
+                    and self.sim.tcp is not None):
+                t = self.sim.tcp
+                return True, int(t.snd_end[h, fd]) - int(t.snd_una[h, fd])
+            return True, int(net.out_bytes[h, fd])
         if op == "sendto":
             fd, ip, port, n = a
             ok = None
@@ -929,8 +1001,6 @@ class ProcessRuntime:
             else:
                 net = self.sim.net
                 sel = self._lane(h)
-                from shadow_tpu.net.rings import set_hs
-
                 slot = jnp.full_like(mask, fd, I32)
                 was_live = sel & (net.sk_type[:, fd] != SocketType.NONE)
                 net = net.replace(

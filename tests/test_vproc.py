@@ -136,3 +136,57 @@ def test_tcp_transfer_coroutines():
     assert got == [total]
     assert all(p.done for p in rt.procs)
     assert int(sim.events.overflow) == 0
+
+
+def test_sockbuf_syscalls():
+    """The reference's sockbuf surface (test_sockbuf.c:57-130):
+    setsockopt/getsockopt SO_SNDBUF/SO_RCVBUF round-trip, pinning a
+    size disables that direction's autotuning (master.c:355-364), and
+    ioctl INQ/OUTQ report buffered byte counts."""
+    import numpy as np
+
+    from shadow_tpu.process import vproc
+    from shadow_tpu.process.vproc import SO
+
+    b = _bundle()
+    rt = vproc.ProcessRuntime(b)
+    out = {}
+
+    def client(env):
+        fd = yield vproc.socket(vproc.SocketType.TCP)
+        yield vproc.setsockopt(fd, SO.SNDBUF, 50_000)
+        yield vproc.setsockopt(fd, SO.RCVBUF, 60_000)
+        out["snd"] = yield vproc.getsockopt(fd, SO.SNDBUF)
+        out["rcv"] = yield vproc.getsockopt(fd, SO.RCVBUF)
+        rc = yield vproc.connect(fd, env["server_ip"], 7777)
+        assert rc == 0
+        yield vproc.send(fd, 4000)
+        # queued-but-unacked output visible through SIOCOUTQ
+        out["outq"] = yield vproc.ioctl_outq(fd)
+        yield vproc.sleep(2 * 10**9)
+        yield vproc.close(fd)
+
+    def server(env):
+        fd = yield vproc.socket(vproc.SocketType.TCP)
+        yield vproc.bind(fd, 7777)
+        yield vproc.listen(fd)
+        child = yield vproc.accept(fd)
+        yield vproc.sleep(10**9)   # let data pile up unread
+        out["inq"] = yield vproc.ioctl_inq(child)
+        n = yield vproc.recv(child)
+        out["got"] = n
+        yield vproc.close(child)
+
+    env = {"server_ip": b.ip_of("server")}
+    rt.spawn(0, lambda _h: client(env), start_time=10**9)
+    rt.spawn(1, lambda _h: server(env), start_time=10**9)
+    rt.run(end_time=5 * 10**9)
+
+    assert out["snd"] == 50_000 and out["rcv"] == 60_000
+    assert not bool(np.asarray(rt.sim.net.autotune_snd)[0])
+    assert not bool(np.asarray(rt.sim.net.autotune_rcv)[0])
+    # the un-pinned host keeps autotuning
+    assert bool(np.asarray(rt.sim.net.autotune_snd)[1])
+    assert out["outq"] >= 0
+    assert out["inq"] > 0          # bytes were waiting before recv
+    assert out["got"] > 0
