@@ -158,6 +158,30 @@ def gettime():
     return Sys("gettime", ())
 
 
+TIMER_FD_BASE = 1 << 19   # timerfd handles above the pipe space
+
+
+def timerfd_create():
+    """timerfd_create() analog: allocates one of the host's
+    cfg.timers_per_host timer slots (ref: timer.c / host_createDescriptor
+    DT_TIMER). Returns a timer fd, or -1 when slots are exhausted."""
+    return Sys("timerfd_create", ())
+
+
+def timerfd_settime(tfd, expire_ns, interval_ns=0):
+    """Arm to fire at ABSOLUTE sim time expire_ns, then every
+    interval_ns (0 = one-shot); expire_ns 0 disarms (ref:
+    timer_setTime, timer.c:201-...)."""
+    return Sys("timerfd_settime", (tfd, expire_ns, interval_ns))
+
+
+def timerfd_read(tfd):
+    """Blocking timerfd read: waits until >=1 expiration, returns the
+    expiration count since the last read (ref: timer read semantics,
+    timer.c)."""
+    return Sys("timerfd_read", (tfd,))
+
+
 class SO:
     """setsockopt/getsockopt option names (the SOL_SOCKET subset the
     reference's sockbuf test exercises, test_sockbuf.c:57-88)."""
@@ -364,6 +388,12 @@ class ProcessRuntime:
         self.cfg: NetConfig = bundle.cfg
         self.sim = bundle.sim
         self.procs: list[_Proc] = []
+        # per-host timerfd slot allocator (timerfd_create) and
+        # per-(host,slot) read counter (keeps the ET edge base
+        # monotone: tm_expirations resets on read, so fires alone
+        # would repeat old values)
+        self._timer_alloc: dict = {}
+        self._timer_reads: dict = {}
         self._step = make_step_fn(self.cfg, app_handlers)
         if mesh is not None:
             from shadow_tpu.parallel.shard import make_sharded_window
@@ -503,6 +533,15 @@ class ProcessRuntime:
         """(in_gen, out_gen) of a socket fd; for a nested epoll, the
         sum of its watches' generations (monotonic — any child edge
         advances the parent's)."""
+        if fd >= TIMER_FD_BASE:
+            # monotone edge base: pending fires + 2x completed reads
+            # (a read consumes at least one fire, so the sum never
+            # revisits a previous value) + re-arms
+            ts = fd - TIMER_FD_BASE
+            n = int(self.sim.net.tm_expirations[p.host, ts])
+            g = int(self.sim.net.tm_gen[p.host, ts])
+            r = self._timer_reads.get((p.host, ts), 0)
+            return (n + 2 * r + g, 0)
         if fd >= PIPE_FD_BASE:
             ep = self._channels.get((p.host, fd))
             if ep is None:
@@ -540,6 +579,12 @@ class ProcessRuntime:
         """Current EPOLL.IN|OUT readiness of a socket fd, pipe fd, or
         a nested epoll fd (an epoll is readable when it would report
         at least one event — epoll-as-descriptor, ref: epoll.c:96-98)."""
+        if fd >= TIMER_FD_BASE:
+            # a timerfd is readable while unread expirations exist
+            # (ref: timer readiness drives epoll, timer.c + epoll.c)
+            ts = fd - TIMER_FD_BASE
+            n = int(self.sim.net.tm_expirations[p.host, ts])
+            return EPOLL.IN if n > 0 else 0
         if fd >= PIPE_FD_BASE:
             # channel status bits (ref: channel.c:22-60,147-180 flips)
             ep = self._channels.get((p.host, fd))
@@ -1018,6 +1063,37 @@ class ProcessRuntime:
                 self._flags_cache = None
                 self._tcp_st_cache = None
             return True, 0
+        if op == "timerfd_create":
+            nxt = self._timer_alloc.get(h, 0)
+            if nxt >= self.cfg.timers_per_host:
+                return True, -1
+            self._timer_alloc[h] = nxt + 1
+            return True, TIMER_FD_BASE + nxt
+        if op == "timerfd_settime":
+            tfd, expire, interval = a
+            slot = jnp.full_like(mask, tfd - TIMER_FD_BASE, I32)
+            from shadow_tpu.net import timers as timermod
+
+            if expire == 0:
+                self.sim = timermod.timer_disarm(self.sim, mask, slot)
+                return True, 0
+            self._apply(lambda sim, buf: timermod.timer_set(
+                sim, buf, mask, slot, expire, interval), now)
+            return True, 0
+        if op == "timerfd_read":
+            tfd = a[0]
+            ts = tfd - TIMER_FD_BASE
+            n = int(self.sim.net.tm_expirations[h, ts])
+            if n == 0:
+                return False, None
+            from shadow_tpu.net import timers as timermod
+
+            slot = jnp.full_like(mask, ts, I32)
+            sim2, cnt = timermod.timer_read(self.sim, mask, slot)
+            self.sim = sim2
+            self._timer_reads[(h, ts)] = \
+                self._timer_reads.get((h, ts), 0) + 1
+            return True, int(cnt[h])
         if op == "sleep":
             if p.block is None:
                 p.wake_time = now + int(a[0])

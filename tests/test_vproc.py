@@ -190,3 +190,44 @@ def test_sockbuf_syscalls():
     assert out["outq"] >= 0
     assert out["inq"] > 0          # bytes were waiting before recv
     assert out["got"] > 0
+
+
+def test_timerfd_syscalls():
+    """timerfd parity through the virtual-process surface (ref:
+    timer.c + the timerfd/ test dir): create, arm absolute+interval,
+    blocking read returns the expiration count, epoll watches a
+    timerfd, disarm invalidates in-flight expirations."""
+    from shadow_tpu.process.vproc import EPOLL
+
+    b = _bundle()
+    rt = vproc.ProcessRuntime(b)
+    out = {}
+
+    def proc(_h):
+        tfd = yield vproc.timerfd_create()
+        assert tfd >= vproc.TIMER_FD_BASE
+        # periodic: first at 2s, then every 1s
+        yield vproc.timerfd_settime(tfd, 2 * 10**9, 10**9)
+        n1 = yield vproc.timerfd_read(tfd)        # blocks until >= 1
+        t1 = yield vproc.gettime()
+        out["n1"], out["t1"] = n1, t1
+        # epoll on the timerfd
+        ep = yield vproc.epoll_create()
+        yield vproc.epoll_ctl(ep, EPOLL.CTL_ADD, tfd, EPOLL.IN)
+        evs = yield vproc.epoll_wait(ep)
+        out["evs"] = evs
+        n2 = yield vproc.timerfd_read(tfd)
+        out["n2"] = n2
+        # disarm: no further fires counted
+        yield vproc.timerfd_settime(tfd, 0)
+        yield vproc.sleep(3 * 10**9)
+        out["after_disarm"] = int(rt.sim.net.tm_expirations[0, 0])
+
+    rt.spawn(0, proc, start_time=10**9)
+    rt.run(end_time=10 * 10**9)
+
+    assert out["n1"] >= 1
+    assert out["t1"] >= 2 * 10**9
+    assert out["evs"] and out["evs"][0][0] >= vproc.TIMER_FD_BASE
+    assert out["n2"] >= 1
+    assert out["after_disarm"] == 0
