@@ -145,6 +145,18 @@ def close(fd):
     return Sys("close", (fd,))
 
 
+SHUT_RD, SHUT_WR, SHUT_RDWR = 0, 1, 2
+
+
+def shutdown(fd, how=SHUT_WR):
+    """shutdown(2) analog (ref: process_emu_shutdown). SHUT_WR sends
+    FIN behind any queued data while the fd stays readable — the
+    half-close the reference's shutdown/ test exercises. SHUT_RD is a
+    local no-op (arriving data is still buffered, like Linux);
+    SHUT_RDWR behaves as SHUT_WR."""
+    return Sys("shutdown", (fd, how))
+
+
 def sleep(ns):
     """nanosleep (ref: process_emu_nanosleep -> pth_nanosleep,
     process.c:3141-3148); wakes at the first window boundary >= the
@@ -675,8 +687,25 @@ class ProcessRuntime:
                 return True, events
             return False, None
         if op == "bind":
-            net, port = sk_bind(self.sim.net, mask, jnp.full_like(mask, a[0], I32),
-                                0, a[1])
+            fd, want = a[0], int(a[1])
+            # EADDRINUSE: another live same-protocol socket on this
+            # host already binds the requested port (ref:
+            # _host_isInterfaceAvailable -> networkinterface_isAssociated,
+            # host.c:1029-1052; ephemeral requests scan for a free
+            # port instead, host.c:1087-1099 — our counter allocator
+            # never collides with itself, so only explicit ports can
+            # conflict)
+            net = self.sim.net
+            if want != 0:
+                proto = int(net.sk_type[h, fd])
+                taken = bool(np.any(
+                    (np.asarray(net.sk_type[h]) == proto)
+                    & (np.asarray(net.sk_bound_port[h]) == want)
+                    & (np.arange(net.sk_type.shape[1]) != fd)))
+                if taken:
+                    return True, -1
+            net, port = sk_bind(net, mask, jnp.full_like(mask, fd, I32),
+                                0, want)
             self.sim = self.sim.replace(net=net)
             return True, int(port[h])
         if op == "listen":
@@ -1094,6 +1123,14 @@ class ProcessRuntime:
             self._timer_reads[(h, ts)] = \
                 self._timer_reads.get((h, ts), 0) + 1
             return True, int(cnt[h])
+        if op == "shutdown":
+            fd, how = a
+            if how in (SHUT_WR, SHUT_RDWR) \
+                    and int(self.sim.net.sk_type[h, fd]) == SocketType.TCP:
+                self._apply(lambda sim, buf: tcpmod.tcp_close(
+                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
+                    now, buf), now)
+            return True, 0
         if op == "sleep":
             if p.block is None:
                 p.wake_time = now + int(a[0])

@@ -231,3 +231,81 @@ def test_timerfd_syscalls():
     assert out["evs"] and out["evs"][0][0] >= vproc.TIMER_FD_BASE
     assert out["n2"] >= 1
     assert out["after_disarm"] == 0
+
+
+def test_bind_eaddrinuse():
+    """Binding an explicit port twice on one host fails (ref: the
+    bind/ test dir; _host_isInterfaceAvailable, host.c:1029-1052),
+    while ephemeral binds keep succeeding, and the same port on a
+    DIFFERENT host is fine."""
+    b = _bundle()
+    rt = vproc.ProcessRuntime(b)
+    out = {}
+
+    def proc_a(_h):
+        f1 = yield vproc.socket(SocketType.UDP)
+        r1 = yield vproc.bind(f1, 4242)
+        f2 = yield vproc.socket(SocketType.UDP)
+        r2 = yield vproc.bind(f2, 4242)     # conflict
+        f3 = yield vproc.socket(SocketType.UDP)
+        r3 = yield vproc.bind(f3, 0)        # ephemeral: fine
+        out["a"] = (r1, r2, r3)
+
+    def proc_b(_h):
+        fd = yield vproc.socket(SocketType.UDP)
+        out["b"] = yield vproc.bind(fd, 4242)  # other host: fine
+
+    rt.spawn(0, proc_a)
+    rt.spawn(1, proc_b)
+    rt.run(end_time=10**9)
+
+    r1, r2, r3 = out["a"]
+    assert r1 == 4242 and r2 == -1 and r3 > 0
+    assert out["b"] == 4242
+
+
+def test_shutdown_half_close():
+    """shutdown(SHUT_WR) sends FIN but the socket stays readable —
+    the client half-closes after its request and still receives the
+    full response (ref: the shutdown/ test shape; the server sees EOF
+    after draining the request)."""
+    b = _bundle()
+    rt = vproc.ProcessRuntime(b)
+    out = {}
+
+    def client(_h):
+        fd = yield vproc.socket(SocketType.TCP)
+        rc = yield vproc.connect(fd, b.ip_of("server"), 7878)
+        assert rc == 0
+        yield vproc.send(fd, 3000)
+        yield vproc.shutdown(fd, vproc.SHUT_WR)   # half-close
+        total = 0
+        while True:
+            n = yield vproc.recv(fd)
+            if n == 0:
+                break
+            total += n
+        out["client_rcvd"] = total
+        yield vproc.close(fd)
+
+    def server(_h):
+        lfd = yield vproc.socket(SocketType.TCP)
+        yield vproc.bind(lfd, 7878)
+        yield vproc.listen(lfd)
+        child = yield vproc.accept(lfd)
+        got = 0
+        while True:
+            n = yield vproc.recv(child)
+            if n == 0:        # client's FIN after the half-close
+                break
+            got += n
+        out["server_rcvd"] = got
+        yield vproc.send(child, 5000)   # respond AFTER client's FIN
+        yield vproc.close(child)
+
+    rt.spawn(0, client, start_time=10**9)
+    rt.spawn(1, server, start_time=10**9)
+    rt.run(end_time=15 * 10**9)
+
+    assert out["server_rcvd"] == 3000
+    assert out["client_rcvd"] == 5000
