@@ -171,45 +171,55 @@ def main(argv=None) -> int:
     # each round, slave.c:446-450)
     try:
         cfg = parse_config(text)
-        # a relative <topology path> is relative to the CONFIG FILE, not
-        # the cwd (so `shadow-tpu some/dir/shadow.config.xml` works from
-        # anywhere — the reference resolves the same way)
-        if args.config and cfg.topology_path \
-                and not os.path.isabs(cfg.topology_path):
-            import dataclasses
-
-            cfg = dataclasses.replace(cfg, topology_path=os.path.join(
-                os.path.dirname(os.path.abspath(args.config)),
-                cfg.topology_path))
+        # relative <topology path> / <plugin path="*.py"> entries are
+        # relative to the CONFIG FILE, not the cwd (the reference
+        # resolves the same way) — load() handles both via base_dir
         loaded = load(cfg, seed=args.seed,
-                      overrides=overrides_from_args(args))
+                      overrides=overrides_from_args(args),
+                      base_dir=os.path.dirname(os.path.abspath(args.config))
+                      if args.config else None)
         b = loaded.bundle
         logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
                        f"min window {b.min_jump} ns, "
                        f"end {b.cfg.end_time} ns")
 
         t0 = time.time()
+        cap = None
         if b.cfg.pcap:
-            # pcap capture needs the host window loop to drain the ring
-            # (ref: per-interface PCapWriter, pcap_writer.c)
-            from shadow_tpu.utils import checkpoint as ckpt
+            # pcap capture needs a host-driven window loop to drain
+            # the ring (ref: per-interface PCapWriter, pcap_writer.c)
             from shadow_tpu.utils.pcap import CaptureSession
+
+            cap = CaptureSession(b, args.data_directory)
+        if loaded.vprocs:
+            # .py plugins: coroutine processes over the simulated
+            # syscall surface — the config-reachable form of the
+            # reference's plugin loading (SURVEY §7.1). Composes with
+            # pcap: the runtime's window loop drains the capture ring.
+            from shadow_tpu.process.vproc import ProcessRuntime
+
+            mesh = None
+            if args.workers > 1 and not b.cfg.pcap:
+                from jax.sharding import Mesh
+
+                mesh = Mesh(np.array(jax.devices()[:args.workers]),
+                            ("hosts",))
+            rt = ProcessRuntime(b, app_handlers=loaded.handlers,
+                                mesh=mesh)
+            for hi, fn, st, sp in loaded.vprocs:
+                rt.spawn(hi, fn, start_time=st, stop_time=sp)
+            sim, stats = rt.run(
+                on_window=(lambda s, wend: cap.drain(s)) if cap else None)
+        elif b.cfg.pcap:
+            from shadow_tpu.utils import checkpoint as ckpt
 
             if args.workers > 1:
                 logger.warning(0, "shadow-tpu",
                                f"logpcap forces the serial window loop; "
                                f"--workers {args.workers} ignored")
-
-            cap = CaptureSession(b, args.data_directory)
             sim, stats, _ = ckpt.run_windows(
                 b, app_handlers=loaded.handlers,
                 on_window=lambda s, wend: cap.drain(s))
-            cap.drain(sim)
-            cap.close()
-            if cap.dropped:
-                logger.warning(b.cfg.end_time, "shadow-tpu",
-                               f"pcap ring overran: {cap.dropped} records "
-                               f"lost (raise NetConfig.pcap_ring)")
         elif args.workers > 1:
             from jax.sharding import Mesh
 
@@ -224,6 +234,13 @@ def main(argv=None) -> int:
 
             sim, stats = run(b, app_handlers=loaded.handlers,
                              app_bulk=b.app_bulk)
+        if cap is not None:
+            cap.drain(sim)
+            cap.close()
+            if cap.dropped:
+                logger.warning(b.cfg.end_time, "shadow-tpu",
+                               f"pcap ring overran: {cap.dropped} records "
+                               f"lost (raise NetConfig.pcap_ring)")
         wall = time.time() - t0
 
         # end-of-run heartbeat + object accounting (ref: the tracker

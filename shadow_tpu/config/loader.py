@@ -5,6 +5,7 @@ register-hosts path (ref: master.c:161-398).
 
 from __future__ import annotations
 
+import pathlib
 from dataclasses import dataclass
 from typing import Callable
 
@@ -281,10 +282,14 @@ class LoadedSim:
     bundle: SimBundle
     handlers: tuple
     config: ShadowConfig
+    # virtual-process coroutines from .py plugins:
+    # (host_index, proc_fn(host)->generator, start_ns, stop_ns)
+    vprocs: tuple = ()
 
 
 def load(config: ShadowConfig, *, seed: int = 1,
-         overrides: dict | None = None) -> LoadedSim:
+         overrides: dict | None = None,
+         base_dir: str | None = None) -> LoadedSim:
     """ShadowConfig -> built SimBundle + app handlers. `overrides`
     carries CLI-level settings (qdisc, buffers, runahead — the
     reference's Options-beats-XML precedence is inverted for host
@@ -293,7 +298,12 @@ def load(config: ShadowConfig, *, seed: int = 1,
     if config.topology_text is not None:
         graphml = config.topology_text
     else:
-        with open(config.topology_path) as f:
+        tp = config.topology_path
+        if base_dir and not pathlib.Path(tp).is_absolute():
+            # relative <topology path> is relative to the CONFIG FILE
+            # (the reference resolves the same way)
+            tp = str(pathlib.Path(base_dir) / tp)
+        with open(tp) as f:
             graphml = f.read()
 
     host_specs: list[HostSpec] = []
@@ -374,10 +384,54 @@ def load(config: ShadowConfig, *, seed: int = 1,
                               * simtime.ONE_MILLISECOND)
 
     handlers: list = []
+    vprocs: list = []
     for model, asg in assignments.items():
+        if model.endswith(".py"):
+            if base_dir and not pathlib.Path(model).is_absolute():
+                # like <topology path>, a relative plugin path is
+                # relative to the CONFIG FILE
+                model = str(pathlib.Path(base_dir) / model)
+            # Python-file plugin: the virtual-process form of the
+            # reference's plugin .so loading (SURVEY §7.1 — apps are
+            # coroutines against the simulated-syscall surface
+            # instead of interposed binaries). The module defines
+            #   def main(env): ... yield vproc.<syscall>() ...
+            # env: host (name), host_index, args (the <process>
+            # arguments), resolve(name) -> ip, cfg.
+            import importlib.util
+            import os
+
+            if not os.path.isfile(model):
+                raise ValueError(
+                    f"plugin file '{model}' not found (paths resolve "
+                    f"relative to the config file)")
+            spec_ = importlib.util.spec_from_file_location(
+                pathlib.Path(model).stem, model)
+            mod = importlib.util.module_from_spec(spec_)
+            spec_.loader.exec_module(mod)
+            if not hasattr(mod, "main"):
+                raise ValueError(
+                    f"plugin '{model}' defines no main(env) generator")
+            for hi, p in asg:
+                env = {
+                    "host": bundle.host_names[hi],
+                    "host_index": hi,
+                    "args": list(p.arguments),
+                    "resolve": bundle.ip_of,
+                    "cfg": bundle.cfg,
+                }
+                vprocs.append((
+                    hi,
+                    (lambda _h, m=mod, e=env: m.main(e)),
+                    p.starttime or 0,
+                    p.stoptime if p.stoptime else -1,
+                ))
+            continue
         if model not in _REGISTRY:
             raise ValueError(
                 f"unknown plugin model '{model}' (registered: "
-                f"{plugin_names()}); register_plugin() to extend")
+                f"{plugin_names()}, or a path to a .py plugin file); "
+                f"register_plugin() to extend")
         handlers.extend(_REGISTRY[model](bundle, asg))
-    return LoadedSim(bundle=bundle, handlers=tuple(handlers), config=config)
+    return LoadedSim(bundle=bundle, handlers=tuple(handlers),
+                     config=config, vprocs=tuple(vprocs))

@@ -1249,9 +1249,12 @@ class ProcessRuntime:
                 freed += 1
         return freed
 
-    def run(self, end_time: int | None = None):
+    def run(self, end_time: int | None = None, on_window=None):
         """The master window loop (ref: master.c:450-480 +
-        slave.c:413-466) with coroutine continuation between windows."""
+        slave.c:413-466) with coroutine continuation between windows.
+        `on_window(sim, wend)` runs after every device window — pcap
+        drains, heartbeats, progress hooks (mirrors
+        checkpoint.run_windows)."""
         end = end_time if end_time is not None else self.cfg.end_time
         min_jump = max(int(self.bundle.min_jump), 1)
 
@@ -1291,6 +1294,8 @@ class ProcessRuntime:
             # wait_readable polls read stale readiness forever
             self._flags_cache = None
             self._tcp_st_cache = None
+            if on_window is not None:
+                on_window(self.sim, wend)
             total = EngineStats(
                 events_processed=total.events_processed
                 + stats.events_processed,
