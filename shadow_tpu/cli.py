@@ -191,6 +191,15 @@ def main(argv=None) -> int:
             from shadow_tpu.utils.pcap import CaptureSession
 
             cap = CaptureSession(b, args.data_directory)
+        mesh = None
+        if args.workers > 1 and b.cfg.pcap:
+            logger.warning(0, "shadow-tpu",
+                           f"logpcap forces the serial window loop; "
+                           f"--workers {args.workers} ignored")
+        elif args.workers > 1:
+            from jax.sharding import Mesh
+
+            mesh = Mesh(np.array(jax.devices()[:args.workers]), ("hosts",))
         if loaded.vprocs:
             # .py plugins: coroutine processes over the simulated
             # syscall surface — the config-reachable form of the
@@ -198,12 +207,13 @@ def main(argv=None) -> int:
             # pcap: the runtime's window loop drains the capture ring.
             from shadow_tpu.process.vproc import ProcessRuntime
 
-            mesh = None
-            if args.workers > 1 and not b.cfg.pcap:
-                from jax.sharding import Mesh
-
-                mesh = Mesh(np.array(jax.devices()[:args.workers]),
-                            ("hosts",))
+            if b.app_bulk is not None:
+                # ProcessRuntime's window loop has no bulk-pass hook
+                # yet; a mixed .py-plugin + bulk-capable-app config
+                # falls back to per-event micro-steps.
+                logger.warning(0, "shadow-tpu",
+                               "bulk window pass unavailable with .py "
+                               "plugins; using per-event micro-steps")
             rt = ProcessRuntime(b, app_handlers=loaded.handlers,
                                 mesh=mesh)
             for hi, fn, st, sp in loaded.vprocs:
@@ -213,20 +223,12 @@ def main(argv=None) -> int:
         elif b.cfg.pcap:
             from shadow_tpu.utils import checkpoint as ckpt
 
-            if args.workers > 1:
-                logger.warning(0, "shadow-tpu",
-                               f"logpcap forces the serial window loop; "
-                               f"--workers {args.workers} ignored")
             sim, stats, _ = ckpt.run_windows(
                 b, app_handlers=loaded.handlers,
                 on_window=lambda s, wend: cap.drain(s))
-        elif args.workers > 1:
-            from jax.sharding import Mesh
-
+        elif mesh is not None:
             from shadow_tpu.parallel.shard import run_sharded
 
-            devs = jax.devices()[:args.workers]
-            mesh = Mesh(np.array(devs), ("hosts",))
             sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers,
                                      app_bulk=b.app_bulk)
         else:

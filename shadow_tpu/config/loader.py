@@ -295,15 +295,18 @@ def load(config: ShadowConfig, *, seed: int = 1,
     reference's Options-beats-XML precedence is inverted for host
     element attributes, matching master.c:355-364)."""
     overrides = overrides or {}
+
+    def _resolve(path: str) -> str:
+        # a relative <topology path> / <plugin path> is relative to
+        # the CONFIG FILE (the reference resolves the same way)
+        if base_dir and not pathlib.Path(path).is_absolute():
+            return str(pathlib.Path(base_dir) / path)
+        return path
+
     if config.topology_text is not None:
         graphml = config.topology_text
     else:
-        tp = config.topology_path
-        if base_dir and not pathlib.Path(tp).is_absolute():
-            # relative <topology path> is relative to the CONFIG FILE
-            # (the reference resolves the same way)
-            tp = str(pathlib.Path(base_dir) / tp)
-        with open(tp) as f:
+        with open(_resolve(config.topology_path)) as f:
             graphml = f.read()
 
     host_specs: list[HostSpec] = []
@@ -378,6 +381,54 @@ def load(config: ShadowConfig, *, seed: int = 1,
                     "tcp_windows", "cpu_threshold_ns",
                     "cpu_precision_ns")},
     )
+    # Validate plugin references BEFORE the expensive device build: a
+    # config typo should fail in milliseconds, not after a multi-minute
+    # state build/compile at scale.
+    py_modules: dict = {}
+    for model in assignments:
+        if not model.endswith(".py"):
+            if model not in _REGISTRY:
+                raise ValueError(
+                    f"unknown plugin model '{model}' (registered: "
+                    f"{plugin_names()}, or a path to a .py plugin "
+                    f"file); register_plugin() to extend")
+            continue
+        path = _resolve(model)
+        # Python-file plugin: the virtual-process form of the
+        # reference's plugin .so loading (SURVEY §7.1 — apps are
+        # coroutines against the simulated-syscall surface
+        # instead of interposed binaries). The module defines
+        #   def main(env): ... yield vproc.<syscall>() ...
+        # env: host (name), host_index, args (the <process>
+        # arguments), resolve(name) -> ip, cfg.
+        import importlib.util
+        import inspect
+        import os
+        import sys
+
+        if not os.path.isfile(path):
+            raise ValueError(
+                f"plugin file '{path}' not found (paths resolve "
+                f"relative to the config file)")
+        # full-path hash in the name: two plugins may share a basename
+        # (clients/app.py vs servers/app.py) and must not collide
+        import hashlib
+
+        digest = hashlib.sha1(path.encode()).hexdigest()[:8]
+        modname = f"shadow_tpu_plugin_{pathlib.Path(path).stem}_{digest}"
+        spec_ = importlib.util.spec_from_file_location(modname, path)
+        mod = importlib.util.module_from_spec(spec_)
+        # register before exec so pickling / get_type_hints machinery
+        # can find the module by name (the documented importlib recipe)
+        sys.modules[modname] = mod
+        spec_.loader.exec_module(mod)
+        if not inspect.isgeneratorfunction(getattr(mod, "main", None)):
+            raise ValueError(
+                f"plugin '{path}' defines no main(env) generator "
+                f"(main must be a generator function yielding vproc "
+                f"syscalls)")
+        py_modules[model] = mod
+
     bundle = build(cfg, graphml, host_specs)
     if "runahead" in overrides and overrides["runahead"]:
         bundle.min_jump = int(overrides["runahead"]
@@ -387,31 +438,7 @@ def load(config: ShadowConfig, *, seed: int = 1,
     vprocs: list = []
     for model, asg in assignments.items():
         if model.endswith(".py"):
-            if base_dir and not pathlib.Path(model).is_absolute():
-                # like <topology path>, a relative plugin path is
-                # relative to the CONFIG FILE
-                model = str(pathlib.Path(base_dir) / model)
-            # Python-file plugin: the virtual-process form of the
-            # reference's plugin .so loading (SURVEY §7.1 — apps are
-            # coroutines against the simulated-syscall surface
-            # instead of interposed binaries). The module defines
-            #   def main(env): ... yield vproc.<syscall>() ...
-            # env: host (name), host_index, args (the <process>
-            # arguments), resolve(name) -> ip, cfg.
-            import importlib.util
-            import os
-
-            if not os.path.isfile(model):
-                raise ValueError(
-                    f"plugin file '{model}' not found (paths resolve "
-                    f"relative to the config file)")
-            spec_ = importlib.util.spec_from_file_location(
-                pathlib.Path(model).stem, model)
-            mod = importlib.util.module_from_spec(spec_)
-            spec_.loader.exec_module(mod)
-            if not hasattr(mod, "main"):
-                raise ValueError(
-                    f"plugin '{model}' defines no main(env) generator")
+            mod = py_modules[model]
             for hi, p in asg:
                 env = {
                     "host": bundle.host_names[hi],
@@ -424,14 +451,13 @@ def load(config: ShadowConfig, *, seed: int = 1,
                     hi,
                     (lambda _h, m=mod, e=env: m.main(e)),
                     p.starttime or 0,
+                    # stoptime absent OR "0" = run to sim end: the
+                    # reference maps unset to 0 (master.c:300) and
+                    # only schedules a stop when stopTime > 0
+                    # (process.c:1348), so 0 is "never stop" there too
                     p.stoptime if p.stoptime else -1,
                 ))
             continue
-        if model not in _REGISTRY:
-            raise ValueError(
-                f"unknown plugin model '{model}' (registered: "
-                f"{plugin_names()}, or a path to a .py plugin file); "
-                f"register_plugin() to extend")
         handlers.extend(_REGISTRY[model](bundle, asg))
     return LoadedSim(bundle=bundle, handlers=tuple(handlers),
                      config=config, vprocs=tuple(vprocs))

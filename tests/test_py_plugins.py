@@ -8,7 +8,6 @@ virtual process on each assigned host."""
 import contextlib
 import io
 import json
-import pathlib
 
 import pytest
 
