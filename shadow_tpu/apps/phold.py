@@ -87,6 +87,7 @@ class PholdBulk:
     (handle_nic_send, same micro-step)."""
 
     max_send_len = MSG_SIZE
+    resolves_dst = True   # peers are picked by index; dst_host always set
 
     def precheck(self, cfg, sim):
         # injection still running (PROC_START/KIND_INJECT chains) is

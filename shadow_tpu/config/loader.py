@@ -402,7 +402,6 @@ def load(config: ShadowConfig, *, seed: int = 1,
         # env: host (name), host_index, args (the <process>
         # arguments), resolve(name) -> ip, cfg.
         import importlib.util
-        import inspect
         import os
         import sys
 
@@ -422,11 +421,12 @@ def load(config: ShadowConfig, *, seed: int = 1,
         # can find the module by name (the documented importlib recipe)
         sys.modules[modname] = mod
         spec_.loader.exec_module(mod)
-        if not inspect.isgeneratorfunction(getattr(mod, "main", None)):
+        # callable is the runtime contract (main(env) must return a
+        # generator, but a plain wrapper delegating to one is fine)
+        if not callable(getattr(mod, "main", None)):
             raise ValueError(
-                f"plugin '{path}' defines no main(env) generator "
-                f"(main must be a generator function yielding vproc "
-                f"syscalls)")
+                f"plugin '{path}' defines no callable main(env) "
+                f"(it must return a generator yielding vproc syscalls)")
         py_modules[model] = mod
 
     bundle = build(cfg, graphml, host_specs)

@@ -324,6 +324,60 @@ def segment_ranks(sorted_keys: jax.Array) -> jax.Array:
     return pos - seg_start
 
 
+# Group width for insert_flat's sort-free "count-route": cross-group
+# ranks come from a scatter-add [n/G, H] count matrix + exclusive
+# cumsum, within-group ranks from an [n/G, G, G] compare cube. Larger
+# G shrinks the count matrix and grows the cube.
+INSERT_GROUP = 64
+# Above these element counts the count matrix / free-slot cube are
+# worse than the sort path (and at 100k unsharded hosts the count
+# matrix alone would be ~30 GB) — fall back to sorting.
+COUNT_MATRIX_BUDGET = 400_000_000
+SLOT_CUBE_BUDGET = 1_000_000_000
+
+
+def _insert_impl(n: int, H: int) -> str:
+    if jax.default_backend() == "cpu":
+        # CPU gathers/sorts are cheap; the count matrix is pure waste
+        return "sort"
+    ng = -(-n // INSERT_GROUP)
+    return "count" if ng * H <= COUNT_MATRIX_BUDGET else "sort"
+
+
+def _pack_time(t: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """i64 -> (lo, hi) i32 words, exact for every bit pattern."""
+    lo = t.astype(jnp.uint32).astype(I32)
+    hi = (t >> 32).astype(I32)
+    return lo, hi
+
+
+def _unpack_time(lo: jax.Array, hi: jax.Array) -> jax.Array:
+    return (hi.astype(jnp.int64) << 32) | lo.astype(jnp.uint32).astype(
+        jnp.int64)
+
+
+def _free_slot_of_rank(q: EventQueue, impl: str) -> jax.Array:
+    """[H, K] map: rank r (among a row's free slots, ascending slot
+    order) -> slot index, K where the row has fewer than r+1 free
+    slots. Insertion fills holes in place — the queue is never
+    compacted (pop order is argmin-based, so intra-row layout carries
+    no semantics; both impls produce identical values so plane layout
+    is impl-independent)."""
+    H, K = q.time.shape
+    free = ~q.valid()                                      # [H,K]
+    if impl == "count" and H * K * K <= SLOT_CUBE_BUDGET:
+        free_rank = jnp.cumsum(free, axis=1, dtype=I32) - free
+        hit = free[:, :, None] & (
+            free_rank[:, :, None] == jnp.arange(K)[None, None, :])
+        slot = jnp.sum(
+            jnp.where(hit, jnp.arange(K)[:, None], 0), axis=1, dtype=I32)
+        return jnp.where(jnp.any(hit, axis=1), slot, K)
+    # row-sort mechanism, same values: free slots first, index order
+    order = jnp.argsort(~free, axis=1, stable=True).astype(I32)
+    n_free = jnp.sum(free, axis=1, dtype=I32)              # [H]
+    return jnp.where(jnp.arange(K)[None, :] < n_free[:, None], order, K)
+
+
 def insert_flat(
     q: EventQueue,
     valid: jax.Array,  # [n] bool
@@ -333,37 +387,82 @@ def insert_flat(
     src: jax.Array,    # [n] i32 (global source host id)
     seq: jax.Array,    # [n] i32
     words: jax.Array,  # [n, NWORDS] i32
+    impl: str | None = None,
 ) -> EventQueue:
-    """Insert a flat batch of events into their destination rows: sort
-    by row (stable, so the caller's order is the within-row order),
-    rank within each row's segment, scatter into the compacted row at
-    fill_count[row] + rank. Overflow is counted, never silent."""
+    """Insert a flat batch of events into their destination rows, in
+    caller order within each row (the determinism contract: caller
+    order = global source order). Overflow is counted, never silent.
+
+    Each entry's within-row rank = #earlier entries with the same row;
+    its slot = the rank-th free slot of that row (holes fill in
+    place). Two bit-identical rank computations, chosen per backend:
+
+    - "count" (accelerators): scatter-add a [n/G, H] per-group count
+      matrix, exclusive-cumsum it for cross-group ranks, add an
+      [n/G, G, G] within-group compare cube. No sort, no per-entry
+      gathers except the two [n] map lookups — on TPU, XLA lowers a
+      composed 491k-element sort + its plane gathers to ~70 ms of
+      serial loops; this form is a few bandwidth-bound ms.
+    - "sort" (CPU / over-budget shapes): stable argsort by row +
+      segment ranks, the classic shuffle.
+
+    All planes move through ONE packed [.., 5+W] i32 gather/scatter
+    (time split into two i32 words) instead of per-plane ops."""
     n = row.shape[0]
     H = q.num_hosts
-    skey = jnp.where(valid, row, H)
-    order = jnp.argsort(skey, stable=True)
-    row_s = skey[order]
-    time_s = time[order]
-    kind_s = kind[order]
-    src_s = src[order]
-    seq_s = seq[order]
-    words_s = words[order]
-    valid_s = row_s < H
-    rank = segment_ranks(row_s)
+    K = q.capacity
+    W = q.words.shape[-1]
+    if impl is None:
+        impl = _insert_impl(n, H)
+    rowc = jnp.where(valid, row, H)
 
-    q = compact_rows(q)
-    base = q.fill_count()                                  # [H]
-    slot = base[jnp.where(valid_s, row_s, 0)] + rank       # [n]
-    fits = valid_s & (slot < q.capacity)
-    r = jnp.where(fits, row_s, H)                          # OOB -> drop
-    slot = jnp.where(fits, slot, q.capacity)
+    tlo, thi = _pack_time(time)
+    packed = jnp.concatenate(
+        [tlo[:, None], thi[:, None], kind[:, None], src[:, None],
+         seq[:, None], words], axis=1)                     # [n, 5+W]
+
+    if impl == "count":
+        G = INSERT_GROUP
+        pad = (-n) % G
+        rowp = jnp.pad(rowc, (0, pad), constant_values=H)
+        ng = rowp.shape[0] // G
+        gidx = jnp.arange(ng * G) // G
+        cnt = jnp.zeros((ng, H), I32).at[gidx, rowp].add(1, mode="drop")
+        base_excl = jnp.cumsum(cnt, axis=0, dtype=I32) - cnt
+        base = base_excl[
+            jnp.clip(gidx, 0, ng - 1), jnp.clip(rowp, 0, H - 1)]
+        rg = rowp.reshape(ng, G)
+        earlier = jnp.arange(G)[:, None] < jnp.arange(G)[None, :]
+        intra = jnp.sum(
+            (rg[:, :, None] == rg[:, None, :]) & earlier[None],
+            axis=1, dtype=I32).reshape(-1)
+        rank = (base + intra)[:n]
+        row_o, rank_o, packed_o, valid_o = rowc, rank, packed, valid
+    else:
+        order = jnp.argsort(rowc, stable=True)
+        row_o = rowc[order]
+        packed_o = packed[order]
+        valid_o = row_o < H
+        rank_o = segment_ranks(row_o)
+
+    slot_map = _free_slot_of_rank(q, impl)                 # [H,K]
+    cand = slot_map[
+        jnp.clip(row_o, 0, H - 1), jnp.clip(rank_o, 0, K - 1)]
+    fits = valid_o & (rank_o < K) & (cand < K)
+    r = jnp.where(fits, row_o, H)                          # OOB -> drop
+    s = jnp.where(fits, cand, K)
+
+    packed_q = jnp.concatenate(
+        [jnp.stack(_pack_time(q.time), axis=2), q.kind[:, :, None],
+         q.src[:, :, None], q.seq[:, :, None], q.words], axis=2)
+    packed_q = packed_q.at[r, s].set(packed_o, mode="drop")
     return q.replace(
-        time=q.time.at[r, slot].set(time_s, mode="drop"),
-        kind=q.kind.at[r, slot].set(kind_s, mode="drop"),
-        src=q.src.at[r, slot].set(src_s, mode="drop"),
-        seq=q.seq.at[r, slot].set(seq_s, mode="drop"),
-        words=q.words.at[r, slot, :].set(words_s, mode="drop"),
-        overflow=q.overflow + jnp.sum(valid_s & ~fits, dtype=I32),
+        time=_unpack_time(packed_q[:, :, 0], packed_q[:, :, 1]),
+        kind=packed_q[:, :, 2],
+        src=packed_q[:, :, 3],
+        seq=packed_q[:, :, 4],
+        words=packed_q[:, :, 5:],
+        overflow=q.overflow + jnp.sum(valid_o & ~fits, dtype=I32),
     )
 
 

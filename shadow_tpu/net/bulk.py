@@ -1,13 +1,15 @@
 """Bulk window pass: process a host's whole window of UDP packet
 arrivals in ONE vectorized pass instead of one micro-step per event.
 
-This is SURVEY.md §7.2's sort+segment design: every order-dependent
-quantity is computed through ONE per-row lexsort of the window's
-events by the deterministic total order (EventOrder), giving
-O(K log K) ranks/prefix-sums in [H,K] working memory (an earlier
-revision used [H,K,K] compare-reduce cubes — at 100k hosts x K=64
-those are 400M-element temporaries, the scale limiter), and the
-token-bucket evolution — a chain of refill-then-consume steps
+This is SURVEY.md §7.2's sort+segment design with a backend-adaptive
+order representation (EventOrder): on accelerators, order-dependent
+quantities are masked [H,K,K] compare-reduce sums (zero sorts, zero
+gathers, zero scatters — on TPU those three lower to serial
+element-at-a-time loops and dominated the pass; the fused cube
+reduces run at HBM bandwidth); on the CPU fallback, one per-row
+lexsort gives O(K log K) ranks/prefix-sums in [H,K] working memory
+(the cube blows the cache at 100k hosts). Both are bit-identical.
+The token-bucket evolution — a chain of refill-then-consume steps
 f_i(x) = min(cap, x + dq_i*refill) - w_i — telescopes into the
 closed form
 
@@ -76,18 +78,33 @@ I64 = jnp.int64
 
 @dataclass(frozen=True)
 class EventOrder:
-    """Per-row sorted view of the window's event slots under the
-    deterministic total order (time, then (src, seq) tie key — the
-    reference's event.c:110-153 comparator; dst is the row).
+    """Per-row total order over the window's event slots under the
+    deterministic comparator (time, then (src, seq) tie key — the
+    reference's event.c:110-153; dst is the row), in one of two
+    bit-identical representations chosen per backend:
 
-    perm[h, p] = the slot at sorted position p (ascending);
-    inv[h, k]  = the sorted position of slot k.
-    Ties in (time, tie) cannot occur (the tie key is unique per
-    (src, seq)), so the order is total and sort stability is moot.
+    - "cube": prec[h, j, k] = slot j strictly precedes slot k. All
+      order-dependent quantities become masked [H,K,K] compare-reduce
+      sums — pure elementwise+reduction work that the TPU executes at
+      HBM bandwidth. Measured on a v5e: the sort representation costs
+      ~50 ms per window at H=10k (XLA row sort) plus ~5 ms per
+      take_along_axis, because XLA lowers composed gathers to a
+      serial element-at-a-time loop (~0.1 elem/ns); the K² cube costs
+      ~0.2 ms per reduce with zero gathers.
+    - "sort": perm[h, p] = slot at ascending position p, inv = its
+      inverse; ranks/suffix sums via cumsum in sorted space + two
+      take_along_axis. O(K log K) work — the right shape for the CPU
+      fallback, where gathers are cheap and a K² cube at 100k hosts
+      would blow the cache (this was this module's original form).
+
+    Ties in (time, tie) occur only between INVALID/stale slots; the
+    slot index breaks them (the "sort" path's stable lexsort does the
+    same), keeping both representations exact permutations.
     """
 
-    perm: Any   # [H,K] i32
-    inv: Any    # [H,K] i32
+    prec: Any = None   # [H,K,K] bool (cube) or None
+    perm: Any = None   # [H,K] i32 (sort) or None
+    inv: Any = None    # [H,K] i32 (sort) or None
 
     def _sorted(self, value):
         return jnp.take_along_axis(value, self.perm, axis=1)
@@ -96,15 +113,45 @@ class EventOrder:
         return jnp.take_along_axis(value, self.inv, axis=1)
 
 
-def make_order(t, tie) -> EventOrder:
-    perm = jnp.lexsort((tie, t), axis=-1).astype(I32)
-    inv = jnp.argsort(perm, axis=1).astype(I32)
-    return EventOrder(perm=perm, inv=inv)
+# Above this many prec-cube elements (H*K*K) fall back to the sort
+# representation: at 100k hosts x K=64 the cube is ~410M entries —
+# fine as a fused TPU reduce, hostile to a CPU cache. The budget is
+# sized so every bench/scale shape up to 100k x K<=96 stays on the
+# cube when on an accelerator.
+CUBE_BUDGET_ACCEL = 1_000_000_000
+CUBE_BUDGET_CPU = 4_000_000
+
+
+def _default_impl(H: int, K: int) -> str:
+    import jax
+
+    budget = (CUBE_BUDGET_CPU if jax.default_backend() == "cpu"
+              else CUBE_BUDGET_ACCEL)
+    return "cube" if H * K * K <= budget else "sort"
+
+
+def make_order(t, tie, impl: str | None = None) -> EventOrder:
+    H, K = t.shape
+    if impl is None:
+        impl = _default_impl(H, K)
+    if impl == "sort":
+        perm = jnp.lexsort((tie, t), axis=-1).astype(I32)
+        inv = jnp.argsort(perm, axis=1).astype(I32)
+        return EventOrder(perm=perm, inv=inv)
+    tj, tk = t[:, :, None], t[:, None, :]
+    ej, ek = tie[:, :, None], tie[:, None, :]
+    jlt = jnp.arange(K)[:, None] < jnp.arange(K)[None, :]
+    prec = (tj < tk) | ((tj == tk) & ((ej < ek) | ((ej == ek) & jlt)))
+    return EventOrder(prec=prec)
 
 
 def rank_in_order(order: EventOrder, weight):
     """[H,K] number of weighted events strictly preceding each slot
     under the total order (exclusive prefix count)."""
+    if order.prec is not None:
+        w = weight.astype(I32)
+        return jnp.sum(jnp.where(order.prec, w[:, :, None], 0), axis=1,
+                       dtype=I32)
     w = order._sorted(weight.astype(I32))
     pref = jnp.cumsum(w, axis=1) - w
     return order._unsorted(pref)
@@ -112,6 +159,10 @@ def rank_in_order(order: EventOrder, weight):
 
 def suffix_sum(order: EventOrder, value):
     """[H,K] sum of value_i over events strictly AFTER each slot."""
+    if order.prec is not None:
+        return jnp.sum(jnp.where(order.prec, value[:, None, :],
+                                 jnp.zeros((), value.dtype)), axis=2,
+                       dtype=value.dtype)
     v = order._sorted(value)
     incl = jnp.cumsum(v, axis=1)
     total = incl[:, -1:]
@@ -160,12 +211,18 @@ class AppBulk:
     """Interface an on-device app exposes to opt into the bulk pass.
 
     max_send_len: static upper bound on reply payload length.
+    resolves_dst: True = every masked send carries a valid dst_host
+    (>= 0), so the pass skips the ip->host searchsorted entirely — on
+    a TPU that lookup lowers to a ~14-iteration while loop of serial
+    gathers costing ~100 ms per window at 10k hosts (measured v5e);
+    apps that pick peers by index should always set it.
     precheck(cfg, sim) -> [H] bool — app-side eligibility (no mutation).
     run(cfg, sim, d: BulkDeliveries) -> (sim, BulkSends) — consume
     EVERY delivery in d.mask and stage at most one reply per event.
     """
 
     max_send_len: int = 0
+    resolves_dst: bool = False
 
     def precheck(self, cfg, sim):
         raise NotImplementedError
@@ -234,7 +291,8 @@ def _lookup_bulk(net, mask, dst_ip, dst_port, src_ip, src_port):
     return jnp.where(s >= 0, s, g)
 
 
-def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
+def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
+                 order_impl: str | None = None) -> Callable | None:
     """Build the per-window bulk pass, or None when the config cannot
     support it (static preconditions)."""
     if cfg.tcp:
@@ -305,7 +363,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
 
         ev = inwin & elig[:, None]                     # events we consume
         n_ev = jnp.sum(ev, axis=1, dtype=I32)          # [H]
-        order = make_order(t, tie)
+        order = make_order(t, tie, impl=order_impl)
 
         matched = ev & (slot >= 0)
         nosock = ev & (slot < 0)
@@ -337,9 +395,12 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         n_send = jnp.sum(smask, axis=1, dtype=I32)
 
         # ---- NIC egress: reliability draw, latency, outbox entries ---
-        dsth = jnp.where(
-            sends.dst_host >= 0, sends.dst_host,
-            host_of_ip(net, sends.dst_ip))
+        if app_bulk.resolves_dst:
+            dsth = sends.dst_host
+        else:
+            dsth = jnp.where(
+                sends.dst_host >= 0, sends.dst_host,
+                host_of_ip(net, sends.dst_ip))
         known = smask & (dsth >= 0)
         u2 = rng.uniform_at(net.rng_keys, sends.nic_draw_ctr)
         V = net.latency_ns.shape[0]
@@ -414,20 +475,44 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         send_rank = rank_in_order(order, emit_ok)
         seq = q.next_seq[:, None] + send_rank
         M = sim.outbox.capacity
-        # scatter each emitted reply to its time-order outbox column
-        # (ranks are unique among emit_ok, so no index collides;
-        # non-emitting events target column M and are dropped)
-        lane_h = jnp.arange(H)[:, None]
-        col = jnp.where(emit_ok, ord_col, M)
-
-        def place(val, fill, dtype):
-            base = jnp.full((H, M), fill, dtype)
-            return base.at[lane_h, col].set(
-                jnp.asarray(val, dtype), mode="drop")
-
+        # each emitted reply lands at its time-order outbox column
+        # (ranks are unique among emit_ok, so no column collides)
         out = sim.outbox
-        got_col = jnp.zeros((H, M), bool).at[lane_h, col].set(
-            True, mode="drop")
+        if order.prec is not None:
+            # one-hot reduce instead of scatter: XLA lowers composed
+            # scatters on TPU to serial per-element loops (~5 ms each
+            # at [10k,48] — 7 of them dominated the pass); the masked
+            # [H,K,M] reduction is a fused bandwidth-bound sum
+            onehot = emit_ok[:, :, None] & (
+                ord_col[:, :, None] == jnp.arange(M)[None, None, :])
+            got_col = jnp.any(onehot, axis=1)          # [H,M]
+
+            def place(val, fill, dtype):
+                v = jnp.asarray(val, dtype)
+                s = jnp.sum(jnp.where(onehot, v[:, :, None],
+                                      jnp.zeros((), dtype)), axis=1,
+                            dtype=dtype)
+                return jnp.where(got_col, s, jnp.asarray(fill, dtype))
+
+            def place_words(wds):
+                return jnp.sum(
+                    jnp.where(onehot[:, :, :, None], wds[:, :, None, :],
+                              0), axis=1, dtype=I32)
+        else:
+            lane_h = jnp.arange(H)[:, None]
+            col = jnp.where(emit_ok, ord_col, M)
+
+            def place(val, fill, dtype):
+                base = jnp.full((H, M), fill, dtype)
+                return base.at[lane_h, col].set(
+                    jnp.asarray(val, dtype), mode="drop")
+
+            def place_words(wds):
+                return jnp.zeros((H, M, wds.shape[2]), I32).at[
+                    lane_h, col].set(wds, mode="drop")
+
+            got_col = jnp.zeros((H, M), bool).at[lane_h, col].set(
+                True, mode="drop")
         o_dst = place(dsth, -1, I32)
         o_time = place(t + lat, simtime.INVALID, I64)
         o_src = place(jnp.broadcast_to(lane[:, None], (H, K)), 0, I32)
@@ -447,8 +532,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk) -> Callable | None:
         wds = wds.at[:, :, pf.W_STATUS].set(
             pf.PDS_SND_CREATED | pf.PDS_SND_SOCKET_BUFFERED
             | pf.PDS_SND_INTERFACE_SENT | pf.PDS_INET_SENT)
-        o_words = jnp.zeros((H, M, q.words.shape[2]), I32).at[
-            lane_h, col].set(wds, mode="drop")
+        o_words = place_words(wds)
         keep = ~got_col
         out = out.replace(
             dst=jnp.where(keep, out.dst, o_dst),

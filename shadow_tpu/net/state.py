@@ -515,7 +515,11 @@ def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
 def host_of_ip(net: NetState, ip):
     """Device ip -> host-index lookup ([...] i64 -> [...] i32, -1 when
     unknown). Replaces worker_resolveIPToAddress (ref: worker.c:255)."""
-    idx = jnp.searchsorted(net.ip_sorted, ip)
+    # scan_unrolled: the default 'scan' method is a lax.fori_loop whose
+    # ~14 iterations each launch serial gathers on TPU (~100 ms at
+    # [10k,48] queries, measured v5e); unrolled, the same binary search
+    # fuses into the surrounding program
+    idx = jnp.searchsorted(net.ip_sorted, ip, method="scan_unrolled")
     idx = jnp.clip(idx, 0, net.ip_sorted.shape[0] - 1)
     hit = net.ip_sorted[idx] == ip
     return jnp.where(hit, net.host_of_ip_sorted[idx], -1)

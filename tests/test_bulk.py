@@ -184,3 +184,22 @@ def test_bulk_static_preconditions():
     cfg = NetConfig(num_hosts=4, tcp=False, outbox_capacity=8,
                     event_capacity=32)
     assert make_bulk_fn(cfg, phold.BULK) is None
+
+
+@pytest.mark.parametrize("forced", ["cube", "sort"])
+def test_bulk_order_impls_bit_identical(forced, monkeypatch):
+    """EventOrder has two representations (prec cube for accelerators,
+    lexsort for the CPU fallback); both must produce bit-identical
+    simulations. Force each and compare against the serial engine."""
+    from shadow_tpu.net import bulk as bulkmod
+
+    monkeypatch.setattr(bulkmod, "_default_impl", lambda H, K: forced)
+    H, load, sim_s = 24, 3, 1
+    b1 = _build(H, load, sim_s, 5)
+    sim_a, st_a = make_runner(b1, app_handlers=(phold.handler,))(b1.sim)
+
+    b2 = _build(H, load, sim_s, 5)
+    sim_b, st_b = make_runner(b2, app_handlers=(phold.handler,),
+                              app_bulk=phold.BULK)(b2.sim)
+    assert int(st_b.micro_steps) < int(st_a.micro_steps) // 2
+    _compare(sim_a, sim_b, st_a, st_b)

@@ -179,3 +179,43 @@ def test_compact_rows_preserves_multiset():
     assert v[:2].all() and not v[2:].any()
     _, popped = _drain_host(q3, 1)
     assert [t for t, _, _ in popped] == [20, 30]
+
+
+def test_insert_flat_impls_bit_identical():
+    """insert_flat has two rank computations (count-route for
+    accelerators, stable sort for CPU); both must place every entry
+    in the same slot, including hole-filling, ordering within a row,
+    and overflow counting."""
+    import numpy as np
+
+    from shadow_tpu.core.events import insert_flat
+
+    rng = np.random.default_rng(42)
+    H, K, W = 13, 7, 6
+    n = 150
+    q0 = EventQueue.create(H, K, nwords=W)
+    # pre-occupy random slots (holes pattern) with live events
+    occ = rng.random((H, K)) < 0.4
+    t0 = jnp.where(jnp.asarray(occ),
+                   jnp.asarray(rng.integers(1, 1000, (H, K))),
+                   simtime.INVALID)
+    q0 = q0.replace(time=t0.astype(q0.time.dtype))
+
+    valid = jnp.asarray(rng.random(n) < 0.8)
+    row = jnp.asarray(rng.integers(0, H, n), jnp.int32)
+    time = jnp.asarray(rng.integers(1000, 9999, n))
+    kind = jnp.asarray(rng.integers(1, 5, n), jnp.int32)
+    src = jnp.asarray(rng.integers(0, H, n), jnp.int32)
+    seq = jnp.asarray(np.arange(n), jnp.int32)
+    words = jnp.asarray(rng.integers(-2**31, 2**31 - 1, (n, W)), jnp.int32)
+
+    qa = insert_flat(q0, valid, row, time, kind, src, seq, words,
+                     impl="count")
+    qb = insert_flat(q0, valid, row, time, kind, src, seq, words,
+                     impl="sort")
+    for f in ("time", "kind", "src", "seq", "words", "overflow"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(qa, f)), np.asarray(getattr(qb, f)),
+            err_msg=f"{f} diverged between impls")
+    # overflow must have engaged (n >> free capacity) and be counted
+    assert int(qa.overflow) > 0

@@ -30,9 +30,7 @@ def build_warm_phold(H: int, load: int, sim_s: int = 5, windows: int = 3):
     from shadow_tpu.net import bulk as bulkmod
     from shadow_tpu.net.step import make_step_fn
 
-    cap = max(16, 3 * load) if H <= 4096 else 6 * load
-    b = _build_phold(H, load, sim_s, cap=cap)
-    b.sim = phold.setup(b.sim, load=load)
+    b = _build_phold(H, load, sim_s)   # includes phold.setup
     step = make_step_fn(b.cfg, (phold.handler,))
     bulk_fn = bulkmod.make_bulk_fn(b.cfg, phold.BULK)
 

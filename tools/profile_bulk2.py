@@ -87,7 +87,8 @@ def make_prefix(cfg, app_bulk, wend, stop):
             & (slot[:, :, None] == jnp.arange(S)[None, None, :]),
             axis=1, dtype=I32)
         if stop == "elig":
-            fold(elig, n_ev, order.perm, arr_per_sock)
+            fold(elig, n_ev, arr_per_sock,
+                 order.perm if order.perm is not None else order.prec)
             return acc
 
         d = bulkmod.BulkDeliveries(
