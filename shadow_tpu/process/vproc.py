@@ -451,7 +451,16 @@ class ProcessRuntime:
         process_stop aborting the plugin main thread,
         process.c:1286-1324; use try/finally in the coroutine for
         cleanup)."""
-        self.procs.append(_Proc(host=host, gen=proc_fn(host),
+        gen = proc_fn(host)
+        # fail loudly here, not as an opaque AttributeError deep in the
+        # window loop: the contract is a generator yielding syscalls
+        if not hasattr(gen, "send") or not hasattr(gen, "close"):
+            raise TypeError(
+                f"virtual process for host {host} returned "
+                f"{type(gen).__name__}, not a generator (its main/"
+                f"proc_fn must be or return a generator yielding vproc "
+                f"syscalls)")
+        self.procs.append(_Proc(host=host, gen=gen,
                                 start_time=start_time,
                                 stop_time=stop_time))
 
