@@ -100,6 +100,11 @@ def make_parser() -> argparse.ArgumentParser:
     # (loader.py hints; an explicit value always wins, matching the
     # reference's Options-beats-everything precedence)
     p.add_argument("--sockets-per-host", type=int, default=None)
+    p.add_argument("--track-paths", action="store_true",
+                   help="count packets per (src,dst) topology vertex "
+                        "pair, logged at shutdown (ref: topology.c "
+                        "per-path counters); forces the serial window "
+                        "loop")
     p.add_argument("--event-capacity", type=int, default=None)
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
@@ -126,6 +131,7 @@ def overrides_from_args(args) -> dict:
         "runahead": args.runahead,
         "sockets_per_host": args.sockets_per_host,
         "event_capacity": args.event_capacity,
+        "track_paths": args.track_paths or None,
     }
     return {k: v for k, v in overrides.items() if v is not None}
 
@@ -144,11 +150,16 @@ def main(argv=None) -> int:
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
     # honor JAX_PLATFORMS through jax.config: an out-of-tree platform
     # plugin's get_backend hook can ignore the env var but the lazy
-    # backend init honors the config (must run before backend touch)
+    # backend init honors the config (must run before backend touch).
+    # An EXPLICIT prior jax.config.update("jax_platforms", ...) by the
+    # embedding program wins — a global sitecustomize can re-export
+    # JAX_PLATFORMS, making the env var unreliable as user intent
+    # (see .claude/skills/verify: forcing CPU requires the config
+    # route precisely because of that)
     import os
 
     plat = os.environ.get("JAX_PLATFORMS")
-    if plat:
+    if plat and jax.config.jax_platforms is None:
         jax.config.update("jax_platforms", plat)
 
     from shadow_tpu.config.examples import example_config
@@ -192,9 +203,10 @@ def main(argv=None) -> int:
 
             cap = CaptureSession(b, args.data_directory)
         mesh = None
-        if args.workers > 1 and b.cfg.pcap:
+        if args.workers > 1 and (b.cfg.pcap or b.cfg.track_paths):
+            which = "logpcap" if b.cfg.pcap else "--track-paths"
             logger.warning(0, "shadow-tpu",
-                           f"logpcap forces the serial window loop; "
+                           f"{which} forces the serial window loop; "
                            f"--workers {args.workers} ignored")
         elif args.workers > 1:
             from jax.sharding import Mesh
@@ -262,6 +274,22 @@ def main(argv=None) -> int:
         oc = objcount.gather(sim, stats=stats)
         logger.message(b.cfg.end_time, "shadow-tpu", oc.format())
         logger.message(b.cfg.end_time, "shadow-tpu", oc.format_diff())
+
+        # per-host executed-event lines (ref: the per-host execution
+        # timer logged at shutdown, host.c:314-317) + per-path packet
+        # counts (ref: topology.c:2053-2063), info level
+        exec_h = np.asarray(sim.net.ctr_events_exec)
+        for hi in np.argsort(-exec_h)[: min(len(exec_h), 10)]:
+            if exec_h[hi] > 0:
+                logger.info(b.cfg.end_time, b.host_names[hi],
+                            f"executed {int(exec_h[hi])} events")
+        if b.cfg.track_paths:
+            mat = np.asarray(sim.net.ctr_path_packets)
+            vs, vd = np.nonzero(mat)
+            for a, c in zip(vs, vd):
+                logger.message(
+                    b.cfg.end_time, "shadow-tpu",
+                    f"path {a}->{c}: {int(mat[a, c])} packets")
 
         ev = int(stats.events_processed)
         sim_s = b.cfg.end_time / 1e9

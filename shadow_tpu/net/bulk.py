@@ -306,6 +306,10 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
     if cfg.pcap:
         # capture-ring appends are per-event; keep the serial path
         return None
+    if cfg.track_paths:
+        # observability mode: the serial NIC pass carries the per-path
+        # scatter-add; the bulk closed form does not reproduce it
+        return None
     if cfg.out_ring < 2:
         return None
     if cfg.outbox_capacity < cfg.event_capacity:
@@ -591,6 +595,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
             + jnp.sum(jnp.where(smask, swl, 0), axis=1),
             ctr_drop_reliability=net.ctr_drop_reliability
             + jnp.sum(drop, axis=1, dtype=I64),
+            ctr_events_exec=net.ctr_events_exec + n_ev.astype(I64),
         )
 
         # consume the window's events

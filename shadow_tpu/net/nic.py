@@ -559,6 +559,13 @@ def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
         words[:, pf.W_STATUS]))
     buf = emit(buf, send, dsth, now + lat, EventKind.PACKET, words)
 
+    if cfg.track_paths:
+        # per-path packet counters (ref: topology.c:2053-2063 — the
+        # reference bumps the Path's count on every routing lookup of
+        # a send, dropped or not; loopback never reaches the topology)
+        net = net.replace(ctr_path_packets=net.ctr_path_packets.at[
+            vsrc, vdst].add(known.astype(I64), mode="drop"))
+
     # tracker byte split (ref: tracker.c:51-99): data vs retransmit,
     # classified by the packet's own audit trail
     is_retx = (words[:, pf.W_STATUS] & pf.PDS_SND_TCP_RETRANSMITTED) != 0

@@ -124,6 +124,13 @@ class NetConfig:
                                  # inlining the TCP machine into the
                                  # device program (UDP-only workloads
                                  # compile much faster)
+    # Per-path packet counters (ref: topology.c:2053-2063 per-Path
+    # packetCount, logged at cache clear): a [V,V] matrix counting
+    # remote send attempts per (src vertex, dst vertex). Off by
+    # default: the hot-path scatter-add costs real time on TPU, and
+    # the reference pays ~nothing for its CPU counter. Serial-runner
+    # observability — sharded runs keep the [1,1] zero matrix.
+    track_paths: bool = False
     bootstrap_end: int = 0       # "unlimited bandwidth" period end
                                  # (ref: master.c:261-268)
     end_time: int = simtime.ONE_SECOND
@@ -191,6 +198,9 @@ class NetConfig:
 REPLICATED_FIELDS = frozenset({
     "host_ip", "ip_sorted", "host_of_ip_sorted", "vertex_of_host",
     "latency_ns", "reliability", "bw_up_kibps", "bw_down_kibps",
+    # serial-runner observability matrix ([1,1] zeros when sharded —
+    # cfg.track_paths is a serial-only feature)
+    "ctr_path_packets",
 })
 
 
@@ -247,6 +257,14 @@ class NetState:
     ctr_cpu_blocked: jax.Array   # [H] i64 events delayed by the CPU
     ctr_cpu_delay_ns: jax.Array  # [H] i64 total virtual processing delay
                                  # (ref: tracker_addVirtualProcessingDelay)
+    # per-host executed-event count — the device-meaningful analog of
+    # the reference's per-host execution GTimer logged at shutdown
+    # (host.c:114-116,314-317; wall seconds make no sense for a host
+    # that is one lane of a fused device step)
+    ctr_events_exec: jax.Array   # [H] i64
+    # [V,V] remote send attempts per vertex pair when
+    # cfg.track_paths, else [1,1] (ref: topology.c:2053-2063)
+    ctr_path_packets: jax.Array  # [Vp,Vp] i64
     # --- process lifetime (ref: process.c:1286-1360) ------------------
     # True once the host's PROC_STOP event fired: app handlers are
     # masked off from then on (the device analog of process_stop
@@ -372,6 +390,7 @@ def make_net_state(
 ) -> NetState:
     H, S = cfg.num_hosts, cfg.sockets_per_host
     BI, BO, R, T = cfg.in_ring, cfg.out_ring, cfg.router_ring, cfg.timers_per_host
+    num_vertices = int(np.asarray(latency_ns).shape[0])
 
     # bytes per refill interval (ref: network_interface.c:196-203)
     tf = simtime.ONE_SECOND // TB_REFILL_INTERVAL
@@ -413,6 +432,10 @@ def make_net_state(
         cpu_cost=jnp.asarray(cost, I64),
         ctr_cpu_blocked=z_h,
         ctr_cpu_delay_ns=z_h,
+        ctr_events_exec=z_h,
+        ctr_path_packets=jnp.zeros(
+            (num_vertices, num_vertices) if cfg.track_paths else (1, 1),
+            I64),
         lane_id=jnp.arange(H, dtype=I32),
         rng_keys=rng.host_streams(cfg.seed, H),
         rng_ctr=jnp.zeros((H,), jnp.uint32),

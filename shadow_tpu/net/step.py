@@ -138,6 +138,12 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
             lambda op: nic.handle_nic_send(cfg, op[0], popped, op[1]),
             lambda op: op,
             (sim, buf))
+        # per-host executed-event accounting (the device analog of the
+        # reference's per-host execution timer, host.c:314-317);
+        # popped.valid is post-CPU-gate, so deferred events count once
+        sim = sim.replace(net=sim.net.replace(
+            ctr_events_exec=sim.net.ctr_events_exec
+            + popped.valid.astype(jnp.int64)))
         return sim, buf
 
     return step

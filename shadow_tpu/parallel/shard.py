@@ -190,6 +190,14 @@ def _harness_specs(mesh: Mesh, axis: str, sim):
     H = sim.events.num_hosts
     if H % num_shards != 0:
         raise ValueError(f"num_hosts={H} not divisible by {num_shards} shards")
+    net = getattr(sim, "net", None)
+    if net is not None and net.ctr_path_packets.shape != (1, 1):
+        # each shard would scatter-add only its own hosts into its
+        # local replica of the declared-replicated matrix — silently
+        # wrong counts; the CLI serializes track_paths runs instead
+        raise ValueError(
+            "cfg.track_paths is serial-only: per-path packet counters "
+            "do not aggregate across shards (run without a mesh)")
     specs = sim_specs(sim, axis)
     stats_specs = EngineStats(
         events_processed=P(), micro_steps=P(), windows=P()
