@@ -100,6 +100,12 @@ def make_parser() -> argparse.ArgumentParser:
     # (loader.py hints; an explicit value always wins, matching the
     # reference's Options-beats-everything precedence)
     p.add_argument("--sockets-per-host", type=int, default=None)
+    p.add_argument("--platform", default="auto",
+                   help="JAX backend to run on ('auto' = honor "
+                        "JAX_PLATFORMS / plugin default; 'cpu' forces "
+                        "the CPU backend — the reliable way to run "
+                        "without the TPU, since a global sitecustomize "
+                        "may re-export JAX_PLATFORMS)")
     p.add_argument("--track-paths", action="store_true",
                    help="count packets per (src,dst) topology vertex "
                         "pair, logged at shutdown (ref: topology.c "
@@ -148,19 +154,20 @@ def main(argv=None) -> int:
     cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
     jax.config.update("jax_compilation_cache_dir", str(cache))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    # honor JAX_PLATFORMS through jax.config: an out-of-tree platform
+    # select the backend through jax.config (an out-of-tree platform
     # plugin's get_backend hook can ignore the env var but the lazy
-    # backend init honors the config (must run before backend touch).
-    # An EXPLICIT prior jax.config.update("jax_platforms", ...) by the
-    # embedding program wins — a global sitecustomize can re-export
-    # JAX_PLATFORMS, making the env var unreliable as user intent
-    # (see .claude/skills/verify: forcing CPU requires the config
-    # route precisely because of that)
+    # backend init honors the config; must run before backend touch).
+    # --platform beats the env var: a global sitecustomize may
+    # re-export JAX_PLATFORMS, making the env var unreliable as an
+    # expression of user intent.
     import os
 
-    plat = os.environ.get("JAX_PLATFORMS")
-    if plat and jax.config.jax_platforms is None:
-        jax.config.update("jax_platforms", plat)
+    if args.platform != "auto":
+        jax.config.update("jax_platforms", args.platform)
+    else:
+        plat = os.environ.get("JAX_PLATFORMS")
+        if plat:
+            jax.config.update("jax_platforms", plat)
 
     from shadow_tpu.config.examples import example_config
     from shadow_tpu.config.loader import load

@@ -268,6 +268,84 @@ def _configure_testdeterminism(bundle: SimBundle, assignments):
 register_plugin("testdeterminism", _configure_testdeterminism)
 register_plugin("shadow-plugin-test-determinism",
                 _configure_testdeterminism)
+# the reference's random test plugin dumps simulated-random values for
+# the determinism byte-compare (test_random.c reads rand()/urandom —
+# all interposed onto the host Random); randdump is the same surface
+register_plugin("testrandom", _configure_testdeterminism)
+register_plugin("shadow-plugin-test-random", _configure_testdeterminism)
+
+
+def _vproc_entry(bundle: "SimBundle", hi: int, p, main_fn):
+    """One virtual-process registration tuple — the SINGLE place
+    defining the plugin env contract and the start/stop mapping
+    (stoptime absent OR "0" = run to sim end: the reference maps
+    unset to 0, master.c:300, and only schedules a stop when
+    stopTime > 0, process.c:1348)."""
+    env = {
+        "host": bundle.host_names[hi],
+        "host_index": hi,
+        "args": list(p.arguments),
+        "resolve": bundle.ip_of,
+        "cfg": bundle.cfg,
+    }
+    return (
+        hi,
+        (lambda _h, m=main_fn, e=env: m(e)),
+        p.starttime or 0,
+        p.stoptime if p.stoptime else -1,
+    )
+
+
+def _vproc_plugin(main_fn, hints=None):
+    """Adapt a reftests-style generator into a registry plugin: each
+    assigned process becomes a virtual process (the same shape the
+    .py-plugin path produces), so the reference's syscall-test configs
+    run verbatim (ref: SURVEY.md §4 dual-mode plugins)."""
+
+    def configure(bundle: SimBundle, assignments):
+        extra = getattr(bundle, "extra_vprocs", None)
+        if extra is None:
+            extra = []
+            bundle.extra_vprocs = extra
+        for hi, p in assignments:
+            extra.append(_vproc_entry(bundle, hi, p, main_fn))
+        return ()
+
+    if hints is not None:
+        configure.hints = hints
+    return configure
+
+
+def _register_reftests():
+    from shadow_tpu.apps import reftests as rt
+
+    no_tcp = lambda assignments: {"tcp": False}  # noqa: E731
+    stream = lambda assignments: _tcp_stream_hints(  # noqa: E731
+        assignments, n_clients=1)
+    for names, fn, hints in (
+        (("testbind", "libshadow-plugin-test-bind.so"), rt.bind_main, None),
+        (("testepoll", "libshadow-plugin-test-epoll.so"),
+         rt.epoll_main, no_tcp),
+        (("test_epoll_writeable",
+          "libshadow-plugin-test-epoll-writeable.so"),
+         rt.epoll_writeable_main, stream),
+        (("testpoll", "libshadow-plugin-test-poll.so"),
+         rt.poll_main, no_tcp),
+        (("testsockbuf", "libshadow-plugin-test-sockbuf.so"),
+         rt.sockbuf_main, None),
+        (("testtimerfd", "libshadow-plugin-test-timerfd.so"),
+         rt.timerfd_main, no_tcp),
+        (("testsleep", "libshadow-plugin-test-sleep.so"),
+         rt.sleep_main, no_tcp),
+        (("testshutdown", "libshadow-plugin-test-shutdown.so"),
+         rt.shutdown_main, stream),
+    ):
+        cfgfn = _vproc_plugin(fn, hints)
+        for name in names:
+            register_plugin(name, cfgfn)
+
+
+_register_reftests()
 register_plugin("testudp", _configure_testudp)
 register_plugin("test-udp", _configure_testudp)
 register_plugin("pingpong", _configure_pingpong)
@@ -440,24 +518,14 @@ def load(config: ShadowConfig, *, seed: int = 1,
         if model.endswith(".py"):
             mod = py_modules[model]
             for hi, p in asg:
-                env = {
-                    "host": bundle.host_names[hi],
-                    "host_index": hi,
-                    "args": list(p.arguments),
-                    "resolve": bundle.ip_of,
-                    "cfg": bundle.cfg,
-                }
-                vprocs.append((
-                    hi,
-                    (lambda _h, m=mod, e=env: m.main(e)),
-                    p.starttime or 0,
-                    # stoptime absent OR "0" = run to sim end: the
-                    # reference maps unset to 0 (master.c:300) and
-                    # only schedules a stop when stopTime > 0
-                    # (process.c:1348), so 0 is "never stop" there too
-                    p.stoptime if p.stoptime else -1,
-                ))
+                vprocs.append(_vproc_entry(bundle, hi, p, mod.main))
             continue
         handlers.extend(_REGISTRY[model](bundle, asg))
+        # registry plugins may register virtual processes instead of
+        # (or alongside) device handlers (_vproc_plugin)
+        extra = getattr(bundle, "extra_vprocs", None)
+        if extra:
+            vprocs.extend(extra)
+            bundle.extra_vprocs = []
     return LoadedSim(bundle=bundle, handlers=tuple(handlers),
                      config=config, vprocs=tuple(vprocs))

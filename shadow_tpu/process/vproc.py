@@ -697,6 +697,13 @@ class ProcessRuntime:
             return False, None
         if op == "bind":
             fd, want = a[0], int(a[1])
+            # EINVAL: the socket is already bound (explicitly, or
+            # implicitly by connect's ephemeral bind) — a second bind
+            # fails (ref: test_bind.c:93-95,112-114 asserts EINVAL on
+            # re-bind; host_bindToInterface is only reached for
+            # unbound sockets)
+            if int(self.sim.net.sk_bound_port[h, fd]) != 0:
+                return True, -1
             # EADDRINUSE: another live same-protocol socket on this
             # host already binds the requested port (ref:
             # _host_isInterfaceAvailable -> networkinterface_isAssociated,
@@ -1115,8 +1122,12 @@ class ProcessRuntime:
             if expire == 0:
                 self.sim = timermod.timer_disarm(self.sim, mask, slot)
                 return True, 0
+            # timerfd(2) default semantics: it_value is RELATIVE to
+            # now (no TFD_TIMER_ABSTIME on the surface — the
+            # reference's timer_setTime converts the same way,
+            # timer.c); timer_set itself takes an absolute deadline
             self._apply(lambda sim, buf: timermod.timer_set(
-                sim, buf, mask, slot, expire, interval), now)
+                sim, buf, mask, slot, now + expire, interval), now)
             return True, 0
         if op == "timerfd_read":
             tfd = a[0]
@@ -1292,9 +1303,22 @@ class ProcessRuntime:
                       if not p.done and not p.started]
             cands += [p.stop_time for p in self.procs
                       if not p.done and p.stop_time >= 0]
-            wstart = min(c for c in cands if c >= 0)
+            # never step backward: a (buggy or already-fired) event
+            # timestamped before `now` must not rewind the clock —
+            # the engine's own advance rule clamps the same way
+            # (engine.run: first = max(min, start_time))
+            wstart = max(min(c for c in cands if c >= 0), now)
             if wstart > end or wstart >= simtime.INVALID:
                 break
+            if wstart > now:
+                # jump to the next deadline and resume THERE, before
+                # running any device window: process starts, sleep
+                # wakes, and stop kills happen at their exact sim
+                # times (the reference schedules each as an event at
+                # that time — process.c:1326-1360; a window-end
+                # resume would make every one late by min_jump)
+                now = int(wstart)
+                continue
             wend = min(wstart + min_jump, end + 1)
             self.sim, stats, next_min = self._jit_window(
                 self.sim, wstart, wend)

@@ -124,3 +124,46 @@ def test_reference_determinism1_two_runs_and_shardings():
     sim, _ = run_sharded(loaded.bundle, mesh,
                          app_handlers=loaded.handlers)
     np.testing.assert_array_equal(np.asarray(sim.app.samples), s1)
+
+
+# ---------------------------------------------------------------------
+# The syscall-semantics test dirs, run from the reference's own
+# configs via virtual-process plugin mappings (apps/reftests.py).
+# A reftest generator asserts like its C original; any failure
+# propagates out of ProcessRuntime.run.
+# ---------------------------------------------------------------------
+
+REF_TEST = pathlib.Path("/root/reference/src/test")
+
+
+def _run_vproc_config(path: pathlib.Path, seed=7):
+    from shadow_tpu.process.vproc import ProcessRuntime
+
+    cfg = parse_config(path.read_text())
+    loaded = load(cfg, seed=seed)
+    rt = ProcessRuntime(loaded.bundle, app_handlers=loaded.handlers)
+    for hi, fn, st, sp in loaded.vprocs:
+        rt.spawn(hi, fn, start_time=st, stop_time=sp)
+    sim, stats = rt.run()
+    # every registered virtual process must have RUN (a generator that
+    # never started would vacuously "pass")
+    assert loaded.vprocs
+    return sim, stats, rt
+
+
+@pytest.mark.parametrize("rel", [
+    "bind/bind.test.shadow.config.xml",
+    "epoll/epoll.test.shadow.config.xml",
+    "epoll/epoll-writeable.test.shadow.config.xml",
+    "poll/poll.test.shadow.config.xml",
+    "sockbuf/sockbuf.test.shadow.config.xml",
+    "timerfd/timerfd.test.shadow.config.xml",
+    "sleep/sleep.test.shadow.config.xml",
+    "shutdown/shutdown.test.shadow.config.xml",
+])
+def test_reference_syscall_config(rel):
+    sim, stats, rt = _run_vproc_config(REF_TEST / rel)
+    assert int(sim.events.overflow) == 0
+    # all coroutines ran to completion (none left blocked at sim end)
+    for p in rt.procs:
+        assert p.done, (rel, p.host)
