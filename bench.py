@@ -3,12 +3,24 @@
 Default workload is PHOLD (the PDES-scheduler stress benchmark the
 reference also uses, src/test/phold/): every host keeps `load`
 messages circulating, so all lanes stay busy and the committed-events
-rate measures raw engine throughput. BENCH_WORKLOAD=pingpong|bulk
-selects the other BASELINE.json shapes.
+rate measures raw engine throughput. Env knobs:
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+  BENCH_WORKLOAD=phold|pingpong   workload shape (BASELINE.json)
+  BENCH_HOSTS=N                   host count (default 10240 on TPU)
+  BENCH_SIM_SECONDS=N             simulated seconds (default 5)
+  BENCH_LOAD=N                    PHOLD messages per host (default 8)
+  BENCH_SHARDS=N                  run under shard_map over an N-device
+                                  mesh (CPU: N virtual devices are
+                                  forced; TPU: needs N real chips)
+  BENCH_TOPO=one|ref              'ref' = the reference's real
+                                  183-vertex Internet graph instead of
+                                  the single-vertex 50 ms fixture
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
+"backend", ...}. `backend` records where the run actually executed —
+a CPU-fallback number can never masquerade as a TPU one.
 vs_baseline compares against BASELINE.json's published events_per_sec
-when present; 0.0 until measured.
+at the same scale; 0.0 until measured.
 """
 
 from __future__ import annotations
@@ -19,6 +31,15 @@ import time
 
 # On a shared TPU, grab the chip; fall back to CPU quietly.
 os.environ.setdefault("JAX_PLATFORMS", "tpu,cpu")
+
+_SHARDS = int(os.environ.get("BENCH_SHARDS", "0"))
+if _SHARDS > 1 and "host_platform_device_count" not in os.environ.get(
+        "XLA_FLAGS", ""):
+    # must precede the first jax import: the host-platform device
+    # count is read at backend init (only affects the CPU platform)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + f" --xla_force_host_platform_device_count={_SHARDS}").strip()
 
 import jax
 import numpy as np
@@ -34,9 +55,27 @@ ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
   </graph>
 </graphml>"""
 
+# The reference's real Internet-derived topology (183 vertices, 16.8k
+# edges) — the graph every real Shadow experiment runs on and BASELINE
+# config #2's explicit input. Overridable for installs without the
+# reference tree mounted.
+REF_TOPOLOGY = os.environ.get(
+    "SHADOW_REF_TOPOLOGY",
+    "/root/reference/resource/topology.graphml.xml.xz")
+
+
+def ref_topology_text() -> str:
+    import lzma
+
+    if REF_TOPOLOGY.endswith(".xz"):
+        with lzma.open(REF_TOPOLOGY, "rt") as f:
+            return f.read()
+    with open(REF_TOPOLOGY) as f:
+        return f.read()
+
 
 def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
-                 cap: int | None = None):
+                 cap: int | None = None, graph: str | None = None):
     from shadow_tpu.apps import phold
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
@@ -56,12 +95,28 @@ def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
                     event_capacity=cap, outbox_capacity=cap,
                     router_ring=cap, in_ring=max(16, 2 * load))
     hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
-    b = build(cfg, ONE_VERTEX, hosts)
+    b = build(cfg, graph or ONE_VERTEX, hosts)
     b.sim = phold.setup(b.sim, load=load)
     return b
 
 
-def _phold_runner(H, load, sim_s, seed=1):
+def _make_phold_fn(b, shards: int):
+    from shadow_tpu.apps import phold
+    from shadow_tpu.net.build import make_runner
+
+    if shards > 1:
+        from shadow_tpu.parallel.shard import make_sharded_runner
+
+        mesh = jax.make_mesh((shards,), ("hosts",))
+        return make_sharded_runner(b, mesh, "hosts",
+                                   app_handlers=(phold.handler,),
+                                   app_bulk=phold.BULK)
+    return make_runner(b, app_handlers=(phold.handler,),
+                       app_bulk=phold.BULK)
+
+
+def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
+                  graph: str | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -72,18 +127,15 @@ def _phold_runner(H, load, sim_s, seed=1):
     Queue capacity starts tight (3*load) and doubles on overflow —
     events are counted when dropped, never silently lost, so a clean
     overflow==0 run at a tight capacity is sound AND fast."""
-    from shadow_tpu.apps import phold
-    from shadow_tpu.net.build import make_runner
-
     state = {"n": 0, "cap": None, "fn": None, "sims": None}
 
     def build_at(cap):
-        b = _build_phold(H, load, sim_s, seed, cap)
-        fn = make_runner(b, app_handlers=(phold.handler,),
-                         app_bulk=phold.BULK)
+        b = _build_phold(H, load, sim_s, seed, cap, graph)
+        fn = _make_phold_fn(b, shards)
         # pre-build distinct-seed inputs so the timed call measures
         # only the device program, not host-side setup
-        sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap).sim
+        sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap,
+                                       graph).sim
                           for i in (1, 2)]
         for s in sims:
             jax.block_until_ready(s.net.rng_keys)
@@ -139,51 +191,79 @@ def _pingpong_runner(H, sim_s):
     return go
 
 
-def _probe_backend() -> None:
+def _probe_backend(tries: int = 4, timeout_s: int = 180) -> int:
     """The axon TPU tunnel can wedge (backend init hangs forever, no
-    error). Probe device init in a subprocess with a timeout; if it
-    hangs or dies, force the CPU backend via jax.config BEFORE this
-    process touches a backend — a slow benchmark beats a hung one."""
+    error). Probe device init in a subprocess with a timeout, with
+    bounded retries + backoff — the tunnel often recovers within
+    minutes, and a TPU number is the whole point of the benchmark. If
+    every try hangs or dies, force the CPU backend via jax.config
+    BEFORE this process touches a backend — a slow benchmark beats a
+    hung one. (jax.config, not the env var: the global axon
+    sitecustomize re-exports JAX_PLATFORMS at interpreter start, so
+    env settings are unreliable; lazy backend init honors the config.)
+
+    Returns the probed accelerator device count (0 = unresponsive,
+    CPU forced)."""
     import subprocess
     import sys
 
-    try:
-        r = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            env=dict(os.environ), capture_output=True, text=True,
-            timeout=180)
-        if r.returncode == 0 and "ok" in r.stdout:
-            return
-    except subprocess.TimeoutExpired:
-        pass
-    import jax
+    for attempt in range(tries):
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print('ok', len(jax.devices()))"],
+                env=dict(os.environ), capture_output=True, text=True,
+                timeout=timeout_s)
+            if r.returncode == 0 and r.stdout.startswith("ok"):
+                return int(r.stdout.split()[1])
+        except subprocess.TimeoutExpired:
+            pass
+        if attempt < tries - 1:
+            delay = 30 * (attempt + 1)
+            print(f"WARNING: device backend probe {attempt + 1}/{tries} "
+                  f"failed; retrying in {delay}s", file=sys.stderr)
+            time.sleep(delay)
 
     jax.config.update("jax_platforms", "cpu")
-    print("WARNING: device backend unresponsive; benchmarking on CPU",
-          file=sys.stderr)
+    print("WARNING: device backend unresponsive after "
+          f"{tries} probes; benchmarking on CPU", file=sys.stderr)
+    return 0
 
 
 def main() -> None:
-    _probe_backend()
+    if os.environ.get("BENCH_PLATFORM") == "cpu":
+        # explicit CPU run (dev/CI): skip the accelerator probe
+        jax.config.update("jax_platforms", "cpu")
+        ndev = 0
+    else:
+        ndev = _probe_backend()
+    if _SHARDS > 1 and ndev < _SHARDS:
+        # not enough real chips for the requested mesh: run the
+        # sharded loop on forced virtual CPU devices (the same
+        # validation mesh the multi-chip dryrun uses)
+        jax.config.update("jax_platforms", "cpu")
     workload = os.environ.get("BENCH_WORKLOAD", "phold")
+    topo = os.environ.get("BENCH_TOPO", "one")
     # Default scale per backend, each compared against the measured
     # baseline AT THAT SCALE (below): the accelerator streams the
     # [H,K] state from HBM and wants lanes, so bigger is better; the
     # 1-core CPU fallback is cache-bound and 1k's working set fits L3.
-    import jax as _jax
-
-    default_h = "1024" if _jax.default_backend() == "cpu" else "10240"
+    default_h = "1024" if jax.default_backend() == "cpu" else "10240"
     H = int(os.environ.get("BENCH_HOSTS", default_h))
     sim_s = int(os.environ.get("BENCH_SIM_SECONDS", "5"))
     load = int(os.environ.get("BENCH_LOAD", "8"))
+    graph = ref_topology_text() if topo == "ref" else None
 
     if workload == "phold":
-        runner = _phold_runner(H, load, sim_s)
+        runner = _phold_runner(H, load, sim_s, shards=_SHARDS, graph=graph)
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
     else:
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
+    if topo == "ref":
+        name += "_reftopo"
+    if _SHARDS > 1:
+        name += f"_{_SHARDS}shards"
 
     runner()                      # compile + warm (may escalate capacity)
     while True:
@@ -192,7 +272,12 @@ def main() -> None:
         wall = time.perf_counter() - t0
         if not getattr(runner, "escalated", False):
             break                 # a recompile polluted the timing; redo
-    value = events / wall
+    total_rate = events / wall
+    # per-CHIP metric: a sharded run reports aggregate/shards so the
+    # value stays comparable to the 1-chip/1-core baseline (reporting
+    # the aggregate under the per-chip name would inflate vs_baseline
+    # by the shard count)
+    value = total_rate / _SHARDS if _SHARDS > 1 else total_rate
 
     # compare against the measured baseline AT THE SAME SCALE (the
     # C pthread heap-skeleton upper bound, BASELINE.md): the published
@@ -214,12 +299,17 @@ def main() -> None:
         pass
     vs = value / baseline if baseline else 0.0
 
-    print(json.dumps({
+    out = {
         "metric": name,
         "value": round(value, 1),
         "unit": "events/s",
         "vs_baseline": round(vs, 3),
-    }))
+        "backend": jax.default_backend(),
+    }
+    if _SHARDS > 1:
+        out["shards"] = _SHARDS
+        out["total_events_per_sec"] = round(total_rate, 1)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

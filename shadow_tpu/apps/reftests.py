@@ -237,8 +237,18 @@ def epoll_writeable_main(env):
         yield vproc.close(child)
         yield vproc.close(fd)
     else:
-        server_ip = env["resolve"](env["args"][1] if len(env["args"]) > 1
-                                   else "testnode")
+        if len(env["args"]) > 1:
+            server_name = env["args"][1]
+        elif "testnode" in env["hosts"]:
+            # the reference's epoll-writeable config names its server
+            # host "testnode"; honor that default only when it exists
+            server_name = "testnode"
+        else:
+            raise ValueError(
+                "epoll_writeable client needs the server hostname as its "
+                "second process argument (no host named 'testnode' in "
+                "this config)")
+        server_ip = env["resolve"](server_name)
         fd = yield vproc.socket(SocketType.TCP)
         r = yield vproc.connect(fd, server_ip, port)
         assert r == 0

@@ -32,7 +32,9 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("config", nargs="?", help="shadow.config.xml path")
     p.add_argument("--test", action="store_true",
                    help="run the built-in example config (ref: --test)")
-    p.add_argument("--test-clients", type=int, default=100)
+    p.add_argument("--test-clients", type=int, default=1000,
+                   help="clients in the built-in --test config; the "
+                        "reference bakes in 1000 (examples.c:10-12)")
     p.add_argument("-w", "--workers", type=int, default=1,
                    help="device shards (ref: worker threads)")
     p.add_argument("-s", "--seed", type=int, default=1)
@@ -106,11 +108,13 @@ def make_parser() -> argparse.ArgumentParser:
                         "the CPU backend — the reliable way to run "
                         "without the TPU, since a global sitecustomize "
                         "may re-export JAX_PLATFORMS)")
-    p.add_argument("--track-paths", action="store_true",
+    p.add_argument("--track-paths", action=argparse.BooleanOptionalAction,
+                   default=None,
                    help="count packets per (src,dst) topology vertex "
                         "pair, logged at shutdown (ref: topology.c "
                         "per-path counters); forces the serial window "
-                        "loop")
+                        "loop; --no-track-paths overrides a config "
+                        "that enables it")
     p.add_argument("--event-capacity", type=int, default=None)
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
@@ -137,7 +141,7 @@ def overrides_from_args(args) -> dict:
         "runahead": args.runahead,
         "sockets_per_host": args.sockets_per_host,
         "event_capacity": args.event_capacity,
-        "track_paths": args.track_paths or None,
+        "track_paths": args.track_paths,
     }
     return {k: v for k, v in overrides.items() if v is not None}
 
@@ -202,6 +206,25 @@ def main(argv=None) -> int:
                        f"end {b.cfg.end_time} ns")
 
         t0 = time.time()
+
+        # periodic run-time progress records (the reference's per-round
+        # heartbeat, slave.c:390-411, feeding plot-shadow's tick plot).
+        # Host-driven window loops call this per window; the whole-run
+        # device path reports a single final tick instead (a per-window
+        # host callback would forfeit its on-device speed).
+        prog_state = {"last": -1}
+
+        def progress_hook(s, wend):
+            sec = int(wend) // 10**9
+            bucket = sec // max(args.heartbeat_frequency, 1)
+            if bucket > prog_state["last"]:
+                prog_state["last"] = bucket
+                logger.message(
+                    int(wend), "shadow-tpu", "[shadow-progress] "
+                    + json.dumps({
+                        "sim_seconds": round(int(wend) / 1e9, 3),
+                        "wall_seconds": round(time.time() - t0, 3)}))
+
         cap = None
         if b.cfg.pcap:
             # pcap capture needs a host-driven window loop to drain
@@ -237,14 +260,21 @@ def main(argv=None) -> int:
                                 mesh=mesh)
             for hi, fn, st, sp in loaded.vprocs:
                 rt.spawn(hi, fn, start_time=st, stop_time=sp)
-            sim, stats = rt.run(
-                on_window=(lambda s, wend: cap.drain(s)) if cap else None)
+            def vproc_hook(s, wend, _cap=cap):
+                if _cap is not None:
+                    _cap.drain(s)
+                progress_hook(s, wend)
+
+            sim, stats = rt.run(on_window=vproc_hook)
         elif b.cfg.pcap:
             from shadow_tpu.utils import checkpoint as ckpt
 
+            def pcap_hook(s, wend):
+                cap.drain(s)
+                progress_hook(s, wend)
+
             sim, stats, _ = ckpt.run_windows(
-                b, app_handlers=loaded.handlers,
-                on_window=lambda s, wend: cap.drain(s))
+                b, app_handlers=loaded.handlers, on_window=pcap_hook)
         elif mesh is not None:
             from shadow_tpu.parallel.shard import run_sharded
 
@@ -303,6 +333,7 @@ def main(argv=None) -> int:
         report = {
             "events": ev,
             "windows": int(stats.windows),
+            "sim_seconds": round(sim_s, 3),
             # verification hook (ref: the reference's example config
             # downloads are verified by their sizes): the app's own rcvd
             # units — bytes for bulk, replies for pingpong

@@ -1,7 +1,7 @@
 """Built-in example configuration behind `--test` (ref: examples.c —
-the reference bakes in a 1000-client filetransfer XML; here a
-100-client bulk-download over one network vertex, scaled to finish
-quickly on any backend)."""
+the reference bakes in a 1000-client filetransfer XML; the same
+1000-client bulk-download over one network vertex here, with
+--test-clients to scale it down for quick smoke runs)."""
 
 EXAMPLE_GRAPHML = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
   <key attr.name="packetloss" attr.type="double" for="edge" id="d4" />
@@ -37,7 +37,7 @@ def example_body(clients: int, kib: int, server_attrs: str = "",
   </host>"""
 
 
-def example_config(clients: int = 100, kib: int = 330,
+def example_config(clients: int = 1000, kib: int = 330,
                    stoptime: int = 60) -> str:
     """ref: example_getTestContents (examples.c:10-30)."""
     return f"""<shadow stoptime="{stoptime}">

@@ -286,6 +286,7 @@ def _vproc_entry(bundle: "SimBundle", hi: int, p, main_fn):
         "host_index": hi,
         "args": list(p.arguments),
         "resolve": bundle.ip_of,
+        "hosts": bundle.host_names,
         "cfg": bundle.cfg,
     }
     return (

@@ -475,12 +475,16 @@ def clear_outbox(out: Outbox) -> Outbox:
     )
 
 
-def route_outbox(q: EventQueue, out: Outbox) -> tuple[EventQueue, Outbox]:
+def route_outbox(q: EventQueue, out: Outbox,
+                 impl: str | None = None) -> tuple[EventQueue, Outbox]:
     """Deliver all staged cross-host events into destination rows.
 
     Single-shard version: destination host ids are row indices
     directly. The multi-chip path runs insert_flat after an all-to-all
     keyed by dst // hosts_per_shard (see shadow_tpu.parallel.shard).
+    `impl` overrides the insert mechanism ("count"/"sort") for callers
+    whose arrays live on a different backend than jax.default_backend()
+    (values are bit-identical either way; this is perf-only).
     """
     H, M = out.dst.shape
     n = H * M
@@ -494,6 +498,7 @@ def route_outbox(q: EventQueue, out: Outbox) -> tuple[EventQueue, Outbox]:
         q, valid, dst,
         out.time.reshape(n), out.kind.reshape(n), out.src.reshape(n),
         out.seq.reshape(n), out.words.reshape(n, out.words.shape[-1]),
+        impl=impl,
     )
     q = q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
     return q, clear_outbox(out)

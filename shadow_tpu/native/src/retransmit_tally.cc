@@ -10,9 +10,11 @@
 // Exposed through a C ABI (ref: the retransmit_tally_* wrappers,
 // tcp_retransmit_tally.h:29-50) and consumed from Python via ctypes
 // (shadow_tpu/native/tally.py). The device TCP engine keeps a reduced
-// single-range scoreboard on-chip (net/tcp.py); this native tally is
-// the full-fidelity bookkeeping used by the host-side validation
-// tools and host-resident protocol paths.
+// 3-range advertised-list scoreboard on-chip (net/tcp.py sack_l/r +
+// sack_clip_len); this native tally is its full-fidelity
+// differential-validation ORACLE: tests/test_tally_oracle.py drives
+// both with the same heavy-loss packet streams and asserts the
+// device's retransmit decisions match the interval-set computation.
 
 #include <algorithm>
 #include <cstdint>

@@ -17,6 +17,7 @@ import numpy as np
 import jax.numpy as jnp
 
 from shadow_tpu.core import simtime
+from shadow_tpu.core.engine import _default_route
 from shadow_tpu.core.engine import run as engine_run
 from shadow_tpu.core.events import EventKind, emit_words, push_rows
 from shadow_tpu.net.state import (
@@ -148,7 +149,8 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
 
 
 def make_runner(bundle: SimBundle, app_handlers=(),
-                end_time: int | None = None, app_bulk=None):
+                end_time: int | None = None, app_bulk=None,
+                route_impl: str | None = None):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
     seconds per call at this op count; a reused jitted callable pays
@@ -158,7 +160,13 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     `app_bulk` (a net.bulk.AppBulk) turns on the bulk window pass:
     eligible hosts' whole windows are consumed in one vectorized pass
     per window instead of one micro-step per event, bit-identically
-    (see net/bulk.py)."""
+    (see net/bulk.py).
+
+    `route_impl` ("count"/"sort") overrides the outbox-insert
+    mechanism when the arrays live on a different backend than
+    jax.default_backend() — e.g. CPU-pinned state on a TPU host
+    (values are bit-identical either way; perf-only, mirrors
+    make_bulk_fn's order_impl)."""
     import jax
 
     step = make_step_fn(bundle.cfg, app_handlers)
@@ -167,13 +175,23 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     if app_bulk is not None:
         from shadow_tpu.net.bulk import make_bulk_fn
 
+        # (make_bulk_fn's order_impl is a separate knob with its own
+        # vocabulary, "cube"/"sort" — not forwarded from route_impl)
         bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
+    route_fn = _default_route
+    if route_impl is not None:
+        from shadow_tpu.core.events import route_outbox
+
+        def route_fn(sim):
+            q, out = route_outbox(sim.events, sim.outbox, impl=route_impl)
+            return sim.replace(events=q, outbox=out)
 
     def _go(sim):
         return engine_run(
             sim, step, end_time=end, min_jump=bundle.min_jump,
             emit_capacity=bundle.cfg.emit_capacity,
             lane_id=sim.net.lane_id,
+            route_fn=route_fn,
             bulk_fn=bulk_fn,
         )
 

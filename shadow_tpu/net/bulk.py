@@ -231,7 +231,8 @@ class AppBulk:
         raise NotImplementedError
 
 
-def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok):
+def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok,
+                 send_wire: int):
     net = sim.net
     q = sim.events
     kind_ok = jnp.all(~inwin | (q.kind == EventKind.PACKET), axis=1)
@@ -253,9 +254,17 @@ def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok):
     recv_need = jnp.sum(jnp.where(inwin & nonboot, wl, 0), axis=1)
     recv_ok = (recv_need == 0) | (
         net.tb_recv_tokens >= recv_need + pf.MTU)
+    # Send budget without relying on refills: the serial drain polices
+    # tokens >= MTU before EACH send and consumes the reply's actual
+    # wire bytes (nic.py; ref: network_interface.c:519-579), so
+    # n*send_wire + MTU tokens guarantee the drain never defers.
+    # send_wire is the app's static reply bound — using MTU per send
+    # would wrongly disqualify every low-bandwidth vertex (the real
+    # topology's buckets hold ~2 MTU) even when replies are tiny.
     n_nonboot = jnp.sum(inwin & nonboot, axis=1)
     send_ok = (n_nonboot == 0) | (
-        net.tb_send_tokens >= (n_nonboot + 1).astype(I64) * pf.MTU)
+        net.tb_send_tokens
+        >= n_nonboot.astype(I64) * send_wire + pf.MTU)
     return (kind_ok & udp_ok & quiesced & codel_ok & recv_ok & send_ok
             & app_ok)
 
@@ -318,10 +327,11 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
         # the CPU admission gate serializes event execution per host;
         # the bulk pass has no equivalent yet
         return None
-    # Replies must fit one MTU on the wire: then each send consumes at
-    # most MTU tokens, the (n+1)*MTU eligibility budget is a true upper
-    # bound, and the serial path's max(tokens-w, 0) floor can never
-    # engage mid-window (the closed form below doesn't model it).
+    # Replies must fit one MTU on the wire: then send_wire <= MTU, the
+    # n*send_wire + MTU eligibility budget (_eligibility) is a true
+    # upper bound on the serial drain's token need, and the serial
+    # path's max(tokens-w, 0) floor can never engage mid-window (the
+    # closed form below doesn't model it).
     if app_bulk.max_send_len + pf.HDR_UDP > pf.MTU:
         return None
 
@@ -363,7 +373,8 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
             ~inwin | (slot < 0) | (length <= rcvbuf_at), axis=1)
 
         elig = _eligibility(cfg, sim, inwin, t, wl, nonboot,
-                            app_ok & sndbuf_ok & rcv_fit)
+                            app_ok & sndbuf_ok & rcv_fit,
+                            app_bulk.max_send_len + pf.HDR_UDP)
 
         ev = inwin & elig[:, None]                     # events we consume
         n_ev = jnp.sum(ev, axis=1, dtype=I32)          # [H]

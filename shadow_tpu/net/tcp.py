@@ -744,6 +744,26 @@ def tcp_flush(cfg: NetConfig, sim, mask, slot, now, buf):
 # segment regeneration for retransmission
 # ---------------------------------------------------------------------
 
+def sack_clip_len(una, seg, sack_l, sack_r):
+    """The device scoreboard's retransmit decision rule: clip a
+    retransmission starting at snd_una so it ends at the first
+    peer-sacked left edge above una — sacked bytes need no resend
+    (ref: the reference tally's lost-range computation excludes sacked
+    intervals, tcp_retransmit_tally.cc compute_lost). Because the
+    receiver advertises its LOWEST parked ranges (stamp_at_wire), the
+    first sacked edge above una is always in the advertised list, so
+    this decision is bit-equal to the full interval-set tally's first
+    lost range — differentially validated against the native tally
+    under heavy random loss in tests/test_tally_oracle.py.
+
+    una: [H] i32; seg: [H] i32 proposed length; sack_l/sack_r:
+    [H, SACK_RANGES] i32 advertised scoreboard. Returns clipped [H]."""
+    above = (sack_r > sack_l) & (sack_l > una[:, None])
+    big = jnp.iinfo(I32).max
+    first_sacked = jnp.min(jnp.where(above, sack_l, big), axis=1)
+    return jnp.minimum(seg, jnp.maximum(first_sacked - una, 1))
+
+
 def _retransmit_one(cfg, sim, mask, slot, now, buf):
     """Re-send the segment at snd_una (ref: _tcp_retransmitPacket).
     SYN / SYN|ACK / FIN are regenerated from the state machine; data
@@ -771,19 +791,13 @@ def _retransmit_one(cfg, sim, mask, slot, now, buf):
                             pf.TCPF_FIN | pf.TCPF_ACK, una, 0, now,
                             retransmit=True)
     seg = jnp.minimum(end - una, MSS)
-    # clip the retransmission at the first peer-sacked edge above una:
-    # sacked bytes need no resend (ref: the tally's lost-range
-    # computation excludes sacked intervals)
     H = mask.shape[0]
     lane = jnp.arange(H)
     S = tcp.sack_l.shape[1]
     sc = jnp.clip(slot, 0, S - 1)
     sll = tcp.sack_l[lane, sc]                         # [H, SACK_RANGES]
     srr = tcp.sack_r[lane, sc]
-    above = (srr > sll) & (sll > una[:, None])
-    big = jnp.iinfo(I32).max
-    first_sacked = jnp.min(jnp.where(above, sll, big), axis=1)
-    seg = jnp.minimum(seg, jnp.maximum(first_sacked - una, 1))
+    seg = sack_clip_len(una, seg, sll, srr)
     sim, buf, _ = _enqueue_seg(sim, buf, is_data, slot, pf.TCPF_ACK, una, seg,
                                now, retransmit=True)
     sent = is_syn | is_synack | is_fin | is_data

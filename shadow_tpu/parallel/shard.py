@@ -215,25 +215,13 @@ def _sharded_route_fn(axis: str, num_shards: int, lane,
     return route
 
 
-def sharded_engine_run(
-    mesh: Mesh,
-    axis: str,
-    sim,
-    step_fn,
-    *,
-    end_time: int,
-    min_jump: int,
-    emit_capacity: int = 4,
-    lane_id_fn=None,
-    exchange_capacity: int | None = None,
-    bulk_fn=None,
-):
-    """shard_map the full engine.run over `mesh[axis]`. `sim` is the
-    *global* state (as built for single-shard); sharding/replication
-    follows sim_specs. lane_id_fn(local_sim) must return the [Hl]
-    global host ids of the shard's rows (defaults to sim.net.lane_id).
-
-    Returns (sim, stats) with global arrays reassembled."""
+def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
+                    end_time: int, min_jump: int, emit_capacity: int,
+                    lane_id_fn=None, exchange_capacity: int | None = None,
+                    bulk_fn=None):
+    """Shared factory: a jitted sim -> (sim, stats) running the full
+    engine loop under shard_map (used by sharded_engine_run and
+    make_sharded_runner — keep their semantics identical)."""
     num_shards, specs, stats_specs = _harness_specs(mesh, axis, sim)
 
     def _body(local_sim):
@@ -262,10 +250,39 @@ def sharded_engine_run(
         _body, mesh=mesh, in_specs=(specs,), out_specs=(specs, stats_specs),
         check_vma=False,
     )
+    jitted = jax.jit(shmapped)
     in_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                 is_leaf=lambda x: isinstance(x, P))
-    sim = jax.device_put(sim, in_shardings)
-    return jax.jit(shmapped)(sim)
+
+    def go(s):
+        return jitted(jax.device_put(s, in_shardings))
+
+    return go
+
+
+def sharded_engine_run(
+    mesh: Mesh,
+    axis: str,
+    sim,
+    step_fn,
+    *,
+    end_time: int,
+    min_jump: int,
+    emit_capacity: int = 4,
+    lane_id_fn=None,
+    exchange_capacity: int | None = None,
+    bulk_fn=None,
+):
+    """shard_map the full engine.run over `mesh[axis]`. `sim` is the
+    *global* state (as built for single-shard); sharding/replication
+    follows sim_specs. lane_id_fn(local_sim) must return the [Hl]
+    global host ids of the shard's rows (defaults to sim.net.lane_id).
+
+    Returns (sim, stats) with global arrays reassembled."""
+    return _make_whole_run(
+        mesh, axis, sim, step_fn, end_time=end_time, min_jump=min_jump,
+        emit_capacity=emit_capacity, lane_id_fn=lane_id_fn,
+        exchange_capacity=exchange_capacity, bulk_fn=bulk_fn)(sim)
 
 
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
@@ -300,14 +317,16 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
     return jax.jit(shmapped)
 
 
-def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
-                end_time: int | None = None,
-                exchange_capacity: int | None = None,
-                app_bulk=None):
-    """Multi-chip variant of shadow_tpu.net.build.run. `app_bulk`
-    enables the bulk window pass (net/bulk.py) — it is purely
-    lane-local (no collectives), so it composes with the sharded
-    window loop unchanged."""
+def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
+                        app_handlers=(), end_time: int | None = None,
+                        exchange_capacity: int | None = None,
+                        app_bulk=None):
+    """Multi-chip variant of shadow_tpu.net.build.make_runner: a
+    REUSABLE jitted sim -> (sim, stats) callable running the whole
+    window loop under shard_map (benchmarks must reuse one callable —
+    re-tracing the netstack costs seconds per call; see make_runner).
+    The input sim may be unsharded; device_put inside applies the
+    NamedShardings once per call."""
     from shadow_tpu.net.step import make_step_fn
 
     step = make_step_fn(bundle.cfg, app_handlers)
@@ -316,11 +335,20 @@ def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
         from shadow_tpu.net.bulk import make_bulk_fn
 
         bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
-    return sharded_engine_run(
+    return _make_whole_run(
         mesh, axis, bundle.sim, step,
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
-        bulk_fn=bulk_fn,
-    )
+        bulk_fn=bulk_fn)
+
+
+def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
+                end_time: int | None = None,
+                exchange_capacity: int | None = None,
+                app_bulk=None):
+    """One-shot multi-chip variant of shadow_tpu.net.build.run."""
+    return make_sharded_runner(
+        bundle, mesh, axis, app_handlers, end_time,
+        exchange_capacity, app_bulk)(bundle.sim)

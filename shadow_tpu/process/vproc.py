@@ -44,7 +44,7 @@ from shadow_tpu.core.engine import EngineStats, step_window
 from shadow_tpu.core.events import EmitBuffer, apply_emissions
 from shadow_tpu.net import tcp as tcpmod
 from shadow_tpu.net import udp as udpmod
-from shadow_tpu.net.rings import set_hs
+from shadow_tpu.net.rings import gather_hs, set_hs
 from shadow_tpu.net.sockets import sk_bind, sk_create
 from shadow_tpu.net.state import NetConfig, SocketFlags, SocketType
 from shadow_tpu.net.step import make_step_fn
@@ -168,6 +168,22 @@ def gettime():
     """gettimeofday/clock_gettime analog: the current sim time in ns
     (ref: worker_getEmulatedTime, worker.c:385-390)."""
     return Sys("gettime", ())
+
+
+def gethostbyname(name: str):
+    """Runtime name resolution through the simulation's DNS registry
+    (ref: process_emu_gethostbyname family, process.h:237-250, backed
+    by dns_resolveNameToAddress, dns.c). Returns the host's network IP
+    as an int, or -1 when the name is not registered — so configs can
+    address peers by hostname instead of IP hint, exactly as reference
+    plugins do."""
+    return Sys("gethostbyname", (name,))
+
+
+def getaddrinfo(name: str):
+    """Alias of gethostbyname for the modern-API spelling the
+    reference also interposes (process_emu_getaddrinfo)."""
+    return Sys("gethostbyname", (name,))
 
 
 TIMER_FD_BASE = 1 << 19   # timerfd handles above the pipe space
@@ -439,6 +455,12 @@ class ProcessRuntime:
         # host-side copy of the (static) IP tables for addr -> host id
         self._ip_sorted = np.asarray(self.sim.net.ip_sorted)
         self._host_of_ip_sorted = np.asarray(self.sim.net.host_of_ip_sorted)
+        # dispatch accounting (SURVEY §7.4.4 batching evidence): one
+        # "dispatch" = one fused device op (_apply); one "syscall" =
+        # one coroutine request executed. Batched, dispatches grow
+        # ~per-window-per-op-kind, not per syscall.
+        self.stat_device_dispatches = 0
+        self.stat_syscalls = 0
 
     # -- process registration -----------------------------------------
 
@@ -489,6 +511,7 @@ class ProcessRuntime:
         events — no pipeline send drain runs out here."""
         from shadow_tpu.net import nic
 
+        self.stat_device_dispatches += 1
         buf = EmitBuffer.create(self.cfg.num_hosts, self.cfg.emit_capacity,
                                 nwords=self.cfg.words_width)
         sim, buf = fn(self.sim, buf)
@@ -641,17 +664,17 @@ class ProcessRuntime:
         blocking one). Blocking decisions come from the live device
         state / the op's own result — never from a snapshot, which
         would go stale the moment an earlier syscall in the same pass
-        mutated state. Returns (ready, result)."""
+        mutated state. Returns (ready, result).
+
+        Ops in BATCH_OPS have exactly ONE implementation — the batched
+        one; a lone call is a singleton batch (no second copy of the
+        semantics to drift)."""
+        if call.op in self.BATCH_OPS:
+            return self._exec_batch(call.op, [p], now)[p.host]
         h = p.host
         mask = self._lane(h)
         op, a = call.op, call.args
 
-        if op == "socket":
-            net, slot = sk_create(self.sim.net, mask, a[0])
-            self.sim = self.sim.replace(net=net)
-            self._flags_cache = None
-            self._tcp_st_cache = None
-            return True, int(slot[h])
         if op == "epoll_create":
             epfd = p.next_epfd
             p.next_epfd += 1
@@ -695,35 +718,6 @@ class ProcessRuntime:
             if events:
                 return True, events
             return False, None
-        if op == "bind":
-            fd, want = a[0], int(a[1])
-            # EINVAL: the socket is already bound (explicitly, or
-            # implicitly by connect's ephemeral bind) — a second bind
-            # fails (ref: test_bind.c:93-95,112-114 asserts EINVAL on
-            # re-bind; host_bindToInterface is only reached for
-            # unbound sockets)
-            if int(self.sim.net.sk_bound_port[h, fd]) != 0:
-                return True, -1
-            # EADDRINUSE: another live same-protocol socket on this
-            # host already binds the requested port (ref:
-            # _host_isInterfaceAvailable -> networkinterface_isAssociated,
-            # host.c:1029-1052; ephemeral requests scan for a free
-            # port instead, host.c:1087-1099 — our counter allocator
-            # never collides with itself, so only explicit ports can
-            # conflict)
-            net = self.sim.net
-            if want != 0:
-                proto = int(net.sk_type[h, fd])
-                taken = bool(np.any(
-                    (np.asarray(net.sk_type[h]) == proto)
-                    & (np.asarray(net.sk_bound_port[h]) == want)
-                    & (np.arange(net.sk_type.shape[1]) != fd)))
-                if taken:
-                    return True, -1
-            net, port = sk_bind(net, mask, jnp.full_like(mask, fd, I32),
-                                0, want)
-            self.sim = self.sim.replace(net=net)
-            return True, int(port[h])
         if op == "listen":
             self.sim = tcpmod.tcp_listen(self.sim, mask,
                                          jnp.full_like(mask, a[0], I32))
@@ -732,6 +726,9 @@ class ProcessRuntime:
             return True, 0
         if op == "gettime":
             return True, now
+        if op == "gethostbyname":
+            addr = self.bundle.dns.resolve_name(a[0])
+            return True, (addr.ip if addr is not None else -1)
         if op == "setsockopt":
             fd, opt, val = a
             net = self.sim.net
@@ -772,20 +769,6 @@ class ProcessRuntime:
                 t = self.sim.tcp
                 return True, int(t.snd_end[h, fd]) - int(t.snd_una[h, fd])
             return True, int(net.out_bytes[h, fd])
-        if op == "sendto":
-            fd, ip, port, n = a
-            ok = None
-
-            def do(sim, buf):
-                nonlocal ok
-                net, okk = udpmod.udp_enqueue_send(
-                    sim.net, mask, jnp.full_like(mask, fd, I32), ip, port, n, -1)
-                ok = okk
-                from shadow_tpu.net import nic
-                return nic.notify_wants_send(sim.replace(net=net), buf, okk, now)
-
-            self._apply(do, now)
-            return True, bool(ok[h])
         # Blocking-syscall retries are gated on host-side cached
         # readiness, so a blocked process costs NO device dispatch per
         # window unless its call can actually progress (the batching
@@ -824,26 +807,6 @@ class ProcessRuntime:
             self._apply(do, now)
             if child is not None and child >= 0:
                 return True, child
-            return False, None
-        if op == "send":
-            fd, n = a
-            # WRITABLE is cleared when the stream buffer fills and
-            # restored by ACK progress (tcp_send / the ACK path)
-            if not self._sk_flag(h, fd, SocketFlags.WRITABLE):
-                return False, None
-            acc = None
-
-            def do(sim, buf):
-                nonlocal acc
-                sim, buf, accepted = tcpmod.tcp_send(
-                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
-                    n, now, buf)
-                acc = int(accepted[h])
-                return sim, buf
-
-            self._apply(do, now)
-            if acc and acc > 0:
-                return True, acc
             return False, None
         if op == "pipe":
             base = self._next_pipe_fd.setdefault(h, PIPE_FD_BASE)
@@ -892,222 +855,6 @@ class ProcessRuntime:
             if q.writers == 0:
                 return True, b""         # EOF: all write ends closed
             return False, None
-        if op == "send_data":
-            fd, data = a
-            if not self._sk_flag(h, fd, SocketFlags.WRITABLE):
-                return False, None    # see "send": retry gating
-            key = self._stream_key(p, fd, sending=True)
-            acc = None
-
-            def do(sim, buf):
-                nonlocal acc
-                sim, buf, accepted = tcpmod.tcp_send(
-                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
-                    len(data), now, buf)
-                acc = int(accepted[h])
-                return sim, buf
-
-            self._apply(do, now)
-            if acc and acc > 0:
-                self._streams.setdefault(key, bytearray()).extend(data[:acc])
-                return True, acc
-            return False, None
-        if op == "recv_data":
-            fd, maxb = a
-            # READABLE covers both pending data and a consumed FIN
-            # (EOF keeps it set; tcp_recv clears only drained-not-eof)
-            if not self._sk_flag(h, fd, SocketFlags.READABLE):
-                return False, None
-            key = self._stream_key(p, fd, sending=False)
-            nread = eof = None
-
-            def do(sim, buf):
-                nonlocal nread, eof
-                sim, buf, nr, ef = tcpmod.tcp_recv(
-                    sim, mask, jnp.full_like(mask, fd, I32),
-                    maxb, now, buf)
-                nread, eof = int(nr[h]), bool(ef[h])
-                return sim, buf
-
-            self._apply(do, now)
-            if nread and nread > 0:
-                fifo = self._streams.get(key)
-                if fifo is None or len(fifo) < nread:
-                    # peer sent length-only traffic (send/sendto):
-                    # deliver zero bytes for the missing content
-                    have = bytes(fifo[:nread]) if fifo else b""
-                    out = have + b"\x00" * (nread - len(have))
-                    if fifo:
-                        del fifo[:len(have)]
-                else:
-                    out = bytes(fifo[:nread])
-                    del fifo[:nread]
-                return True, out
-            if eof:
-                return True, b""   # EOF
-            return False, None
-        if op == "sendto_data":
-            fd, ip, port, data = a
-            payref = self.pool.put(bytes(data))
-            ok = None
-
-            def do(sim, buf):
-                nonlocal ok
-                net, okk = udpmod.udp_enqueue_send(
-                    sim.net, mask, jnp.full_like(mask, fd, I32), ip, port,
-                    len(data), payref)
-                ok = okk
-                from shadow_tpu.net import nic
-                return nic.notify_wants_send(sim.replace(net=net), buf, okk, now)
-
-            self._apply(do, now)
-            queued = bool(ok[h])
-            if not queued:
-                self.pool.unref(payref)   # EWOULDBLOCK: nothing holds it
-            return True, queued
-        if op == "recvfrom_data":
-            fd = a[0]
-            if not self._sk_flag(h, fd, SocketFlags.READABLE):
-                return False, None
-            res = None
-            got_any = False
-
-            def do(sim, buf):
-                nonlocal res, got_any
-                net, got, sip, spt, ln, pref = udpmod.udp_recv(
-                    sim.net, mask, jnp.full_like(mask, fd, I32))
-                res = (int(sip[h]), int(spt[h]), int(ln[h]), int(pref[h]))
-                got_any = bool(got[h])
-                return sim.replace(net=net), buf
-
-            self._apply(do, now)
-            if got_any:
-                sip, spt, ln, pref = res
-                if pref >= 0:
-                    data = self.pool.get(pref)
-                    self.pool.unref(pref)
-                else:
-                    data = b"\x00" * ln   # synthetic (length-only) sender
-                return True, (sip, spt, data)
-            return False, None
-        if op == "recv":
-            fd, maxb = a
-            if not self._sk_flag(h, fd, SocketFlags.READABLE):
-                return False, None    # retry gating: no data, no EOF
-            is_tcp = self.sim.tcp is not None and (
-                int(self.sim.net.sk_type[h, fd]) == SocketType.TCP
-                or self._tcp_st(h, fd) != 0)
-            if is_tcp:
-                nread = eof = None
-
-                def do(sim, buf):
-                    nonlocal nread, eof
-                    sim, buf, nr, ef = tcpmod.tcp_recv(
-                        sim, mask, jnp.full_like(mask, fd, I32),
-                        maxb, now, buf)
-                    nread, eof = int(nr[h]), bool(ef[h])
-                    return sim, buf
-
-                self._apply(do, now)
-                if nread and nread > 0:
-                    return True, nread
-                if eof:
-                    return True, 0     # EOF
-                return False, None
-            # UDP fd: byte-count of one datagram
-            res = None
-            got_any = False
-            pref = -1
-
-            def do(sim, buf):
-                nonlocal res, got_any, pref
-                net, got, sip, spt, ln, pr = udpmod.udp_recv(
-                    sim.net, mask, jnp.full_like(mask, fd, I32))
-                res, got_any = int(ln[h]), bool(got[h])
-                pref = int(pr[h])
-                return sim.replace(net=net), buf
-
-            self._apply(do, now)
-            if got_any:
-                if pref >= 0:
-                    self.pool.unref(pref)  # content discarded by the
-                    # length-only API; drop the pool ref (payload.c)
-                return True, res
-            return False, None
-        if op == "recvfrom":
-            fd = a[0]
-            if not self._sk_flag(h, fd, SocketFlags.READABLE):
-                return False, None
-            res = None
-            got_any = False
-            pref = -1
-
-            def do(sim, buf):
-                nonlocal res, got_any, pref
-                net, got, sip, spt, ln, pr = udpmod.udp_recv(
-                    sim.net, mask, jnp.full_like(mask, fd, I32))
-                res = (int(sip[h]), int(spt[h]), int(ln[h]))
-                got_any = bool(got[h])
-                pref = int(pr[h])
-                return sim.replace(net=net), buf
-
-            self._apply(do, now)
-            if got_any:
-                if pref >= 0:
-                    self.pool.unref(pref)  # see recv: length-only API
-                return True, res
-            return False, None
-        if op == "close":
-            fd = a[0]
-            if fd >= PIPE_FD_BASE:
-                ep = self._channels.pop((h, fd), None)
-                for epl in p.epolls.values():
-                    epl.watches.pop(fd, None)
-                if ep is not None:
-                    # closing an end flips the peer's status: last
-                    # writer gone -> reader sees EOF (readable); last
-                    # reader gone -> writer sees EPIPE (writable)
-                    # (ref: channel.c close/free status flips)
-                    if ep.recv_q is not None:
-                        ep.recv_q.readers -= 1
-                        ep.recv_q.out_gen += 1
-                    if ep.send_q is not None:
-                        ep.send_q.writers -= 1
-                        ep.send_q.in_gen += 1
-                return True, 0
-            if fd >= EPOLL_FD_BASE:
-                p.epolls.pop(fd, None)
-                return True, 0
-            # closing a socket removes its watches (the reference
-            # deregisters listeners when a descriptor is freed) —
-            # otherwise a stale watch reports the readiness of
-            # whatever unrelated socket later reuses the slot
-            for ep in p.epolls.values():
-                ep.watches.pop(fd, None)
-            if int(self.sim.net.sk_type[h, fd]) == SocketType.TCP:
-                self._apply(lambda sim, buf: tcpmod.tcp_close(
-                    self.cfg, sim, mask, jnp.full_like(mask, fd, I32),
-                    now, buf), now)
-            else:
-                net = self.sim.net
-                sel = self._lane(h)
-                slot = jnp.full_like(mask, fd, I32)
-                was_live = sel & (net.sk_type[:, fd] != SocketType.NONE)
-                net = net.replace(
-                    sk_type=set_hs(net.sk_type, sel, slot,
-                                   jnp.zeros_like(slot)),
-                    sk_flags=set_hs(net.sk_flags, sel, slot,
-                                    jnp.zeros_like(slot)),
-                    sk_bound_port=set_hs(net.sk_bound_port, sel, slot,
-                                         jnp.zeros_like(slot)),
-                    # object accounting (ref: object_counter.c)
-                    ctr_sk_free=net.ctr_sk_free
-                    + was_live.astype(jnp.int64),
-                )
-                self.sim = self.sim.replace(net=net)
-                self._flags_cache = None
-                self._tcp_st_cache = None
-            return True, 0
         if op == "timerfd_create":
             nxt = self._timer_alloc.get(h, 0)
             if nxt >= self.cfg.timers_per_host:
@@ -1165,18 +912,366 @@ class ProcessRuntime:
             return False, None
         raise ValueError(f"unknown syscall {op}")
 
+    # -- batched syscall execution (SURVEY §7.4.4) ----------------------
+    # Data-plane ops whose device kernel is a masked [H] batch update:
+    # N processes on N distinct hosts issuing the same op in the same
+    # scheduler round execute as ONE fused device op with a multi-hot
+    # mask and per-host argument vectors — the per-window syscall
+    # batching the reference gets for free from shared memory and we
+    # need to amortize device dispatch latency (VERDICT r2 weak #6:
+    # O(procs x syscalls) dispatches walled any 1000-vproc config).
+
+    BATCH_OPS = frozenset((
+        "sendto", "sendto_data", "recvfrom", "recvfrom_data",
+        "recv", "recv_data", "send", "send_data",
+        "socket", "bind", "close",
+    ))
+
+    def _batch_arrays(self, group, cols, dtypes=None):
+        """mask + [H] arg arrays from a {host: args-tuple} group.
+        `cols` = indices into each args tuple to vectorize; `dtypes`
+        per column (default i32, matching the serial path's
+        jnp.full_like(mask, v, I32) slots; IPs need i64)."""
+        H = self.cfg.num_hosts
+        m = np.zeros(H, bool)
+        dts = dtypes or [np.int32] * len(cols)
+        out = [np.zeros(H, dt) for dt in dts]
+        for h, a in group.items():
+            m[h] = True
+            for i, c in enumerate(cols):
+                out[i][h] = a[c]
+        return (jnp.asarray(m),) + tuple(jnp.asarray(x) for x in out)
+
+    def _exec_batch(self, op: str, procs: list, now: int) -> dict:
+        """Execute one op kind for processes on DISTINCT hosts as one
+        fused device op. Returns {host: (ready, result)} with results
+        identical to per-host _exec (same kernels, multi-hot mask).
+        Host-side work (payload pool, stream FIFOs) runs per host in
+        spawn order, exactly as the serial path interleaves it."""
+        res: dict = {}
+
+        if op in ("sendto", "sendto_data"):
+            # non-blocking datagram sends; pool puts first (spawn order)
+            group = {}
+            prefs = {}
+            for p in procs:
+                fd, ip, port, last = p.pending.args
+                if op == "sendto_data":
+                    prefs[p.host] = self.pool.put(bytes(last))
+                    group[p.host] = (fd, ip, port, len(last),
+                                     prefs[p.host])
+                else:
+                    group[p.host] = (fd, ip, port, last, -1)
+            mask, fd, ip, port, n, pref = self._batch_arrays(
+                group, (0, 1, 2, 3, 4),
+                dtypes=(np.int32, np.int64, np.int32, np.int32, np.int32))
+            ok = None
+
+            def do(sim, buf):
+                nonlocal ok
+                net, okk = udpmod.udp_enqueue_send(
+                    sim.net, mask, fd, ip, port, n, pref)
+                ok = okk
+                from shadow_tpu.net import nic
+                return nic.notify_wants_send(
+                    sim.replace(net=net), buf, okk, now)
+
+            self._apply(do, now)
+            ok = np.asarray(ok)
+            for p in procs:
+                queued = bool(ok[p.host])
+                if op == "sendto_data" and not queued:
+                    self.pool.unref(prefs[p.host])  # EWOULDBLOCK
+                res[p.host] = (True, queued)
+            return res
+
+        if op in ("recvfrom", "recvfrom_data", "recv", "recv_data"):
+            # blocked unless READABLE (host-side cache, no dispatch)
+            ready_procs = []
+            for p in procs:
+                fd = p.pending.args[0]
+                if self._sk_flag(p.host, fd, SocketFlags.READABLE):
+                    ready_procs.append(p)
+                else:
+                    res[p.host] = (False, None)
+            # split TCP stream reads from UDP datagram reads ("recv"
+            # on a TCP fd is a stream read; "recv_data" is stream-only
+            # by contract — both exactly as serial _exec routes them).
+            # ONE sk_type snapshot for the whole batch, not a device
+            # indexing read per process.
+            tcp_grp, udp_grp = [], []
+            sktype = (np.asarray(self.sim.net.sk_type)
+                      if ready_procs and op == "recv" else None)
+            for p in ready_procs:
+                fd = p.pending.args[0]
+                is_tcp = op == "recv_data" or (
+                    op == "recv" and self.sim.tcp is not None and (
+                        int(sktype[p.host, fd]) == SocketType.TCP
+                        or self._tcp_st(p.host, fd) != 0))
+                (tcp_grp if is_tcp else udp_grp).append(p)
+
+            if tcp_grp:
+                group = {p.host: (p.pending.args[0],
+                                  p.pending.args[1] if
+                                  len(p.pending.args) > 1 else 1 << 30)
+                         for p in tcp_grp}
+                mask, fd, maxb = self._batch_arrays(group, (0, 1))
+                got = {}
+
+                def dot(sim, buf):
+                    sim, buf, nr, ef = tcpmod.tcp_recv(
+                        sim, mask, fd, maxb, now, buf)
+                    got["nr"], got["ef"] = nr, ef
+                    return sim, buf
+
+                self._apply(dot, now)
+                nr = np.asarray(got["nr"])
+                ef = np.asarray(got["ef"])
+                for p in tcp_grp:
+                    h = p.host
+                    nread, eof = int(nr[h]), bool(ef[h])
+                    if nread > 0:
+                        if op == "recv":
+                            res[h] = (True, nread)
+                        else:
+                            key = self._stream_key(
+                                p, p.pending.args[0], sending=False)
+                            fifo = self._streams.get(key)
+                            if fifo is None or len(fifo) < nread:
+                                have = bytes(fifo[:nread]) if fifo else b""
+                                out = have + b"\x00" * (nread - len(have))
+                                if fifo:
+                                    del fifo[:len(have)]
+                            else:
+                                out = bytes(fifo[:nread])
+                                del fifo[:nread]
+                            res[h] = (True, out)
+                    elif eof:
+                        res[h] = (True, 0 if op == "recv" else b"")
+                    else:
+                        res[h] = (False, None)
+
+            if udp_grp:
+                group = {p.host: (p.pending.args[0],) for p in udp_grp}
+                mask, fd = self._batch_arrays(group, (0,))
+                got = {}
+
+                def dou(sim, buf):
+                    net, g, sip, spt, ln, pr = udpmod.udp_recv(
+                        sim.net, mask, fd)
+                    got.update(g=g, sip=sip, spt=spt, ln=ln, pr=pr)
+                    return sim.replace(net=net), buf
+
+                self._apply(dou, now)
+                g = np.asarray(got["g"])
+                sip = np.asarray(got["sip"])
+                spt = np.asarray(got["spt"])
+                ln = np.asarray(got["ln"])
+                pr = np.asarray(got["pr"])
+                for p in udp_grp:
+                    h = p.host
+                    if not bool(g[h]):
+                        res[h] = (False, None)
+                        continue
+                    pref = int(pr[h])
+                    if op == "recvfrom_data":
+                        if pref >= 0:
+                            data = self.pool.get(pref)
+                            self.pool.unref(pref)
+                        else:
+                            data = b"\x00" * int(ln[h])
+                        res[h] = (True, (int(sip[h]), int(spt[h]), data))
+                    else:
+                        if pref >= 0:
+                            self.pool.unref(pref)  # length-only API
+                        if op == "recvfrom":
+                            res[h] = (True, (int(sip[h]), int(spt[h]),
+                                             int(ln[h])))
+                        else:          # "recv" on a UDP fd
+                            res[h] = (True, int(ln[h]))
+            return res
+
+        if op in ("send", "send_data"):
+            ready_procs = []
+            for p in procs:
+                fd = p.pending.args[0]
+                if self._sk_flag(p.host, fd, SocketFlags.WRITABLE):
+                    ready_procs.append(p)
+                else:
+                    res[p.host] = (False, None)
+            if ready_procs:
+                group = {}
+                for p in ready_procs:
+                    fd, last = p.pending.args
+                    n = len(last) if op == "send_data" else last
+                    group[p.host] = (fd, n)
+                mask, fd, n = self._batch_arrays(group, (0, 1))
+                got = {}
+
+                def dos(sim, buf):
+                    sim, buf, accepted = tcpmod.tcp_send(
+                        self.cfg, sim, mask, fd, n, now, buf)
+                    got["acc"] = accepted
+                    return sim, buf
+
+                self._apply(dos, now)
+                acc = np.asarray(got["acc"])
+                for p in ready_procs:
+                    h = p.host
+                    a = int(acc[h])
+                    if a > 0:
+                        if op == "send_data":
+                            key = self._stream_key(
+                                p, p.pending.args[0], sending=True)
+                            self._streams.setdefault(
+                                key, bytearray()).extend(
+                                    p.pending.args[1][:a])
+                        res[h] = (True, a)
+                    else:
+                        res[h] = (False, None)
+            return res
+
+        if op == "socket":
+            group = {p.host: (p.pending.args[0],) for p in procs}
+            mask, stype = self._batch_arrays(group, (0,))
+            self.stat_device_dispatches += 1
+            net, slot = sk_create(self.sim.net, mask, stype)
+            self.sim = self.sim.replace(net=net)
+            self._flags_cache = None
+            self._tcp_st_cache = None
+            s = np.asarray(slot)
+            return {p.host: (True, int(s[p.host])) for p in procs}
+
+        if op == "bind":
+            # host-side EINVAL / EADDRINUSE checks from ONE snapshot
+            # (the serial path's per-bind int() reads cost one device
+            # sync each — ADVICE r2 #4), then one fused sk_bind
+            net = self.sim.net
+            bound = np.asarray(net.sk_bound_port)
+            sktype = np.asarray(net.sk_type)
+            S = bound.shape[1]
+            group = {}
+            ok_procs = []
+            for p in procs:
+                fd, want = p.pending.args[0], int(p.pending.args[1])
+                h = p.host
+                if int(bound[h, fd]) != 0:
+                    res[h] = (True, -1)        # EINVAL: already bound
+                    continue
+                if want != 0:
+                    proto = int(sktype[h, fd])
+                    taken = bool(np.any(
+                        (sktype[h] == proto) & (bound[h] == want)
+                        & (np.arange(S) != fd)))
+                    if taken:
+                        res[h] = (True, -1)    # EADDRINUSE
+                        continue
+                group[h] = (fd, want)
+                ok_procs.append(p)
+            if group:
+                mask, fd, want = self._batch_arrays(group, (0, 1))
+                self.stat_device_dispatches += 1
+                net2, port = sk_bind(net, mask, fd, 0, want)
+                self.sim = self.sim.replace(net=net2)
+                self._flags_cache = None
+                self._tcp_st_cache = None
+                prt = np.asarray(port)
+                for p in ok_procs:
+                    res[p.host] = (True, int(prt[p.host]))
+            return res
+
+        if op == "close":
+            # pipe/timer/epoll closes are pure host-side bookkeeping
+            # (no device dispatch); socket closes split into one
+            # tcp_close and one fused UDP slot clear
+            tcp_grp, udp_grp = [], []
+            sktype = np.asarray(self.sim.net.sk_type)
+            for p in procs:
+                fd = p.pending.args[0]
+                if fd >= EPOLL_FD_BASE:        # pipes/timers/epolls too
+                    res[p.host] = self._close_special(p, fd)
+                    continue
+                for ep in p.epolls.values():
+                    ep.watches.pop(fd, None)
+                if int(sktype[p.host, fd]) == SocketType.TCP:
+                    tcp_grp.append(p)
+                else:
+                    udp_grp.append(p)
+            if tcp_grp:
+                group = {p.host: (p.pending.args[0],) for p in tcp_grp}
+                mask, fd = self._batch_arrays(group, (0,))
+                self._apply(lambda sim, buf: tcpmod.tcp_close(
+                    self.cfg, sim, mask, fd, now, buf), now)
+                for p in tcp_grp:
+                    res[p.host] = (True, 0)
+            if udp_grp:
+                group = {p.host: (p.pending.args[0],) for p in udp_grp}
+                sel, slot = self._batch_arrays(group, (0,))
+                self.stat_device_dispatches += 1
+                net = self.sim.net
+                was_live = sel & (gather_hs(net.sk_type, slot)
+                                  != SocketType.NONE)
+                net = net.replace(
+                    sk_type=set_hs(net.sk_type, sel, slot,
+                                   jnp.zeros_like(slot)),
+                    sk_flags=set_hs(net.sk_flags, sel, slot,
+                                    jnp.zeros_like(slot)),
+                    sk_bound_port=set_hs(net.sk_bound_port, sel, slot,
+                                         jnp.zeros_like(slot)),
+                    ctr_sk_free=net.ctr_sk_free
+                    + was_live.astype(jnp.int64),
+                )
+                self.sim = self.sim.replace(net=net)
+                self._flags_cache = None
+                self._tcp_st_cache = None
+                for p in udp_grp:
+                    res[p.host] = (True, 0)
+            return res
+
+        raise ValueError(f"op {op} is not batchable")
+
+    def _close_special(self, p: _Proc, fd: int):
+        """close() of a non-socket fd: pipe/socketpair ends (status
+        flips for the peer — last writer gone -> reader sees EOF,
+        last reader gone -> writer sees EPIPE, ref: channel.c
+        close/free), or an epoll descriptor. Pure host-side."""
+        h = p.host
+        if fd >= PIPE_FD_BASE:
+            ep = self._channels.pop((h, fd), None)
+            for epl in p.epolls.values():
+                epl.watches.pop(fd, None)
+            if ep is not None:
+                if ep.recv_q is not None:
+                    ep.recv_q.readers -= 1
+                    ep.recv_q.out_gen += 1
+                if ep.send_q is not None:
+                    ep.send_q.writers -= 1
+                    ep.send_q.in_gen += 1
+            return True, 0
+        p.epolls.pop(fd, None)
+        return True, 0
+
     # -- scheduler ------------------------------------------------------
 
     def _resume_all(self, now: int) -> None:
         """Advance every runnable coroutine until all are blocked
-        (the pth_yield loop, process.c:1227-1229). Processes run in
-        spawn order — deterministic. Sweeps repeat while channel
-        activity occurred: a pipe write/read/close by a later process
-        can unblock an earlier one at the same instant (the
-        reference's status-change notify re-enters process_continue
-        within the same sim time, epoll.c:583-680). Only channels
-        need this — every other cross-process path rides device
-        events, which land in a later window."""
+        (the pth_yield loop, process.c:1227-1229), in breadth-first
+        ROUNDS so data-plane syscalls from distinct hosts fuse into
+        one device op each (_exec_batch; SURVEY §7.4.4). Each round
+        claims the earliest runnable process per host (per-host spawn
+        order — one host's syscalls stay strictly serialized, the
+        per-host determinism contract), executes non-batchable ops in
+        spawn order, then each batchable op kind as one fused masked
+        op. A process that blocks is parked for the rest of the sweep
+        (the serial loop visited each process once per sweep too).
+
+        Sweeps repeat while channel activity occurred: a pipe
+        write/read/close by a later process can unblock an earlier
+        one at the same instant (the reference's status-change notify
+        re-enters process_continue within the same sim time,
+        epoll.c:583-680). Only channels need this — every other
+        cross-process path rides device events, which land in a
+        later window."""
         chan_ops = ("pipe", "socketpair", "write", "read")
         # syscalls whose blocking state channel activity can change;
         # later sweeps retry ONLY processes blocked on these (cheap,
@@ -1184,45 +1279,86 @@ class ProcessRuntime:
         # accept, ...) every sweep would cost a device dispatch per
         # blocked process per sweep for state that cannot have changed
         retry_ops = ("read", "write", "wait_readable", "epoll_wait")
+
+        def advance(p, idx, ready, result, parked):
+            """Feed one syscall result back into its coroutine."""
+            call = p.pending
+            if not ready:
+                p.block = call
+                parked.add(idx)
+                return False
+            if call.op in chan_ops or (
+                    call.op == "close" and call.args
+                    and call.args[0] >= PIPE_FD_BASE):
+                advance.chan_activity = True
+            p.block = None
+            try:
+                p.pending = p.gen.send(result)
+            except StopIteration:
+                p.done = True
+                p.pending = None
+            return True
+
         sweep = 0
         while True:
-            chan_activity = False
-            for p in self.procs:
-                if p.done or now < p.start_time:
-                    continue
-                if sweep > 0 and p.block is not None \
-                        and p.block.op not in retry_ops:
-                    continue
-                if not p.started:
-                    p.started = True
-                    try:
-                        p.pending = next(p.gen)
-                    except StopIteration:
-                        p.done = True
+            advance.chan_activity = False
+            parked: set = set()           # proc indices blocked this sweep
+            while True:                   # rounds
+                claimed: dict = {}        # host -> (idx, proc)
+                for idx, p in enumerate(self.procs):
+                    if p.done or now < p.start_time or idx in parked:
                         continue
-                    p.block = None
-                # run until this process blocks
-                while True:
-                    call = getattr(p, "pending", None)
-                    if call is None:
-                        break
-                    ready, result = self._exec(p, call, now)
-                    if not ready:
-                        p.block = call
-                        break
-                    if call.op in chan_ops or (
-                            call.op == "close" and call.args
-                            and call.args[0] >= PIPE_FD_BASE):
-                        chan_activity = True
-                    p.block = None
-                    try:
-                        p.pending = p.gen.send(result)
-                    except StopIteration:
+                    if sweep > 0 and p.block is not None \
+                            and p.block.op not in retry_ops:
+                        continue
+                    if p.host in claimed:
+                        continue
+                    claimed[p.host] = (idx, p)
+                if not claimed:
+                    break
+                progress = False
+                parked_before = len(parked)
+                batches: dict = {}
+                serial = []
+                for h in sorted(claimed):
+                    idx, p = claimed[h]
+                    if not p.started:
+                        p.started = True
+                        try:
+                            p.pending = next(p.gen)
+                        except StopIteration:
+                            p.done = True
+                            # a finished process IS progress: its host
+                            # is claimable by a successor next round
+                            progress = True
+                            continue
+                        p.block = None
+                    if p.pending is None:
                         p.done = True
-                        p.pending = None
-                        break
+                        progress = True
+                        continue
+                    if p.pending.op in self.BATCH_OPS:
+                        batches.setdefault(p.pending.op, []).append((idx, p))
+                    else:
+                        serial.append((idx, p))
+                for idx, p in sorted(serial):
+                    ready, result = self._exec(p, p.pending, now)
+                    self.stat_syscalls += 1
+                    progress |= advance(p, idx, ready, result, parked)
+                for op in sorted(batches):
+                    lst = batches[op]
+                    results = self._exec_batch(op, [p for _, p in lst], now)
+                    self.stat_syscalls += len(lst)
+                    for idx, p in lst:
+                        ready, result = results[p.host]
+                        progress |= advance(p, idx, ready, result, parked)
+                # a newly-parked process changes the next round's
+                # claims (a same-host successor becomes claimable), so
+                # parking counts as progress for loop continuation
+                if not progress and len(parked) == parked_before:
+                    break
             sweep += 1
-            if not chan_activity:
+            if not advance.chan_activity:
                 break
 
     def gc_pool(self) -> int:

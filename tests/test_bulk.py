@@ -203,3 +203,18 @@ def test_bulk_order_impls_bit_identical(forced, monkeypatch):
                               app_bulk=phold.BULK)(b2.sim)
     assert int(st_b.micro_steps) < int(st_a.micro_steps) // 2
     _compare(sim_a, sim_b, st_a, st_b)
+
+
+def test_route_impl_override_bit_identical():
+    """make_runner(route_impl=...) forces the outbox-insert mechanism
+    (the cross-backend override of events.route_outbox/insert_flat —
+    ADVICE r2 #1): a "count"-forced run on the CPU backend must be
+    bit-identical to the default ("sort" on CPU)."""
+    H, load, sim_s = 24, 3, 1
+    b1 = _build(H, load, sim_s, 5)
+    sim_a, st_a = make_runner(b1, app_handlers=(phold.handler,))(b1.sim)
+
+    b2 = _build(H, load, sim_s, 5)
+    sim_b, st_b = make_runner(b2, app_handlers=(phold.handler,),
+                              route_impl="count")(b2.sim)
+    _compare(sim_a, sim_b, st_a, st_b)

@@ -1,0 +1,167 @@
+"""Differential validation: the device TCP engine's 3-range
+advertised-list scoreboard vs the native interval-set tally (VERDICT
+r2 weak #7 / next #6).
+
+The device keeps only the peer's advertised SACK list (3 ranges,
+net/tcp.py sack_l/sack_r) and decides retransmissions with
+tcp.sack_clip_len: resend [snd_una, first sacked edge above una).
+The native tally (native/src/retransmit_tally.cc, the re-design of
+the reference's only core C++ component, tcp_retransmit_tally.cc)
+keeps FULL interval sets and computes lost = [snd_una,
+recovery_point) minus sacked, at >= 3 dup-acks.
+
+These must agree on the first lost range: the receiver advertises its
+LOWEST parked ranges (tcp.stamp_at_wire picks ascending left edges),
+so the first sacked edge above una is always inside the advertised
+list, no matter how many ranges the 3-slot budget dropped. This test
+drives both with the same heavy-random-loss segment streams and
+asserts bit-equality of the retransmit decision — and, past the first
+range, the documented envelope: the device only ever RE-sends bytes
+(conservative), never skips bytes the tally calls lost.
+"""
+
+import numpy as np
+import pytest
+
+from shadow_tpu.native.tally import DUPL_ACK_LOST_THRESH, RetransmitTally
+
+MSS = 1460
+
+
+def _advertised(parked, budget=3):
+    """The receiver's wire advertisement: lowest `budget` parked
+    ranges ascending by left edge (tcp.stamp_at_wire)."""
+    return sorted(parked)[:budget]
+
+
+def _receiver_accept(rcv_nxt, parked, seq, seg_end):
+    """Park/merge an arriving segment; advance rcv_nxt over any now
+    in-order prefix. Returns (rcv_nxt, parked)."""
+    merged = parked + [(seq, seg_end)]
+    merged.sort()
+    out = []
+    for b, e in merged:
+        if out and b <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((b, e))
+    # absorb the in-order prefix
+    while out and out[0][0] <= rcv_nxt:
+        rcv_nxt = max(rcv_nxt, out[0][1])
+        out.pop(0)
+    return rcv_nxt, out
+
+
+def _device_clip(una, proposed, adv):
+    """The actual device decision (tcp.sack_clip_len) on one lane."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.net import tcp as tcpmod
+
+    S = 3
+    sl = np.zeros((1, S), np.int32)
+    sr = np.zeros((1, S), np.int32)
+    for i, (b, e) in enumerate(adv):
+        sl[0, i], sr[0, i] = b, e
+    out = tcpmod.sack_clip_len(
+        jnp.asarray([una], jnp.int32), jnp.asarray([proposed], jnp.int32),
+        jnp.asarray(sl), jnp.asarray(sr))
+    return int(out[0])
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3])
+@pytest.mark.parametrize("loss", [0.2, 0.45])
+def test_device_scoreboard_matches_interval_tally(seed, loss):
+    rng = np.random.default_rng(1000 * seed + int(loss * 100))
+    nseg = 60
+    total = nseg * MSS
+
+    decisions = 0
+    for _trial in range(8):
+        # --- transmit phase: heavy random loss ---------------------
+        delivered = rng.random(nseg) >= loss
+        if delivered.all() or not delivered[: DUPL_ACK_LOST_THRESH].any():
+            continue
+        rcv_nxt, parked = 0, []
+        acks = []   # (cum_ack, advertised ranges) per delivered segment
+        for i in range(nseg):
+            if not delivered[i]:
+                continue
+            rcv_nxt, parked = _receiver_accept(
+                rcv_nxt, parked, i * MSS, (i + 1) * MSS)
+            acks.append((rcv_nxt, _advertised(parked)))
+
+        # --- sender processes the ACK stream -----------------------
+        tally = RetransmitTally(0)
+        una = 0
+        dup = 0
+        recovery_point = -1
+        adv_now = []
+        for cum, adv in acks:
+            adv_now = adv
+            if cum > una:
+                una = cum
+                dup = 0
+                tally.advance(cum)
+                if recovery_point >= 0 and cum >= recovery_point:
+                    recovery_point = -1
+            else:
+                dup += 1
+                tally.dupl_ack()
+            for b, e in adv:
+                tally.mark_sacked(b, e)
+            if dup >= DUPL_ACK_LOST_THRESH and recovery_point < 0:
+                recovery_point = total
+                tally.set_recovery_point(total)
+
+            if recovery_point < 0:
+                continue
+            # --- the decision point: what do we retransmit? --------
+            lost = tally.lost_ranges()
+            proposed = min(total - una, MSS)
+            dev_len = _device_clip(una, proposed, adv_now)
+            if not lost:
+                continue
+            decisions += 1
+            lb, le = lost[0]
+            # bit-equality on the first lost range (truncated to MSS)
+            assert lb == una, (lb, una)
+            assert min(le, una + MSS) == min(una + dev_len, una + MSS), (
+                lost, adv_now, una, dev_len)
+            # conservative envelope: no byte of the device's range is
+            # fully sacked (equality above already implies it for the
+            # overlap; spot-check via the tally's own query API)
+            assert not tally.is_sacked(una, una + dev_len)
+
+    assert decisions > 0, "loss pattern produced no retransmit decisions"
+
+
+def test_oracle_agreement_under_many_parked_ranges():
+    """>3 parked ranges: the advertised list drops information, but
+    the FIRST range is always advertised, so decisions still match."""
+    tally = RetransmitTally(0)
+    # every even segment of 10 lost -> receiver parks 5 ranges
+    parked = [(MSS * (2 * i + 1), MSS * (2 * i + 2)) for i in range(5)]
+    adv = _advertised(parked)
+    assert len(adv) == 3 and adv[0][0] == MSS
+    for b, e in parked:           # the full tally hears everything
+        tally.mark_sacked(b, e)
+    for _ in range(DUPL_ACK_LOST_THRESH):
+        tally.dupl_ack()
+    tally.set_recovery_point(12 * MSS)
+    lost = tally.lost_ranges()
+    dev_len = _device_clip(0, MSS, adv)
+    assert lost[0] == (0, MSS)
+    assert dev_len == MSS
+    # second lost hole [2*MSS, 3*MSS): after advancing una there, the
+    # advertisement still leads with its bounding ranges
+    tally2 = RetransmitTally(2 * MSS)
+    for b, e in parked:
+        tally2.mark_sacked(b, e)
+    for _ in range(DUPL_ACK_LOST_THRESH):
+        tally2.dupl_ack()
+    tally2.set_recovery_point(12 * MSS)
+    adv2 = _advertised([r for r in parked if r[1] > 2 * MSS])
+    dev_len2 = _device_clip(2 * MSS, MSS, adv2)
+    assert tally2.lost_ranges()[0] == (2 * MSS, 3 * MSS)
+    assert dev_len2 == MSS

@@ -309,3 +309,37 @@ def test_shutdown_half_close():
 
     assert out["server_rcvd"] == 3000
     assert out["client_rcvd"] == 5000
+
+
+def test_gethostbyname():
+    """Runtime name resolution through the DNS registry (VERDICT r2
+    missing #4; ref: process_emu_gethostbyname, process.h:237-250,
+    dns.c). A vproc addresses its peer by hostname, never touching the
+    config-time IP."""
+    b = _bundle()
+    results = {}
+
+    def server(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        src_ip, src_port, n = yield vproc.recvfrom(fd)
+        results["got"] = n
+        yield vproc.close(fd)
+
+    def client(host):
+        ip = yield vproc.gethostbyname("server")
+        results["resolved"] = ip
+        results["missing"] = (yield vproc.gethostbyname("no-such-host"))
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, 0)
+        yield vproc.sendto(fd, ip, PORT, 64)
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    rt.spawn(1, server)
+    rt.spawn(0, client)
+    rt.run()
+
+    assert results["resolved"] == b.ip_of("server")
+    assert results["missing"] == -1
+    assert results["got"] == 64

@@ -1,0 +1,80 @@
+"""1000 concurrent virtual processes (VERDICT r2 next #5 — the
+reference's own smoke-stress bar is 1000 clients, examples.c:10-12)
+driven through the per-window syscall BATCHING path (SURVEY §7.4.4):
+data-plane syscalls from distinct hosts fuse into one masked device
+op per op kind per scheduler round, so device dispatches grow with
+windows, not with processes x syscalls.
+"""
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import ProcessRuntime
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="poi"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="poi" target="poi"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 1000
+PORT = 9000
+ROUNDS = 3
+
+
+def test_thousand_vprocs_batched():
+    cfg = NetConfig(num_hosts=H, end_time=30 * simtime.ONE_SECOND,
+                    tcp=False, sockets_per_host=2, event_capacity=8,
+                    outbox_capacity=8, router_ring=8, in_ring=8)
+    hosts = [HostSpec(name=f"n{i}") for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+
+    pongs = np.zeros(H, np.int64)
+
+    # even hosts ping their odd neighbor, which echoes — 500
+    # client/server pairs = 1000 concurrent coroutines, all issuing
+    # sendto/recvfrom in the same windows
+    def client(host):
+        peer = b.ip_of(f"n{host + 1}")
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        for _ in range(ROUNDS):
+            yield vproc.sendto(fd, peer, PORT, 64)
+            _sip, _spt, n = yield vproc.recvfrom(fd)
+            assert n == 64
+            pongs[host] += 1
+        yield vproc.close(fd)
+
+    def server(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        for _ in range(ROUNDS):
+            sip, spt, n = yield vproc.recvfrom(fd)
+            yield vproc.sendto(fd, sip, spt, n)
+        yield vproc.close(fd)
+
+    rt = ProcessRuntime(b)
+    for i in range(0, H, 2):
+        rt.spawn(i, client)
+        rt.spawn(i + 1, server)
+
+    sim, stats = rt.run()
+
+    assert (pongs[0::2] == ROUNDS).all()
+    assert int(np.asarray(sim.events.overflow)) == 0
+    assert int(np.asarray(sim.outbox.overflow)) == 0
+
+    # the batching evidence: 1000 processes x ~14 syscalls each, but
+    # device dispatches stay within a few per op kind per window —
+    # two orders of magnitude below one-dispatch-per-syscall
+    assert rt.stat_syscalls >= H * (4 + 2 * ROUNDS) * 0.9
+    assert rt.stat_device_dispatches < rt.stat_syscalls / 20, (
+        rt.stat_device_dispatches, rt.stat_syscalls)

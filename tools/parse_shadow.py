@@ -35,6 +35,11 @@ RAM_RE = re.compile(
 TICK_RE = re.compile(
     r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) .*simulation complete "
     r"(?P<json>\{.*\})")
+# periodic run-time progress records (cli.py progress_hook — the
+# reference's per-round tick heartbeats feeding plot-shadow)
+PROGRESS_RE = re.compile(
+    r"^(?P<h>\d+):(?P<m>\d+):(?P<s>\d+)\.(?P<ns>\d+) .*"
+    r"\[shadow-progress\] (?P<json>\{.*\})")
 
 
 def _open(path: str):
@@ -80,6 +85,10 @@ def parse(stream):
                 "recv_bytes_by_second": {}, "send_bytes_by_second": {},
                 "retransmits_by_second": {}, "drops_by_second": {}})
             node.setdefault("ram_bytes_by_second", {})[t] = int(m["bytes"])
+            continue
+        m = PROGRESS_RE.match(line)
+        if m:
+            ticks.append(json.loads(m["json"]))
             continue
         m = TICK_RE.match(line)
         if m:

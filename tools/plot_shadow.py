@@ -1,10 +1,16 @@
 #!/usr/bin/env python3
 """Plot stats.shadow.json files — the analog of the reference's
-src/tools/plot-shadow.py (throughput time series + CDFs across
-experiments).
+src/tools/plot-shadow.py: cross-experiment overlay plots (throughput
+time series, per-node CDFs, RAM, retransmits) plus run-time progress
+("tick") plots, combined into one multi-page PDF (the reference
+combines pages with PdfPages the same way, plot-shadow.py).
 
-Usage: plot_shadow.py -d stats.shadow.json LABEL [-d ... LABEL2]
+Usage: plot_shadow.py -d stats.shadow.json LABEL [-d FILE2 LABEL2 ...]
                       [-o prefix]
+
+Each -d pair adds one experiment; every page overlays all of them —
+the comparison workflow the reference's README describes (run two
+experiments, parse both, plot both on shared axes).
 """
 
 from __future__ import annotations
@@ -21,6 +27,23 @@ def _series(node_block: dict, key: str) -> tuple[list, list]:
     return xs, ys
 
 
+def _aggregate(stats: dict, key: str) -> dict[int, int]:
+    """Per-second totals of `key` over all nodes."""
+    acc: dict[int, int] = {}
+    for blk in stats["nodes"].values():
+        xs, ys = _series(blk, key)
+        for x, y in zip(xs, ys):
+            acc[x] = acc.get(x, 0) + y
+    return acc
+
+
+def _new_page(plt, title: str):
+    fig, ax = plt.subplots(figsize=(7, 5))
+    ax.set_title(title, fontsize=11)
+    ax.grid(alpha=0.3)
+    return fig, ax
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("-d", "--data", nargs=2, action="append",
@@ -33,73 +56,100 @@ def main(argv=None) -> int:
 
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
+        from matplotlib.backends.backend_pdf import PdfPages
     except ImportError:
         print("matplotlib unavailable; install it to plot", file=sys.stderr)
         return 1
 
-    fig, axes = plt.subplots(2, 3, figsize=(15, 7))
-    (ax_rx, ax_tx, ax_ram), (ax_cdf, ax_retx, ax_prog) = axes
-
+    experiments = []
     for path, label in args.data:
         with open(path) as f:
-            stats = json.load(f)
-        # aggregate per-second totals over all nodes
-        rx_tot: dict[int, int] = {}
-        tx_tot: dict[int, int] = {}
-        retx_tot: dict[int, int] = {}
-        final_rx = []
-        for node, blk in stats["nodes"].items():
-            for key, acc in (("recv_bytes_by_second", rx_tot),
-                             ("send_bytes_by_second", tx_tot),
-                             ("retransmits_by_second", retx_tot)):
-                xs, ys = _series(blk, key)
-                for x, y in zip(xs, ys):
-                    acc[x] = acc.get(x, 0) + y
-            xs, ys = _series(blk, "recv_bytes_by_second")
-            if ys:
-                final_rx.append(sum(ys))
-        for acc, ax, name in ((rx_tot, ax_rx, "recv"), (tx_tot, ax_tx, "send"),
-                              (retx_tot, ax_retx, "retransmits")):
-            xs = sorted(acc)
-            ax.plot(xs, [acc[x] / (1 << 20) for x in xs], label=label)
-            ax.set_xlabel("sim time (s)")
-            ax.set_ylabel(f"{name} MiB/interval"
-                          if name != "retransmits" else "segments/interval")
-        if final_rx:
-            final_rx.sort()
-            n = len(final_rx)
-            ax_cdf.plot([b / (1 << 20) for b in final_rx],
-                        [(i + 1) / n for i in range(n)], label=label)
-            ax_cdf.set_xlabel("total recv MiB per node")
-            ax_cdf.set_ylabel("CDF")
-        # RAM held in simulated buffers (ref: plot-shadow's RAM panel)
-        ram_tot: dict[int, int] = {}
-        for node, blk in stats["nodes"].items():
-            xs, ys = _series(blk, "ram_bytes_by_second")
-            for x, y in zip(xs, ys):
-                ram_tot[x] = ram_tot.get(x, 0) + y
-        if ram_tot:
-            xs = sorted(ram_tot)
-            ax_ram.plot(xs, [ram_tot[x] / (1 << 20) for x in xs],
-                        label=label)
-        ax_ram.set_xlabel("sim time (s)")
-        ax_ram.set_ylabel("buffered MiB (all nodes)")
-        # run-time progress (ref: plot-shadow's "tick" real-time
-        # panel); the LAST tick is the whole-run figure
-        sw = next((t["simulated_seconds_per_wall_second"]
-                   for t in reversed(stats.get("ticks", []))
-                   if t.get("simulated_seconds_per_wall_second")
-                   is not None), None)
-        if sw is not None:
-            ax_prog.bar([label], [sw], alpha=0.7)
-        ax_prog.set_ylabel("simulated-sec per wall-sec")
+            experiments.append((label, json.load(f)))
 
-    for ax in axes.flat:
-        ax.legend(fontsize=8)
-        ax.grid(alpha=0.3)
-    fig.tight_layout()
+    pages = [
+        ("total recv throughput", "recv_bytes_by_second",
+         "MiB/interval", 1 << 20),
+        ("total send throughput", "send_bytes_by_second",
+         "MiB/interval", 1 << 20),
+        ("retransmitted segments", "retransmits_by_second",
+         "segments/interval", 1),
+        ("buffered RAM (all nodes)", "ram_bytes_by_second",
+         "MiB", 1 << 20),
+    ]
+
     out = f"{args.output_prefix}.pdf"
-    fig.savefig(out)
+    with PdfPages(out) as pdf:
+        # -- aggregate time-series pages, one metric per page ----------
+        for title, key, ylabel, scale in pages:
+            fig, ax = _new_page(plt, title)
+            for label, stats in experiments:
+                acc = _aggregate(stats, key)
+                xs = sorted(acc)
+                if xs:
+                    ax.plot(xs, [acc[x] / scale for x in xs], label=label)
+            ax.set_xlabel("sim time (s)")
+            ax.set_ylabel(ylabel)
+            ax.legend(fontsize=8)
+            pdf.savefig(fig)
+            plt.close(fig)
+
+        # -- per-node total CDF (the cross-experiment fairness view) ---
+        fig, ax = _new_page(plt, "per-node total recv (CDF)")
+        for label, stats in experiments:
+            totals = []
+            for blk in stats["nodes"].values():
+                _, ys = _series(blk, "recv_bytes_by_second")
+                if ys:
+                    totals.append(sum(ys))
+            if totals:
+                totals.sort()
+                n = len(totals)
+                ax.plot([b / (1 << 20) for b in totals],
+                        [(i + 1) / n for i in range(n)], label=label)
+        ax.set_xlabel("total recv MiB per node")
+        ax.set_ylabel("CDF")
+        ax.legend(fontsize=8)
+        pdf.savefig(fig)
+        plt.close(fig)
+
+        # -- run-time progress ("tick") pages --------------------------
+        # periodic [shadow-progress] records: cumulative sim seconds
+        # vs wall seconds (the reference's real-time tick plot)
+        fig, ax = _new_page(plt, "run-time progress")
+        any_prog = False
+        for label, stats in experiments:
+            pts = [(t["wall_seconds"], t["sim_seconds"])
+                   for t in stats.get("ticks", [])
+                   if "wall_seconds" in t and "sim_seconds" in t]
+            if pts:
+                pts.sort()
+                ax.plot([p[0] for p in pts], [p[1] for p in pts],
+                        label=label, marker=".")
+                any_prog = True
+        if any_prog:
+            ax.set_xlabel("wall time (s)")
+            ax.set_ylabel("simulated time (s)")
+            ax.legend(fontsize=8)
+            pdf.savefig(fig)
+        plt.close(fig)
+
+        # whole-run rate comparison bars
+        fig, ax = _new_page(plt, "simulated-sec per wall-sec")
+        labels, rates = [], []
+        for label, stats in experiments:
+            sw = next((t["simulated_seconds_per_wall_second"]
+                       for t in reversed(stats.get("ticks", []))
+                       if t.get("simulated_seconds_per_wall_second")
+                       is not None), None)
+            if sw is not None:
+                labels.append(label)
+                rates.append(sw)
+        if labels:
+            ax.bar(labels, rates, alpha=0.7)
+        ax.set_ylabel("simulated-sec / wall-sec")
+        pdf.savefig(fig)
+        plt.close(fig)
+
     print(f"wrote {out}")
     return 0
 

@@ -45,7 +45,32 @@ def main() -> int:
                     help="force the CPU backend")
     ap.add_argument("--no-bulk", action="store_true",
                     help="disable the bulk window pass")
+    ap.add_argument("--topology", default="one",
+                    choices=["one", "ref"],
+                    help="'one' = the single-vertex 50 ms fixture; "
+                         "'ref' = the reference's real Internet-derived "
+                         "graph (resource/topology.graphml.xml.xz, 183 "
+                         "vertices / 16.8k edges) with hosts attached "
+                         "by uniform draw — puts the latency gather, "
+                         "per-vertex bandwidth diversity, and the "
+                         "honest min-jump inside every measured window")
+    ap.add_argument("--shards", type=int, default=0,
+                    help="run the window loop under shard_map over an "
+                         "N-device mesh (0 = single shard). On the CPU "
+                         "backend N virtual devices are forced; on TPU "
+                         "N must not exceed the real device count")
     args = ap.parse_args()
+
+    if args.shards > 1:
+        # must precede the first jax import: the host-platform device
+        # count is read at backend init
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "host_platform_device_count" not in flags:
+            os.environ["XLA_FLAGS"] = (
+                flags + f" --xla_force_host_platform_device_count="
+                f"{args.shards}").strip()
 
     import jax
 
@@ -59,7 +84,11 @@ def main() -> int:
         _s.path.insert(0, str(_p.Path(__file__).resolve().parent.parent))
         import bench as _bench
 
-        _bench._probe_backend()
+        ndev = _bench._probe_backend()
+        if args.shards > 1 and ndev < args.shards:
+            # not enough real chips for the mesh: virtual CPU devices
+            # (XLA_FLAGS forced above, before the backend initializes)
+            jax.config.update("jax_platforms", "cpu")
     import pathlib
 
     cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
@@ -75,7 +104,8 @@ def main() -> int:
     from shadow_tpu.net.build import HostSpec, build, make_runner
     from shadow_tpu.net.state import NetConfig
 
-    ONE_VERTEX = bench.ONE_VERTEX
+    topo_text = (bench.ref_topology_text() if args.topology == "ref"
+                 else bench.ONE_VERTEX)
 
     def build_workload(seed, cap):
         """Returns (bundle, runner_kwargs, verify(sim) -> bool)."""
@@ -84,7 +114,7 @@ def main() -> int:
             from shadow_tpu.apps import phold
 
             b = bench._build_phold(H, args.load, args.sim_seconds, seed,
-                                   cap)
+                                   cap, graph=topo_text)
             kw = dict(app_handlers=(phold.handler,),
                       app_bulk=None if args.no_bulk else phold.BULK)
             return b, kw, lambda sim: int(
@@ -102,7 +132,7 @@ def main() -> int:
             hosts = [HostSpec(name=f"n{i}",
                               proc_start_time=simtime.ONE_SECOND)
                      for i in range(H)]
-            b = build(cfg, ONE_VERTEX, hosts)
+            b = build(cfg, topo_text, hosts)
             circuits = [list(range(c * hop, (c + 1) * hop))
                         for c in range(ncirc)]
             b.sim = relay.setup(b.sim, circuits=circuits,
@@ -129,7 +159,7 @@ def main() -> int:
                         event_capacity=cap, outbox_capacity=cap,
                         router_ring=cap, in_ring=32)
         hosts = [HostSpec(name=f"n{i}") for i in range(H)]
-        b = build(cfg, ONE_VERTEX, hosts)
+        b = build(cfg, topo_text, hosts)
         b.sim = gossip.setup(b.sim, peers_per_host=8,
                              block_interval=2 * simtime.ONE_SECOND,
                              max_blocks=blocks)
@@ -147,10 +177,18 @@ def main() -> int:
     # run tight, escalate on counted overflow (the bench.py pattern:
     # a clean overflow==0 pass at a tight capacity is sound AND fast;
     # each escalation costs one recompile)
+    def runner_for(b, kw):
+        if args.shards > 1:
+            from shadow_tpu.parallel.shard import make_sharded_runner
+
+            mesh = jax.make_mesh((args.shards,), ("hosts",))
+            return make_sharded_runner(b, mesh, "hosts", **kw)
+        return make_runner(b, **kw)
+
     cap = args.cap or (0 if args.workload == "phold" else 64)
     for attempt in range(4):
         b, kw, verify = build_workload(args.seed, cap or None)
-        fn = make_runner(b, **kw)
+        fn = runner_for(b, kw)
 
         t0 = time.perf_counter()
         sim, stats = fn(b.sim)
@@ -187,6 +225,8 @@ def main() -> int:
     print(json.dumps({
         "hosts": args.hosts,
         "workload": args.workload,
+        "topology": args.topology,
+        "shards": args.shards,
         "platform": jax.devices()[0].platform,
         "events": ev,
         "wall_s": round(wall, 3),
