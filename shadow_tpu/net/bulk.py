@@ -251,20 +251,29 @@ def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok,
         & (jnp.sum(net.in_count, axis=1) == 0)
     )
     codel_ok = ~net.codel_dropping & (net.codel_interval_expire == 0)
-    recv_need = jnp.sum(jnp.where(inwin & nonboot, wl, 0), axis=1)
+    # Token budgets without relying on refills: the serial NIC polices
+    # tokens >= MTU before EACH pull/send and consumes the packet's
+    # actual wire bytes (nic.py; ref: network_interface.c:421-455,
+    # 519-579). The worst prefix requirement for n transfers of sizes
+    # w_i is (sum w_i) - w_last + MTU — the LAST transfer needs no
+    # headroom after it. Bounding w_last below by the window's
+    # smallest arrival (receive) / by send_wire (send) keeps the gate
+    # exact enough for low-bandwidth vertices: the real topology's
+    # buckets hold barely over one MTU, and the old "+ full MTU after
+    # everything" form disqualified them permanently even at n=1.
+    recv_w = jnp.where(inwin & nonboot, wl, 0)
+    recv_need = jnp.sum(recv_w, axis=1)
+    recv_min = jnp.min(
+        jnp.where(inwin & nonboot, wl, jnp.iinfo(jnp.int32).max), axis=1)
     recv_ok = (recv_need == 0) | (
-        net.tb_recv_tokens >= recv_need + pf.MTU)
-    # Send budget without relying on refills: the serial drain polices
-    # tokens >= MTU before EACH send and consumes the reply's actual
-    # wire bytes (nic.py; ref: network_interface.c:519-579), so
-    # n*send_wire + MTU tokens guarantee the drain never defers.
+        net.tb_recv_tokens >= recv_need - recv_min + pf.MTU)
     # send_wire is the app's static reply bound — using MTU per send
-    # would wrongly disqualify every low-bandwidth vertex (the real
-    # topology's buckets hold ~2 MTU) even when replies are tiny.
+    # would wrongly disqualify every low-bandwidth vertex even when
+    # replies are tiny.
     n_nonboot = jnp.sum(inwin & nonboot, axis=1)
     send_ok = (n_nonboot == 0) | (
         net.tb_send_tokens
-        >= n_nonboot.astype(I64) * send_wire + pf.MTU)
+        >= (n_nonboot.astype(I64) - 1) * send_wire + pf.MTU)
     return (kind_ok & udp_ok & quiesced & codel_ok & recv_ok & send_ok
             & app_ok)
 
@@ -328,10 +337,10 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
         # the bulk pass has no equivalent yet
         return None
     # Replies must fit one MTU on the wire: then send_wire <= MTU, the
-    # n*send_wire + MTU eligibility budget (_eligibility) is a true
-    # upper bound on the serial drain's token need, and the serial
-    # path's max(tokens-w, 0) floor can never engage mid-window (the
-    # closed form below doesn't model it).
+    # (n-1)*send_wire + MTU eligibility budget (_eligibility's
+    # worst-prefix bound) is a true upper bound on the serial drain's
+    # token need, and the serial path's max(tokens-w, 0) floor can
+    # never engage mid-window (the closed form below doesn't model it).
     if app_bulk.max_send_len + pf.HDR_UDP > pf.MTU:
         return None
 

@@ -33,6 +33,19 @@ def main() -> int:
                     choices=["phold", "relay", "gossip"])
     ap.add_argument("--hosts", type=int, default=10240)
     ap.add_argument("--load", type=int, default=8)
+    ap.add_argument("--hop", type=int, default=5,
+                    help="relay circuit length: 5 = the Tor-relay shape "
+                         "(config #3), 2 = pairwise client->server bulk "
+                         "transfers (config #2's 1k-host tgen shape)")
+    ap.add_argument("--bytes", type=int, default=100_000,
+                    help="bytes per relay circuit")
+    ap.add_argument("--allow-partial", action="store_true",
+                    help="report completion fraction instead of "
+                         "failing when transfers are unfinished at "
+                         "end_time (real-topology RTTs reach ~4.6 s; "
+                         "short sims cannot finish slow-start on the "
+                         "worst paths — the CPU floor can't afford "
+                         "long ones)")
     ap.add_argument("--sim-seconds", type=int, default=2)
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--cap", type=int, default=0,
@@ -62,15 +75,13 @@ def main() -> int:
     args = ap.parse_args()
 
     if args.shards > 1:
-        # must precede the first jax import: the host-platform device
-        # count is read at backend init
-        import os
+        import pathlib as _p
+        import sys as _s
 
-        flags = os.environ.get("XLA_FLAGS", "")
-        if "host_platform_device_count" not in flags:
-            os.environ["XLA_FLAGS"] = (
-                flags + f" --xla_force_host_platform_device_count="
-                f"{args.shards}").strip()
+        _s.path.insert(0, str(_p.Path(__file__).resolve().parent.parent))
+        import bench as _b
+
+        _b.force_virtual_devices(args.shards)
 
     import jax
 
@@ -101,7 +112,7 @@ def main() -> int:
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     import bench
     from shadow_tpu.core import simtime
-    from shadow_tpu.net.build import HostSpec, build, make_runner
+    from shadow_tpu.net.build import HostSpec, build
     from shadow_tpu.net.state import NetConfig
 
     topo_text = (bench.ref_topology_text() if args.topology == "ref"
@@ -122,9 +133,9 @@ def main() -> int:
         if args.workload == "relay":
             from shadow_tpu.apps import relay
 
-            hop = 5
+            hop = args.hop
             ncirc = H // hop
-            total = 100_000   # bytes per circuit
+            total = args.bytes   # bytes per circuit
             cfg = NetConfig(num_hosts=H, seed=seed,
                             end_time=args.sim_seconds * simtime.ONE_SECOND,
                             sockets_per_host=4, event_capacity=cap,
@@ -141,6 +152,8 @@ def main() -> int:
             def verify(sim):
                 rcvd = np.asarray(sim.app.rcvd)
                 servers = np.asarray(sim.app.role) == relay.ROLE_SERVER
+                verify.fraction = float(
+                    np.minimum(rcvd[servers] / total, 1.0).mean())
                 return bool((rcvd[servers] == total).all())
 
             return b, dict(app_handlers=(relay.handler,)), verify
@@ -177,18 +190,10 @@ def main() -> int:
     # run tight, escalate on counted overflow (the bench.py pattern:
     # a clean overflow==0 pass at a tight capacity is sound AND fast;
     # each escalation costs one recompile)
-    def runner_for(b, kw):
-        if args.shards > 1:
-            from shadow_tpu.parallel.shard import make_sharded_runner
-
-            mesh = jax.make_mesh((args.shards,), ("hosts",))
-            return make_sharded_runner(b, mesh, "hosts", **kw)
-        return make_runner(b, **kw)
-
     cap = args.cap or (0 if args.workload == "phold" else 64)
     for attempt in range(4):
         b, kw, verify = build_workload(args.seed, cap or None)
-        fn = runner_for(b, kw)
+        fn = bench.make_shard_aware_runner(b, args.shards, **kw)
 
         t0 = time.perf_counter()
         sim, stats = fn(b.sim)
@@ -222,7 +227,10 @@ def main() -> int:
         if hasattr(leaf, "nbytes"))
     ovf = overflow_of(sim)
     verified = verify(sim)
+    fraction = getattr(verify, "fraction", 1.0 if verified else 0.0)
     print(json.dumps({
+        **({"completion_fraction": round(fraction, 4)}
+           if fraction < 1.0 else {}),
         "hosts": args.hosts,
         "workload": args.workload,
         "topology": args.topology,
@@ -237,6 +245,8 @@ def main() -> int:
         "overflow": ovf,
         "verified": verified,
     }))
+    if not verified and args.allow_partial:
+        return 0
     assert verified, "workload did not complete correctly"
     return 0
 
