@@ -37,8 +37,10 @@ per-event work is provably independent across the window:
 - CoDel is in its idle good state (interval_expire == 0, not
   dropping) — then every dequeue has sojourn 0 and provably leaves
   the CoDel state untouched (ref: router_queue_codel.c:161-196);
-- token buckets conservatively cover the whole window's wire bytes
-  without relying on refills, so the serial drain never defers
+- token buckets, projected by ONE analytic refill to the window's
+  first in-window arrival (exactly the serial path's level at its
+  first pull), cover the whole window's wire bytes without relying
+  on further mid-window refills, so the serial drain never defers
   (ref: network_interface.c:421-455,519-579);
 - the app's bulk handler accepts the host (precheck) and its sends
   fit the send buffer without tripping the transient-full WRITABLE
@@ -251,7 +253,8 @@ def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok,
         & (jnp.sum(net.in_count, axis=1) == 0)
     )
     codel_ok = ~net.codel_dropping & (net.codel_interval_expire == 0)
-    # Token budgets without relying on refills: the serial NIC polices
+    # Token budgets with ONE projected refill (to the first arrival;
+    # no reliance on further mid-window refills): the serial NIC polices
     # tokens >= MTU before EACH pull/send and consumes the packet's
     # actual wire bytes (nic.py; ref: network_interface.c:421-455,
     # 519-579). The worst prefix requirement for n transfers of sizes
@@ -261,19 +264,30 @@ def _eligibility(cfg: NetConfig, sim, inwin, t, wl, nonboot, app_ok,
     # exact enough for low-bandwidth vertices: the real topology's
     # buckets hold barely over one MTU, and the old "+ full MTU after
     # everything" form disqualified them permanently even at n=1.
+    # Bucket levels are recorded AT LAST ACCESS (refill_tokens is
+    # analytic-on-access), so a long-idle host's stored tokens are
+    # stale-low. Project each bucket to the window's FIRST in-window
+    # arrival time — exactly the serial path's level at its first
+    # pull (refill is monotone in time, so this never overstates a
+    # later transfer's budget). Without this, a host that drained its
+    # bucket once read as broke forever and fell serial every window.
+    from shadow_tpu.net.nic import projected_tokens
+
+    t_first = jnp.min(
+        jnp.where(inwin & nonboot, t, simtime.INVALID), axis=1)
+    send_tok, recv_tok = projected_tokens(net, t_first)
     recv_w = jnp.where(inwin & nonboot, wl, 0)
     recv_need = jnp.sum(recv_w, axis=1)
     recv_min = jnp.min(
         jnp.where(inwin & nonboot, wl, jnp.iinfo(jnp.int32).max), axis=1)
     recv_ok = (recv_need == 0) | (
-        net.tb_recv_tokens >= recv_need - recv_min + pf.MTU)
+        recv_tok >= recv_need - recv_min + pf.MTU)
     # send_wire is the app's static reply bound — using MTU per send
     # would wrongly disqualify every low-bandwidth vertex even when
     # replies are tiny.
     n_nonboot = jnp.sum(inwin & nonboot, axis=1)
     send_ok = (n_nonboot == 0) | (
-        net.tb_send_tokens
-        >= (n_nonboot.astype(I64) - 1) * send_wire + pf.MTU)
+        send_tok >= (n_nonboot.astype(I64) - 1) * send_wire + pf.MTU)
     return (kind_ok & udp_ok & quiesced & codel_ok & recv_ok & send_ok
             & app_ok)
 

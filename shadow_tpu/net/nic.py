@@ -77,6 +77,21 @@ def refill_tokens(net: NetState, mask, now):
     )
 
 
+def projected_tokens(net: NetState, at_time):
+    """Bucket levels projected to `at_time` [H] — the value
+    refill_tokens would produce on an access at that instant, without
+    mutating state. Single source of the analytic-refill formula for
+    read-only consumers (bulk._eligibility's token gate); keep in
+    lockstep with refill_tokens above."""
+    dq = jnp.maximum(at_time // TB_REFILL_INTERVAL - net.tb_quantum,
+                     0).astype(jnp.int64)
+    send_cap = net.tb_send_refill + pf.MTU
+    recv_cap = net.tb_recv_refill + pf.MTU
+    send = jnp.minimum(send_cap, net.tb_send_tokens + dq * net.tb_send_refill)
+    recv = jnp.minimum(recv_cap, net.tb_recv_tokens + dq * net.tb_recv_refill)
+    return send, recv
+
+
 def next_refill_time(now):
     return (now // TB_REFILL_INTERVAL + 1) * TB_REFILL_INTERVAL
 
