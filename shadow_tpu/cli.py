@@ -241,7 +241,23 @@ def main(argv=None) -> int:
         elif args.workers > 1:
             from jax.sharding import Mesh
 
-            mesh = Mesh(np.array(jax.devices()[:args.workers]), ("hosts",))
+            # contiguous-block sharding needs hosts % shards == 0; the
+            # reference accepts any worker count for any host count
+            # (scheduler.c round-robins), so adapt rather than error:
+            # largest divisor of H within both the request and the
+            # device count (clamping FIRST keeps the result a divisor,
+            # and bounds the search for absurd --workers values)
+            wmax = min(args.workers, len(jax.devices()), b.cfg.num_hosts)
+            w = max(d for d in range(1, wmax + 1)
+                    if b.cfg.num_hosts % d == 0)
+            if w != args.workers:
+                logger.warning(
+                    0, "shadow-tpu",
+                    f"--workers {args.workers} does not divide "
+                    f"{b.cfg.num_hosts} hosts (or exceeds the device "
+                    f"count); using {w}")
+            if w > 1:
+                mesh = Mesh(np.array(jax.devices()[:w]), ("hosts",))
         if loaded.vprocs:
             # .py plugins: coroutine processes over the simulated
             # syscall surface — the config-reachable form of the
