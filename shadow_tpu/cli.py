@@ -151,13 +151,11 @@ def main(argv=None) -> int:
 
     # persist compiled device programs across CLI invocations (the
     # netstack step compiles in minutes cold; seconds warm)
-    import pathlib
-
     import jax
 
-    cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
-    jax.config.update("jax_compilation_cache_dir", str(cache))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    from shadow_tpu.utils.compcache import enable_compile_cache
+
+    enable_compile_cache()
     # select the backend through jax.config (an out-of-tree platform
     # plugin's get_backend hook can ignore the env var but the lazy
     # backend init honors the config; must run before backend touch).

@@ -1,0 +1,30 @@
+"""Shared persistent-compile-cache configuration.
+
+Every entry point (bench.py, tools/scale_run.py, the CLI, the test
+suite) must point JAX's persistent compilation cache at the SAME
+repo-local directory: the whole short-TPU-window strategy (see
+tools/tpu_watch.py) depends on one entry point's compile being every
+other entry point's cache hit. One helper, four callers — the three
+config knobs live nowhere else.
+
+Known tradeoff: XLA:CPU cache entries embed the compile machine's CPU
+features; executing them on a host with fewer features logs a
+cpu_aot_loader mismatch warning (observed benign in this container,
+documented in docs/4-performance.md). Set SHADOW_NO_COMPILE_CACHE=1
+to opt out if a foreign cache entry ever misbehaves.
+"""
+
+from __future__ import annotations
+
+import os
+import pathlib
+
+
+def enable_compile_cache() -> None:
+    import jax
+
+    if os.environ.get("SHADOW_NO_COMPILE_CACHE"):
+        return
+    cache = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
+    jax.config.update("jax_compilation_cache_dir", str(cache))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

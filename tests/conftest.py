@@ -30,9 +30,9 @@ jax.config.update("jax_num_cpu_devices", 8)
 # machine inlined into one while-loop body); persist compiled binaries
 # so the multi-minute XLA compile is paid once per (shape, code)
 # rather than once per pytest invocation.
-_cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
-jax.config.update("jax_compilation_cache_dir", str(_cache))
-jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+from shadow_tpu.utils.compcache import enable_compile_cache  # noqa: E402
+
+enable_compile_cache()
 
 assert jax.default_backend() == "cpu", jax.default_backend()
 assert len(jax.devices()) == 8, jax.devices()

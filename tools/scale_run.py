@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import time
 
 
@@ -87,6 +88,19 @@ def main() -> int:
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    elif os.environ.get("BENCH_ASSUME_DEVICE"):
+        # caller already holds a live session (tools/tpu_watch.py runs
+        # this in-process under it) — re-probing in a subprocess would
+        # start a FRESH backend init, which hangs if the tunnel window
+        # has closed even though our held session is fine. The probe
+        # path's virtual-CPU-mesh fallback is impossible here: the
+        # caller's backend is already initialized, so jax_platforms
+        # can no longer be switched — fail loudly instead.
+        if args.shards > 1 and len(jax.devices()) < args.shards:
+            raise SystemExit(
+                f"--shards {args.shards} needs {args.shards} devices "
+                f"but the held session has {len(jax.devices())}; run "
+                "without BENCH_ASSUME_DEVICE for the virtual-CPU mesh")
     else:
         # shared wedged-tunnel guard (see bench._probe_backend)
         import pathlib as _p
@@ -101,16 +115,14 @@ def main() -> int:
             # (XLA_FLAGS forced above, before the backend initializes)
             jax.config.update("jax_platforms", "cpu")
     import pathlib
-
-    cache = pathlib.Path(__file__).resolve().parent.parent / ".jax_cache"
-    jax.config.update("jax_compilation_cache_dir", str(cache))
-
     import sys
 
     import numpy as np
 
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     import bench
+
+    bench.enable_compile_cache()
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
     from shadow_tpu.net.state import NetConfig
