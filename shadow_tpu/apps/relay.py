@@ -102,6 +102,46 @@ def setup(sim, *, circuits: list[list[int]], total_bytes: int):
     return sim.replace(app=app)
 
 
+class RelayTcpBulk:
+    """TCP bulk-pass contract (net/tcp_bulk.TcpAppBulk) for the relay
+    model: in the steady state every delivery is read in full from
+    up_conn and (for relays) immediately forwarded downstream — the
+    exact per-micro-step behavior of handler() below, minus the
+    accept/feed/close phases, which precheck routes to the serial
+    path."""
+
+    def precheck(self, cfg, sim):
+        app = sim.app
+        client = app.role == ROLE_CLIENT
+        relay = app.role == ROLE_RELAY
+        listener = app.lsock >= 0
+        ok = jnp.where(listener, app.up_conn >= 0, True)
+        # clients must be past the feed + close calls (pure draining)
+        ok = ok & jnp.where(client, (app.to_send == 0) & app.closed_down,
+                            True)
+        # EOF propagation / teardown phases are serial
+        ok = ok & ~app.up_eof & (app.fwd_pending == 0)
+        ok = ok & jnp.where(relay | client, app.connected, True)
+        ok = ok & jnp.where(relay, ~app.closed_down, True)
+        return ok
+
+    def on_data(self, cfg, app, mask, slot, nread, now):
+        # the app only reads up_conn; data on any other socket is out
+        # of the model
+        ok = ~mask | (slot == app.up_conn)
+        m = mask & (slot == app.up_conn)
+        server = app.role == ROLE_SERVER
+        relay = app.role == ROLE_RELAY
+        app = app.replace(
+            rcvd=app.rcvd + jnp.where(m & server, nread, 0).astype(I64))
+        fwd_mask = m & relay
+        return app, ok, fwd_mask, app.down_sock, jnp.where(
+            fwd_mask, nread, 0)
+
+
+TCP_BULK = RelayTcpBulk()
+
+
 def handler(cfg: NetConfig, sim, popped, buf):
     app = sim.app
     now = popped.time

@@ -150,6 +150,7 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
 
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
+                app_tcp_bulk=None,
                 route_impl: str | None = None):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
@@ -178,6 +179,10 @@ def make_runner(bundle: SimBundle, app_handlers=(),
         # (make_bulk_fn's order_impl is a separate knob with its own
         # vocabulary, "cube"/"sort" — not forwarded from route_impl)
         bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
+    if bulk_fn is None and app_tcp_bulk is not None:
+        from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
+
+        bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
     route_fn = _default_route
     if route_impl is not None:
         from shadow_tpu.core.events import route_outbox

@@ -1,0 +1,954 @@
+"""TCP bulk window pass: consume a host's whole window of steady-state
+TCP traffic without running the full micro-step pipeline per event.
+
+The serialization floor of TCP workloads is arrival serialization: one
+host's K in-window segments take K micro-steps, and each micro-step
+pays the WHOLE handler pipeline — pop, the complete TCP receive
+machine, NIC drain, queue insertion (docs/4-performance.md "TCP: the
+serialization floor"; the reference's per-event cost is one cheap
+tcp_processPacket call, tcp.c:1777-2100). This pass replaces those K
+full-pipeline micro-steps with K iterations of a ~100x smaller body: a
+lax.while_loop whose body pops one event per host from a *candidate*
+queue and applies only the reduced steady-state semantics:
+
+  - in-order data-bearing segments (seq == rcv_nxt, flags == ACK):
+    router-ring cycle, token charge, rcv_nxt/app_rbytes advance,
+    READABLE + in-gen edge, delayed-ACK scheduling
+    (ref: tcp.c:1777-2100 in-order path + tcp.c:2066-2091);
+  - the app's synchronous consume-and-forward (tcp_recv semantics
+    incl. Linux-DRS autotune, then tcp_send + flush on the forward
+    socket — the TcpAppBulk contract);
+  - pure ACKs: snd_wnd update, RTT/RTO (Karn/Jacobson incl. the first
+    sample's BDP buffer sizing), congestion growth via the SAME
+    cong.ca_update the serial path calls, snd_una advance,
+    send-buffer autotune, RTO re-arm, and the flush of newly
+    admissible segments (ref: tcp.c ACK path);
+  - flush bursts of ANY length: one flush call packetizes up to
+    FLUSH_SEGMENTS segments and chains a same-time TCP_FLUSH
+    continuation into the candidate queue, which a later scan
+    iteration pops in the exact (time, src, seq) interleaving the
+    serial fixpoint would use (ref: tcp.c:1121 drain-while-sendable);
+  - segment wiring: out-ring cycle, priority stamps, wire-time header
+    stamps (stamp_at_wire parity), per-packet reliability draws at
+    the exact serial RNG counters, outbox entries with the exact
+    per-source sequence numbers the serial path would assign;
+  - delayed-ACK timer fires (incl. stale-generation no-ops), with the
+    pure ACK's wire trip;
+  - RTX timer fires whose deadline moved (stale die, disarmed clear,
+    pending re-emit) — only a DUE deadline (a real RTO) is out of
+    model.
+
+Commit/abort: the pass runs on ALL hosts against candidate state and
+raises a per-host `bad` flag the moment anything outside the reduced
+model appears — SYN/FIN/RST, reordering or loss artifacts (seq !=
+rcv_nxt, dup-ACKs, SACK blocks, recovery state), window-update ACKs,
+buffer/token shortfalls, persist conditions, FIN emission, actual
+RTO expiry. Hosts flagged bad DISCARD their
+candidate state and fall back to the serial window fixpoint untouched
+— exactly like UDP bulk ineligibility (net/bulk.py). For committed
+hosts the final state is bit-identical to the serial path by
+construction; tests/test_tcp_bulk.py asserts full-sim equality.
+
+Like net/bulk.py this multiplies throughput only when most hosts
+commit most windows — the lossless steady state of relay/Tor-shaped
+workloads (BASELINE config #3), where handshakes and teardowns are a
+few serial windows bracketing thousands of eligible ones.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core import rng, simtime
+from shadow_tpu.core.events import EventKind, _onehot, _put, _tie_key
+from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net import tcp_cong as cong
+from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net.sockets import lookup_socket
+from shadow_tpu.net.state import (
+    NetConfig,
+    QDisc,
+    RouterQ,
+    SocketFlags,
+    host_of_ip,
+)
+from shadow_tpu.net.tcp import (
+    DACK_QUICK_LIMIT,
+    DACK_QUICK_NS,
+    DACK_SLOW_NS,
+    FLUSH_SEGMENTS,
+    MAX_BACKOFF,
+    MSS,
+    RTO_MAX_MS,
+    RTO_MIN_MS,
+    SNDMEM_SKB,
+    TCP_WMEM_MAX,
+    TCP_RMEM_MAX,
+    TcpSt,
+    _ms,
+)
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+
+class TcpAppBulk:
+    """App contract for the TCP bulk pass.
+
+    The serial app handler runs on every micro-step and reacts to
+    readiness; the bulk pass instead calls `on_data` once per
+    delivered in-order segment (per scan iteration) and expects the
+    app to behave like the steady-state relay/server pattern: consume
+    everything available synchronously, optionally submit bytes on a
+    forward socket at the same instant. Anything richer (accepts,
+    connects, closes, partial reads, sends not triggered by this
+    delivery) must be excluded by precheck — those hosts take the
+    serial path."""
+
+    def precheck(self, cfg: NetConfig, sim) -> jax.Array:
+        """[H] bool — hosts whose app is in the steady consume/forward
+        state this pass models."""
+        raise NotImplementedError
+
+    def on_data(self, cfg: NetConfig, app, mask, slot, nread, now):
+        """One in-order delivery of `nread` bytes on (lane, slot) at
+        `now`, which the pass is about to hand to the app in full
+        (tcp_recv of everything available). Returns
+        (app', ok[H], fwd_mask[H], fwd_slot[H], fwd_bytes[H]):
+        ok False where the app would NOT read this socket fully right
+        now (host falls back to serial); fwd_* request a tcp_send of
+        fwd_bytes on fwd_slot at the same instant (the relay
+        store-and-forward)."""
+        raise NotImplementedError
+
+
+def _flag(bad, why, cond, bit):
+    """Raise the abort flag and record WHICH model boundary was hit.
+    Bits are assigned in source order; tools/tcp_bulk_debug decodes
+    them. The why mask costs one [H] OR per site and is the difference
+    between 'the pass doesn't engage' and knowing what to widen next."""
+    return bad | cond, why | jnp.where(cond, jnp.int64(bit), 0)
+
+
+class _Carry(NamedTuple):
+    sim: Any
+    bad: jax.Array       # [H] bool — host fell out of the model
+    why: jax.Array       # [H] i64 — abort-reason bitmask (_flag sites)
+    seq_ctr: jax.Array   # [H] i32 — candidate next_seq
+    it: jax.Array        # [] i32 iteration guard
+
+
+def _pop_masked(q, wend, allow):
+    """pop_earliest with a per-host allow mask (bad hosts must stop
+    popping or the loop never terminates)."""
+    t = q.time
+    tmin = jnp.min(t, axis=1, keepdims=True)
+    is_tmin = t == tmin
+    tie = jnp.where(is_tmin, _tie_key(q.src, q.seq),
+                    jnp.iinfo(jnp.int64).max)
+    idx = jnp.argmin(tie, axis=1)
+    rows = jnp.arange(q.num_hosts)
+    ptime = t[rows, idx]
+    valid = allow & (ptime < jnp.asarray(wend, simtime.DTYPE))
+    sel = _onehot(valid, idx, q.capacity)
+    q = q.replace(time=jnp.where(sel, simtime.INVALID, q.time))
+    from shadow_tpu.core.events import Popped
+
+    return q, Popped(valid=valid, time=ptime, kind=q.kind[rows, idx],
+                     src=q.src[rows, idx], seq=q.seq[rows, idx],
+                     words=q.words[rows, idx])
+
+
+def _push_local(q, mask, time, kind, words, lane, seq):
+    """push_rows with an explicit seq (the serial path's apply_emissions
+    assigns per-source seqs at emission; the scan carries the counter)."""
+    from shadow_tpu.core.events import push_rows
+
+    # push_rows assigns first-free slot — identical allocation rule
+    return push_rows(q, mask, time,
+                     jnp.broadcast_to(jnp.asarray(kind, I32), mask.shape),
+                     lane, seq, words)
+
+
+def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
+                     debug: bool = False) -> Callable | None:
+    """Build the TCP bulk window pass, or None when the config cannot
+    support it (static preconditions — mirrors bulk.make_bulk_fn).
+    debug=True makes bulk_fn return a third value: a dict with the
+    per-host eligibility/commit masks and the why bitmask (engine
+    callers must use debug=False)."""
+    if not cfg.tcp:
+        return None
+    if cfg.qdisc != QDisc.FIFO or cfg.router_qdisc != RouterQ.CODEL:
+        return None
+    if cfg.pcap or cfg.track_paths:
+        return None
+    if cfg.cpu_threshold_ns >= 0:
+        return None
+    if cfg.nic_drain != FLUSH_SEGMENTS:
+        # the no-chain invariant below pairs one flush's segments with
+        # one drain pass; unequal bounds would chain NIC_SEND events
+        return None
+    if cfg.out_ring < FLUSH_SEGMENTS:
+        return None
+
+    R = cfg.router_ring
+    BO = cfg.out_ring
+    alg = cfg.tcp_cong
+
+    def bulk_fn(sim, wend):
+        net0 = sim.net
+        q0 = sim.events
+        H, K = q0.time.shape
+        S = net0.sk_type.shape[1]
+        GH = net0.host_ip.shape[0]
+        lane = net0.lane_id
+        rows = jnp.arange(H)
+        wend64 = jnp.asarray(wend, simtime.DTYPE)
+
+        # ---- host-level static eligibility ---------------------------
+        inwin0 = q0.time < wend64
+        kind_ok = jnp.all(
+            ~inwin0 | (q0.kind == EventKind.PACKET)
+            | (q0.kind == EventKind.TCP_DACK_TIMER)
+            | (q0.kind == EventKind.TCP_RTX_TIMER), axis=1)
+        nonboot = jnp.all(~inwin0 | (q0.time >= cfg.bootstrap_end), axis=1)
+        quiesced = (
+            (net0.rq_count == 0)
+            & ~net0.nic_recv_pending & ~net0.nic_send_pending
+            & ~net0.nic_send_now
+            & (jnp.sum(net0.out_count, axis=1) == 0)
+            & (jnp.sum(net0.in_count, axis=1) == 0)
+            & ~net0.proc_stopped)
+        codel_ok = ~net0.codel_dropping & (net0.codel_interval_expire == 0)
+        app_ok = app_bulk.precheck(cfg, sim)
+        has_work = jnp.any(inwin0, axis=1)
+        elig = kind_ok & nonboot & quiesced & codel_ok & app_ok & has_work
+        # precheck failures land in the top why bits for the debug view
+        why0 = (jnp.where(~kind_ok, jnp.int64(1) << 56, 0)
+                | jnp.where(~nonboot, jnp.int64(1) << 57, 0)
+                | jnp.where(~quiesced, jnp.int64(1) << 58, 0)
+                | jnp.where(~codel_ok, jnp.int64(1) << 59, 0)
+                | jnp.where(~app_ok, jnp.int64(1) << 60, 0)
+                | jnp.where(~has_work, jnp.int64(1) << 61, 0))
+
+        # ---- per-socket per-window constants -------------------------
+        # peer host / latency / reliability (ip->host once per window)
+        peer_h = host_of_ip(net0, net0.sk_peer_ip)          # [H,S]
+        peer_hc = jnp.clip(peer_h, 0, GH - 1)
+        vsrc = net0.vertex_of_host[lane][:, None]            # [H,1]
+        vdst = net0.vertex_of_host[peer_hc]                  # [H,S]
+        lat_s = net0.latency_ns[vsrc, vdst]                  # [H,S]
+        lat_rev_s = net0.latency_ns[vdst, vsrc]              # [H,S]
+        rel_s = net0.reliability[vsrc, vdst]                 # [H,S]
+        peer_up_s = net0.bw_up_kibps[peer_hc]                # [H,S]
+        peer_down_s = net0.bw_down_kibps[peer_hc]            # [H,S]
+
+        # ---- the reduced per-event scan ------------------------------
+        def cond(c):
+            live = ~c.bad & jnp.any(c.sim.events.time < wend64, axis=1)
+            return jnp.any(live) & (c.it < 4 * K + 8)
+
+        def body(c):
+            sim, bad, why, seq_ctr, it = c
+            net, tcp, app = sim.net, sim.tcp, sim.app
+            q, p = _pop_masked(sim.events, wend64, ~bad & elig)
+            v = p.valid
+            t = p.time
+            words = p.words
+            is_pkt = v & (p.kind == EventKind.PACKET)
+            is_dk = v & (p.kind == EventKind.TCP_DACK_TIMER)
+            is_fl = v & (p.kind == EventKind.TCP_FLUSH)
+            is_rtx = v & (p.kind == EventKind.TCP_RTX_TIMER)
+            bad, why = _flag(bad, why,
+                             (v & ~(is_pkt | is_dk | is_fl | is_rtx)), 1)
+
+            # ===== packet classification =============================
+            proto = pf.proto_of(words)
+            flags = pf.tcp_flags_of(words)
+            bad, why = _flag(bad, why, (is_pkt & (proto != pf.PROTO_TCP)), 2)
+            bad, why = _flag(bad, why, (is_pkt & (flags != pf.TCPF_ACK)), 4)
+            # arriving SACK blocks = upstream loss artifacts
+            sack_any = (
+                (words[:, pf.W_SACKL] != 0) | (words[:, pf.W_SACKR] != 0)
+                | (words[:, pf.W_SACKL2] != 0) | (words[:, pf.W_SACKR2] != 0)
+                | (words[:, pf.W_SACKL3] != 0) | (words[:, pf.W_SACKR3] != 0))
+            bad, why = _flag(bad, why, (is_pkt & sack_any), 8)
+
+            src_port, dst_port = pf.ports_of(words)
+            dst_ip = words[:, pf.W_DSTIP].astype(jnp.uint32).astype(I64)
+            src_ip = net.host_ip[jnp.clip(p.src, 0, GH - 1)]
+            slot = lookup_socket(net, is_pkt, jnp.full((H,), pf.PROTO_TCP,
+                                                       I32),
+                                 dst_ip, dst_port, src_ip, src_port)
+            bad, why = _flag(bad, why, (is_pkt & (slot < 0)), 16)
+            slot = jnp.where(slot >= 0, slot, 0)
+            st = gather_hs(tcp.st, slot)
+            bad, why = _flag(bad, why, (is_pkt & ~((st == TcpSt.ESTABLISHED) | (st == TcpSt.FIN_WAIT_1))), 32)
+            pkt = is_pkt & ~bad
+
+            seqno = words[:, pf.W_SEQ]
+            ackno = words[:, pf.W_ACK]
+            length = words[:, pf.W_LEN]
+            peer_win = words[:, pf.W_WIN]
+            tsval = words[:, pf.W_TSVAL]
+            tsecho = words[:, pf.W_TSECHO]
+            is_data = pkt & (length > 0)
+            is_ack = pkt & (length == 0)
+
+            # loss / reorder artifacts abort: the model only covers the
+            # exactly-in-order case (seq == rcv_nxt)
+            rcv_nxt = gather_hs(tcp.rcv_nxt, slot)
+            bad, why = _flag(bad, why, (is_data & (seqno != rcv_nxt)), 64)
+            # socket-level out-of-model state
+            sc = jnp.clip(slot, 0, S - 1)
+            oo_any = jnp.any(tcp.oo_r[rows, sc] > tcp.oo_l[rows, sc],
+                             axis=1)
+            sk_any = jnp.any(tcp.sack_r[rows, sc] > tcp.sack_l[rows, sc],
+                             axis=1)
+            bad, why = _flag(bad, why, (pkt & (oo_any | sk_any)), 128)
+            bad, why = _flag(bad, why, (pkt & gather_hs(tcp.fin_rcvd, slot)), 256)
+            bad, why = _flag(bad, why, (pkt & (gather_hs(tcp.dup_acks, slot) > 0)), 512)
+            bad, why = _flag(bad, why, (pkt & gather_hs(tcp.in_recovery, slot)), 1024)
+            pkt = pkt & ~bad
+            is_data = is_data & ~bad
+            is_ack = is_ack & ~bad
+
+            # ===== router ring cycle + rx token charge ================
+            # (ref: router.c:104-125 + network_interface.c:421-455; the
+            # ring is empty between events in the eligible regime, so
+            # enqueue position == head and the packet dequeues in the
+            # same micro-step, leaving head advanced and the written
+            # planes behind)
+            wl_in = pf.wire_length(proto, length).astype(I64)
+            # ring-plane contents below head are dead storage (the
+            # bit-identity convention of tests/test_bulk.py excludes
+            # them); only the head advance is live state
+            net = net.replace(
+                rq_head=jnp.where(pkt, (net.rq_head + 1) % R, net.rq_head),
+            )
+            # analytic refill at the arrival instant, then the charge
+            dq = jnp.maximum(t // simtime.ONE_MILLISECOND - net.tb_quantum,
+                             0)
+            refresh = pkt & (dq > 0)
+            recv_tok = jnp.minimum(net.tb_recv_refill + pf.MTU,
+                                   net.tb_recv_tokens
+                                   + dq * net.tb_recv_refill)
+            send_tok0 = jnp.minimum(net.tb_send_refill + pf.MTU,
+                                    net.tb_send_tokens
+                                    + dq * net.tb_send_refill)
+            net = net.replace(
+                tb_recv_tokens=jnp.where(refresh, recv_tok,
+                                         net.tb_recv_tokens),
+                tb_send_tokens=jnp.where(refresh, send_tok0,
+                                         net.tb_send_tokens),
+                tb_quantum=jnp.where(refresh, t // simtime.ONE_MILLISECOND,
+                                     net.tb_quantum),
+            )
+            bad, why = _flag(bad, why, (pkt & (net.tb_recv_tokens < pf.MTU)), 2048)
+            net = net.replace(
+                tb_recv_tokens=jnp.maximum(
+                    net.tb_recv_tokens - jnp.where(pkt, wl_in, 0), 0))
+
+            net = net.replace(
+                ctr_rx_packets=net.ctr_rx_packets + pkt.astype(I64),
+                ctr_rx_bytes=net.ctr_rx_bytes + jnp.where(pkt, wl_in, 0),
+                ctr_rx_data_bytes=net.ctr_rx_data_bytes
+                + jnp.where(pkt, length, 0).astype(I64),
+            )
+
+            # ===== reduced tcp_packet_in ==============================
+            # ts_recent (in-window: seq <= rcv_nxt holds for both kinds)
+            tsr = gather_hs(tcp.ts_recent, slot)
+            tcp = tcp.replace(ts_recent=set_hs(
+                tcp.ts_recent, pkt & (seqno <= rcv_nxt) & (tsval >= tsr),
+                slot, tsval))
+
+            # snd_wnd + (empty) SACK scoreboard replacement
+            wnd_prev = gather_hs(tcp.snd_wnd, slot)
+            tcp = tcp.replace(snd_wnd=set_hs(tcp.snd_wnd, pkt, slot,
+                                             peer_win))
+
+            una = gather_hs(tcp.snd_una, slot)
+            nxt = gather_hs(tcp.snd_nxt, slot)
+            smax = gather_hs(tcp.snd_max, slot)
+            new_ack = pkt & (ackno > una) & (ackno <= smax)
+            bad, why = _flag(bad, why, (pkt & (ackno > smax)), 4096)
+            bad, why = _flag(bad, why, (new_ack & (ackno > nxt)), 8192)
+            dup_ack = pkt & (ackno == una) & (una < nxt) & (length == 0) \
+                & (peer_win == wnd_prev)
+            bad, why = _flag(bad, why, dup_ack, 16384)
+            # a DATA segment whose embedded ack also advances our send
+            # side (bidirectional stream on one socket) would need two
+            # flush targets in one iteration — out of model
+            bad, why = _flag(bad, why, (pkt & (length > 0)
+                                        & (ackno > una)), 1 << 43)
+            new_ack = new_ack & ~bad
+
+            # RTT / RTO (ref: tcp.c:991-1026)
+            rtt = jnp.maximum(_ms(t) - tsecho, 1)
+            srtt = gather_hs(tcp.srtt_ms, slot)
+            sample = new_ack & (tsecho > 0)
+            first = sample & (srtt < 0)
+            rttvar = gather_hs(tcp.rttvar_ms, slot)
+            srtt_n = jnp.where(first, rtt, srtt + (rtt - srtt) // 8)
+            rttvar_n = jnp.where(first, rtt // 2,
+                                 (3 * rttvar + jnp.abs(srtt - rtt)) // 4)
+            rto_n = jnp.clip(srtt_n + jnp.maximum(4 * rttvar_n, 1),
+                             RTO_MIN_MS, RTO_MAX_MS)
+            tcp = tcp.replace(
+                srtt_ms=set_hs(tcp.srtt_ms, sample, slot, srtt_n),
+                rttvar_ms=set_hs(tcp.rttvar_ms, sample, slot, rttvar_n),
+                rto_ms=set_hs(tcp.rto_ms, sample, slot, rto_n),
+                backoff=set_hs(tcp.backoff, new_ack, slot,
+                               jnp.zeros((H,), I32)),
+            )
+
+            # congestion growth — same hook code as the serial path
+            cwnd = gather_hs(tcp.cwnd, slot)
+            ssth = gather_hs(tcp.ssthresh, slot)
+            ca = gather_hs(tcp.ca_acc, slot)
+            n_acked = jnp.where(new_ack, (ackno - una + MSS - 1) // MSS, 0)
+            ss = new_ack & (cwnd < ssth)
+            grown = cwnd + n_acked
+            spill = ss & (grown >= ssth)
+            cwnd1 = jnp.where(ss, jnp.minimum(grown, ssth), cwnd)
+            ca_in = jnp.where(spill, grown - ssth,
+                              jnp.where(new_ack & ~ss, n_acked, 0))
+            in_ca = (new_ack & ~ss) | spill
+            ca_base = jnp.where(spill, 0, ca)
+            cwnd1, ca1, epoch1 = cong.ca_update(
+                alg, in_ca, cwnd1, jnp.where(in_ca, ca_base, ca), ca_in,
+                gather_hs(tcp.cub_wmax, slot),
+                gather_hs(tcp.cub_epoch_ms, slot), _ms(t))
+            tcp = tcp.replace(
+                cwnd=set_hs(tcp.cwnd, new_ack, slot, cwnd1),
+                ca_acc=set_hs(tcp.ca_acc, new_ack, slot, ca1),
+                cub_epoch_ms=set_hs(tcp.cub_epoch_ms, in_ca, slot, epoch1),
+                snd_una=set_hs(tcp.snd_una, new_ack, slot, ackno),
+            )
+            una2 = jnp.where(new_ack, ackno, una)
+
+            # initial buffer sizing on the FIRST RTT sample (ref:
+            # tcp.c:1007-1009 + _tcp_tuneInitialBufferSizes): BDP from
+            # the topology's true two-way latency and the bottleneck of
+            # local/peer interface bandwidth, x1.25
+            from shadow_tpu.net.tcp import (
+                RECV_BUFFER_MIN, SEND_BUFFER_MIN)
+
+            at_init = first & ~gather_hs(tcp.at_init_done, slot)
+            peer_ip_sl = gather_hs(net.sk_peer_ip, slot)
+            self_ip = net.host_ip[lane]
+            is_loop = (peer_ip_sl == self_ip) | ((peer_ip_sl >> 24) == 127)
+            rtt_topo_ms = jnp.maximum(
+                (gather_hs(lat_s, slot) + gather_hs(lat_rev_s, slot))
+                // simtime.ONE_MILLISECOND, 1)
+            my_up = net.bw_up_kibps[lane]
+            my_down = net.bw_down_kibps[lane]
+            bdp_snd = rtt_topo_ms * jnp.minimum(
+                my_up, gather_hs(peer_down_s, slot)) * 1280 // 1000
+            bdp_rcv = rtt_topo_ms * jnp.minimum(
+                my_down, gather_hs(peer_up_s, slot)) * 1280 // 1000
+            init_snd = jnp.where(
+                is_loop, TCP_WMEM_MAX,
+                jnp.clip(bdp_snd, SEND_BUFFER_MIN, TCP_WMEM_MAX)
+            ).astype(I32)
+            init_rcv = jnp.where(
+                is_loop, TCP_RMEM_MAX,
+                jnp.clip(bdp_rcv, RECV_BUFFER_MIN, TCP_RMEM_MAX)
+            ).astype(I32)
+            net = net.replace(
+                sk_sndbuf=set_hs(net.sk_sndbuf,
+                                 at_init & net.autotune_snd, slot,
+                                 init_snd),
+                sk_rcvbuf=set_hs(net.sk_rcvbuf,
+                                 at_init & net.autotune_rcv, slot,
+                                 init_rcv))
+            tcp = tcp.replace(at_init_done=set_hs(
+                tcp.at_init_done, at_init, slot, True))
+
+            # send-buffer autotune growth (ref: tcp.c:566-592)
+            srtt_now = jnp.maximum(jnp.where(sample, srtt_n, srtt),
+                                   0).astype(I64)
+            max_wmem = jnp.clip(my_up * 1024 * srtt_now // 1000,
+                                TCP_WMEM_MAX, 10 * TCP_WMEM_MAX)
+            want_snd = jnp.minimum(I64(SNDMEM_SKB) * 2 * cwnd1.astype(I64),
+                                   max_wmem).astype(I32)
+            cur_snd = gather_hs(net.sk_sndbuf, slot)
+            net = net.replace(sk_sndbuf=set_hs(
+                net.sk_sndbuf,
+                new_ack & net.autotune_snd & (want_snd > cur_snd),
+                slot, want_snd))
+            # ACK progress reopened stream room -> WRITABLE (edge helper)
+            wroom = new_ack & (
+                gather_hs(net.sk_sndbuf, slot)
+                - (gather_hs(tcp.snd_end, slot) - ackno) > 0)
+            fl_w = gather_hs(net.sk_flags, slot)
+            edge_w = wroom & ((fl_w & SocketFlags.WRITABLE) == 0)
+            net = net.replace(
+                sk_flags=set_hs(net.sk_flags, wroom, slot,
+                                fl_w | SocketFlags.WRITABLE),
+                sk_out_gen=set_hs(net.sk_out_gen, edge_w, slot,
+                                  gather_hs(net.sk_out_gen, slot) + 1))
+
+            # RTO deadline after progress (ref: tcp.c ACK path)
+            still_out = new_ack & (ackno < smax)
+            done_ack = new_ack & (ackno >= smax)
+            rto_ns = gather_hs(tcp.rto_ms, slot).astype(I64) \
+                * simtime.ONE_MILLISECOND
+            tcp = tcp.replace(
+                rtx_expire=set_hs(tcp.rtx_expire, still_out, slot,
+                                  t + rto_ns),
+                )
+            tcp = tcp.replace(rtx_expire=set_hs(
+                tcp.rtx_expire, done_ack, slot,
+                jnp.full((H,), simtime.INVALID, I64)))
+
+            # ===== in-order data receive ==============================
+            freeb = gather_hs(net.sk_rcvbuf, slot) \
+                - gather_hs(tcp.app_rbytes, slot)
+            bad, why = _flag(bad, why, (is_data & (length > freeb)), 65536)
+            is_data = is_data & ~bad
+            rb0 = gather_hs(tcp.app_rbytes, slot)
+            tcp = tcp.replace(
+                rcv_nxt=set_hs(tcp.rcv_nxt, is_data, slot,
+                               rcv_nxt + length),
+                app_rbytes=set_hs(tcp.app_rbytes, is_data, slot,
+                                  rb0 + length),
+            )
+            fl_r = gather_hs(net.sk_flags, slot)
+            net = net.replace(
+                sk_flags=set_hs(net.sk_flags, is_data, slot,
+                                fl_r | SocketFlags.READABLE),
+                sk_in_gen=set_hs(net.sk_in_gen, is_data, slot,
+                                 gather_hs(net.sk_in_gen, slot) + 1),
+            )
+
+            # delayed-ACK scheduling (ref: tcp.c:2066-2091) — the push
+            # is the FIRST emission of this micro-step (seq order)
+            cnt = gather_hs(tcp.dack_counter, slot) + 1
+            tcp = tcp.replace(dack_counter=set_hs(
+                tcp.dack_counter, is_data, slot, cnt))
+            sched = is_data & ~gather_hs(tcp.dack_scheduled, slot)
+            nq = gather_hs(tcp.quick_acks, slot)
+            quick = nq < DACK_QUICK_LIMIT
+            ddelay = jnp.where(quick, DACK_QUICK_NS, DACK_SLOW_NS)
+            tcp = tcp.replace(
+                quick_acks=set_hs(tcp.quick_acks, sched & quick, slot,
+                                  nq + 1),
+                dack_scheduled=set_hs(tcp.dack_scheduled, sched, slot,
+                                      True))
+            W = q.words.shape[-1]
+            dkw = jnp.zeros((H, W), I32)
+            dkw = dkw.at[:, 0].set(slot.astype(I32))
+            dkw = dkw.at[:, 1].set(gather_hs(tcp.dack_gen, slot))
+            free_before = jnp.any(q.time == simtime.INVALID, axis=1)
+            bad, why = _flag(bad, why, (sched & ~free_before), 131072)
+            q = _push_local(q, sched & ~bad, t + ddelay,
+                            EventKind.TCP_DACK_TIMER, dkw, lane, seq_ctr)
+            seq_ctr = seq_ctr + (sched & ~bad).astype(I32)
+
+            # ===== app consume + forward ==============================
+            app, app_okm, fwd_mask, fwd_slot, fwd_bytes = app_bulk.on_data(
+                cfg, app, is_data, slot, length, t)
+            bad, why = _flag(bad, why, (is_data & ~app_okm), 262144)
+            is_data = is_data & ~bad
+            fwd_mask = fwd_mask & is_data
+            # tcp_recv semantics: read EVERYTHING available
+            avail = gather_hs(tcp.app_rbytes, slot)
+            win_before = gather_hs(net.sk_rcvbuf, slot) - avail
+            tcp = tcp.replace(app_rbytes=set_hs(
+                tcp.app_rbytes, is_data, slot, jnp.zeros((H,), I32)))
+            # Linux-DRS receive autotune (ref: tcp.c:535-564)
+            at_on = is_data & net.autotune_rcv
+            copied = gather_hs(tcp.at_copied, slot) + avail
+            space = jnp.maximum(2 * copied, gather_hs(tcp.at_space, slot))
+            cur_r = gather_hs(net.sk_rcvbuf, slot)
+            srtt2 = gather_hs(tcp.srtt_ms, slot)
+            my_down = net.bw_down_kibps[lane]
+            max_rmem = jnp.clip(
+                my_down * 1024 * jnp.maximum(srtt2, 0).astype(I64) // 1000,
+                TCP_RMEM_MAX, 10 * TCP_RMEM_MAX)
+            growing = at_on & (space > cur_r)
+            tcp = tcp.replace(at_space=set_hs(tcp.at_space, growing, slot,
+                                              space))
+            new_size = jnp.minimum(space.astype(I64), max_rmem).astype(I32)
+            net = net.replace(sk_rcvbuf=set_hs(
+                net.sk_rcvbuf, growing & (new_size > cur_r), slot,
+                new_size))
+            tcp = tcp.replace(at_copied=set_hs(tcp.at_copied, at_on, slot,
+                                               copied))
+            last = gather_hs(tcp.at_last, slot)
+            tcp = tcp.replace(at_last=set_hs(
+                tcp.at_last, at_on & (last == 0), slot, t))
+            rtt_ns2 = jnp.maximum(srtt2, 0).astype(I64) \
+                * simtime.ONE_MILLISECOND
+            reset = at_on & (last > 0) & (srtt2 > 0) & (t - last > rtt_ns2)
+            tcp = tcp.replace(
+                at_last=set_hs(tcp.at_last, reset, slot, t),
+                at_copied=set_hs(tcp.at_copied, reset, slot,
+                                 jnp.zeros((H,), I32)))
+            # drained -> clear READABLE (no EOF in the eligible regime)
+            fl_d = gather_hs(net.sk_flags, slot)
+            net = net.replace(sk_flags=set_hs(
+                net.sk_flags, is_data, slot,
+                fl_d & ~SocketFlags.READABLE))
+            # receiver silly-window update ACK => out of model
+            win_after = gather_hs(net.sk_rcvbuf, slot)
+            bad, why = _flag(bad, why, (is_data & (win_before < 2 * MSS) & (win_after - win_before >= MSS)), 524288)
+
+            # tcp_send semantics on the forward socket (full accept or
+            # abort; ref: tcp_sendUserData, tcp.c:2126-2190)
+            fsl = jnp.where(fwd_mask, fwd_slot, 0)
+            fst = gather_hs(tcp.st, fsl)
+            can_send = fwd_mask & (
+                (fst == TcpSt.ESTABLISHED) | (fst == TcpSt.CLOSE_WAIT)
+                | (fst == TcpSt.SYN_SENT) | (fst == TcpSt.SYN_RCVD))
+            bad, why = _flag(bad, why, (fwd_mask & ~can_send), 1048576)
+            f_una = gather_hs(tcp.snd_una, fsl)
+            f_end = gather_hs(tcp.snd_end, fsl)
+            f_sndbuf = gather_hs(net.sk_sndbuf, fsl)
+            room = jnp.maximum(f_sndbuf - (f_end - f_una), 0)
+            bad, why = _flag(bad, why, (can_send & (room < fwd_bytes)), 2097152)
+            bad, why = _flag(bad, why, (can_send & (room - fwd_bytes <= 0)), 4194304)
+            can_send = can_send & ~bad
+            tcp = tcp.replace(snd_end=set_hs(tcp.snd_end, can_send, fsl,
+                                             f_end + fwd_bytes))
+
+            # ===== flush of admissible segments =======================
+            # data arrivals flush the forward socket; ACKs flush the
+            # arrival socket; popped TCP_FLUSH continuations flush
+            # their own slot (ref: _tcp_flush via tcp_send / the ACK
+            # path / handle_tcp_flush)
+            flslot = jnp.where(is_fl, p.word(0), 0)
+            tcp = tcp.replace(flush_pending=set_hs(
+                tcp.flush_pending, is_fl, flslot, False))
+            reopened = is_ack & (wnd_prev == 0) & (peer_win > 0)
+            fl_mask = can_send | new_ack | reopened | is_fl
+            fslot = jnp.where(can_send, fsl,
+                              jnp.where(is_fl, flslot, slot))
+            g_una = gather_hs(tcp.snd_una, fslot)
+            g_nxt = gather_hs(tcp.snd_nxt, fslot)
+            g_end = gather_hs(tcp.snd_end, fslot)
+            g_st = gather_hs(tcp.st, fslot)
+            g_cwnd = gather_hs(tcp.cwnd, fslot)
+            g_wnd = jnp.minimum(g_cwnd * MSS, gather_hs(tcp.snd_wnd, fslot))
+            can_data = fl_mask & (
+                (g_st == TcpSt.ESTABLISHED) | (g_st == TcpSt.CLOSE_WAIT)
+                | (g_st == TcpSt.FIN_WAIT_1) | (g_st == TcpSt.LAST_ACK))
+            A = jnp.clip(jnp.minimum(g_end - g_nxt, g_una + g_wnd - g_nxt),
+                         0)
+            A = jnp.where(can_data, A, 0)
+            # one flush call packetizes at most FLUSH_SEGMENTS segments;
+            # the remainder chains a same-time TCP_FLUSH continuation
+            # exactly like the serial path (its pop order among other
+            # same-instant events follows the same (time, src, seq)
+            # comparator, so the scan replays the interleaving)
+            A_now = jnp.minimum(A, FLUSH_SEGMENTS * MSS)
+            n_seg = (A_now + MSS - 1) // MSS
+            rest = A - A_now
+            # FIN would ride once all data is packetized => out of model
+            bad, why = _flag(bad, why, (fl_mask & gather_hs(tcp.fin_pending, fslot) & (g_nxt + A_now == g_end)), 16777216)
+            fl_mask = fl_mask & ~bad
+            n_seg = jnp.where(fl_mask, n_seg, 0)
+            A_now = jnp.where(fl_mask, A_now, 0)
+            tcp = tcp.replace(
+                snd_nxt=set_hs(tcp.snd_nxt, fl_mask, fslot,
+                               g_nxt + A_now),
+                snd_max=set_hs(tcp.snd_max, fl_mask, fslot,
+                               jnp.maximum(gather_hs(tcp.snd_max, fslot),
+                                           g_nxt + A_now)))
+            chain = fl_mask & (rest > 0) & ~gather_hs(
+                tcp.flush_pending, fslot)
+            tcp = tcp.replace(flush_pending=set_hs(
+                tcp.flush_pending, chain, fslot, True))
+            cw_ = jnp.zeros((H, W), I32).at[:, 0].set(fslot.astype(I32))
+            free_c = jnp.any(q.time == simtime.INVALID, axis=1)
+            bad, why = _flag(bad, why, chain & ~free_c, 1 << 42)
+            chain = chain & ~bad
+            q = _push_local(q, chain, t, EventKind.TCP_FLUSH, cw_, lane,
+                            seq_ctr)
+            seq_ctr = seq_ctr + chain.astype(I32)
+
+            # RTO arm after flush (ref: tcp_flush tail + _arm_rtx)
+            h_una = gather_hs(tcp.snd_una, fslot)
+            h_nxt = gather_hs(tcp.snd_nxt, fslot)
+            # persist condition (zero window, unsent data waiting) — the
+            # serial path would arm a probe timer (out of model)
+            bad, why = _flag(bad, why, (fl_mask & (h_una == h_nxt) & (gather_hs(tcp.snd_end, fslot) > h_nxt) & (gather_hs(tcp.snd_wnd, fslot) == 0)), 33554432)
+            fl_mask = fl_mask & ~bad
+            outstanding = fl_mask & (h_una < h_nxt)
+            need = outstanding & (
+                gather_hs(tcp.rtx_expire, fslot) == simtime.INVALID)
+            rto_arm = (gather_hs(tcp.rto_ms, fslot).astype(I64)
+                       << jnp.minimum(gather_hs(tcp.backoff, fslot),
+                                      MAX_BACKOFF).astype(I64)) \
+                * simtime.ONE_MILLISECOND
+            rto_arm = jnp.minimum(rto_arm,
+                                  I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
+            deadline = t + rto_arm
+            tcp = tcp.replace(rtx_expire=set_hs(tcp.rtx_expire, need,
+                                                fslot, deadline))
+            in_flight = gather_hs(tcp.rtx_event, fslot)
+            earlier = need & in_flight & (
+                deadline < gather_hs(tcp.rtx_fire, fslot))
+            need_event = (need & ~in_flight) | earlier
+            bad, why = _flag(bad, why, (need_event & (deadline < wend64)), 67108864)
+            need_event = need_event & ~bad
+            gen = gather_hs(tcp.rtx_gen, fslot) + 1
+            tcp = tcp.replace(
+                rtx_gen=set_hs(tcp.rtx_gen, need_event, fslot, gen),
+                rtx_event=set_hs(tcp.rtx_event, need_event, fslot, True),
+                rtx_fire=set_hs(tcp.rtx_fire, need_event, fslot, deadline))
+            rw = jnp.zeros((H, W), I32)
+            rw = rw.at[:, 0].set(fslot.astype(I32))
+            rw = rw.at[:, 1].set(gen)
+            free_b = jnp.any(q.time == simtime.INVALID, axis=1)
+            bad, why = _flag(bad, why, (need_event & ~free_b), 134217728)
+            q = _push_local(q, need_event & ~bad, deadline,
+                            EventKind.TCP_RTX_TIMER, rw, lane, seq_ctr)
+            seq_ctr = seq_ctr + (need_event & ~bad).astype(I32)
+
+            # ===== DACK fire ==========================================
+            dgen = p.word(1)
+            dslot = jnp.where(is_dk, p.word(0), 0)
+            live_dk = is_dk & (dgen == gather_hs(tcp.dack_gen, dslot))
+            tcp = tcp.replace(dack_scheduled=set_hs(
+                tcp.dack_scheduled, live_dk, dslot, False))
+            fire = live_dk & (gather_hs(tcp.dack_counter, dslot) > 0)
+            tcp = tcp.replace(dack_counter=set_hs(
+                tcp.dack_counter, fire, dslot, jnp.zeros((H,), I32)))
+
+            # ===== RTX timer fire (ref: handle_tcp_rtx) ===============
+            # stale generations die; a disarmed deadline clears the
+            # in-flight flag; a deadline that MOVED later re-emits the
+            # covering event. A DUE deadline is a real RTO — loss
+            # recovery is out of model.
+            rgen = p.word(1)
+            rslot = jnp.where(is_rtx, p.word(0), 0)
+            live_rtx = is_rtx & (rgen == gather_hs(tcp.rtx_gen, rslot))
+            rdl = gather_hs(tcp.rtx_expire, rslot)
+            r_disarm = live_rtx & (rdl == simtime.INVALID)
+            r_pending = live_rtx & ~r_disarm & (t < rdl)
+            r_due = live_rtx & ~r_disarm & ~r_pending
+            bad, why = _flag(bad, why, r_due, 1 << 40)
+            tcp = tcp.replace(rtx_event=set_hs(
+                tcp.rtx_event, r_disarm, rslot, False))
+            r_emit = r_pending & ~bad
+            xw = jnp.zeros((H, W), I32)
+            xw = xw.at[:, 0].set(rslot.astype(I32))
+            xw = xw.at[:, 1].set(rgen)
+            free_x = jnp.any(q.time == simtime.INVALID, axis=1)
+            bad, why = _flag(bad, why, r_emit & ~free_x, 1 << 41)
+            r_emit = r_emit & ~bad
+            q = _push_local(q, r_emit, rdl, EventKind.TCP_RTX_TIMER, xw,
+                            lane, seq_ctr)
+            seq_ctr = seq_ctr + r_emit.astype(I32)
+            tcp = tcp.replace(rtx_fire=set_hs(
+                tcp.rtx_fire, r_emit, rslot, rdl))
+
+            # ===== wire: out-ring cycle + stamps + outbox =============
+            # Packets this micro-step: n_seg data segments on fslot, or
+            # one pure ACK on dslot. Mutually exclusive per lane.
+            wslot = jnp.where(fire, dslot, fslot)
+            n_pkt = jnp.where(fire, 1, n_seg)
+            sending = (fire | (n_seg > 0)) & ~bad
+            n_pkt = jnp.where(sending, n_pkt, 0)
+
+            # refill the send bucket at t (drain-entry refill); the
+            # arrival path refilled already (same quantum -> no-op)
+            dq2 = jnp.maximum(t // simtime.ONE_MILLISECOND
+                              - net.tb_quantum, 0)
+            refresh2 = sending & (dq2 > 0)
+            send_tok = jnp.minimum(net.tb_send_refill + pf.MTU,
+                                   net.tb_send_tokens
+                                   + dq2 * net.tb_send_refill)
+            recv_tok2 = jnp.minimum(net.tb_recv_refill + pf.MTU,
+                                    net.tb_recv_tokens
+                                    + dq2 * net.tb_recv_refill)
+            net = net.replace(
+                tb_send_tokens=jnp.where(refresh2, send_tok,
+                                         net.tb_send_tokens),
+                tb_recv_tokens=jnp.where(refresh2, recv_tok2,
+                                         net.tb_recv_tokens),
+                tb_quantum=jnp.where(refresh2,
+                                     t // simtime.ONE_MILLISECOND,
+                                     net.tb_quantum))
+
+            # stamps shared by every packet of the burst (state does
+            # not change between same-instant wires)
+            stamp_ack = gather_hs(tcp.rcv_nxt, wslot)
+            stamp_win = jnp.maximum(
+                gather_hs(net.sk_rcvbuf, wslot)
+                - gather_hs(tcp.app_rbytes, wslot), 0)
+            stamp_tse = gather_hs(tcp.ts_recent, wslot)
+            w_sport = gather_hs(net.sk_bound_port, wslot)
+            w_dport = gather_hs(net.sk_peer_port, wslot)
+            w_dip = gather_hs(net.sk_peer_ip, wslot)
+            w_dsth = gather_hs(peer_h, wslot)
+            bad, why = _flag(bad, why, (sending & (w_dsth < 0)), 268435456)
+            sending = sending & ~bad
+            n_pkt = jnp.where(sending, n_pkt, 0)
+            w_lat = gather_hs(lat_s, wslot)
+            w_rel = gather_hs(rel_s, wslot)
+            # the wired ACK cancels any pending delayed ACK on ITS
+            # socket (ref: tcp.c:1105-1108 via nic wire_ack_departed)
+            tcp = tcp.replace(dack_counter=set_hs(
+                tcp.dack_counter, sending, wslot, jnp.zeros((H,), I32)))
+
+            seg_base = jnp.where(fire, gather_hs(tcp.snd_nxt, wslot),
+                                 g_nxt)
+            out = sim.outbox
+            M = out.capacity
+            drops = jnp.zeros((H,), I32)
+            last_drop = net.last_drop_status
+            tx_wl = jnp.zeros((H,), I64)
+            ring_head0 = gather_hs(net.out_head, wslot)
+            rngc = net.rng_ctr
+            emitted = jnp.zeros((H,), I32)
+            ob_count = out.count
+            ob_over = jnp.zeros((H,), bool)
+            for j in range(FLUSH_SEGMENTS):
+                pj = sending & (j < n_pkt)
+                lenj = jnp.where(
+                    fire, 0,
+                    jnp.clip(A_now - j * MSS, 0, MSS)).astype(I32)
+                seqj = seg_base + j * MSS
+                wlj = pf.wire_length(jnp.full((H,), pf.PROTO_TCP, I32),
+                                     lenj).astype(I64)
+                # token policing before EACH wire (serial `can` check)
+                bad, why = _flag(bad, why, (pj & (net.tb_send_tokens - tx_wl < pf.MTU)), 536870912)
+                pj = pj & ~bad
+                # the out ring's plane contents are dead storage below
+                # head (tests/test_bulk.py DEAD convention) — only the
+                # head advance + priority counter are live; the wire
+                # copy carries the enqueue-time words + wire stamps
+                ring_w = jnp.zeros((H, W), I32)
+                ring_w = ring_w.at[:, pf.W_PROTO].set(
+                    pf.PROTO_TCP | (pf.TCPF_ACK << 8))
+                ring_w = ring_w.at[:, pf.W_LEN].set(lenj)
+                ring_w = ring_w.at[:, pf.W_PORTS].set(
+                    pf.pack_ports(w_sport, w_dport))
+                ring_w = ring_w.at[:, pf.W_SEQ].set(seqj)
+                ring_w = ring_w.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
+                ring_w = ring_w.at[:, pf.W_DSTIP].set(
+                    w_dip.astype(jnp.uint32).astype(I32))
+                ring_w = ring_w.at[:, pf.W_STATUS].set(
+                    pf.PDS_SND_CREATED | pf.PDS_SND_TCP_ENQUEUE_THROTTLED
+                    | pf.PDS_SND_SOCKET_BUFFERED)
+                wire_w = ring_w.at[:, pf.W_ACK].set(stamp_ack)
+                wire_w = wire_w.at[:, pf.W_WIN].set(stamp_win)
+                wire_w = wire_w.at[:, pf.W_TSVAL].set(_ms(t))
+                wire_w = wire_w.at[:, pf.W_TSECHO].set(stamp_tse)
+                wire_w = wire_w.at[:, pf.W_STATUS].set(
+                    ring_w[:, pf.W_STATUS] | pf.PDS_SND_INTERFACE_SENT)
+                # reliability draw at the exact serial counter
+                u = rng.uniform_at(net.rng_keys, rngc + j)
+                dropj = pj & (lenj > 0) & (u > w_rel)
+                sendj = pj & ~dropj
+                wire_sent = wire_w.at[:, pf.W_STATUS].set(
+                    wire_w[:, pf.W_STATUS] | pf.PDS_INET_SENT)
+                last_drop = jnp.where(
+                    dropj, wire_w[:, pf.W_STATUS] | pf.PDS_INET_DROPPED,
+                    last_drop)
+                drops = drops + dropj.astype(I32)
+                tx_wl = tx_wl + jnp.where(pj, wlj, 0)
+                # outbox append at the running column
+                col = ob_count + emitted
+                okb = sendj & (col < M)
+                ob_over = ob_over | (sendj & ~(col < M))
+                colc = jnp.clip(col, 0, M - 1)
+                out = out.replace(
+                    dst=out.dst.at[rows, colc].set(
+                        jnp.where(okb, w_dsth, out.dst[rows, colc])),
+                    time=out.time.at[rows, colc].set(
+                        jnp.where(okb, t + w_lat, out.time[rows, colc])),
+                    kind=out.kind.at[rows, colc].set(
+                        jnp.where(okb, EventKind.PACKET,
+                                  out.kind[rows, colc])),
+                    src=out.src.at[rows, colc].set(
+                        jnp.where(okb, lane, out.src[rows, colc])),
+                    seq=out.seq.at[rows, colc].set(
+                        jnp.where(okb, seq_ctr + emitted,
+                                  out.seq[rows, colc])),
+                    words=out.words.at[rows, colc].set(
+                        jnp.where(okb[:, None], wire_sent,
+                                  out.words[rows, colc])),
+                )
+                emitted = emitted + sendj.astype(I32)
+            bad, why = _flag(bad, why, ob_over, 1073741824)
+            out = out.replace(count=jnp.where(sending & ~bad,
+                                              ob_count + emitted,
+                                              out.count))
+            seq_ctr = seq_ctr + jnp.where(sending & ~bad, emitted, 0)
+            net = net.replace(
+                out_head=set_hs(net.out_head, sending, wslot,
+                                (ring_head0 + n_pkt) % BO),
+                priority_ctr=net.priority_ctr
+                + jnp.where(sending, n_pkt, 0).astype(I64),
+                rng_ctr=rngc + jnp.where(sending, n_pkt, 0).astype(
+                    jnp.uint32),
+                tb_send_tokens=jnp.maximum(
+                    net.tb_send_tokens - jnp.where(sending, tx_wl, 0), 0),
+                ctr_tx_packets=net.ctr_tx_packets
+                + jnp.where(sending, n_pkt, 0).astype(I64),
+                ctr_tx_bytes=net.ctr_tx_bytes
+                + jnp.where(sending, tx_wl, 0),
+                ctr_tx_data_bytes=net.ctr_tx_data_bytes
+                + jnp.where(sending, A_now, 0).astype(I64),
+                ctr_drop_reliability=net.ctr_drop_reliability
+                + drops.astype(I64),
+                last_drop_status=last_drop,
+                ctr_events_exec=net.ctr_events_exec + v.astype(I64),
+            )
+
+            sim = sim.replace(events=q, outbox=out, net=net, tcp=tcp,
+                              app=app)
+            return _Carry(sim, bad, why, seq_ctr, it + 1)
+
+        init = _Carry(sim, ~elig, why0,
+                      q0.next_seq, jnp.zeros((), I32))
+        final = jax.lax.while_loop(cond, body, init)
+        sim_c, bad, why = final.sim, final.bad, final.why
+        # anything still pending in-window (iteration-guard trip, or a
+        # lane that went bad mid-stream) aborts — the serial fixpoint
+        # picks those hosts up from their ORIGINAL state
+        bad, why = _flag(bad, why, jnp.any(sim_c.events.time < wend64, axis=1), 2147483648)
+        commit = elig & ~bad
+
+        # ---- merge candidate state for committed hosts ----------------
+        def merge(orig, cand):
+            def m(a, b):
+                # global scalars (overflow) and replicated lookup
+                # tables ([V,V] latency etc.) are never touched by the
+                # scan — pass them through rather than broadcasting the
+                # per-host commit mask over a non-host leading dim
+                if a.ndim == 0 or a.shape[0] != H:
+                    return a
+                cm = commit.reshape((H,) + (1,) * (a.ndim - 1))
+                return jnp.where(cm, b, a)
+
+            return jax.tree_util.tree_map(m, orig, cand)
+
+        q_m = merge(sim.events, sim_c.events)
+        q_m = q_m.replace(next_seq=jnp.where(commit, final.seq_ctr,
+                                             sim.events.next_seq))
+        out_m = merge(sim.outbox, sim_c.outbox)
+        net_m = merge(sim.net, sim_c.net)
+        tcp_m = merge(sim.tcp, sim_c.tcp)
+        app_m = merge(sim.app, sim_c.app)
+        n = jnp.sum(jnp.where(
+            commit,
+            sim_c.net.ctr_events_exec - sim.net.ctr_events_exec, 0),
+            dtype=I64)
+        sim = sim.replace(events=q_m, outbox=out_m, net=net_m, tcp=tcp_m,
+                          app=app_m)
+        if debug:
+            return sim, n, {"elig": elig, "bad": bad, "why": why,
+                            "commit": commit, "iters": final.it}
+        return sim, n
+
+    return bulk_fn

@@ -320,7 +320,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
 def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
                         app_handlers=(), end_time: int | None = None,
                         exchange_capacity: int | None = None,
-                        app_bulk=None):
+                        app_bulk=None, app_tcp_bulk=None):
     """Multi-chip variant of shadow_tpu.net.build.make_runner: a
     REUSABLE jitted sim -> (sim, stats) callable running the whole
     window loop under shard_map (benchmarks must reuse one callable —
@@ -335,6 +335,13 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
         from shadow_tpu.net.bulk import make_bulk_fn
 
         bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
+    if bulk_fn is None and app_tcp_bulk is not None:
+        # lane-local like the UDP pass (all its reads/writes are
+        # per-row or replicated-table gathers), so it drops straight
+        # into the shard-local window step
+        from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
+
+        bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
     return _make_whole_run(
         mesh, axis, bundle.sim, step,
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
@@ -347,8 +354,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
                 end_time: int | None = None,
                 exchange_capacity: int | None = None,
-                app_bulk=None):
+                app_bulk=None, app_tcp_bulk=None):
     """One-shot multi-chip variant of shadow_tpu.net.build.run."""
     return make_sharded_runner(
         bundle, mesh, axis, app_handlers, end_time,
-        exchange_capacity, app_bulk)(bundle.sim)
+        exchange_capacity, app_bulk, app_tcp_bulk)(bundle.sim)

@@ -670,6 +670,11 @@ class ProcessRuntime:
         one; a lone call is a singleton batch (no second copy of the
         semantics to drift)."""
         if call.op in self.BATCH_OPS:
+            # _exec_batch reads each proc's pending call (p.pending);
+            # a caller handing us any OTHER call would silently execute
+            # the wrong args — fail loudly instead
+            assert call is p.pending, "BATCH_OPS delegation requires " \
+                "call is p.pending (args are read from there)"
             return self._exec_batch(call.op, [p], now)[p.host]
         h = p.host
         mask = self._lane(h)
@@ -947,7 +952,11 @@ class ProcessRuntime:
         fused device op. Returns {host: (ready, result)} with results
         identical to per-host _exec (same kernels, multi-hot mask).
         Host-side work (payload pool, stream FIFOs) runs per host in
-        spawn order, exactly as the serial path interleaves it."""
+        the caller's batch order, which the scheduler builds by sorted
+        host id — PER-HOST ordering is exactly the serial path's, but
+        CROSS-host side-effect order (e.g. pool-ref assignment) is
+        host-sorted rather than global spawn order. Deterministic
+        either way; per-host state is bitwise unaffected."""
         res: dict = {}
 
         if op in ("sendto", "sendto_data"):

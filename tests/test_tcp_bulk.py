@@ -1,0 +1,126 @@
+"""Golden bit-identity for the TCP bulk window pass (net/tcp_bulk.py):
+the relay workload run with the pass enabled must finish in EXACTLY
+the state the serial micro-step engine produces — the commit/abort
+design makes every committed host bit-identical by construction, and
+aborted hosts fall back to the same serial fixpoint.
+
+Dead-storage conventions follow tests/test_bulk.py: consumed ring
+slots / sub-head ring planes / cleared outbox planes carry no
+semantics and are excluded.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from shadow_tpu.apps import relay
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">%(bw)d</data><data key="dn">%(bw)d</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+DEAD = {
+    "in_src_ip", "in_src_port", "in_len", "in_payref", "in_status",
+    "out_words", "out_priority",
+    "rq_src", "rq_enq_ts", "rq_words",
+}
+
+
+def _build_relay(H, hop, total, sim_s, seed=1, bw=102400):
+    cap = 64
+    cfg = NetConfig(num_hosts=H, seed=seed,
+                    end_time=sim_s * simtime.ONE_SECOND,
+                    sockets_per_host=4, event_capacity=cap,
+                    outbox_capacity=cap, router_ring=cap)
+    hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H)]
+    b = build(cfg, GRAPH % {"bw": bw}, hosts)
+    ncirc = H // hop
+    circuits = [list(range(c * hop, (c + 1) * hop)) for c in range(ncirc)]
+    b.sim = relay.setup(b.sim, circuits=circuits, total_bytes=total)
+    return b
+
+
+def _compare(sim_a, sim_b, stats_a, stats_b):
+    na, nb = sim_a.net, sim_b.net
+    for f in type(na).__dataclass_fields__:
+        if f in DEAD:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(getattr(na, f)), np.asarray(getattr(nb, f)),
+            err_msg=f"net.{f} diverged")
+    ta, tb = sim_a.tcp, sim_b.tcp
+    for f in type(ta).__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(ta, f)), np.asarray(getattr(tb, f)),
+            err_msg=f"tcp.{f} diverged")
+    qa, qb = sim_a.events, sim_b.events
+    for f in ("time", "kind", "src", "seq", "words", "next_seq",
+              "overflow"):
+        a = np.asarray(getattr(qa, f))
+        b = np.asarray(getattr(qb, f))
+        if f in ("kind", "src", "seq", "words"):
+            live_a = np.asarray(qa.time) != simtime.INVALID
+            live_b = np.asarray(qb.time) != simtime.INVALID
+            if f == "words":
+                live_a = live_a[..., None]
+                live_b = live_b[..., None]
+            a = np.where(live_a, a, 0)
+            b = np.where(live_b, b, 0)
+        np.testing.assert_array_equal(a, b,
+                                      err_msg=f"events.{f} diverged")
+    for f in ("dst", "time", "count", "overflow"):
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sim_a.outbox, f)),
+            np.asarray(getattr(sim_b.outbox, f)),
+            err_msg=f"outbox.{f} diverged")
+    for f in type(sim_a.app).__dataclass_fields__:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sim_a.app, f)),
+            np.asarray(getattr(sim_b.app, f)),
+            err_msg=f"app.{f} diverged")
+    assert int(stats_a.events_processed) == int(stats_b.events_processed)
+    assert int(stats_a.windows) == int(stats_b.windows)
+
+
+@pytest.mark.parametrize("seed", [1, 5])
+def test_tcp_bulk_relay_bit_identical(seed):
+    H, hop, total, sim_s = 10, 5, 30_000, 6
+    b1 = _build_relay(H, hop, total, sim_s, seed)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+
+    b2 = _build_relay(H, hop, total, sim_s, seed)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+
+    assert int(sim_a.events.overflow) == 0
+    assert int(sim_b.events.overflow) == 0
+    # the transfers actually complete on both paths
+    servers = np.asarray(sim_a.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
+    _compare(sim_a, sim_b, st_a, st_b)
+    # the pass must actually engage in the lossless steady state
+    assert int(st_b.micro_steps) < int(st_a.micro_steps), (
+        int(st_b.micro_steps), int(st_a.micro_steps))
+
+
+def test_tcp_bulk_pairwise_bit_identical():
+    """hop=2 (client->server pairs, BASELINE config #2's shape)."""
+    H, hop, total, sim_s = 8, 2, 50_000, 6
+    b1 = _build_relay(H, hop, total, sim_s, seed=3)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed=3)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+    _compare(sim_a, sim_b, st_a, st_b)

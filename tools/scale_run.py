@@ -168,7 +168,10 @@ def main() -> int:
                     np.minimum(rcvd[servers] / total, 1.0).mean())
                 return bool((rcvd[servers] == total).all())
 
-            return b, dict(app_handlers=(relay.handler,)), verify
+            kw = dict(app_handlers=(relay.handler,))
+            if not args.no_bulk:
+                kw["app_tcp_bulk"] = relay.TCP_BULK
+            return b, kw, verify
         # gossip
         from shadow_tpu.apps import gossip
 

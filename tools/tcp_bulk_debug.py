@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Window-by-window commit diagnostics for the TCP bulk pass.
+
+Runs the relay workload through engine.step_window with the pass in
+debug mode and prints, per window, how many hosts committed and a
+histogram of abort reasons (the `why` bitmask, decoded back to the
+_flag call sites in net/tcp_bulk.py by source scan).
+
+Usage:
+  python tools/tcp_bulk_debug.py [--hosts 510] [--hop 5]
+      [--bytes 100000] [--sim-seconds 20] [--windows-max 40]
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import re
+import sys
+
+
+def why_legend() -> dict[int, str]:
+    """bit value -> one-line description scraped from the _flag call
+    sites (bits are assigned in source order)."""
+    src = (pathlib.Path(__file__).resolve().parent.parent
+           / "shadow_tpu/net/tcp_bulk.py").read_text()
+    legend = {}
+    for m in re.finditer(
+            r"_flag\(bad, why, (.*?), (\d+|1 << \d+)\)", src, re.DOTALL):
+        cond = " ".join(m.group(1).split())[:64]
+        legend[eval(m.group(2))] = cond  # noqa: S307 — '1 << N' literals
+    for bit, name in ((56, "precheck:kind"), (57, "precheck:bootstrap"),
+                      (58, "precheck:quiesced"), (59, "precheck:codel"),
+                      (60, "precheck:app"), (61, "precheck:no-work")):
+        legend[1 << bit] = name
+    return legend
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=510)
+    ap.add_argument("--hop", type=int, default=5)
+    ap.add_argument("--bytes", type=int, default=100_000)
+    ap.add_argument("--sim-seconds", type=int, default=20)
+    ap.add_argument("--windows-max", type=int, default=10_000)
+    ap.add_argument("--seed", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+    from shadow_tpu.utils.compcache import enable_compile_cache
+
+    enable_compile_cache()
+    import jax.numpy as jnp
+    import numpy as np
+
+    from shadow_tpu.apps import relay
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.engine import EngineStats, step_window
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+    from shadow_tpu.net.step import make_step_fn
+    from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
+
+    GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+      <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+      <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+      <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+      <graph edgedefault="undirected">
+        <node id="v0"><data key="up">102400</data>
+        <data key="dn">102400</data></node>
+        <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+      </graph>
+    </graphml>"""
+
+    H, hop = args.hosts, args.hop
+    cfg = NetConfig(num_hosts=H, seed=args.seed,
+                    end_time=args.sim_seconds * simtime.ONE_SECOND,
+                    sockets_per_host=4, event_capacity=64,
+                    outbox_capacity=64, router_ring=64)
+    hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    circuits = [list(range(c * hop, (c + 1) * hop))
+                for c in range(H // hop)]
+    b.sim = relay.setup(b.sim, circuits=circuits, total_bytes=args.bytes)
+
+    step = make_step_fn(cfg, (relay.handler,))
+    dbg_bulk = make_tcp_bulk_fn(cfg, relay.TCP_BULK, debug=True)
+    legend = why_legend()
+
+    @jax.jit
+    def one_window(sim, wstart):
+        wend = jnp.minimum(wstart + b.min_jump, cfg.end_time + 1)
+        sim, n_bulk, diag = dbg_bulk(sim, wend)
+        stats = EngineStats.create()
+        sim, stats, next_min = step_window(
+            sim, stats, step, wend, emit_capacity=cfg.emit_capacity,
+            lane_id=sim.net.lane_id)
+        return sim, stats, next_min, n_bulk, diag
+
+    sim = b.sim
+    wstart = jnp.min(sim.events.min_time())
+    total_bulk = total_serial = total_micro = 0
+    w = 0
+    agg: dict[int, int] = {}
+    while w < args.windows_max and int(wstart) <= cfg.end_time:
+        sim, stats, next_min, n_bulk, diag = one_window(sim, wstart)
+        n_bulk = int(n_bulk)
+        micro = int(stats.micro_steps)
+        serial_ev = int(stats.events_processed)
+        commit = int(np.sum(np.asarray(diag["commit"])))
+        why = np.asarray(diag["why"])
+        has_work = (why & (1 << 61)) == 0
+        aborted = has_work & ~np.asarray(diag["commit"])
+        PRECHECK = sum(1 << b for b in range(56, 62))
+        GUARD = 1 << 31
+        hist = {}
+        for h in np.nonzero(aborted)[0][:100000]:
+            wv = int(why[h])
+            if wv & PRECHECK:
+                low = (wv & PRECHECK) & -(wv & PRECHECK)
+            else:
+                body = wv & ~GUARD
+                low = (body & -body) if body else (wv & -wv if wv else 0)
+            hist[low] = hist.get(low, 0) + 1
+            agg[low] = agg.get(low, 0) + 1
+        total_bulk += n_bulk
+        total_serial += serial_ev
+        total_micro += micro
+        top = sorted(hist.items(), key=lambda kv: -kv[1])[:4]
+        tops = " ".join(f"{legend.get(k, hex(k))[:40]}x{v}"
+                        for k, v in top)
+        print(f"w{w:4d} t={int(wstart)/1e9:8.3f}s commit={commit:5d} "
+              f"bulk_ev={n_bulk:6d} serial_ev={serial_ev:6d} "
+              f"micro={micro:4d} | {tops}", flush=True)
+        wstart = next_min
+        w += 1
+    print(f"\nTOTAL bulk_ev={total_bulk} serial_ev={total_serial} "
+          f"micro={total_micro}")
+    print("aggregate first-abort reasons:")
+    for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
+        print(f"  {v:8d}  {legend.get(k, hex(k))}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
