@@ -119,10 +119,24 @@ class RelayTcpBulk:
         # clients must be past the feed + close calls (pure draining)
         ok = ok & jnp.where(client, (app.to_send == 0) & app.closed_down,
                             True)
-        # EOF propagation / teardown phases are serial
-        ok = ok & ~app.up_eof & (app.fwd_pending == 0)
+        ok = ok & (app.fwd_pending == 0)
         ok = ok & jnp.where(relay | client, app.connected, True)
-        ok = ok & jnp.where(relay, ~app.closed_down, True)
+        # past-EOF hosts are fine once their close calls have been
+        # issued (the bulk pass models the EOF->close transition in the
+        # FIN's own micro-step; afterwards the app is quiescent):
+        # relays must have propagated (closed_down); servers must have
+        # taken up_conn out of the readable states
+        up = jnp.clip(app.up_conn, 0, sim.tcp.st.shape[1] - 1)
+        up_st = sim.tcp.st[jnp.arange(up.shape[0]), up]
+        # up_conn no longer in a pre-close readable state: the close
+        # was issued (LAST_ACK/teardown) or the slot was already freed
+        # by the final ACK (CLOSED). Pre-ESTABLISHED states also pass,
+        # which is fine — an up_eof host can't be mid-handshake.
+        up_done = (up_st != tcp.TcpSt.ESTABLISHED) \
+            & (up_st != tcp.TcpSt.CLOSE_WAIT)
+        ok = ok & jnp.where(
+            app.up_eof, jnp.where(relay, app.closed_down, up_done),
+            True)
         return ok
 
     def on_data(self, cfg, app, mask, slot, nread, now):
@@ -137,6 +151,31 @@ class RelayTcpBulk:
         fwd_mask = m & relay
         return app, ok, fwd_mask, app.down_sock, jnp.where(
             fwd_mask, nread, 0)
+
+    def on_eof(self, cfg, app, mask, slot, now):
+        """EOF on up_conn: the server closes it; a fully-forwarded
+        relay closes down_sock then up_conn (handler() relay_fin). A
+        FIN on any other socket (down_sock receiving the backward FIN
+        cascade) needs no app action."""
+        m = mask & (slot == app.up_conn) & ~app.up_eof
+        ok = jnp.ones(mask.shape, bool)
+        server = m & (app.role == ROLE_SERVER)
+        relay = m & (app.role == ROLE_RELAY)
+        # a relay with unforwarded bytes would defer its closes to a
+        # later wake — out of model
+        ok = ok & ~(relay & ((app.fwd_pending > 0) | ~app.connected
+                             | app.closed_down))
+        app = app.replace(
+            up_eof=app.up_eof | m,
+            done_at=jnp.where(server & (app.done_at < 0), now,
+                              app.done_at),
+        )
+        c1_mask = server | relay
+        c1_slot = jnp.where(server, app.up_conn, app.down_sock)
+        c2_mask = relay
+        c2_slot = app.up_conn
+        app = app.replace(closed_down=app.closed_down | relay)
+        return app, ok, c1_mask & ok, c1_slot, c2_mask & ok, c2_slot
 
 
 TCP_BULK = RelayTcpBulk()

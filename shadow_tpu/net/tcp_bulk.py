@@ -124,6 +124,30 @@ class TcpAppBulk:
         store-and-forward)."""
         raise NotImplementedError
 
+    def on_eof(self, cfg: NetConfig, app, mask, slot, now):
+        """Peer FIN consumed on (lane, slot) at `now` — the app's
+        tcp_recv would report EOF this micro-step. Returns
+        (app', ok[H], c1_mask, c1_slot, c2_mask, c2_slot): up to two
+        sockets the app tcp_close()s at this instant, in call order
+        (the relay closes down_sock then up_conn). ok False falls the
+        host back to serial. Default: any EOF is out of model."""
+        H = mask.shape[0]
+        z = jnp.zeros((H,), bool)
+        zi = jnp.zeros((H,), jnp.int32)
+        return app, ~mask, z, zi, z, zi
+
+
+def _gate(pred, fn, ops):
+    """lax.cond-skip a section of the scan body when no lane needs it
+    (the kind-gated-pipeline trick, net/step.py): every section is a
+    masked batch update, so all-false-mask == identity and the skip is
+    value-identical. Teardown/timer/push sections run in a tiny
+    minority of iterations but would otherwise cost their full op
+    graphs every iteration."""
+    import jax
+
+    return jax.lax.cond(pred, fn, lambda o: o, ops)
+
 
 def _flag(bad, why, cond, bit):
     """Raise the abort flag and record WHICH model boundary was hit.
@@ -192,7 +216,12 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
         # the no-chain invariant below pairs one flush's segments with
         # one drain pass; unequal bounds would chain NIC_SEND events
         return None
-    if cfg.out_ring < FLUSH_SEGMENTS:
+    if cfg.out_ring <= FLUSH_SEGMENTS:
+        # serial tcp_flush's chain decision includes an out-ring room
+        # check (room2); with out_ring == FLUSH_SEGMENTS the ring is
+        # still full of the just-packetized burst at that moment and
+        # serial STALLS the remainder — the bulk chain-on-rest rule
+        # assumes room, so it needs strictly more ring than one burst
         return None
 
     R = cfg.router_ring
@@ -256,6 +285,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             sim, bad, why, seq_ctr, it = c
             net, tcp, app = sim.net, sim.tcp, sim.app
             q, p = _pop_masked(sim.events, wend64, ~bad & elig)
+            W = q.words.shape[-1]
             v = p.valid
             t = p.time
             words = p.words
@@ -270,7 +300,14 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             proto = pf.proto_of(words)
             flags = pf.tcp_flags_of(words)
             bad, why = _flag(bad, why, (is_pkt & (proto != pf.PROTO_TCP)), 2)
-            bad, why = _flag(bad, why, (is_pkt & (flags != pf.TCPF_ACK)), 4)
+            finp = is_pkt & (flags == (pf.TCPF_FIN | pf.TCPF_ACK))
+            bad, why = _flag(bad, why, (is_pkt & (flags != pf.TCPF_ACK)
+                                        & ~finp), 4)
+            # a FIN carrying data is out of model (this stack emits
+            # dataless FINs; a retransmitted FIN+data never arises
+            # losslessly)
+            bad, why = _flag(bad, why,
+                             (finp & (words[:, pf.W_LEN] != 0)), 1 << 44)
             # arriving SACK blocks = upstream loss artifacts
             sack_any = (
                 (words[:, pf.W_SACKL] != 0) | (words[:, pf.W_SACKR] != 0)
@@ -287,8 +324,14 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             bad, why = _flag(bad, why, (is_pkt & (slot < 0)), 16)
             slot = jnp.where(slot >= 0, slot, 0)
             st = gather_hs(tcp.st, slot)
-            bad, why = _flag(bad, why, (is_pkt & ~((st == TcpSt.ESTABLISHED) | (st == TcpSt.FIN_WAIT_1))), 32)
+            # teardown states are in model; handshake (LISTEN/SYN_*),
+            # TIME_WAIT stragglers, and recycled slots are not
+            bad, why = _flag(bad, why, (is_pkt & ~(
+                (st == TcpSt.ESTABLISHED) | (st == TcpSt.FIN_WAIT_1)
+                | (st == TcpSt.FIN_WAIT_2) | (st == TcpSt.CLOSING)
+                | (st == TcpSt.CLOSE_WAIT) | (st == TcpSt.LAST_ACK))), 32)
             pkt = is_pkt & ~bad
+            finp = finp & ~bad
 
             seqno = words[:, pf.W_SEQ]
             ackno = words[:, pf.W_ACK]
@@ -296,13 +339,20 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             peer_win = words[:, pf.W_WIN]
             tsval = words[:, pf.W_TSVAL]
             tsecho = words[:, pf.W_TSECHO]
-            is_data = pkt & (length > 0)
-            is_ack = pkt & (length == 0)
+            is_data = pkt & (length > 0) & ~finp
+            is_ack = pkt & (length == 0) & ~finp
+            # data only reaches sockets in the serial has_data states
+            bad, why = _flag(bad, why, (is_data & ~(
+                (st == TcpSt.ESTABLISHED) | (st == TcpSt.FIN_WAIT_1)
+                | (st == TcpSt.FIN_WAIT_2))), 1 << 45)
+            is_data = is_data & ~bad
 
             # loss / reorder artifacts abort: the model only covers the
-            # exactly-in-order case (seq == rcv_nxt)
+            # exactly-in-order case (seq == rcv_nxt), for data AND FINs
             rcv_nxt = gather_hs(tcp.rcv_nxt, slot)
             bad, why = _flag(bad, why, (is_data & (seqno != rcv_nxt)), 64)
+            bad, why = _flag(bad, why, (finp & (seqno != rcv_nxt)),
+                             1 << 46)
             # socket-level out-of-model state
             sc = jnp.clip(slot, 0, S - 1)
             oo_any = jnp.any(tcp.oo_r[rows, sc] > tcp.oo_l[rows, sc],
@@ -310,7 +360,12 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             sk_any = jnp.any(tcp.sack_r[rows, sc] > tcp.sack_l[rows, sc],
                              axis=1)
             bad, why = _flag(bad, why, (pkt & (oo_any | sk_any)), 128)
-            bad, why = _flag(bad, why, (pkt & gather_hs(tcp.fin_rcvd, slot)), 256)
+            # pure ACKs to a socket whose peer already FINed are fine
+            # (the final ACK of our FIN in LAST_ACK/CLOSING); data or a
+            # re-FIN after the peer's FIN are not
+            bad, why = _flag(bad, why, ((is_data | finp)
+                                        & gather_hs(tcp.fin_rcvd, slot)),
+                             256)
             bad, why = _flag(bad, why, (pkt & (gather_hs(tcp.dup_acks, slot) > 0)), 512)
             bad, why = _flag(bad, why, (pkt & gather_hs(tcp.in_recovery, slot)), 1024)
             pkt = pkt & ~bad
@@ -379,7 +434,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             bad, why = _flag(bad, why, (pkt & (ackno > smax)), 4096)
             bad, why = _flag(bad, why, (new_ack & (ackno > nxt)), 8192)
             dup_ack = pkt & (ackno == una) & (una < nxt) & (length == 0) \
-                & (peer_win == wnd_prev)
+                & (peer_win == wnd_prev) & ~finp   # ~f_fin per RFC 5681
             bad, why = _flag(bad, why, dup_ack, 16384)
             # a DATA segment whose embedded ack also advances our send
             # side (bidirectional stream on one socket) would need two
@@ -440,36 +495,44 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 RECV_BUFFER_MIN, SEND_BUFFER_MIN)
 
             at_init = first & ~gather_hs(tcp.at_init_done, slot)
-            peer_ip_sl = gather_hs(net.sk_peer_ip, slot)
-            self_ip = net.host_ip[lane]
-            is_loop = (peer_ip_sl == self_ip) | ((peer_ip_sl >> 24) == 127)
-            rtt_topo_ms = jnp.maximum(
-                (gather_hs(lat_s, slot) + gather_hs(lat_rev_s, slot))
-                // simtime.ONE_MILLISECOND, 1)
-            my_up = net.bw_up_kibps[lane]
-            my_down = net.bw_down_kibps[lane]
-            bdp_snd = rtt_topo_ms * jnp.minimum(
-                my_up, gather_hs(peer_down_s, slot)) * 1280 // 1000
-            bdp_rcv = rtt_topo_ms * jnp.minimum(
-                my_down, gather_hs(peer_up_s, slot)) * 1280 // 1000
-            init_snd = jnp.where(
-                is_loop, TCP_WMEM_MAX,
-                jnp.clip(bdp_snd, SEND_BUFFER_MIN, TCP_WMEM_MAX)
-            ).astype(I32)
-            init_rcv = jnp.where(
-                is_loop, TCP_RMEM_MAX,
-                jnp.clip(bdp_rcv, RECV_BUFFER_MIN, TCP_RMEM_MAX)
-            ).astype(I32)
-            net = net.replace(
-                sk_sndbuf=set_hs(net.sk_sndbuf,
-                                 at_init & net.autotune_snd, slot,
-                                 init_snd),
-                sk_rcvbuf=set_hs(net.sk_rcvbuf,
-                                 at_init & net.autotune_rcv, slot,
-                                 init_rcv))
-            tcp = tcp.replace(at_init_done=set_hs(
-                tcp.at_init_done, at_init, slot, True))
 
+            def _at_init_sec(ops):
+                net, tcp = ops
+                peer_ip_sl = gather_hs(net.sk_peer_ip, slot)
+                self_ip = net.host_ip[lane]
+                is_loop = (peer_ip_sl == self_ip) | ((peer_ip_sl >> 24) == 127)
+                rtt_topo_ms = jnp.maximum(
+                    (gather_hs(lat_s, slot) + gather_hs(lat_rev_s, slot))
+                    // simtime.ONE_MILLISECOND, 1)
+                my_up = net.bw_up_kibps[lane]
+                my_down = net.bw_down_kibps[lane]
+                bdp_snd = rtt_topo_ms * jnp.minimum(
+                    my_up, gather_hs(peer_down_s, slot)) * 1280 // 1000
+                bdp_rcv = rtt_topo_ms * jnp.minimum(
+                    my_down, gather_hs(peer_up_s, slot)) * 1280 // 1000
+                init_snd = jnp.where(
+                    is_loop, TCP_WMEM_MAX,
+                    jnp.clip(bdp_snd, SEND_BUFFER_MIN, TCP_WMEM_MAX)
+                ).astype(I32)
+                init_rcv = jnp.where(
+                    is_loop, TCP_RMEM_MAX,
+                    jnp.clip(bdp_rcv, RECV_BUFFER_MIN, TCP_RMEM_MAX)
+                ).astype(I32)
+                net = net.replace(
+                    sk_sndbuf=set_hs(net.sk_sndbuf,
+                                     at_init & net.autotune_snd, slot,
+                                     init_snd),
+                    sk_rcvbuf=set_hs(net.sk_rcvbuf,
+                                     at_init & net.autotune_rcv, slot,
+                                     init_rcv))
+                tcp = tcp.replace(at_init_done=set_hs(
+                    tcp.at_init_done, at_init, slot, True))
+                return net, tcp
+
+            net, tcp = _gate(jnp.any(at_init), _at_init_sec,
+                             (net, tcp))
+
+            my_up = net.bw_up_kibps[lane]
             # send-buffer autotune growth (ref: tcp.c:566-592)
             srtt_now = jnp.maximum(jnp.where(sample, srtt_n, srtt),
                                    0).astype(I64)
@@ -486,13 +549,9 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             wroom = new_ack & (
                 gather_hs(net.sk_sndbuf, slot)
                 - (gather_hs(tcp.snd_end, slot) - ackno) > 0)
-            fl_w = gather_hs(net.sk_flags, slot)
-            edge_w = wroom & ((fl_w & SocketFlags.WRITABLE) == 0)
-            net = net.replace(
-                sk_flags=set_hs(net.sk_flags, wroom, slot,
-                                fl_w | SocketFlags.WRITABLE),
-                sk_out_gen=set_hs(net.sk_out_gen, edge_w, slot,
-                                  gather_hs(net.sk_out_gen, slot) + 1))
+            from shadow_tpu.net.sockets import set_writable
+
+            net = set_writable(net, wroom, slot, True)
 
             # RTO deadline after progress (ref: tcp.c ACK path)
             still_out = new_ack & (ackno < smax)
@@ -506,6 +565,52 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             tcp = tcp.replace(rtx_expire=set_hs(
                 tcp.rtx_expire, done_ack, slot,
                 jnp.full((H,), simtime.INVALID, I64)))
+
+            # ===== ACK of our FIN: teardown transitions ===============
+            # (ref: tcp.c teardown + tcp_bulk ordering note: serial
+            # runs this after its ACK-path flush; with the flush moved
+            # later the values are unchanged because a fin_acked lane
+            # never has data left to flush — all bytes incl. the FIN
+            # are acked.) LAST_ACK frees the socket via the REAL
+            # _free_socket so the recycled-slot reset is by definition
+            # identical.
+            from shadow_tpu.net.tcp import (
+                TIMEWAIT_NS, _free_socket as _tcp_free)
+
+            fin_ever_any = pkt & gather_hs(tcp.fin_pending, slot)
+
+            def _fin_acked_sec(ops):
+                net, tcp, q, seq_ctr, bad, why = ops
+                smax_fa = gather_hs(tcp.snd_max, slot)
+                fin_ever_fa = gather_hs(tcp.fin_pending, slot) & (
+                    smax_fa == gather_hs(tcp.snd_end, slot) + 1)
+                fin_acked = pkt & fin_ever_fa & (ackno == smax_fa)
+                st_fa = gather_hs(tcp.st, slot)
+                tcp = tcp.replace(st=set_hs(
+                    tcp.st, fin_acked & (st_fa == TcpSt.FIN_WAIT_1), slot,
+                    jnp.full((H,), TcpSt.FIN_WAIT_2, I32)))
+                tw1 = fin_acked & (st_fa == TcpSt.CLOSING)
+                tcp = tcp.replace(st=set_hs(
+                    tcp.st, tw1, slot,
+                    jnp.full((H,), TcpSt.TIME_WAIT, I32)))
+                closed_now = fin_acked & (st_fa == TcpSt.LAST_ACK)
+                sim_fs = sim.replace(net=net, tcp=tcp)
+                sim_fs = _tcp_free(cfg, sim_fs, closed_now, slot)
+                net, tcp = sim_fs.net, sim_fs.tcp
+                tww = jnp.zeros((H, W), I32).at[:, 0].set(
+                    slot.astype(I32))
+                free_tw = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, tw1 & ~free_tw, 1 << 47)
+                tw1e = tw1 & ~bad
+                q = _push_local(q, tw1e, t + TIMEWAIT_NS,
+                                EventKind.TCP_CLOSE_TIMER, tww, lane,
+                                seq_ctr)
+                seq_ctr = seq_ctr + tw1e.astype(I32)
+                return net, tcp, q, seq_ctr, bad, why
+
+            net, tcp, q, seq_ctr, bad, why = _gate(
+                jnp.any(fin_ever_any), _fin_acked_sec,
+                (net, tcp, q, seq_ctr, bad, why))
 
             # ===== in-order data receive ==============================
             freeb = gather_hs(net.sk_rcvbuf, slot) \
@@ -527,12 +632,69 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                  gather_hs(net.sk_in_gen, slot) + 1),
             )
 
+            # ===== peer FIN (ref: tcp.c FIN processing) ===============
+            # in-order only (seq == rcv_nxt checked above), so the FIN
+            # consumes immediately: rcv_nxt+1, state transition, EOF
+            # readability edge; FIN_WAIT_2 arms the TIME_WAIT reaper
+            fin_now = finp & ~bad
+
+            def _peer_fin_sec(ops):
+                net, tcp, q, seq_ctr, bad, why = ops
+                st_fp = gather_hs(tcp.st, slot)
+                tcp = tcp.replace(
+                    fin_rcvd=set_hs(tcp.fin_rcvd, fin_now, slot, True),
+                    fin_rseq=set_hs(tcp.fin_rseq, fin_now, slot, seqno),
+                )
+                tcp = tcp.replace(rcv_nxt=set_hs(
+                    tcp.rcv_nxt, fin_now, slot,
+                    gather_hs(tcp.rcv_nxt, slot) + 1))
+                to_cw = fin_now & (st_fp == TcpSt.ESTABLISHED)
+                to_closing = fin_now & (st_fp == TcpSt.FIN_WAIT_1)
+                to_tw = fin_now & (st_fp == TcpSt.FIN_WAIT_2)
+                bad, why = _flag(bad, why,
+                                 fin_now & ~(to_cw | to_closing | to_tw),
+                                 1 << 48)
+                tcp = tcp.replace(st=set_hs(
+                    tcp.st, to_cw, slot,
+                    jnp.full((H,), TcpSt.CLOSE_WAIT, I32)))
+                tcp = tcp.replace(st=set_hs(
+                    tcp.st, to_closing, slot,
+                    jnp.full((H,), TcpSt.CLOSING, I32)))
+                tcp = tcp.replace(st=set_hs(
+                    tcp.st, to_tw, slot,
+                    jnp.full((H,), TcpSt.TIME_WAIT, I32)))
+                tw2 = to_tw & ~bad
+                free_tw2 = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, tw2 & ~free_tw2, 1 << 49)
+                tw2 = tw2 & ~bad
+                tww2 = jnp.zeros((H, W), I32).at[:, 0].set(
+                    slot.astype(I32))
+                q = _push_local(q, tw2, t + TIMEWAIT_NS,
+                                EventKind.TCP_CLOSE_TIMER, tww2, lane,
+                                seq_ctr)
+                seq_ctr = seq_ctr + tw2.astype(I32)
+                fl_f = gather_hs(net.sk_flags, slot)
+                net = net.replace(
+                    sk_flags=set_hs(net.sk_flags, fin_now, slot,
+                                    fl_f | SocketFlags.READABLE),
+                    sk_in_gen=set_hs(net.sk_in_gen, fin_now, slot,
+                                     gather_hs(net.sk_in_gen, slot) + 1),
+                )
+                return net, tcp, q, seq_ctr, bad, why
+
+            net, tcp, q, seq_ctr, bad, why = _gate(
+                jnp.any(fin_now), _peer_fin_sec,
+                (net, tcp, q, seq_ctr, bad, why))
+
             # delayed-ACK scheduling (ref: tcp.c:2066-2091) — the push
-            # is the FIRST emission of this micro-step (seq order)
+            # is the FIRST emission of this micro-step's ACK-generation
+            # stage (seq order); a consumed FIN coalesces its ACK like
+            # in-order data (tcp.c:2066-2091 `delayed = inorder|fin`)
+            ackable = is_data | (fin_now & ~bad)
             cnt = gather_hs(tcp.dack_counter, slot) + 1
             tcp = tcp.replace(dack_counter=set_hs(
-                tcp.dack_counter, is_data, slot, cnt))
-            sched = is_data & ~gather_hs(tcp.dack_scheduled, slot)
+                tcp.dack_counter, ackable, slot, cnt))
+            sched = ackable & ~gather_hs(tcp.dack_scheduled, slot)
             nq = gather_hs(tcp.quick_acks, slot)
             quick = nq < DACK_QUICK_LIMIT
             ddelay = jnp.where(quick, DACK_QUICK_NS, DACK_SLOW_NS)
@@ -541,15 +703,21 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                   nq + 1),
                 dack_scheduled=set_hs(tcp.dack_scheduled, sched, slot,
                                       True))
-            W = q.words.shape[-1]
-            dkw = jnp.zeros((H, W), I32)
-            dkw = dkw.at[:, 0].set(slot.astype(I32))
-            dkw = dkw.at[:, 1].set(gather_hs(tcp.dack_gen, slot))
-            free_before = jnp.any(q.time == simtime.INVALID, axis=1)
-            bad, why = _flag(bad, why, (sched & ~free_before), 131072)
-            q = _push_local(q, sched & ~bad, t + ddelay,
-                            EventKind.TCP_DACK_TIMER, dkw, lane, seq_ctr)
-            seq_ctr = seq_ctr + (sched & ~bad).astype(I32)
+            def _dack_push(ops):
+                q, seq_ctr, bad, why = ops
+                dkw = jnp.zeros((H, W), I32)
+                dkw = dkw.at[:, 0].set(slot.astype(I32))
+                dkw = dkw.at[:, 1].set(gather_hs(tcp.dack_gen, slot))
+                free_before = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, (sched & ~free_before), 131072)
+                q = _push_local(q, sched & ~bad, t + ddelay,
+                                EventKind.TCP_DACK_TIMER, dkw, lane,
+                                seq_ctr)
+                seq_ctr = seq_ctr + (sched & ~bad).astype(I32)
+                return q, seq_ctr, bad, why
+
+            q, seq_ctr, bad, why = _gate(jnp.any(sched), _dack_push,
+                                         (q, seq_ctr, bad, why))
 
             # ===== app consume + forward ==============================
             app, app_okm, fwd_mask, fwd_slot, fwd_bytes = app_bulk.on_data(
@@ -600,6 +768,60 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             win_after = gather_hs(net.sk_rcvbuf, slot)
             bad, why = _flag(bad, why, (is_data & (win_before < 2 * MSS) & (win_after - win_before >= MSS)), 524288)
 
+            # ===== app EOF: the teardown cascade ======================
+            # The serial app observes eof in its tcp_recv on the FIN's
+            # own micro-step and issues its closes right there (relay
+            # handler: server closes up_conn; a drained relay closes
+            # down_sock then up_conn). The hook returns up to two close
+            # targets in that order; tcp_close semantics
+            # (ref: tcp.c:604-699) applied inline, FIN rides via the
+            # flush below.
+            zb = jnp.zeros((H,), bool)
+            zi32 = jnp.zeros((H,), I32)
+
+            def _eof_sec(ops):
+                app, tcp, bad, why, _, _, _, _ = ops
+                app, eof_ok, c1_mask, c1_slot, c2_mask, c2_slot = \
+                    app_bulk.on_eof(cfg, app, fin_now & ~bad, slot, t)
+                bad, why = _flag(bad, why, (fin_now & ~eof_ok), 1 << 50)
+                c1_mask = c1_mask & fin_now & ~bad
+                c2_mask = c2_mask & fin_now & ~bad
+                c1_slot = jnp.asarray(c1_slot, I32)
+                c2_slot = jnp.asarray(c2_slot, I32)
+
+                def close_transitions(tcp, bad, why, cm, cs, bit):
+                    cst = gather_hs(tcp.st, cs)
+                    to_fw1 = cm & ((cst == TcpSt.ESTABLISHED)
+                                   | (cst == TcpSt.SYN_RCVD))
+                    to_la = cm & (cst == TcpSt.CLOSE_WAIT)
+                    # other close paths (deferred SYN_SENT, direct
+                    # frees, re-close) are out of model
+                    bad, why = _flag(bad, why, cm & ~(to_fw1 | to_la),
+                                     bit)
+                    tcp = tcp.replace(st=set_hs(
+                        tcp.st, to_fw1 & ~bad, cs,
+                        jnp.full((H,), TcpSt.FIN_WAIT_1, I32)))
+                    tcp = tcp.replace(st=set_hs(
+                        tcp.st, to_la & ~bad, cs,
+                        jnp.full((H,), TcpSt.LAST_ACK, I32)))
+                    tcp = tcp.replace(fin_pending=set_hs(
+                        tcp.fin_pending, cm & ~bad, cs, True))
+                    return tcp, bad, why
+
+                tcp, bad, why = close_transitions(tcp, bad, why,
+                                                  c1_mask, c1_slot,
+                                                  1 << 51)
+                tcp, bad, why = close_transitions(tcp, bad, why,
+                                                  c2_mask, c2_slot,
+                                                  1 << 52)
+                return (app, tcp, bad, why, c1_mask & ~bad, c1_slot,
+                        c2_mask & ~bad, c2_slot)
+
+            (app, tcp, bad, why, c1_mask, c1_slot, c2_mask,
+             c2_slot) = _gate(
+                jnp.any(fin_now), _eof_sec,
+                (app, tcp, bad, why, zb, zi32, zb, zi32))
+
             # tcp_send semantics on the forward socket (full accept or
             # abort; ref: tcp_sendUserData, tcp.c:2126-2190)
             fsl = jnp.where(fwd_mask, fwd_slot, 0)
@@ -627,9 +849,11 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             tcp = tcp.replace(flush_pending=set_hs(
                 tcp.flush_pending, is_fl, flslot, False))
             reopened = is_ack & (wnd_prev == 0) & (peer_win > 0)
-            fl_mask = can_send | new_ack | reopened | is_fl
+            fl_mask = can_send | new_ack | reopened | is_fl | c1_mask
             fslot = jnp.where(can_send, fsl,
-                              jnp.where(is_fl, flslot, slot))
+                              jnp.where(is_fl, flslot,
+                                        jnp.where(c1_mask, c1_slot,
+                                                  slot)))
             g_una = gather_hs(tcp.snd_una, fslot)
             g_nxt = gather_hs(tcp.snd_nxt, fslot)
             g_end = gather_hs(tcp.snd_end, fslot)
@@ -650,28 +874,38 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             A_now = jnp.minimum(A, FLUSH_SEGMENTS * MSS)
             n_seg = (A_now + MSS - 1) // MSS
             rest = A - A_now
-            # FIN would ride once all data is packetized => out of model
-            bad, why = _flag(bad, why, (fl_mask & gather_hs(tcp.fin_pending, fslot) & (g_nxt + A_now == g_end)), 16777216)
             fl_mask = fl_mask & ~bad
             n_seg = jnp.where(fl_mask, n_seg, 0)
             A_now = jnp.where(fl_mask, A_now, 0)
+            # the FIN rides once all data is packetized (ref: tcp_flush
+            # FIN tail; self-guarding — after it, snd_nxt = end + 1)
+            fin1 = fl_mask & gather_hs(tcp.fin_pending, fslot) \
+                & (g_nxt + A_now == g_end) & (rest == 0)
+            nxt_after = g_nxt + A_now + fin1.astype(I32)
             tcp = tcp.replace(
-                snd_nxt=set_hs(tcp.snd_nxt, fl_mask, fslot,
-                               g_nxt + A_now),
+                snd_nxt=set_hs(tcp.snd_nxt, fl_mask, fslot, nxt_after),
                 snd_max=set_hs(tcp.snd_max, fl_mask, fslot,
                                jnp.maximum(gather_hs(tcp.snd_max, fslot),
-                                           g_nxt + A_now)))
+                                           nxt_after)))
             chain = fl_mask & (rest > 0) & ~gather_hs(
                 tcp.flush_pending, fslot)
-            tcp = tcp.replace(flush_pending=set_hs(
-                tcp.flush_pending, chain, fslot, True))
-            cw_ = jnp.zeros((H, W), I32).at[:, 0].set(fslot.astype(I32))
-            free_c = jnp.any(q.time == simtime.INVALID, axis=1)
-            bad, why = _flag(bad, why, chain & ~free_c, 1 << 42)
-            chain = chain & ~bad
-            q = _push_local(q, chain, t, EventKind.TCP_FLUSH, cw_, lane,
-                            seq_ctr)
-            seq_ctr = seq_ctr + chain.astype(I32)
+
+            def _chain_push(ops):
+                tcp, q, seq_ctr, bad, why = ops
+                tcp = tcp.replace(flush_pending=set_hs(
+                    tcp.flush_pending, chain, fslot, True))
+                cw_ = jnp.zeros((H, W), I32).at[:, 0].set(
+                    fslot.astype(I32))
+                free_c = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, chain & ~free_c, 1 << 42)
+                ch = chain & ~bad
+                q = _push_local(q, ch, t, EventKind.TCP_FLUSH, cw_,
+                                lane, seq_ctr)
+                seq_ctr = seq_ctr + ch.astype(I32)
+                return tcp, q, seq_ctr, bad, why
+
+            tcp, q, seq_ctr, bad, why = _gate(
+                jnp.any(chain), _chain_push, (tcp, q, seq_ctr, bad, why))
 
             # RTO arm after flush (ref: tcp_flush tail + _arm_rtx)
             h_una = gather_hs(tcp.snd_una, fslot)
@@ -683,86 +917,183 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             outstanding = fl_mask & (h_una < h_nxt)
             need = outstanding & (
                 gather_hs(tcp.rtx_expire, fslot) == simtime.INVALID)
-            rto_arm = (gather_hs(tcp.rto_ms, fslot).astype(I64)
-                       << jnp.minimum(gather_hs(tcp.backoff, fslot),
-                                      MAX_BACKOFF).astype(I64)) \
-                * simtime.ONE_MILLISECOND
-            rto_arm = jnp.minimum(rto_arm,
-                                  I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
-            deadline = t + rto_arm
-            tcp = tcp.replace(rtx_expire=set_hs(tcp.rtx_expire, need,
-                                                fslot, deadline))
-            in_flight = gather_hs(tcp.rtx_event, fslot)
-            earlier = need & in_flight & (
-                deadline < gather_hs(tcp.rtx_fire, fslot))
-            need_event = (need & ~in_flight) | earlier
-            bad, why = _flag(bad, why, (need_event & (deadline < wend64)), 67108864)
-            need_event = need_event & ~bad
-            gen = gather_hs(tcp.rtx_gen, fslot) + 1
-            tcp = tcp.replace(
-                rtx_gen=set_hs(tcp.rtx_gen, need_event, fslot, gen),
-                rtx_event=set_hs(tcp.rtx_event, need_event, fslot, True),
-                rtx_fire=set_hs(tcp.rtx_fire, need_event, fslot, deadline))
-            rw = jnp.zeros((H, W), I32)
-            rw = rw.at[:, 0].set(fslot.astype(I32))
-            rw = rw.at[:, 1].set(gen)
-            free_b = jnp.any(q.time == simtime.INVALID, axis=1)
-            bad, why = _flag(bad, why, (need_event & ~free_b), 134217728)
-            q = _push_local(q, need_event & ~bad, deadline,
-                            EventKind.TCP_RTX_TIMER, rw, lane, seq_ctr)
-            seq_ctr = seq_ctr + (need_event & ~bad).astype(I32)
+
+            def _arm_sec(ops):
+                tcp, q, seq_ctr, bad, why = ops
+                rto_arm = (gather_hs(tcp.rto_ms, fslot).astype(I64)
+                           << jnp.minimum(gather_hs(tcp.backoff, fslot),
+                                          MAX_BACKOFF).astype(I64)) \
+                    * simtime.ONE_MILLISECOND
+                rto_arm = jnp.minimum(
+                    rto_arm, I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
+                deadline = t + rto_arm
+                tcp = tcp.replace(rtx_expire=set_hs(
+                    tcp.rtx_expire, need, fslot, deadline))
+                in_flight = gather_hs(tcp.rtx_event, fslot)
+                earlier = need & in_flight & (
+                    deadline < gather_hs(tcp.rtx_fire, fslot))
+                need_event = (need & ~in_flight) | earlier
+                bad, why = _flag(
+                    bad, why, (need_event & (deadline < wend64)),
+                    67108864)
+                need_event = need_event & ~bad
+                gen = gather_hs(tcp.rtx_gen, fslot) + 1
+                tcp = tcp.replace(
+                    rtx_gen=set_hs(tcp.rtx_gen, need_event, fslot, gen),
+                    rtx_event=set_hs(tcp.rtx_event, need_event, fslot,
+                                     True),
+                    rtx_fire=set_hs(tcp.rtx_fire, need_event, fslot,
+                                    deadline))
+                rw = jnp.zeros((H, W), I32)
+                rw = rw.at[:, 0].set(fslot.astype(I32))
+                rw = rw.at[:, 1].set(gen)
+                free_b = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, (need_event & ~free_b),
+                                 134217728)
+                q = _push_local(q, need_event & ~bad, deadline,
+                                EventKind.TCP_RTX_TIMER, rw, lane,
+                                seq_ctr)
+                seq_ctr = seq_ctr + (need_event & ~bad).astype(I32)
+                return tcp, q, seq_ctr, bad, why
+
+            tcp, q, seq_ctr, bad, why = _gate(
+                jnp.any(need), _arm_sec, (tcp, q, seq_ctr, bad, why))
+
+            # ===== secondary close (relay dual-close, tcp_close #2) ===
+            # up_conn: no stream data, so its flush reduces to the FIN
+            # + the RTO arm (ref: tcp_close -> tcp_flush on a drained
+            # CLOSE_WAIT socket)
+            g2_nxt = gather_hs(tcp.snd_nxt, c2_slot)
+
+            def _c2_sec(ops):
+                tcp, q, seq_ctr, bad, why, _ = ops
+                g2_end = gather_hs(tcp.snd_end, c2_slot)
+                bad, why = _flag(bad, why,
+                                 (c2_mask & (g2_end != g2_nxt)), 1 << 53)
+                fin2 = c2_mask & ~bad & gather_hs(tcp.fin_pending,
+                                                  c2_slot)
+                tcp = tcp.replace(
+                    snd_nxt=set_hs(tcp.snd_nxt, fin2, c2_slot,
+                                   g2_nxt + 1),
+                    snd_max=set_hs(tcp.snd_max, fin2, c2_slot,
+                                   jnp.maximum(
+                                       gather_hs(tcp.snd_max, c2_slot),
+                                       g2_nxt + 1)))
+                need2 = fin2 & (gather_hs(tcp.rtx_expire, c2_slot)
+                                == simtime.INVALID)
+                rto2 = (gather_hs(tcp.rto_ms, c2_slot).astype(I64)
+                        << jnp.minimum(gather_hs(tcp.backoff, c2_slot),
+                                       MAX_BACKOFF).astype(I64)) \
+                    * simtime.ONE_MILLISECOND
+                rto2 = jnp.minimum(
+                    rto2, I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
+                dl2 = t + rto2
+                tcp = tcp.replace(rtx_expire=set_hs(
+                    tcp.rtx_expire, need2, c2_slot, dl2))
+                inflt2 = gather_hs(tcp.rtx_event, c2_slot)
+                earl2 = need2 & inflt2 & (
+                    dl2 < gather_hs(tcp.rtx_fire, c2_slot))
+                nev2 = (need2 & ~inflt2) | earl2
+                bad, why = _flag(bad, why, (nev2 & (dl2 < wend64)),
+                                 1 << 54)
+                nev2 = nev2 & ~bad
+                gen2 = gather_hs(tcp.rtx_gen, c2_slot) + 1
+                tcp = tcp.replace(
+                    rtx_gen=set_hs(tcp.rtx_gen, nev2, c2_slot, gen2),
+                    rtx_event=set_hs(tcp.rtx_event, nev2, c2_slot, True),
+                    rtx_fire=set_hs(tcp.rtx_fire, nev2, c2_slot, dl2))
+                rw2 = (jnp.zeros((H, W), I32)
+                       .at[:, 0].set(c2_slot.astype(I32))
+                       .at[:, 1].set(gen2))
+                free_2 = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, nev2 & ~free_2, 1 << 55)
+                nev2 = nev2 & ~bad
+                q = _push_local(q, nev2, dl2, EventKind.TCP_RTX_TIMER,
+                                rw2, lane, seq_ctr)
+                seq_ctr = seq_ctr + nev2.astype(I32)
+                return tcp, q, seq_ctr, bad, why, fin2
+
+            tcp, q, seq_ctr, bad, why, fin2 = _gate(
+                jnp.any(c2_mask), _c2_sec,
+                (tcp, q, seq_ctr, bad, why, zb))
 
             # ===== DACK fire ==========================================
             dgen = p.word(1)
             dslot = jnp.where(is_dk, p.word(0), 0)
-            live_dk = is_dk & (dgen == gather_hs(tcp.dack_gen, dslot))
-            tcp = tcp.replace(dack_scheduled=set_hs(
-                tcp.dack_scheduled, live_dk, dslot, False))
-            fire = live_dk & (gather_hs(tcp.dack_counter, dslot) > 0)
-            tcp = tcp.replace(dack_counter=set_hs(
-                tcp.dack_counter, fire, dslot, jnp.zeros((H,), I32)))
+
+            def _dack_fire_sec(ops):
+                tcp, _ = ops
+                live_dk = is_dk & (dgen == gather_hs(tcp.dack_gen,
+                                                     dslot))
+                tcp = tcp.replace(dack_scheduled=set_hs(
+                    tcp.dack_scheduled, live_dk, dslot, False))
+                fire = live_dk & (gather_hs(tcp.dack_counter, dslot) > 0)
+                tcp = tcp.replace(dack_counter=set_hs(
+                    tcp.dack_counter, fire, dslot, jnp.zeros((H,), I32)))
+                return tcp, fire
+
+            tcp, fire = _gate(jnp.any(is_dk), _dack_fire_sec, (tcp, zb))
 
             # ===== RTX timer fire (ref: handle_tcp_rtx) ===============
             # stale generations die; a disarmed deadline clears the
             # in-flight flag; a deadline that MOVED later re-emits the
             # covering event. A DUE deadline is a real RTO — loss
             # recovery is out of model.
-            rgen = p.word(1)
-            rslot = jnp.where(is_rtx, p.word(0), 0)
-            live_rtx = is_rtx & (rgen == gather_hs(tcp.rtx_gen, rslot))
-            rdl = gather_hs(tcp.rtx_expire, rslot)
-            r_disarm = live_rtx & (rdl == simtime.INVALID)
-            r_pending = live_rtx & ~r_disarm & (t < rdl)
-            r_due = live_rtx & ~r_disarm & ~r_pending
-            bad, why = _flag(bad, why, r_due, 1 << 40)
-            tcp = tcp.replace(rtx_event=set_hs(
-                tcp.rtx_event, r_disarm, rslot, False))
-            r_emit = r_pending & ~bad
-            xw = jnp.zeros((H, W), I32)
-            xw = xw.at[:, 0].set(rslot.astype(I32))
-            xw = xw.at[:, 1].set(rgen)
-            free_x = jnp.any(q.time == simtime.INVALID, axis=1)
-            bad, why = _flag(bad, why, r_emit & ~free_x, 1 << 41)
-            r_emit = r_emit & ~bad
-            q = _push_local(q, r_emit, rdl, EventKind.TCP_RTX_TIMER, xw,
-                            lane, seq_ctr)
-            seq_ctr = seq_ctr + r_emit.astype(I32)
-            tcp = tcp.replace(rtx_fire=set_hs(
-                tcp.rtx_fire, r_emit, rslot, rdl))
+            def _rtx_fire_sec(ops):
+                tcp, q, seq_ctr, bad, why = ops
+                rgen = p.word(1)
+                rslot = jnp.where(is_rtx, p.word(0), 0)
+                live_rtx = is_rtx & (rgen == gather_hs(tcp.rtx_gen,
+                                                       rslot))
+                rdl = gather_hs(tcp.rtx_expire, rslot)
+                r_disarm = live_rtx & (rdl == simtime.INVALID)
+                r_pending = live_rtx & ~r_disarm & (t < rdl)
+                r_due = live_rtx & ~r_disarm & ~r_pending
+                bad, why = _flag(bad, why, r_due, 1 << 40)
+                tcp = tcp.replace(rtx_event=set_hs(
+                    tcp.rtx_event, r_disarm, rslot, False))
+                r_emit = r_pending & ~bad
+                xw = jnp.zeros((H, W), I32)
+                xw = xw.at[:, 0].set(rslot.astype(I32))
+                xw = xw.at[:, 1].set(rgen)
+                free_x = jnp.any(q.time == simtime.INVALID, axis=1)
+                bad, why = _flag(bad, why, r_emit & ~free_x, 1 << 41)
+                r_emit = r_emit & ~bad
+                q = _push_local(q, r_emit, rdl, EventKind.TCP_RTX_TIMER,
+                                xw, lane, seq_ctr)
+                seq_ctr = seq_ctr + r_emit.astype(I32)
+                tcp = tcp.replace(rtx_fire=set_hs(
+                    tcp.rtx_fire, r_emit, rslot, rdl))
+                return tcp, q, seq_ctr, bad, why
+
+            tcp, q, seq_ctr, bad, why = _gate(
+                jnp.any(is_rtx), _rtx_fire_sec,
+                (tcp, q, seq_ctr, bad, why))
 
             # ===== wire: out-ring cycle + stamps + outbox =============
-            # Packets this micro-step: n_seg data segments on fslot, or
-            # one pure ACK on dslot. Mutually exclusive per lane.
+            # Primary burst: n_seg data segments (+ the FIN tail) on
+            # fslot, or one pure ACK on dslot — mutually exclusive per
+            # lane. A relay dual-close adds ONE secondary FIN on
+            # c2_slot, wired after the primary burst (FIFO priority
+            # order, exactly the serial drain).
             wslot = jnp.where(fire, dslot, fslot)
-            n_pkt = jnp.where(fire, 1, n_seg)
-            sending = (fire | (n_seg > 0)) & ~bad
+            n_pkt = jnp.where(fire, 1, n_seg + fin1.astype(I32))
+            # the serial NIC wires at most nic_drain (== FLUSH_SEGMENTS)
+            # packets per micro-step and chains a NIC_SEND for the rest
+            # — a burst past that bound (4 data + FIN, or a dual-close
+            # FIN pair on top of data) is out of model
+            bad, why = _flag(bad, why,
+                             (n_pkt + fin2.astype(I32) > FLUSH_SEGMENTS),
+                             1 << 39)
+            sending = (fire | (n_seg > 0) | fin1) & ~bad
+            fin2 = fin2 & ~bad
             n_pkt = jnp.where(sending, n_pkt, 0)
 
             # refill the send bucket at t (drain-entry refill); the
             # arrival path refilled already (same quantum -> no-op)
             dq2 = jnp.maximum(t // simtime.ONE_MILLISECOND
                               - net.tb_quantum, 0)
-            refresh2 = sending & (dq2 > 0)
+            refresh2 = (sending | fin2) & (dq2 > 0)
             send_tok = jnp.minimum(net.tb_send_refill + pf.MTU,
                                    net.tb_send_tokens
                                    + dq2 * net.tb_send_refill)
@@ -811,43 +1142,50 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
             emitted = jnp.zeros((H,), I32)
             ob_count = out.count
             ob_over = jnp.zeros((H,), bool)
-            for j in range(FLUSH_SEGMENTS):
-                pj = sending & (j < n_pkt)
-                lenj = jnp.where(
-                    fire, 0,
-                    jnp.clip(A_now - j * MSS, 0, MSS)).astype(I32)
-                seqj = seg_base + j * MSS
+            def wire_one(state, pj, lenj, seqj, flagsj, stamps, j_ctr):
+                """Wire ONE packet per masked lane: token policing,
+                enqueue-time words + wire stamps, the reliability draw
+                at the running counter, the outbox append. `state` =
+                (out, bad, why, last_drop, drops, tx_wl, emitted,
+                ob_over); stamps = (ack, win, tse, sport, dport, dip,
+                dsth, lat, rel)."""
+                (out, bad, why, last_drop, drops, tx_wl, emitted,
+                 ob_over) = state
+                (s_ack, s_win, s_tse, s_sport, s_dport, s_dip, s_dsth,
+                 s_lat, s_rel) = stamps
                 wlj = pf.wire_length(jnp.full((H,), pf.PROTO_TCP, I32),
                                      lenj).astype(I64)
                 # token policing before EACH wire (serial `can` check)
-                bad, why = _flag(bad, why, (pj & (net.tb_send_tokens - tx_wl < pf.MTU)), 536870912)
+                bad, why = _flag(
+                    bad, why,
+                    (pj & (net.tb_send_tokens - tx_wl < pf.MTU)),
+                    536870912)
                 pj = pj & ~bad
-                # the out ring's plane contents are dead storage below
-                # head (tests/test_bulk.py DEAD convention) — only the
-                # head advance + priority counter are live; the wire
-                # copy carries the enqueue-time words + wire stamps
+                # out-ring plane contents below head are dead storage
+                # (tests/test_bulk.py DEAD convention); the wire copy
+                # carries the enqueue-time words + wire stamps
                 ring_w = jnp.zeros((H, W), I32)
                 ring_w = ring_w.at[:, pf.W_PROTO].set(
-                    pf.PROTO_TCP | (pf.TCPF_ACK << 8))
+                    pf.PROTO_TCP | (flagsj << 8))
                 ring_w = ring_w.at[:, pf.W_LEN].set(lenj)
                 ring_w = ring_w.at[:, pf.W_PORTS].set(
-                    pf.pack_ports(w_sport, w_dport))
+                    pf.pack_ports(s_sport, s_dport))
                 ring_w = ring_w.at[:, pf.W_SEQ].set(seqj)
                 ring_w = ring_w.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
                 ring_w = ring_w.at[:, pf.W_DSTIP].set(
-                    w_dip.astype(jnp.uint32).astype(I32))
+                    s_dip.astype(jnp.uint32).astype(I32))
                 ring_w = ring_w.at[:, pf.W_STATUS].set(
                     pf.PDS_SND_CREATED | pf.PDS_SND_TCP_ENQUEUE_THROTTLED
                     | pf.PDS_SND_SOCKET_BUFFERED)
-                wire_w = ring_w.at[:, pf.W_ACK].set(stamp_ack)
-                wire_w = wire_w.at[:, pf.W_WIN].set(stamp_win)
+                wire_w = ring_w.at[:, pf.W_ACK].set(s_ack)
+                wire_w = wire_w.at[:, pf.W_WIN].set(s_win)
                 wire_w = wire_w.at[:, pf.W_TSVAL].set(_ms(t))
-                wire_w = wire_w.at[:, pf.W_TSECHO].set(stamp_tse)
+                wire_w = wire_w.at[:, pf.W_TSECHO].set(s_tse)
                 wire_w = wire_w.at[:, pf.W_STATUS].set(
                     ring_w[:, pf.W_STATUS] | pf.PDS_SND_INTERFACE_SENT)
                 # reliability draw at the exact serial counter
-                u = rng.uniform_at(net.rng_keys, rngc + j)
-                dropj = pj & (lenj > 0) & (u > w_rel)
+                u = rng.uniform_at(net.rng_keys, rngc + j_ctr)
+                dropj = pj & (lenj > 0) & (u > s_rel)
                 sendj = pj & ~dropj
                 wire_sent = wire_w.at[:, pf.W_STATUS].set(
                     wire_w[:, pf.W_STATUS] | pf.PDS_INET_SENT)
@@ -856,16 +1194,15 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     last_drop)
                 drops = drops + dropj.astype(I32)
                 tx_wl = tx_wl + jnp.where(pj, wlj, 0)
-                # outbox append at the running column
                 col = ob_count + emitted
                 okb = sendj & (col < M)
                 ob_over = ob_over | (sendj & ~(col < M))
                 colc = jnp.clip(col, 0, M - 1)
                 out = out.replace(
                     dst=out.dst.at[rows, colc].set(
-                        jnp.where(okb, w_dsth, out.dst[rows, colc])),
+                        jnp.where(okb, s_dsth, out.dst[rows, colc])),
                     time=out.time.at[rows, colc].set(
-                        jnp.where(okb, t + w_lat, out.time[rows, colc])),
+                        jnp.where(okb, t + s_lat, out.time[rows, colc])),
                     kind=out.kind.at[rows, colc].set(
                         jnp.where(okb, EventKind.PACKET,
                                   out.kind[rows, colc])),
@@ -879,24 +1216,87 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                   out.words[rows, colc])),
                 )
                 emitted = emitted + sendj.astype(I32)
+                return (out, bad, why, last_drop, drops, tx_wl, emitted,
+                        ob_over)
+
+            stamps1 = (stamp_ack, stamp_win, stamp_tse, w_sport,
+                       w_dport, w_dip, w_dsth, w_lat, w_rel)
+            state = (out, bad, why, last_drop, drops, tx_wl, emitted,
+                     ob_over)
+            for j in range(FLUSH_SEGMENTS + 1):
+                pj = sending & (j < n_pkt)
+                is_fin_j = ~fire & fin1 & (j == n_seg)
+                lenj = jnp.where(
+                    fire | is_fin_j, 0,
+                    jnp.clip(A_now - j * MSS, 0, MSS)).astype(I32)
+                seqj = jnp.where(is_fin_j, g_nxt + A_now,
+                                 seg_base + j * MSS)
+                flagsj = jnp.where(is_fin_j,
+                                   pf.TCPF_FIN | pf.TCPF_ACK,
+                                   pf.TCPF_ACK)
+                state = wire_one(state, pj, lenj, seqj, flagsj,
+                                 stamps1, j)
+            # secondary FIN (dual close) after the whole primary burst
+            def _wire2_sec(ops):
+                state, tcp, fin2v = ops
+                stamps2 = (gather_hs(tcp.rcv_nxt, c2_slot),
+                           jnp.maximum(
+                               gather_hs(net.sk_rcvbuf, c2_slot)
+                               - gather_hs(tcp.app_rbytes, c2_slot), 0),
+                           gather_hs(tcp.ts_recent, c2_slot),
+                           gather_hs(net.sk_bound_port, c2_slot),
+                           gather_hs(net.sk_peer_port, c2_slot),
+                           gather_hs(net.sk_peer_ip, c2_slot),
+                           gather_hs(peer_h, c2_slot),
+                           gather_hs(lat_s, c2_slot),
+                           gather_hs(rel_s, c2_slot))
+                (out, bad, why, last_drop, drops, tx_wl, emitted,
+                 ob_over) = state
+                bad, why = _flag(
+                    bad, why,
+                    (fin2v & (gather_hs(peer_h, c2_slot) < 0)), 1 << 62)
+                fin2v = fin2v & ~bad
+                state = (out, bad, why, last_drop, drops, tx_wl,
+                         emitted, ob_over)
+                state = wire_one(state, fin2v, jnp.zeros((H,), I32),
+                                 g2_nxt,
+                                 jnp.full((H,),
+                                          pf.TCPF_FIN | pf.TCPF_ACK,
+                                          I32),
+                                 stamps2, n_pkt)
+                (out, bad, why, last_drop, drops, tx_wl, emitted,
+                 ob_over) = state
+                fin2v = fin2v & ~bad
+                tcp = tcp.replace(dack_counter=set_hs(
+                    tcp.dack_counter, fin2v, c2_slot,
+                    jnp.zeros((H,), I32)))
+                return state, tcp, fin2v
+
+            state, tcp, fin2 = _gate(jnp.any(fin2), _wire2_sec,
+                                     (state, tcp, fin2))
+            (out, bad, why, last_drop, drops, tx_wl, emitted,
+             ob_over) = state
+
             bad, why = _flag(bad, why, ob_over, 1073741824)
-            out = out.replace(count=jnp.where(sending & ~bad,
+            wired = (sending | fin2) & ~bad
+            out = out.replace(count=jnp.where(wired,
                                               ob_count + emitted,
                                               out.count))
-            seq_ctr = seq_ctr + jnp.where(sending & ~bad, emitted, 0)
+            seq_ctr = seq_ctr + jnp.where(wired, emitted, 0)
+            n_tot = n_pkt + fin2.astype(I32)
             net = net.replace(
                 out_head=set_hs(net.out_head, sending, wslot,
                                 (ring_head0 + n_pkt) % BO),
                 priority_ctr=net.priority_ctr
-                + jnp.where(sending, n_pkt, 0).astype(I64),
-                rng_ctr=rngc + jnp.where(sending, n_pkt, 0).astype(
+                + jnp.where(wired, n_tot, 0).astype(I64),
+                rng_ctr=rngc + jnp.where(wired, n_tot, 0).astype(
                     jnp.uint32),
                 tb_send_tokens=jnp.maximum(
-                    net.tb_send_tokens - jnp.where(sending, tx_wl, 0), 0),
+                    net.tb_send_tokens - jnp.where(wired, tx_wl, 0), 0),
                 ctr_tx_packets=net.ctr_tx_packets
-                + jnp.where(sending, n_pkt, 0).astype(I64),
+                + jnp.where(wired, n_tot, 0).astype(I64),
                 ctr_tx_bytes=net.ctr_tx_bytes
-                + jnp.where(sending, tx_wl, 0),
+                + jnp.where(wired, tx_wl, 0),
                 ctr_tx_data_bytes=net.ctr_tx_data_bytes
                 + jnp.where(sending, A_now, 0).astype(I64),
                 ctr_drop_reliability=net.ctr_drop_reliability
@@ -904,6 +1304,9 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 last_drop_status=last_drop,
                 ctr_events_exec=net.ctr_events_exec + v.astype(I64),
             )
+            net = net.replace(out_head=set_hs(
+                net.out_head, fin2, c2_slot,
+                (gather_hs(net.out_head, c2_slot) + 1) % BO))
 
             sim = sim.replace(events=q, outbox=out, net=net, tcp=tcp,
                               app=app)

@@ -26,7 +26,7 @@ def why_legend() -> dict[int, str]:
            / "shadow_tpu/net/tcp_bulk.py").read_text()
     legend = {}
     for m in re.finditer(
-            r"_flag\(bad, why, (.*?), (\d+|1 << \d+)\)", src, re.DOTALL):
+            r"_flag\(\s*bad,\s*why,\s*(.*?),\s*(\d+|1 << \d+)\)", src, re.DOTALL):
         cond = " ".join(m.group(1).split())[:64]
         legend[eval(m.group(2))] = cond  # noqa: S307 — '1 << N' literals
     for bit, name in ((56, "precheck:kind"), (57, "precheck:bootstrap"),
