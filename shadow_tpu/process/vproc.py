@@ -248,6 +248,22 @@ def wait_readable(fds):
     return Sys("wait_readable", (tuple(fds),))
 
 
+def poll_fds(fds, timeout_ns: int = -1):
+    """poll(2) (ref: host_poll, host.c:949-1009): fds is a sequence of
+    (fd, events) with events an EPOLL.IN|OUT mask (POLLIN/POLLOUT).
+    Returns [(fd, revents), ...] for ready fds — empty list on
+    timeout. timeout_ns < 0 blocks until ready; 0 polls without
+    blocking (may return [])."""
+    return Sys("poll", (tuple(tuple(x) for x in fds), int(timeout_ns)))
+
+
+def select_fds(rfds, wfds, timeout_ns: int = -1):
+    """select(2) (ref: host_select, host.c:852-947): returns
+    (readable, writable) fd lists; ([], []) on timeout. Same timeout
+    semantics as poll_fds."""
+    return Sys("select", (tuple(rfds), tuple(wfds), int(timeout_ns)))
+
+
 # ---------------------------------------------------------------------
 # epoll: the readiness engine (ref: descriptor/epoll.c)
 # ---------------------------------------------------------------------
@@ -915,6 +931,38 @@ class ProcessRuntime:
             if ready:
                 return True, ready
             return False, None
+        if op in ("poll", "select"):
+            # level-triggered readiness scans over the same status
+            # engine epoll uses (ref: host_select/host_poll,
+            # host.c:852-1009 — both walk the descriptor table and
+            # test READABLE/WRITABLE). Timeout rides the sleep
+            # machinery: wake_time is armed on first block and a
+            # timed-out wait returns the empty result.
+            if op == "poll":
+                revs = [(fd, self._fd_ready(p, fd) & ev)
+                        for fd, ev in a[0]]
+                result = [(fd, r) for fd, r in revs if r]
+                got = bool(result)
+                empty = []
+            else:
+                r = [fd for fd in a[0]
+                     if self._fd_ready(p, fd) & EPOLL.IN]
+                w = [fd for fd in a[1]
+                     if self._fd_ready(p, fd) & EPOLL.OUT]
+                result = (r, w)
+                got = bool(r or w)
+                empty = ([], [])
+            timo = a[-1]
+            if got:
+                return True, result
+            if timo == 0:
+                return True, empty
+            if timo > 0:
+                if p.block is None:
+                    p.wake_time = now + timo
+                elif now >= p.wake_time:
+                    return True, empty
+            return False, None
         raise ValueError(f"unknown syscall {op}")
 
     # -- batched syscall execution (SURVEY §7.4.4) ----------------------
@@ -1287,7 +1335,8 @@ class ProcessRuntime:
         # host-side) — re-running device-side blocked ops (tcp_send,
         # accept, ...) every sweep would cost a device dispatch per
         # blocked process per sweep for state that cannot have changed
-        retry_ops = ("read", "write", "wait_readable", "epoll_wait")
+        retry_ops = ("read", "write", "wait_readable", "epoll_wait",
+                     "poll", "select")
 
         def advance(p, idx, ready, result, parked):
             """Feed one syscall result back into its coroutine."""
@@ -1443,7 +1492,9 @@ class ProcessRuntime:
             cands = [int(jnp.min(self.sim.events.min_time()))]
             cands += [p.wake_time for p in self.procs
                       if not p.done and p.block is not None
-                      and p.block.op == "sleep"]
+                      and (p.block.op == "sleep"
+                           or (p.block.op in ("poll", "select")
+                               and p.block.args[-1] > 0))]
             cands += [p.start_time for p in self.procs
                       if not p.done and not p.started]
             cands += [p.stop_time for p in self.procs

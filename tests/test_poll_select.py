@@ -1,0 +1,103 @@
+"""poll/select syscall surface (ref: host_select / host_poll,
+host.c:852-1009, exercised by the reference's poll/ test dir): a
+client-server transfer where the server multiplexes readiness with
+poll() and the client waits for writability with select(), plus
+timeout semantics (poll with a timeout on an idle socket returns
+empty after the wait; timeout 0 never blocks)."""
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.process import vproc
+from shadow_tpu.process.vproc import EPOLL, ProcessRuntime
+
+from tests.test_vproc import GRAPH
+
+PORT = 7100
+
+
+def _bundle(seconds=20):
+    cfg = NetConfig(num_hosts=2, end_time=seconds * simtime.ONE_SECOND)
+    hosts = [HostSpec(name="client", type="client"),
+             HostSpec(name="server", type="server")]
+    return build(cfg, GRAPH, hosts)
+
+
+def test_poll_select_transfer():
+    b = _bundle()
+    server_ip = b.ip_of("server")
+    log = {}
+
+    def server(host):
+        ls = yield vproc.socket(SocketType.TCP)
+        yield vproc.bind(ls, PORT)
+        yield vproc.listen(ls)
+        # poll on the listener until the SYN arrives
+        revs = yield vproc.poll_fds([(ls, EPOLL.IN)])
+        assert revs and revs[0][0] == ls and revs[0][1] & EPOLL.IN
+        child = yield vproc.accept(ls)
+        got = 0
+        while True:
+            revs = yield vproc.poll_fds([(child, EPOLL.IN)])
+            assert revs, "blocking poll returned empty"
+            n = yield vproc.recv(child)
+            if n == 0:
+                break
+            got += n
+        log["got"] = got
+        yield vproc.close(child)
+        yield vproc.close(ls)
+
+    def client(host):
+        fd = yield vproc.socket(SocketType.TCP)
+        yield vproc.connect(fd, server_ip, PORT)
+        sent = 0
+        while sent < 30_000:
+            r, w = yield vproc.select_fds([], [fd])
+            assert fd in w, "select returned without writability"
+            sent += (yield vproc.send(fd, min(30_000 - sent, 8192)))
+        yield vproc.close(fd)
+        log["sent"] = sent
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, client)
+    rt.spawn(1, server)
+    rt.run()
+    assert log["sent"] == 30_000
+    assert log["got"] == 30_000
+
+
+def test_poll_timeout_semantics():
+    b = _bundle(seconds=5)
+    log = {}
+
+    def app(host):
+        fd = yield vproc.socket(SocketType.UDP)
+        yield vproc.bind(fd, PORT)
+        # timeout 0: returns immediately, nothing ready
+        revs = yield vproc.poll_fds([(fd, EPOLL.IN)], timeout_ns=0)
+        assert revs == []
+        t0 = yield vproc.gettime()
+        revs = yield vproc.poll_fds(
+            [(fd, EPOLL.IN)], timeout_ns=200 * simtime.ONE_MILLISECOND)
+        t1 = yield vproc.gettime()
+        assert revs == []
+        log["waited_ns"] = t1 - t0
+        # select timeout on an idle socket likewise returns empty
+        r, w = yield vproc.select_fds(
+            [fd], [], timeout_ns=100 * simtime.ONE_MILLISECOND)
+        assert r == [] and w == []
+        # a writable UDP socket satisfies select immediately
+        r, w = yield vproc.select_fds([], [fd])
+        assert w == [fd]
+        yield vproc.close(fd)
+        log["done"] = True
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, app)
+    rt.run()
+    assert log["done"]
+    # the poll timeout wakes at the first window boundary >= deadline
+    assert log["waited_ns"] >= 200 * simtime.ONE_MILLISECOND
