@@ -96,19 +96,25 @@ void compute_lost(const Tally& t, Ranges* lost) {
     }
     if (cur < end) insert_range(lost, cur, end);
   }
+  // sacked bytes are never lost (explicit timeout marks can cover
+  // them: ref compute_lost subtracts sacked_ from marked_lost_), and
   // never report retransmitted-and-not-again-lost ranges
-  for (const Range& r : t.retransmitted) {
-    Ranges out;
-    for (const Range& l : *lost) {
-      if (l.second <= r.first || r.second <= l.first) {
-        out.push_back(l);
-        continue;
+  auto subtract = [lost](const Ranges& minus) {
+    for (const Range& r : minus) {
+      Ranges out;
+      for (const Range& l : *lost) {
+        if (l.second <= r.first || r.second <= l.first) {
+          out.push_back(l);
+          continue;
+        }
+        if (l.first < r.first) out.emplace_back(l.first, r.first);
+        if (r.second < l.second) out.emplace_back(r.second, l.second);
       }
-      if (l.first < r.first) out.emplace_back(l.first, r.first);
-      if (r.second < l.second) out.emplace_back(r.second, l.second);
+      *lost = std::move(out);
     }
-    *lost = std::move(out);
-  }
+  };
+  subtract(t.sacked);
+  subtract(t.retransmitted);
 }
 
 }  // namespace

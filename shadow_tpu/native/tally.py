@@ -91,7 +91,10 @@ class _PyTally:
                     break
             if cur < end:
                 self._insert(lost, cur, end)
-        for rb, re in self.retransmitted:
+        # sacked bytes are never lost (explicit marks can cover them:
+        # ref compute_lost subtracts sacked_ from marked_lost_), nor
+        # are retransmitted-and-not-again-lost ranges
+        for rb, re in list(self.sacked) + list(self.retransmitted):
             out = []
             for lb, le in lost:
                 if le <= rb or re <= lb:

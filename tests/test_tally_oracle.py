@@ -136,6 +136,149 @@ def test_device_scoreboard_matches_interval_tally(seed, loss):
     assert decisions > 0, "loss pattern produced no retransmit decisions"
 
 
+def _run_recovery(model, nseg, delivered, order):
+    """Drive ONE sender model through initial transmit + the full
+    recovery episode against the deterministic receiver, returning the
+    complete sequence of retransmitted byte ranges.
+
+    The trigger events mirror the reference driver (tcp.c): fast
+    retransmit when the dup-ack count crosses the threshold and the
+    bytes at una were not already retransmitted (tcp_retransmit_tally.cc
+    update's !ranges_contains(retransmitted, last_ack) guard), and an
+    RTO whenever the ACK stream stalls with holes outstanding
+    (tcp.c:1310-1330). What differs per `model` is the retransmit
+    DECISION — which bytes to send:
+
+      device: sack_clip_len over the 3-range advertised list, one
+              segment from una per trigger (net/tcp.py _retransmit_one)
+      tally:  the native interval-set's lost_ranges(); on RTO the
+              reference marks [una, end) lost and flushes EVERY lost
+              range in one burst (tcp.c:1134-1153)
+
+    Information asymmetry is part of the point: the device hears only
+    its 3-range wire advertisement, while the tally model hears the
+    FULL out-of-order set the way the reference's unbounded
+    selectiveACKs GList does (packet.h:52, tcp.c:1622). Equal
+    sequences therefore show the 3-slot reduction loses nothing the
+    full interval machinery would have used. Both models' decisions
+    feed back into their own ACK streams, so a divergence in extent
+    or order shows up as a different sequence."""
+    total = nseg * MSS
+    rcv_nxt, parked = 0, []
+    tally = RetransmitTally(0)
+    una, dup, recovery_point = 0, 0, -1
+    adv_now: list = []
+    retransmits: list = []
+    fast_pending = False
+
+    def covered(seq):
+        return any(b <= seq < e for b, e in retransmits)
+
+    def sender_ack(cum, parked_now):
+        nonlocal una, dup, recovery_point, adv_now, fast_pending
+        adv_now = _advertised(parked_now)      # the 3-range wire view
+        if cum > una:
+            una = cum
+            dup = 0
+            tally.advance(cum)
+            if recovery_point >= 0 and cum >= recovery_point:
+                recovery_point = -1
+        else:
+            dup += 1
+            tally.dupl_ack()
+        # the tally hears the full out-of-order set (unbounded
+        # selectiveACKs, packet.h:52); the device only ever sees
+        # adv_now
+        for b, e in sorted(parked_now):
+            tally.mark_sacked(b, e)
+        if dup >= DUPL_ACK_LOST_THRESH and not covered(una):
+            fast_pending = True
+            if recovery_point < 0:
+                recovery_point = total
+                tally.set_recovery_point(total)
+
+    def xmit(b, e):
+        nonlocal rcv_nxt, parked
+        assert b == una and e > b, (b, e, una)
+        retransmits.append((b, e))
+        tally.mark_retransmitted(b, e)
+        rcv_nxt, parked = _receiver_accept(rcv_nxt, parked, b, e)
+        sender_ack(rcv_nxt, parked)
+
+    def fast_retransmit():
+        nonlocal fast_pending
+        fast_pending = False
+        if model == "device":
+            xmit(una, una + int(_device_clip(una, MSS, adv_now)))
+        else:
+            lost = tally.lost_ranges()
+            assert lost and lost[0][0] == una, (lost, una)
+            xmit(una, una + min(lost[0][1] - una, MSS))
+
+    for i in order:
+        if not delivered[i]:
+            continue
+        rcv_nxt, parked = _receiver_accept(
+            rcv_nxt, parked, i * MSS, (i + 1) * MSS)
+        sender_ack(rcv_nxt, parked)
+        if fast_pending:
+            fast_retransmit()
+
+    guard = 0
+    while una < total:
+        guard += 1
+        assert guard < 4 * nseg, "recovery loop did not converge"
+        if fast_pending:
+            fast_retransmit()
+            continue
+        # RTO: the ACK stream stalled with holes outstanding
+        if model == "device":
+            xmit(una, una + int(_device_clip(una, MSS, adv_now)))
+        else:
+            tally.mark_lost(una, total)
+            burst = tally.lost_ranges()
+            assert burst and burst[0][0] == una, (burst, una)
+            for b, e in burst:
+                for c in range(b, e, MSS):
+                    xmit(c, min(c + MSS, e))
+    return retransmits
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 3, 4, 5])
+@pytest.mark.parametrize("loss", [0.15, 0.35, 0.55])
+@pytest.mark.parametrize("reorder", [False, True])
+def test_full_retransmission_sequence_equivalence(seed, loss, reorder):
+    """VERDICT r3 #6: whole-retransmission-sequence equivalence.
+
+    The device 3-range scoreboard and the native interval tally each
+    independently drive a complete loss-recovery episode (their own
+    decisions feed back into their own ACK streams) under multi-hole
+    loss and, optionally, reordered initial delivery. The sequences of
+    retransmitted byte ranges — which bytes, in which order — must be
+    identical, not merely the first range."""
+    rng = np.random.default_rng(7000 * seed + int(loss * 100) + reorder)
+    episodes = 0
+    for _trial in range(6):
+        nseg = int(rng.integers(20, 64))
+        delivered = rng.random(nseg) >= loss
+        if delivered.all() or not delivered.any():
+            continue
+        order = np.arange(nseg)
+        if reorder:
+            # local shuffles (swap adjacent runs) — heavier than wire
+            # reordering ever gets, still delivers every survivor
+            for _ in range(nseg // 3):
+                j = int(rng.integers(0, nseg - 3))
+                order[j:j + 3] = order[j:j + 3][::-1]
+        dev = _run_recovery("device", nseg, delivered, list(order))
+        tal = _run_recovery("tally", nseg, delivered, list(order))
+        if dev or tal:
+            episodes += 1
+        assert dev == tal, (nseg, np.flatnonzero(~delivered).tolist(),
+                            dev, tal)
+    assert episodes >= 2, "loss patterns produced too few recoveries"
+
+
 def test_oracle_agreement_under_many_parked_ranges():
     """>3 parked ranges: the advertised list drops information, but
     the FIRST range is always advertised, so decisions still match."""
