@@ -327,7 +327,9 @@ def segment_ranks(sorted_keys: jax.Array) -> jax.Array:
 # Group width for insert_flat's sort-free "count-route": cross-group
 # ranks come from a scatter-add [n/G, H] count matrix + exclusive
 # cumsum, within-group ranks from an [n/G, G, G] compare cube. Larger
-# G shrinks the count matrix and grows the cube.
+# G shrinks the count matrix and grows the cube. (Kept for
+# measurement; "sort2" superseded it as the accelerator default in r4
+# — 65.7 -> 30.4 ms/window at 10k hosts on v5e.)
 INSERT_GROUP = 64
 # Above these element counts the count matrix / free-slot cube are
 # worse than the sort path (and at 100k unsharded hosts the count
@@ -338,10 +340,14 @@ SLOT_CUBE_BUDGET = 1_000_000_000
 
 def _insert_impl(n: int, H: int) -> str:
     if jax.default_backend() == "cpu":
-        # CPU gathers/sorts are cheap; the count matrix is pure waste
+        # CPU gathers/sorts are cheap; the packed-plane co-sort and
+        # padded scatter are pure waste there
         return "sort"
-    ng = -(-n // INSERT_GROUP)
-    return "count" if ng * H <= COUNT_MATRIX_BUDGET else "sort"
+    # multi-operand co-sort + lexicographically sorted scatter: no
+    # count matrix, no cube, no per-entry permutation gathers — and
+    # no scale ceiling (the count matrix at 100k hosts would be
+    # ~30 GB; sort2 is O(n log n) compare-exchange on packed planes)
+    return "sort2"
 
 
 def _pack_time(t: jax.Array) -> tuple[jax.Array, jax.Array]:
@@ -378,6 +384,65 @@ def _free_slot_of_rank(q: EventQueue, impl: str) -> jax.Array:
     return jnp.where(jnp.arange(K)[None, :] < n_free[:, None], order, K)
 
 
+def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
+    """The "sort2" insert mechanism: co-sort the packed planes by
+    destination row with one multi-operand lax.sort (the permutation
+    happens inside the vectorized sort network — no per-entry plane
+    gathers, which is what made the classic argsort+shuffle form slow
+    on TPU), then write all planes with ONE lexicographically sorted
+    scatter. Sorted (row, slot) index vectors let XLA take its fast
+    scatter path (~7 ns/row vs ~45 ns/row unsorted, measured r4 on
+    v5e); rejected entries redirect to a pad row/column that is
+    sliced off, so duplicate pad writes are discarded harmlessly.
+    Values are bit-identical to the "count"/"sort" mechanisms: the
+    stable sort preserves caller order within each row, so ranks and
+    chosen free slots agree entry-for-entry."""
+    P = packed.shape[1]
+    cols = tuple(packed[:, j] for j in range(P))
+    srt = jax.lax.sort((rowc,) + cols, num_keys=1, is_stable=True)
+    row_o = srt[0]
+    packed_o = jnp.stack(srt[1:], axis=1)                  # [n, P]
+    valid_o = row_o < H
+    rank_o = segment_ranks(row_o)
+
+    slot_map = _free_slot_of_rank(q, "sort")               # [H, K]
+    # Keep the clipped index sequence genuinely sorted for the hint:
+    # invalid entries (row H, clipped to H-1) restart segment_ranks at
+    # 0, so pin their rank index to K-1 — (H-1, K-1) repeated is >=
+    # every preceding (H-1, k<=K-1) pair. Their cand value is unused
+    # (fits already requires valid_o).
+    rank_c = jnp.where(valid_o, jnp.clip(rank_o, 0, K - 1), K - 1)
+    cand = slot_map.at[
+        jnp.clip(row_o, 0, H - 1), rank_c].get(indices_are_sorted=True)
+    fits = valid_o & (rank_o < K) & (cand < K)
+    # (row, slot) is lexicographically non-decreasing: rows ascend,
+    # and within a row fit slots ascend (rank-th free slot) with the
+    # rejected suffix pinned at the pad column K.
+    r = jnp.where(valid_o, row_o, H)
+    s = jnp.where(fits, cand, K)
+
+    packed_q = jnp.concatenate(
+        [jnp.stack(_pack_time(q.time), axis=2), q.kind[:, :, None],
+         q.src[:, :, None], q.seq[:, :, None], q.words], axis=2)
+    padded = jnp.pad(packed_q, ((0, 1), (0, 1), (0, 0)))   # [H+1,K+1,P]
+    idx = jnp.stack([r, s], axis=1)                        # [n, 2]
+    dnums = jax.lax.ScatterDimensionNumbers(
+        update_window_dims=(1,), inserted_window_dims=(0, 1),
+        scatter_dims_to_operand_dims=(0, 1))
+    padded = jax.lax.scatter(
+        padded, idx, packed_o, dnums, indices_are_sorted=True,
+        unique_indices=False, mode=jax.lax.GatherScatterMode.CLIP)
+    packed_q = padded[:H, :K]
+    return q.replace(
+        time=_unpack_time(packed_q[:, :, 0], packed_q[:, :, 1]),
+        kind=packed_q[:, :, 2],
+        src=packed_q[:, :, 3],
+        seq=packed_q[:, :, 4],
+        words=packed_q[:, :, 5:],
+        overflow=q.overflow + jnp.sum(valid_o & ~fits, dtype=I32),
+    )
+
+
 def insert_flat(
     q: EventQueue,
     valid: jax.Array,  # [n] bool
@@ -395,16 +460,20 @@ def insert_flat(
 
     Each entry's within-row rank = #earlier entries with the same row;
     its slot = the rank-th free slot of that row (holes fill in
-    place). Two bit-identical rank computations, chosen per backend:
+    place). Three bit-identical mechanisms, chosen per backend by
+    _insert_impl:
 
-    - "count" (accelerators): scatter-add a [n/G, H] per-group count
-      matrix, exclusive-cumsum it for cross-group ranks, add an
-      [n/G, G, G] within-group compare cube. No sort, no per-entry
-      gathers except the two [n] map lookups — on TPU, XLA lowers a
-      composed 491k-element sort + its plane gathers to ~70 ms of
-      serial loops; this form is a few bandwidth-bound ms.
-    - "sort" (CPU / over-budget shapes): stable argsort by row +
-      segment ranks, the classic shuffle.
+    - "sort2" (accelerators, the default): one multi-operand lax.sort
+      co-sorting the packed planes by destination row, then a single
+      lexicographically sorted scatter (_insert_sorted_scatter).
+    - "sort" (CPU): stable argsort by row + segment ranks, the
+      classic shuffle — cheap where gathers are cheap.
+    - "count" (kept for measurement, no longer auto-selected):
+      scatter-add a [n/G, H] per-group count matrix, exclusive-cumsum
+      for cross-group ranks, an [n/G, G, G] within-group compare cube
+      (the r2 design that beat the argsort+gather form on TPU before
+      sort2 beat both; INSERT_GROUP/COUNT_MATRIX_BUDGET only matter
+      when it is requested explicitly).
 
     All planes move through ONE packed [.., 5+W] i32 gather/scatter
     (time split into two i32 words) instead of per-plane ops."""
@@ -420,6 +489,9 @@ def insert_flat(
     packed = jnp.concatenate(
         [tlo[:, None], thi[:, None], kind[:, None], src[:, None],
          seq[:, None], words], axis=1)                     # [n, 5+W]
+
+    if impl == "sort2":
+        return _insert_sorted_scatter(q, rowc, packed, n, H, K)
 
     if impl == "count":
         G = INSERT_GROUP
@@ -475,20 +547,21 @@ def clear_outbox(out: Outbox) -> Outbox:
     )
 
 
-def route_outbox(q: EventQueue, out: Outbox,
-                 impl: str | None = None) -> tuple[EventQueue, Outbox]:
-    """Deliver all staged cross-host events into destination rows.
+# Narrow-route tier: outbox rows are cursor-appended (left-packed), so
+# when every row's count fits this width the route runs over a sliced
+# [H, ROUTE_NARROW] view — the whole insert pipeline (sort/scatter,
+# rank maps) scales with candidate count, and the capacity is sized
+# for worst-case bursts the steady state never reaches (measured r4:
+# 10k-host PHOLD load 8 stages max 23/48 per row). None disables.
+ROUTE_NARROW = 24
 
-    Single-shard version: destination host ids are row indices
-    directly. The multi-chip path runs insert_flat after an all-to-all
-    keyed by dst // hosts_per_shard (see shadow_tpu.parallel.shard).
-    `impl` overrides the insert mechanism ("count"/"sort") for callers
-    whose arrays live on a different backend than jax.default_backend()
-    (values are bit-identical either way; this is perf-only).
-    """
-    H, M = out.dst.shape
-    n = H * M
-    dst = out.dst.reshape(n)
+
+def _route_width(q: EventQueue, out: Outbox, width: int,
+                 impl: str | None) -> EventQueue:
+    """Insert the first `width` outbox columns of every row."""
+    H = out.dst.shape[0]
+    n = H * width
+    dst = out.dst[:, :width].reshape(n)
     occupied = dst >= 0
     # A dst outside [0, H) is a routing bug — count it, never silently
     # drop.
@@ -496,11 +569,41 @@ def route_outbox(q: EventQueue, out: Outbox,
     valid = occupied & ~bad_dst
     q = insert_flat(
         q, valid, dst,
-        out.time.reshape(n), out.kind.reshape(n), out.src.reshape(n),
-        out.seq.reshape(n), out.words.reshape(n, out.words.shape[-1]),
+        out.time[:, :width].reshape(n), out.kind[:, :width].reshape(n),
+        out.src[:, :width].reshape(n), out.seq[:, :width].reshape(n),
+        out.words[:, :width].reshape(n, out.words.shape[-1]),
         impl=impl,
     )
-    q = q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
+    return q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
+
+
+def route_outbox(q: EventQueue, out: Outbox, impl: str | None = None,
+                 narrow: int | None = None) -> tuple[EventQueue, Outbox]:
+    """Deliver all staged cross-host events into destination rows.
+
+    Single-shard version: destination host ids are row indices
+    directly. The multi-chip path runs insert_flat after an all-to-all
+    keyed by dst // hosts_per_shard (see shadow_tpu.parallel.shard).
+    `impl` overrides the insert mechanism ("count"/"sort"/"sort2") for
+    callers whose arrays live on a different backend than
+    jax.default_backend() (values are bit-identical either way; this
+    is perf-only). `narrow` overrides ROUTE_NARROW.
+
+    Bit-identity of the narrow tier: rows are left-packed, so slicing
+    drops only empty slots, and candidate enumeration order (row-major
+    over the slice) preserves the relative order of every occupied
+    entry — ranks, slots and overflow accounting are unchanged.
+    """
+    H, M = out.dst.shape
+    width = ROUTE_NARROW if narrow is None else narrow
+    if width and width < M:
+        q = jax.lax.cond(
+            jnp.max(out.count) <= width,
+            lambda qq: _route_width(qq, out, width, impl),
+            lambda qq: _route_width(qq, out, M, impl),
+            q)
+    else:
+        q = _route_width(q, out, M, impl)
     return q, clear_outbox(out)
 
 

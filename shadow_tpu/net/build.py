@@ -163,7 +163,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     per window instead of one micro-step per event, bit-identically
     (see net/bulk.py).
 
-    `route_impl` ("count"/"sort") overrides the outbox-insert
+    `route_impl` ("sort2"/"sort"/"count") overrides the outbox-insert
     mechanism when the arrays live on a different backend than
     jax.default_backend() — e.g. CPU-pinned state on a TPU host
     (values are bit-identical either way; perf-only, mirrors
