@@ -32,6 +32,7 @@ from shadow_tpu.net import nic, udp
 from shadow_tpu.net.rings import gather_hs
 from shadow_tpu.net.sockets import sk_bind, sk_create
 from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.net.state import ip_of_hosts
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -142,8 +143,7 @@ def _relay_step(cfg, sim, buf, mask, now):
     idx = jnp.clip(app.relay_next, 0, K - 1)
     peer = app.peers[lane, idx]
     active = mask & (app.relay_block >= 0) & (app.relay_next < K) & (peer >= 0)
-    GH = sim.net.host_ip.shape[0]
-    dst_ip = sim.net.host_ip[jnp.clip(peer, 0, GH - 1)]
+    dst_ip = ip_of_hosts(cfg, sim.net, peer)
     net, ok = udp.udp_enqueue_send(
         sim.net, active, app.sock, dst_ip,
         jnp.full((H,), PORT, I32), BLOCK_BYTES, app.relay_block)

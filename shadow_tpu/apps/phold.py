@@ -24,6 +24,7 @@ from shadow_tpu.net import nic, udp
 from shadow_tpu.net.rings import gather_hs
 from shadow_tpu.net.sockets import sk_bind, sk_create
 from shadow_tpu.net.state import NetConfig, SocketType
+from shadow_tpu.net.state import ip_of_hosts
 
 I32 = jnp.int32
 I64 = jnp.int64
@@ -70,7 +71,7 @@ def _send_one(cfg, sim, buf, mask, now):
     net = net.replace(rng_ctr=jnp.where(mask, ctr, net.rng_ctr))
     peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
     peer = jnp.where(peer >= net.lane_id, peer + 1, peer)  # skip self
-    dst_ip = net.host_ip[jnp.clip(peer, 0, GH - 1)]
+    dst_ip = ip_of_hosts(cfg, net, peer)
     net, ok = udp.udp_enqueue_send(net, mask, app.sock, dst_ip, app.port,
                                    MSG_SIZE, -1)
     app = app.replace(sent=app.sent + ok.astype(I64))
@@ -109,7 +110,7 @@ class PholdBulk:
         u = rng.uniform_at(net.rng_keys, app_ctr)
         peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
         peer = jnp.where(peer >= lane[:, None], peer + 1, peer)
-        dst_ip = net.host_ip[jnp.clip(peer, 0, GH - 1)]
+        dst_ip = ip_of_hosts(cfg, net, peer)
 
         m = jnp.sum(d.mask, axis=1, dtype=I32)
         sim = sim.replace(

@@ -384,63 +384,139 @@ def _free_slot_of_rank(q: EventQueue, impl: str) -> jax.Array:
     return jnp.where(jnp.arange(K)[None, :] < n_free[:, None], order, K)
 
 
-def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
-    """The "sort2" insert mechanism: co-sort the packed planes by
-    destination row with one multi-operand lax.sort (the permutation
-    happens inside the vectorized sort network — no per-entry plane
-    gathers, which is what made the classic argsort+shuffle form slow
-    on TPU), then write all planes with ONE lexicographically sorted
-    scatter. Sorted (row, slot) index vectors let XLA take its fast
-    scatter path (~7 ns/row vs ~45 ns/row unsorted, measured r4 on
-    v5e); rejected entries redirect to a pad row/column that is
-    sliced off, so duplicate pad writes are discarded harmlessly.
-    Values are bit-identical to the "count"/"sort" mechanisms: the
-    stable sort preserves caller order within each row, so ranks and
-    chosen free slots agree entry-for-entry."""
-    P = packed.shape[1]
-    cols = tuple(packed[:, j] for j in range(P))
-    srt = jax.lax.sort((rowc,) + cols, num_keys=1, is_stable=True)
-    row_o = srt[0]
-    packed_o = jnp.stack(srt[1:], axis=1)                  # [n, P]
-    valid_o = row_o < H
-    rank_o = segment_ranks(row_o)
+# Per-destination-row arrival budget of the "sort2" select sweep: when
+# every destination row receives at most this many entries (measured
+# 10k PHOLD: max 23), the insert needs NO per-entry scatter at all —
+# a windowed gather (H index rows) plus INSERT_SWEEP dense selects.
+# Rows over budget fall back to the sorted-scatter form via lax.cond.
+INSERT_SWEEP = 32
 
-    slot_map = _free_slot_of_rank(q, "sort")               # [H, K]
-    # Keep the clipped index sequence genuinely sorted for the hint:
-    # invalid entries (row H, clipped to H-1) restart segment_ranks at
-    # 0, so pin their rank index to K-1 — (H-1, K-1) repeated is >=
-    # every preceding (H-1, k<=K-1) pair. Their cand value is unused
-    # (fits already requires valid_o).
-    rank_c = jnp.where(valid_o, jnp.clip(rank_o, 0, K - 1), K - 1)
-    cand = slot_map.at[
-        jnp.clip(row_o, 0, H - 1), rank_c].get(indices_are_sorted=True)
-    fits = valid_o & (rank_o < K) & (cand < K)
-    # (row, slot) is lexicographically non-decreasing: rows ascend,
-    # and within a row fit slots ascend (rank-th free slot) with the
-    # rejected suffix pinned at the pad column K.
-    r = jnp.where(valid_o, row_o, H)
-    s = jnp.where(fits, cand, K)
 
-    packed_q = jnp.concatenate(
+def _queue_packed(q: EventQueue):
+    """The queue's planes as one [H, K, 5+W] i32 tensor."""
+    return jnp.concatenate(
         [jnp.stack(_pack_time(q.time), axis=2), q.kind[:, :, None],
          q.src[:, :, None], q.seq[:, :, None], q.words], axis=2)
-    padded = jnp.pad(packed_q, ((0, 1), (0, 1), (0, 0)))   # [H+1,K+1,P]
-    idx = jnp.stack([r, s], axis=1)                        # [n, 2]
-    dnums = jax.lax.ScatterDimensionNumbers(
-        update_window_dims=(1,), inserted_window_dims=(0, 1),
-        scatter_dims_to_operand_dims=(0, 1))
-    padded = jax.lax.scatter(
-        padded, idx, packed_o, dnums, indices_are_sorted=True,
-        unique_indices=False, mode=jax.lax.GatherScatterMode.CLIP)
-    packed_q = padded[:H, :K]
+
+
+def _queue_unpacked(q: EventQueue, packed_q, overflow_add):
     return q.replace(
         time=_unpack_time(packed_q[:, :, 0], packed_q[:, :, 1]),
         kind=packed_q[:, :, 2],
         src=packed_q[:, :, 3],
         seq=packed_q[:, :, 4],
         words=packed_q[:, :, 5:],
-        overflow=q.overflow + jnp.sum(valid_o & ~fits, dtype=I32),
+        overflow=q.overflow + overflow_add,
     )
+
+
+def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
+    """The "sort2" insert mechanism: co-sort the packed planes by
+    destination row with one multi-operand lax.sort (the permutation
+    happens inside the vectorized sort network — no per-entry plane
+    gathers, which is what made the classic argsort+shuffle form slow
+    on TPU), then apply the sorted stream with one of two writers:
+
+    - select sweep (common case, every destination row receives at
+      most INSERT_SWEEP entries): per-row arrival counts come from one
+      single-plane sorted scatter-add; each row's arrivals are pulled
+      as a contiguous [INSERT_SWEEP, P] window of the sorted stream
+      with ONE gather of H index rows (per-entry gathers/scatters on
+      TPU cost ~20-45 ns/row serialized — H rows instead of n is the
+      whole win); arrival j then lands in the row's j-th free slot
+      via INSERT_SWEEP dense masked selects, fully vectorized.
+    - sorted scatter (fallback): one lexicographically sorted
+      [n, P] scatter into a padded operand; rejected entries redirect
+      to a pad row/column that is sliced off, so duplicate pad writes
+      are discarded harmlessly.
+
+    Values are bit-identical to the "count"/"sort" mechanisms either
+    way: the stable sort preserves caller order within each row, so
+    ranks and chosen free slots agree entry-for-entry."""
+    P = packed.shape[1]
+    cols = tuple(packed[:, j] for j in range(P))
+    srt = jax.lax.sort((rowc,) + cols, num_keys=1, is_stable=True)
+    row_o = srt[0]
+    packed_o = jnp.stack(srt[1:], axis=1)                  # [n, P]
+    valid_o = row_o < H
+
+    # per-destination-row arrival counts (invalid entries fall in the
+    # dropped bin H) and each row's start offset in the sorted stream
+    cnt = jnp.zeros((H + 1,), I32).at[row_o].add(
+        1, indices_are_sorted=True)[:H]
+    start = jnp.cumsum(cnt, dtype=I32) - cnt               # [H] excl
+
+    free = ~q.valid()                                      # [H, K]
+    nfree = jnp.sum(free, axis=1, dtype=I32)
+    packed_q = _queue_packed(q)
+
+    Wn = INSERT_SWEEP
+
+    def _select_sweep(_):
+        # each row's arrivals as a contiguous window of the stream
+        pad_o = jnp.pad(packed_o, ((0, Wn), (0, 0)))
+        use_pallas = False
+        if jax.default_backend() == "tpu":
+            from shadow_tpu.core import insert_pallas
+
+            use_pallas = insert_pallas.mailbox_available()
+        if use_pallas:
+            # pipelined per-row HBM->VMEM DMAs instead of XLA's
+            # strictly serial H-iteration gather loop. Mosaic needs
+            # the DMA'd minor dim 128-aligned, so the stream is
+            # padded P -> 128 (the extra bytes ride otherwise-idle
+            # DMA bandwidth; the serial loop they replace was latency
+            # bound, not bandwidth bound).
+            wide = jnp.pad(pad_o, ((0, 0), (0, 128 - P)))
+            win = insert_pallas.mailbox_gather(wide, start, Wn)[..., :P]
+        else:
+            dnums = jax.lax.GatherDimensionNumbers(
+                offset_dims=(1, 2), collapsed_slice_dims=(),
+                start_index_map=(0,))
+            win = jax.lax.gather(
+                pad_o, start[:, None], dnums, slice_sizes=(Wn, P),
+                indices_are_sorted=True,
+                mode=jax.lax.GatherScatterMode.CLIP)       # [H, Wn, P]
+        f_rank = jnp.cumsum(free, axis=1, dtype=I32) - free
+        acc = packed_q
+        for j in range(Wn):
+            take = free & (f_rank == j) & (j < cnt)[:, None]
+            acc = jnp.where(take[:, :, None], win[:, j, None, :], acc)
+        ofl = jnp.sum(jnp.maximum(cnt - nfree, 0), dtype=I32)
+        return acc, ofl
+
+    def _sorted_scatter(_):
+        rank_o = segment_ranks(row_o)
+        slot_map = _free_slot_of_rank(q, "sort")           # [H, K]
+        # Keep the clipped index sequence genuinely sorted for the
+        # hint: invalid entries (row H, clipped to H-1) restart
+        # segment_ranks at 0, so pin their rank index to K-1 —
+        # (H-1, K-1) repeated is >= every preceding (H-1, k<=K-1)
+        # pair. Their cand value is unused (fits requires valid_o).
+        rank_c = jnp.where(valid_o, jnp.clip(rank_o, 0, K - 1), K - 1)
+        cand = slot_map.at[
+            jnp.clip(row_o, 0, H - 1), rank_c].get(
+            indices_are_sorted=True)
+        fits = valid_o & (rank_o < K) & (cand < K)
+        # (row, slot) is lexicographically non-decreasing: rows
+        # ascend, and within a row fit slots ascend (rank-th free
+        # slot) with the rejected suffix pinned at the pad column K.
+        r = jnp.where(valid_o, row_o, H)
+        s = jnp.where(fits, cand, K)
+        padded = jnp.pad(packed_q, ((0, 1), (0, 1), (0, 0)))
+        idx = jnp.stack([r, s], axis=1)                    # [n, 2]
+        dnums = jax.lax.ScatterDimensionNumbers(
+            update_window_dims=(1,), inserted_window_dims=(0, 1),
+            scatter_dims_to_operand_dims=(0, 1))
+        padded = jax.lax.scatter(
+            padded, idx, packed_o, dnums, indices_are_sorted=True,
+            unique_indices=False, mode=jax.lax.GatherScatterMode.CLIP)
+        ofl = jnp.sum(valid_o & ~fits, dtype=I32)
+        return padded[:H, :K], ofl
+
+    packed_q, ofl = jax.lax.cond(
+        jnp.max(cnt) <= Wn, _select_sweep, _sorted_scatter, 0)
+    return _queue_unpacked(q, packed_q, ofl)
 
 
 def insert_flat(
@@ -524,18 +600,9 @@ def insert_flat(
     r = jnp.where(fits, row_o, H)                          # OOB -> drop
     s = jnp.where(fits, cand, K)
 
-    packed_q = jnp.concatenate(
-        [jnp.stack(_pack_time(q.time), axis=2), q.kind[:, :, None],
-         q.src[:, :, None], q.seq[:, :, None], q.words], axis=2)
-    packed_q = packed_q.at[r, s].set(packed_o, mode="drop")
-    return q.replace(
-        time=_unpack_time(packed_q[:, :, 0], packed_q[:, :, 1]),
-        kind=packed_q[:, :, 2],
-        src=packed_q[:, :, 3],
-        seq=packed_q[:, :, 4],
-        words=packed_q[:, :, 5:],
-        overflow=q.overflow + jnp.sum(valid_o & ~fits, dtype=I32),
-    )
+    packed_q = _queue_packed(q).at[r, s].set(packed_o, mode="drop")
+    return _queue_unpacked(q, packed_q,
+                           jnp.sum(valid_o & ~fits, dtype=I32))
 
 
 def clear_outbox(out: Outbox) -> Outbox:
@@ -589,16 +656,22 @@ def route_outbox(q: EventQueue, out: Outbox, impl: str | None = None,
     jax.default_backend() (values are bit-identical either way; this
     is perf-only). `narrow` overrides ROUTE_NARROW.
 
-    Bit-identity of the narrow tier: rows are left-packed, so slicing
-    drops only empty slots, and candidate enumeration order (row-major
-    over the slice) preserves the relative order of every occupied
-    entry — ranks, slots and overflow accounting are unchanged.
+    Bit-identity of the narrow tier: the gate is the true maximum
+    OCCUPIED column (not the per-row count — the UDP bulk pass stages
+    replies at sparse time-order columns, net/bulk.py ord_col, so a
+    row can hold entries past its count), so slicing drops only empty
+    slots, and candidate enumeration order (row-major over the slice)
+    preserves the relative order of every occupied entry — ranks,
+    slots and overflow accounting are unchanged.
     """
     H, M = out.dst.shape
     width = ROUTE_NARROW if narrow is None else narrow
     if width and width < M:
+        occupied_width = jnp.max(
+            jnp.where(out.dst >= 0, jnp.arange(M, dtype=I32)[None, :] + 1,
+                      0))
         q = jax.lax.cond(
-            jnp.max(out.count) <= width,
+            occupied_width <= width,
             lambda qq: _route_width(qq, out, width, impl),
             lambda qq: _route_width(qq, out, M, impl),
             q)

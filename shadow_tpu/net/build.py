@@ -105,9 +105,20 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
     placement = top.attach_hosts([h.hints() for h in hosts], draws)
     min_jump = top.min_jump_ns(placement)
 
+    # Sequentially-allocated IPs (no config pinned an address out of
+    # order) unlock the arithmetic IP fast path in the bulk passes
+    # (state.ip_of_hosts) — detected here where the table is still
+    # host-side numpy, and threaded through the bundle's cfg so every
+    # step/bulk function built from it agrees.
+    host_ips = dns.host_ips(cfg.num_hosts)
+    if cfg.num_hosts and np.array_equal(
+            host_ips, host_ips[0] + np.arange(cfg.num_hosts)):
+        from dataclasses import replace as _dc_replace
+        cfg = _dc_replace(cfg, ip_affine_base=int(host_ips[0]))
+
     net = make_net_state(
         cfg,
-        host_ips=dns.host_ips(cfg.num_hosts),
+        host_ips=host_ips,
         bw_up_kibps=placement.bw_up_kibps,
         bw_down_kibps=placement.bw_down_kibps,
         vertex_of_host=placement.vertex,

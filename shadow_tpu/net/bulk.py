@@ -64,6 +64,7 @@ import jax.numpy as jnp
 from shadow_tpu.core import rng, simtime
 from shadow_tpu.core.events import EventKind, EventQueue, _tie_key
 from shadow_tpu.net import packetfmt as pf
+from shadow_tpu.net.state import ip_of_hosts
 from shadow_tpu.net.state import (
     TB_REFILL_INTERVAL,
     NetConfig,
@@ -382,7 +383,7 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
         src_port = pw & 0xFFFF
         dst_port = (pw >> 16) & 0xFFFF
         dst_ip = q.words[:, :, pf.W_DSTIP].astype(jnp.uint32).astype(I64)
-        src_ip = net.host_ip[jnp.clip(src, 0, GH - 1)]
+        src_ip = ip_of_hosts(cfg, net, src)
         payref = q.words[:, :, pf.W_PAYREF]
 
         slot = _lookup_bulk(net, inwin, dst_ip, dst_port, src_ip, src_port)

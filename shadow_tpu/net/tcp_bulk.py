@@ -75,6 +75,7 @@ from shadow_tpu.net.state import (
     SocketFlags,
     host_of_ip,
 )
+from shadow_tpu.net.state import ip_of_hosts
 from shadow_tpu.net.tcp import (
     DACK_QUICK_LIMIT,
     DACK_QUICK_NS,
@@ -318,7 +319,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
                 src_port, dst_port = pf.ports_of(words)
                 dst_ip = words[:, pf.W_DSTIP].astype(jnp.uint32).astype(I64)
-                src_ip = net.host_ip[jnp.clip(p.src, 0, GH - 1)]
+                src_ip = ip_of_hosts(cfg, net, p.src)
                 slot = lookup_socket(net, is_pkt, jnp.full((H,), pf.PROTO_TCP,
                                                            I32),
                                      dst_ip, dst_port, src_ip, src_port)
