@@ -77,6 +77,7 @@ def sim_specs(sim, axis: str):
 def route_outbox_sharded(
     q: EventQueue, out: Outbox, axis: str, num_shards: int,
     lane_id: jax.Array, exchange_capacity: int | None = None,
+    narrow: int | None = None,
 ) -> tuple[EventQueue, Outbox]:
     """Exchange staged cross-host events across shards and insert them
     into destination rows — the window-boundary all-to-all of
@@ -93,12 +94,21 @@ def route_outbox_sharded(
     exchange_capacity bounds the per-peer exchange buffer (default:
     the whole outbox, Hl*M, which can never overflow). Smaller values
     cut ICI transfer ~linearly; entries beyond the cap are counted in
-    q.overflow, never silently dropped."""
+    q.overflow, never silently dropped.
+
+    The narrow tier (r4, the sharded analog of events.ROUTE_NARROW):
+    the worst-case buffer is sized for one shard sending its WHOLE
+    outbox to one peer, but a steady-state window spreads far fewer
+    events across peers — so both the collective payload and the
+    receive-side insert (which scale with num_shards * C) run at a
+    narrow capacity whenever the LARGEST per-target group fits it,
+    decided by a scalar pmax so every shard takes the same branch.
+    Entries never drop: oversize windows take the full-width branch."""
     Hl, M = out.dst.shape
     GH = Hl * num_shards
     base = lane_id[0]
     n = Hl * M
-    C = n if exchange_capacity is None else min(exchange_capacity, n)
+    C_full = n if exchange_capacity is None else min(exchange_capacity, n)
 
     dst = out.dst.reshape(n)
     occupied = dst >= 0
@@ -111,11 +121,6 @@ def route_outbox_sharded(
     tgt_s = tgt[order]
     ok = tgt_s < num_shards
     rank = segment_ranks(tgt_s)
-    fits = ok & (rank < C)
-    xofl = jnp.sum(ok & ~fits, dtype=I32)
-
-    row = jnp.where(fits, tgt_s, num_shards)
-    slot = jnp.where(fits, rank, C)
 
     # Pack EVERY plane — the i64 time split into two i32 words — into
     # one buffer so the per-window exchange is exactly ONE collective
@@ -130,31 +135,54 @@ def route_outbox_sharded(
          out.words], axis=2,
     )  # [Hl, M, 6+W]
     flat = packed.reshape(n, 6 + W)[order]
-    sb_i32 = jnp.zeros((num_shards, C, 6 + W), I32).at[..., 0].set(-1)
-    sb_i32 = sb_i32.at[row, slot].set(flat, mode="drop")
 
-    a2a = partial(lax.all_to_all, axis_name=axis, split_axis=0, concat_axis=0)
-    rb_i32 = a2a(sb_i32)
+    def exchange(qq, C):
+        fits = ok & (rank < C)
+        xofl = jnp.sum(ok & ~fits, dtype=I32)
+        row = jnp.where(fits, tgt_s, num_shards)
+        slot = jnp.where(fits, rank, C)
+        sb_i32 = jnp.zeros((num_shards, C, 6 + W), I32).at[..., 0].set(-1)
+        sb_i32 = sb_i32.at[row, slot].set(flat, mode="drop")
 
-    nn = num_shards * C
-    ri32 = rb_i32.reshape(nn, 6 + W)
-    rdst = ri32[:, 0]
-    rtime = _unpack_time(ri32[:, 1], ri32[:, 2])
-    occupied_r = rdst >= 0
-    local_row = rdst - base
-    # An arriving dst outside this shard's [base, base+Hl) block means
-    # the lane assignment violated the contiguous-block contract —
-    # count it loudly (a negative row would otherwise wrap-around
-    # write; an oversized one would be silently dropped).
-    misrouted = occupied_r & ((local_row < 0) | (local_row >= Hl))
-    rvalid = occupied_r & ~misrouted
-    q = insert_flat(
-        q, rvalid, jnp.where(rvalid, local_row, Hl),
-        rtime, ri32[:, 3], ri32[:, 4],
-        ri32[:, 5], ri32[:, 6:],
-    )
-    q = q.replace(overflow=q.overflow + jnp.sum(bad, dtype=I32) + xofl
-                  + jnp.sum(misrouted, dtype=I32))
+        a2a = partial(lax.all_to_all, axis_name=axis, split_axis=0,
+                      concat_axis=0)
+        rb_i32 = a2a(sb_i32)
+
+        nn = num_shards * C
+        ri32 = rb_i32.reshape(nn, 6 + W)
+        rdst = ri32[:, 0]
+        rtime = _unpack_time(ri32[:, 1], ri32[:, 2])
+        occupied_r = rdst >= 0
+        local_row = rdst - base
+        # An arriving dst outside this shard's [base, base+Hl) block
+        # means the lane assignment violated the contiguous-block
+        # contract — count it loudly (a negative row would otherwise
+        # wrap-around write; an oversized one would be silently
+        # dropped).
+        misrouted = occupied_r & ((local_row < 0) | (local_row >= Hl))
+        rvalid = occupied_r & ~misrouted
+        qq = insert_flat(
+            qq, rvalid, jnp.where(rvalid, local_row, Hl),
+            rtime, ri32[:, 3], ri32[:, 4],
+            ri32[:, 5], ri32[:, 6:],
+        )
+        return qq.replace(
+            overflow=qq.overflow + jnp.sum(bad, dtype=I32) + xofl
+            + jnp.sum(misrouted, dtype=I32))
+
+    C_n = (max(M, n // (4 * num_shards)) if narrow is None
+           else narrow)
+    if C_n and C_n < C_full:
+        # +1 so rank == C_n-1 fits; empty windows give gmax == 0
+        gmax = lax.pmax(
+            jnp.max(jnp.where(ok, rank, -1)) + 1, axis)
+        q = lax.cond(
+            gmax <= C_n,
+            lambda qq: exchange(qq, C_n),
+            lambda qq: exchange(qq, C_full),
+            q)
+    else:
+        q = exchange(q, C_full)
     return q, clear_outbox(out)
 
 
@@ -205,11 +233,12 @@ def _harness_specs(mesh: Mesh, axis: str, sim):
 
 
 def _sharded_route_fn(axis: str, num_shards: int, lane,
-                      exchange_capacity: int | None):
+                      exchange_capacity: int | None,
+                      narrow: int | None = None):
     """The window-boundary all-to-all as an engine route_fn."""
     def route(s):
         q, out = route_outbox_sharded(s.events, s.outbox, axis, num_shards,
-                                      lane, exchange_capacity)
+                                      lane, exchange_capacity, narrow)
         return s.replace(events=q, outbox=out)
     return route
 
@@ -217,6 +246,7 @@ def _sharded_route_fn(axis: str, num_shards: int, lane,
 def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                     end_time: int, min_jump: int, emit_capacity: int,
                     lane_id_fn=None, exchange_capacity: int | None = None,
+                    narrow: int | None = None,
                     bulk_fn=None):
     """Shared factory: a jitted sim -> (sim, stats) running the full
     engine loop under shard_map (used by sharded_engine_run and
@@ -234,7 +264,7 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             emit_capacity=emit_capacity,
             lane_id=lane,
             route_fn=_sharded_route_fn(axis, num_shards, lane,
-                                       exchange_capacity),
+                                       exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
             bulk_fn=bulk_fn,
         )
@@ -270,6 +300,7 @@ def sharded_engine_run(
     emit_capacity: int = 4,
     lane_id_fn=None,
     exchange_capacity: int | None = None,
+    narrow: int | None = None,
     bulk_fn=None,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
@@ -281,11 +312,13 @@ def sharded_engine_run(
     return _make_whole_run(
         mesh, axis, sim, step_fn, end_time=end_time, min_jump=min_jump,
         emit_capacity=emit_capacity, lane_id_fn=lane_id_fn,
-        exchange_capacity=exchange_capacity, bulk_fn=bulk_fn)(sim)
+        exchange_capacity=exchange_capacity, narrow=narrow,
+        bulk_fn=bulk_fn)(sim)
 
 
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
-                        exchange_capacity: int | None = None):
+                        exchange_capacity: int | None = None,
+                        narrow: int | None = None):
     """A jitted (sim, wend) -> (sim, stats, next_min) running ONE
     window round under shard_map — the building block for host-driven
     window loops (ProcessRuntime, checkpoint.run_windows) on a mesh.
@@ -303,7 +336,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             local_sim, stats, step_fn, wend,
             emit_capacity=cfg.emit_capacity, lane_id=lane,
             route_fn=_sharded_route_fn(axis, num_shards, lane,
-                                       exchange_capacity),
+                                       exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
         )
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
