@@ -12,6 +12,9 @@ rate measures raw engine throughput. Env knobs:
   BENCH_SHARDS=N                  run under shard_map over an N-device
                                   mesh (CPU: N virtual devices are
                                   forced; TPU: needs N real chips)
+  BENCH_REPLICAS=R                ensemble mode: R independent
+                                  replicas of the H-host sim in one
+                                  device program (aggregate ev/s)
   BENCH_TOPO=one|ref              'ref' = the reference's real
                                   183-vertex Internet graph instead of
                                   the single-vertex 50 ms fixture
@@ -81,7 +84,8 @@ def ref_topology_text() -> str:
 
 
 def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
-                 cap: int | None = None, graph: str | None = None):
+                 cap: int | None = None, graph: str | None = None,
+                 replica_size: int | None = None):
     from shadow_tpu.apps import phold
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
@@ -102,7 +106,7 @@ def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
                     router_ring=cap, in_ring=max(16, 2 * load))
     hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
     b = build(cfg, graph or ONE_VERTEX, hosts)
-    b.sim = phold.setup(b.sim, load=load)
+    b.sim = phold.setup(b.sim, load=load, replica_size=replica_size)
     return b
 
 
@@ -130,7 +134,8 @@ def _make_phold_fn(b, shards: int):
 
 
 def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
-                  graph: str | None = None):
+                  graph: str | None = None,
+                  replica_size: int | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -144,12 +149,12 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     state = {"n": 0, "cap": None, "fn": None, "sims": None}
 
     def build_at(cap):
-        b = _build_phold(H, load, sim_s, seed, cap, graph)
+        b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size)
         fn = _make_phold_fn(b, shards)
         # pre-build distinct-seed inputs so the timed call measures
         # only the device program, not host-side setup
         sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap,
-                                       graph).sim
+                                       graph, replica_size).sim
                           for i in (1, 2)]
         for s in sims:
             jax.block_until_ready(s.net.rng_keys)
@@ -307,9 +312,19 @@ def main() -> None:
     load = int(os.environ.get("BENCH_LOAD", "8"))
     graph = ref_topology_text() if topo == "ref" else None
 
+    # BENCH_REPLICAS=R: run R independent replicas of the H-host sim
+    # in one device program (ensemble mode) — small configs alone
+    # cannot fill the TPU's lanes; R replicas report AGGREGATE
+    # events/s per chip, the honest per-chip throughput for the
+    # seed-ensemble use case.
+    replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
     if workload == "phold":
-        runner = _phold_runner(H, load, sim_s, shards=_SHARDS, graph=graph)
+        runner = _phold_runner(H * replicas, load, sim_s, shards=_SHARDS,
+                               graph=graph,
+                               replica_size=H if replicas > 1 else None)
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
+        if replicas > 1:
+            name += f"_x{replicas}replicas"
     else:
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"

@@ -40,18 +40,47 @@ class PholdApp:
     remaining: jax.Array  # [H] i32 initial-load messages still to inject
     sent: jax.Array       # [H] i64
     rcvd: jax.Array       # [H] i64
+    # ensemble mode: peers draw from [peer_base, peer_base+peer_span)
+    # instead of the whole host range — R independent replicas of a
+    # config run in ONE device program, no cross-replica traffic (the
+    # seed-ensemble / parameter-sweep shape; small configs get the
+    # lanes a single replica cannot fill)
+    peer_base: jax.Array  # [H] i32
+    peer_span: jax.Array  # [H] i32
 
 
-def setup(sim, *, load: int, port: int = 9000):
-    """All hosts run PHOLD: bind a UDP socket, seed `load` messages."""
+def _replica_peer(app, net, u):
+    """Uniform peer within the lane's replica, excluding self. `u` is
+    [H] (handler path) or [H, K] (bulk path, one draw per consumed
+    event)."""
+    span, local, base = (app.peer_span, net.lane_id - app.peer_base,
+                         app.peer_base)
+    if u.ndim == 2:
+        span, local, base = span[:, None], local[:, None], base[:, None]
+    p = jnp.minimum((u * (span - 1)).astype(I32), span - 2)
+    p = jnp.where(p >= local, p + 1, p)      # skip self, stay in-span
+    return base + p
+
+
+def setup(sim, *, load: int, port: int = 9000,
+          replica_size: int | None = None):
+    """All hosts run PHOLD: bind a UDP socket, seed `load` messages.
+    `replica_size` partitions the hosts into independent replicas of
+    that many hosts each (peer draws stay in-replica)."""
     H = sim.net.host_ip.shape[0]
     if H < 2:
         raise ValueError("PHOLD needs at least 2 hosts")
+    rs = H if replica_size is None else replica_size
+    if rs < 2 or H % rs != 0:
+        raise ValueError(f"replica_size={rs} must divide H={H}, be >= 2")
     every = jnp.ones((H,), bool)
     net, sock = sk_create(sim.net, every, SocketType.UDP)
     net, _ = sk_bind(net, every, sock, 0, port)
+    lane = jnp.arange(H, dtype=I32)
     app = PholdApp(
         sock=sock,
+        peer_base=(lane // rs) * rs,
+        peer_span=jnp.full((H,), rs, I32),
         port=jnp.full((H,), port, I32),
         remaining=jnp.full((H,), load, I32),
         sent=jnp.zeros((H,), I64),
@@ -66,11 +95,9 @@ def _send_one(cfg, sim, buf, mask, now):
     stream."""
     app = sim.app
     net = sim.net
-    GH = net.host_ip.shape[0]
     u, ctr = rng.uniform(net.rng_keys, net.rng_ctr)
     net = net.replace(rng_ctr=jnp.where(mask, ctr, net.rng_ctr))
-    peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
-    peer = jnp.where(peer >= net.lane_id, peer + 1, peer)  # skip self
+    peer = _replica_peer(app, net, u)
     dst_ip = ip_of_hosts(cfg, net, peer)
     net, ok = udp.udp_enqueue_send(net, mask, app.sock, dst_ip, app.port,
                                    MSG_SIZE, -1)
@@ -101,15 +128,12 @@ class PholdBulk:
 
         app = sim.app
         net = sim.net
-        GH = net.host_ip.shape[0]
         H, K = d.mask.shape
-        lane = net.lane_id
 
         rc = bulkmod.rank_in_order(d.order, d.mask)    # consumed rank
         app_ctr = net.rng_ctr[:, None] + 2 * rc.astype(jnp.uint32)
         u = rng.uniform_at(net.rng_keys, app_ctr)
-        peer = jnp.minimum((u * (GH - 1)).astype(I32), GH - 2)
-        peer = jnp.where(peer >= lane[:, None], peer + 1, peer)
+        peer = _replica_peer(app, net, u)
         dst_ip = ip_of_hosts(cfg, net, peer)
 
         m = jnp.sum(d.mask, axis=1, dtype=I32)

@@ -1,0 +1,48 @@
+"""Ensemble mode: R independent PHOLD replicas in one device program
+(apps/phold.py replica_size). Peer draws must stay in-replica and the
+per-replica dynamics must match a standalone run of the same size —
+the seed-ensemble / parameter-sweep shape that also fills TPU lanes
+for configs too small to saturate a chip alone (BENCH_REPLICAS)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from bench import _build_phold, _make_phold_fn
+from shadow_tpu.apps import phold
+
+
+def test_replica_peer_draws_stay_in_replica():
+    H, rs = 12, 4
+    b = _build_phold(H, 2, 1, replica_size=rs)
+    app, net = b.sim.app, b.sim.net
+    rng = np.random.default_rng(0)
+    for shape in ((H,), (H, 5)):
+        u = jnp.asarray(rng.random(shape), jnp.float32)
+        peer = np.asarray(phold._replica_peer(app, net, u))
+        lane = np.arange(H).reshape((H,) + (1,) * (len(shape) - 1))
+        base = (lane // rs) * rs
+        assert (peer >= base).all() and (peer < base + rs).all()
+        assert (peer != lane).all()
+
+
+def test_replicas_match_standalone_dynamics():
+    """On the uniform one-vertex topology every message bounces once
+    per 50 ms window, so per-replica processed-event totals are
+    load-conserving and must equal each other AND a standalone run of
+    one replica's size. A cross-replica leak would skew the totals."""
+    rs, R, load = 4, 3, 2
+    b = _build_phold(rs * R, load, 1, replica_size=rs)
+    fn = _make_phold_fn(b, 0)
+    sim, stats = jax.block_until_ready(fn(b.sim))
+    rcvd = np.asarray(sim.app.rcvd).reshape(R, rs)
+    per_replica = rcvd.sum(axis=1)
+    assert (per_replica == per_replica[0]).all(), per_replica
+
+    solo = _build_phold(rs, load, 1)
+    fn1 = _make_phold_fn(solo, 0)
+    sim1, stats1 = jax.block_until_ready(fn1(solo.sim))
+    assert per_replica[0] == int(np.asarray(sim1.app.rcvd).sum()), (
+        per_replica, np.asarray(sim1.app.rcvd).sum())
+    assert int(stats.events_processed) == R * int(stats1.events_processed)
+    assert int(sim.events.overflow) == 0
