@@ -40,10 +40,10 @@ The campaign (in strike order — cheapest/most valuable first):
   bench_ref_topo   PHOLD on the real 183-vertex reference graph
   relay_10240      BASELINE config #3 (Tor-relay shape)
   gossip_5120      BASELINE config #4 (Bitcoin gossip)
-  bench_100k       BASELINE config #5 at spec scale (the biggest
-                   compile)
   bench_1k_x8      ensemble mode: 8 independent 1k replicas in one
                    program (BENCH_REPLICAS) — the small-config row
+  bench_100k       BASELINE config #5 at spec scale (the biggest
+                   compile, so it goes last)
 
 A job that fails the same way twice is terminal (recorded ok=false,
 attempts>=2) so one deterministic failure can't pin the campaign in a
@@ -87,13 +87,12 @@ JOBS = [
     ("gossip_5120", "scale",
      ["--workload", "gossip", "--hosts", "5120", "--sim-seconds", "10"],
      3600),
-    ("bench_100k", "bench",
-     {"BENCH_HOSTS": "102400", "BENCH_SIM_SECONDS": "2"}, 3600),
     # ensemble mode (r4): 8 independent 1k replicas in one program —
     # the small-config row that a lone replica cannot fill lanes for
     ("bench_1k_x8", "bench",
-     {"BENCH_HOSTS": "1024", "BENCH_REPLICAS": "8",
-      "BENCH_SIM_SECONDS": "2"}, 1800),
+     {"BENCH_HOSTS": "1024", "BENCH_REPLICAS": "8"}, 1800),
+    ("bench_100k", "bench",
+     {"BENCH_HOSTS": "102400", "BENCH_SIM_SECONDS": "2"}, 3600),
 ]
 ALL_JOBS = [j[0] for j in JOBS]
 MAX_ATTEMPTS = 2
