@@ -56,6 +56,9 @@ class GossipApp:
     relays: jax.Array       # [H] i64 datagrams pushed
     block_interval: jax.Array  # [] i64 ns between blocks (global)
     max_blocks: jax.Array   # [] i32
+    mine_stride: jax.Array  # [] i32 block-id stride per mining slot
+                            # (= hosts sharing the chain: H, or the
+                            # replica size in ensemble mode)
 
 
 def make_peer_graph(num_hosts: int, k: int, seed: int) -> np.ndarray:
@@ -81,23 +84,44 @@ def make_peer_graph(num_hosts: int, k: int, seed: int) -> np.ndarray:
 
 def setup(sim, *, peers_per_host: int = 8,
           block_interval=10 * simtime.ONE_SECOND, max_blocks: int = 100,
-          miner_stride: int = 1, graph_seed: int = 42):
+          miner_stride: int = 1, graph_seed: int = 42,
+          replica_size: int | None = None):
     """Bind sockets, build the peer graph, seed each host's first MINE
-    event. Block b is mined by host (b * miner_stride) % H."""
+    event. Block b is mined by host (b * miner_stride) % H.
+
+    `replica_size` partitions hosts into independent replicas: each
+    gets its own peer graph (block-diagonal, seeded graph_seed + r —
+    the seed-ensemble shape) and mines its own chain 0..max_blocks."""
     H = sim.net.host_ip.shape[0]
+    rs = H if replica_size is None else replica_size
+    if rs < 3 or H % rs != 0:
+        raise ValueError(f"replica_size={rs} must divide H={H}, be >= 3")
+    if peers_per_host >= rs:
+        raise ValueError(
+            f"peers_per_host={peers_per_host} must be < the peer-graph "
+            f"size {rs} (each host needs that many distinct non-self "
+            f"peers)")
+    R = H // rs
     every = jnp.ones((H,), bool)
     net, sock = sk_create(sim.net, every, SocketType.UDP)
     net, _ = sk_bind(net, every, sock, 0, PORT)
     sim = sim.replace(net=net)
 
-    peers = make_peer_graph(H, peers_per_host, graph_seed)
-    # first block id mined by host h: smallest b >= 0 with
-    # (b * stride) % H == h  (stride=1: b == h)
+    if R == 1:
+        peers = make_peer_graph(H, peers_per_host, graph_seed)
+    else:
+        def block(r):
+            g = make_peer_graph(rs, peers_per_host, graph_seed + r)
+            return np.where(g < 0, -1, g + r * rs)  # keep -1 padding
+        peers = np.concatenate([block(r) for r in range(R)], axis=0)
+    # first block id mined by host h (within its replica): smallest
+    # b >= 0 with (b * stride) % rs == local index
     first = np.full(H, -1, np.int64)
-    for b in range(H):
-        m = (b * miner_stride) % H
-        if first[m] < 0:
-            first[m] = b
+    for r in range(R):
+        for b in range(rs):
+            m = r * rs + (b * miner_stride) % rs
+            if first[m] < 0:
+                first[m] = b
     app = GossipApp(
         peers=jnp.asarray(peers),
         sock=sock,
@@ -110,6 +134,7 @@ def setup(sim, *, peers_per_host: int = 8,
         relays=jnp.zeros((H,), I64),
         block_interval=jnp.asarray(block_interval, I64),
         max_blocks=jnp.asarray(max_blocks, I32),
+        mine_stride=jnp.asarray(rs, I32),
     )
     sim = sim.replace(app=app)
 
@@ -190,8 +215,9 @@ def handler(cfg: NetConfig, sim, popped, buf):
     # kick the relay chain for the freshly mined block
     buf = emit(buf, mine, sim.net.lane_id, now, KIND_RELAY,
                emit_words(0, num_hosts=H))
-    # schedule this host's next mining slot (stride pattern: +H blocks)
-    nxt = app.next_block + H
+    # schedule this host's next mining slot (stride pattern: + the
+    # number of hosts sharing the chain — H, or the replica size)
+    nxt = app.next_block + app.mine_stride
     mine_t = nxt.astype(I64) * app.block_interval
     sched = mine & (nxt < app.max_blocks)
     buf = emit(buf, sched, sim.net.lane_id, mine_t, KIND_MINE,
