@@ -326,6 +326,11 @@ def main() -> None:
         if replicas > 1:
             name += f"_x{replicas}replicas"
     else:
+        if replicas > 1:
+            raise SystemExit(
+                "BENCH_REPLICAS is only wired for BENCH_WORKLOAD=phold; "
+                "a pingpong run would silently measure one replica "
+                "under an unlabeled metric name")
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
     if topo == "ref":

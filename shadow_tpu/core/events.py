@@ -459,7 +459,7 @@ def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
         if jax.default_backend() == "tpu":
             from shadow_tpu.core import insert_pallas
 
-            use_pallas = insert_pallas.mailbox_available()
+            use_pallas = insert_pallas.mailbox_available(H)
         if use_pallas:
             # pipelined per-row HBM->VMEM DMAs instead of XLA's
             # strictly serial H-iteration gather loop. Mosaic needs

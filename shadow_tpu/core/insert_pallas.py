@@ -40,10 +40,20 @@ _BLOCK_HOSTS = 256
 _DMA_DEPTH = 16
 
 
-def mailbox_available() -> bool:
-    """True when the Pallas TPU kernel can be used (the stream lives
-    in HBM, so there is no shape-dependent gate)."""
-    return HAVE_PALLAS
+# The whole [H] start array rides in SMEM per grid step (in_specs[0]);
+# SMEM is ~a few MB per core, so host counts far past the measured
+# 102,400-host working point (400 KB of SMEM) would fail at compile
+# time with no fallback — both lax.cond branches of the caller are
+# always compiled. Gate conservatively: 2 MB of i32 starts.
+_MAX_SMEM_START_ROWS = 512 * 1024
+
+
+def mailbox_available(num_hosts: int = 0) -> bool:
+    """True when the Pallas TPU kernel can be used for `num_hosts`
+    destination rows. The stream itself stays in HBM (no size
+    ceiling); the gate is the [H] SMEM start table — callers past the
+    bound take the XLA gather path instead of failing to compile."""
+    return HAVE_PALLAS and num_hosts <= _MAX_SMEM_START_ROWS
 
 
 def _kernel(Wn: int, B: int, D: int, start_ref, stream_ref, out_ref,
@@ -82,7 +92,9 @@ def _kernel(Wn: int, B: int, D: int, start_ref, stream_ref, out_ref,
 def mailbox_gather(stream, start, Wn: int):
     """[H, Wn, P] windows of `stream` ([n+pad, P] i32, row-sorted) at
     per-host offsets `start` ([H] i32, non-decreasing, start[h] <=
-    n). Caller guarantees mailbox_fits()."""
+    n). Caller contract: the stream is padded by Wn rows at the end
+    and to 128 lanes on the minor dim (Mosaic DMA alignment), and
+    mailbox_available(H) was checked before building this path."""
     H = start.shape[0]
     P = stream.shape[1]
     B = next(b for b in (_BLOCK_HOSTS, 128, 64, 32, 16, 8, 4, 2, 1)

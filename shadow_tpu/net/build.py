@@ -174,11 +174,16 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     per window instead of one micro-step per event, bit-identically
     (see net/bulk.py).
 
-    `route_impl` ("sort2"/"sort"/"count") overrides the outbox-insert
+    `route_impl` ("sort"/"count") overrides the outbox-insert
     mechanism when the arrays live on a different backend than
     jax.default_backend() — e.g. CPU-pinned state on a TPU host
     (values are bit-identical either way; perf-only, mirrors
-    make_bulk_fn's order_impl)."""
+    make_bulk_fn's order_impl). "sort2" is also accepted but must NOT
+    be used as an off-backend override on a TPU host: its Pallas
+    mailbox kernel is gated on jax.default_backend() at trace time
+    (array placement is unknowable under jit), so tracing it against
+    CPU-pinned state would compile the TPU-only kernel. Use "sort"
+    for CPU-pinned overrides."""
     import jax
 
     step = make_step_fn(bundle.cfg, app_handlers)

@@ -9,6 +9,11 @@ _flag call sites in net/tcp_bulk.py by source scan).
 Usage:
   python tools/tcp_bulk_debug.py [--hosts 510] [--hop 5]
       [--bytes 100000] [--sim-seconds 20] [--windows-max 40]
+      [--topology one|ref]
+
+--topology ref runs on the reference's real 183-vertex Internet graph
+(0.5%-per-path loss) — the config #2 regime where aborts are the
+steady state; the histogram is the work-list for loss-aware widening.
 """
 
 from __future__ import annotations
@@ -44,6 +49,7 @@ def main() -> int:
     ap.add_argument("--sim-seconds", type=int, default=20)
     ap.add_argument("--windows-max", type=int, default=10_000)
     ap.add_argument("--seed", type=int, default=2)
+    ap.add_argument("--topology", default="one", choices=["one", "ref"])
     args = ap.parse_args()
 
     import jax
@@ -75,6 +81,10 @@ def main() -> int:
       </graph>
     </graphml>"""
 
+    if args.topology == "ref":
+        import bench
+
+        GRAPH = bench.ref_topology_text()
     H, hop = args.hosts, args.hop
     cfg = NetConfig(num_hosts=H, seed=args.seed,
                     end_time=args.sim_seconds * simtime.ONE_SECOND,
