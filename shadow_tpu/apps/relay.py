@@ -141,8 +141,9 @@ class RelayTcpBulk:
 
     def on_data(self, cfg, app, mask, slot, nread, now):
         # the app only reads up_conn; data on any other socket is out
-        # of the model
-        ok = ~mask | (slot == app.up_conn)
+        # of the model, as is a delivery larger than one CHUNK read
+        # (the serial handler's tcp_recv bound)
+        ok = ~mask | ((slot == app.up_conn) & (nread <= CHUNK))
         m = mask & (slot == app.up_conn)
         server = app.role == ROLE_SERVER
         relay = app.role == ROLE_RELAY

@@ -48,7 +48,7 @@ _DMA_DEPTH = 16
 _MAX_SMEM_START_ROWS = 512 * 1024
 
 
-def mailbox_available(num_hosts: int = 0) -> bool:
+def mailbox_available(num_hosts: int) -> bool:
     """True when the Pallas TPU kernel can be used for `num_hosts`
     destination rows. The stream itself stays in HBM (no size
     ceiling); the gate is the [H] SMEM start table — callers past the

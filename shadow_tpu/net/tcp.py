@@ -315,6 +315,36 @@ def _adv_window(net: NetState, tcp: TcpState, slot):
     return jnp.maximum(free, 0)
 
 
+def sack_advert(tcp: TcpState, slot):
+    """The SACK list a departing packet on (lane, slot) advertises:
+    the SACK_RANGES lowest parked reassembly ranges, ascending by left
+    edge (the full sack list of packet.h:52,77 up to the 3-range
+    budget). Returns ((l1,r1),(l2,r2),(l3,r3)), each [H] i32, zeros
+    where absent. Shared by stamp_at_wire (serial NIC drain) and the
+    TCP bulk pass's wire stage — one selection rule, one bit pattern.
+    The slot's ranges are gathered FIRST so the selection runs over
+    [H, NR] rows, not the full [H, S, NR] socket cube (the bulk scan
+    calls this every while_loop iteration)."""
+    H = slot.shape[0]
+    rows = jnp.arange(H)
+    S = tcp.oo_l.shape[1]
+    sc = jnp.clip(slot, 0, S - 1)
+    ool = tcp.oo_l[rows, sc]                            # [H, NR]
+    oor = tcp.oo_r[rows, sc]
+    big = jnp.iinfo(I32).max
+    key = jnp.where(oor > ool, ool, big)
+    out = []
+    for _ in range(SACK_RANGES):
+        pick = jnp.argmin(key, axis=1)                  # [H]
+        have = key[rows, pick] != big
+        out.append((jnp.where(have, ool[rows, pick], 0),
+                    jnp.where(have, oor[rows, pick], 0)))
+        # exclude the picked range from the next round
+        key = jnp.where(jnp.arange(key.shape[1])[None, :]
+                        == pick[:, None], big, key)
+    return tuple(out)
+
+
 def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
     """Fill ack / advertised window / timestamps on a departing TCP
     packet (ref: tcp_networkInterfaceIsAboutToSendPacket,
@@ -330,26 +360,11 @@ def stamp_at_wire(net: NetState, tcp: TcpState, mask, slot, words, now):
     words = put(words, pf.W_WIN, win)
     words = put(words, pf.W_TSVAL, _ms(now))
     words = put(words, pf.W_TSECHO, tse)
-    # advertise the SACK_RANGES lowest parked reassembly ranges
-    # (ascending by left edge — the full sack list of packet.h:52,77
-    # up to the 3-range budget)
-    oo_valid = tcp.oo_r > tcp.oo_l                      # [H,S,NR]
-    key = jnp.where(oo_valid, tcp.oo_l, jnp.iinfo(I32).max)
     cols = ((pf.W_SACKL, pf.W_SACKR), (pf.W_SACKL2, pf.W_SACKR2),
             (pf.W_SACKL3, pf.W_SACKR3))
-    for cl, cr in cols:
-        pick = jnp.argmin(key, axis=2)                  # [H,S]
-        have = key[jnp.arange(key.shape[0])[:, None],
-                   jnp.arange(key.shape[1])[None, :],
-                   pick] != jnp.iinfo(I32).max
-        sl = jnp.take_along_axis(tcp.oo_l, pick[..., None], axis=2)[..., 0]
-        sr = jnp.take_along_axis(tcp.oo_r, pick[..., None], axis=2)[..., 0]
-        hv = gather_hs(have, slot)
-        words = put(words, cl, jnp.where(hv, gather_hs(sl, slot), 0))
-        words = put(words, cr, jnp.where(hv, gather_hs(sr, slot), 0))
-        # exclude the picked range from the next round
-        taken = jnp.arange(key.shape[2])[None, None, :] == pick[..., None]
-        key = jnp.where(taken, jnp.iinfo(I32).max, key)
+    for (cl, cr), (sl, sr) in zip(cols, sack_advert(tcp, slot)):
+        words = put(words, cl, sl)
+        words = put(words, cr, sr)
     return words
 
 

@@ -34,16 +34,28 @@ queue and applies only the reduced steady-state semantics:
     per-source sequence numbers the serial path would assign;
   - delayed-ACK timer fires (incl. stale-generation no-ops), with the
     pure ACK's wire trip;
-  - RTX timer fires whose deadline moved (stale die, disarmed clear,
-    pending re-emit) — only a DUE deadline (a real RTO) is out of
-    model.
+  - RTX timer fires: stale die, disarmed clear, pending re-emit, and
+    a DUE deadline runs the full timeout machinery — slow-start
+    collapse, backoff, go-back-N retransmit, re-arm (the r5
+    loss-aware widening);
+  - the LOSS REGIME (r5, ref: tcp.c:854-1027 + tcp.c:84-89 — the
+    steady state of the reference's marquee lossy-topology configs):
+    old segments re-ACK; out-of-order segments park in the
+    reassembly ranges and elicit an immediate SACK-bearing dup-ACK;
+    in-order arrivals merge parked ranges and deliver the full gain;
+    arriving SACK blocks replace the sender scoreboard; dup-ACKs
+    count up to fast retransmit (3rd dup-ACK: ssthresh/cwnd from the
+    configured algorithm, recovery entry, snd_una segment re-sent
+    with the sack_clip_len decision rule); partial ACKs re-send;
+    full ACKs exit recovery; every outgoing packet carries the
+    stamp_at_wire SACK advertisement.
 
 Commit/abort: the pass runs on ALL hosts against candidate state and
 raises a per-host `bad` flag the moment anything outside the reduced
-model appears — SYN/FIN/RST, reordering or loss artifacts (seq !=
-rcv_nxt, dup-ACKs, SACK blocks, recovery state), window-update ACKs,
-buffer/token shortfalls, persist conditions, FIN emission, actual
-RTO expiry. Hosts flagged bad DISCARD their
+model appears — SYN/RST, handshake states, a FIN at the wrong seq or
+after a peer FIN (teardown-under-loss stays serial), window-update
+ACKs, buffer/token shortfalls, persist conditions, zero-window
+probes. Hosts flagged bad DISCARD their
 candidate state and fall back to the serial window fixpoint untouched
 — exactly like UDP bulk ineligibility (net/bulk.py). For committed
 hosts the final state is bit-identical to the serial path by
@@ -66,7 +78,7 @@ from shadow_tpu.core import rng, simtime
 from shadow_tpu.core.events import EventKind, _onehot, _put, _tie_key
 from shadow_tpu.net import packetfmt as pf
 from shadow_tpu.net import tcp_cong as cong
-from shadow_tpu.net.rings import gather_hs, set_hs
+from shadow_tpu.net.rings import gather_hs, set_hs, set_ring
 from shadow_tpu.net.sockets import lookup_socket
 from shadow_tpu.net.state import (
     NetConfig,
@@ -83,6 +95,7 @@ from shadow_tpu.net.tcp import (
     FLUSH_SEGMENTS,
     MAX_BACKOFF,
     MSS,
+    RESTART_CWND,
     RTO_MAX_MS,
     RTO_MIN_MS,
     SNDMEM_SKB,
@@ -90,6 +103,8 @@ from shadow_tpu.net.tcp import (
     TCP_RMEM_MAX,
     TcpSt,
     _ms,
+    sack_advert,
+    sack_clip_len,
 )
 
 I32 = jnp.int32
@@ -115,9 +130,12 @@ class TcpAppBulk:
         raise NotImplementedError
 
     def on_data(self, cfg: NetConfig, app, mask, slot, nread, now):
-        """One in-order delivery of `nread` bytes on (lane, slot) at
-        `now`, which the pass is about to hand to the app in full
-        (tcp_recv of everything available). Returns
+        """One in-order delivery on (lane, slot) at `now`: `nread` is
+        EVERYTHING available — the arriving segment's fresh bytes plus
+        any reassembly-range merge gain — which the pass is about to
+        hand to the app in full (the serial tcp_recv return). Apps
+        whose single read is bounded below that (partial reads) must
+        return ok False. Returns
         (app', ok[H], fwd_mask[H], fwd_slot[H], fwd_bytes[H]):
         ok False where the app would NOT read this socket fully right
         now (host falls back to serial); fwd_* request a tcp_send of
@@ -241,10 +259,6 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
         # ---- host-level static eligibility ---------------------------
         inwin0 = q0.time < wend64
-        kind_ok = jnp.all(
-            ~inwin0 | (q0.kind == EventKind.PACKET)
-            | (q0.kind == EventKind.TCP_DACK_TIMER)
-            | (q0.kind == EventKind.TCP_RTX_TIMER), axis=1)
         nonboot = jnp.all(~inwin0 | (q0.time >= cfg.bootstrap_end), axis=1)
         quiesced = (
             (net0.rq_count == 0)
@@ -256,10 +270,15 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
         codel_ok = ~net0.codel_dropping & (net0.codel_interval_expire == 0)
         app_ok = app_bulk.precheck(cfg, sim)
         has_work = jnp.any(inwin0, axis=1)
-        elig = kind_ok & nonboot & quiesced & codel_ok & app_ok & has_work
+        # kind_ok is NOT part of eligibility (r5 prefix-commit): a
+        # non-TCP kind mid-window just STOPS that host's scan there —
+        # the processed prefix commits and the serial fixpoint takes
+        # the tail. Window-level invariants (quiesced NIC/router, app
+        # steady state, bootstrap, codel idle) must still hold at
+        # window start for the per-iteration model to be sound at all.
+        elig = nonboot & quiesced & codel_ok & app_ok & has_work
         # precheck failures land in the top why bits for the debug view
-        why0 = (jnp.where(~kind_ok, jnp.int64(1) << 56, 0)
-                | jnp.where(~nonboot, jnp.int64(1) << 57, 0)
+        why0 = (jnp.where(~nonboot, jnp.int64(1) << 57, 0)
                 | jnp.where(~quiesced, jnp.int64(1) << 58, 0)
                 | jnp.where(~codel_ok, jnp.int64(1) << 59, 0)
                 | jnp.where(~app_ok, jnp.int64(1) << 60, 0)
@@ -285,6 +304,11 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
             def body(c):
                 sim, bad, why, seq_ctr, it = c
+                # prefix-commit snapshot: a lane whose event turns out
+                # to be out of model REVERTS to this iteration-start
+                # state (its event stays queued), so every lane always
+                # carries a clean serial-reachable prefix
+                sim_prev, seq_prev, bad_prev = sim, seq_ctr, bad
                 net, tcp, app = sim.net, sim.tcp, sim.app
                 q, p = _pop_masked(sim.events, wend64, ~bad & elig)
                 W = q.words.shape[-1]
@@ -306,16 +330,10 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 bad, why = _flag(bad, why, (is_pkt & (flags != pf.TCPF_ACK)
                                             & ~finp), 4)
                 # a FIN carrying data is out of model (this stack emits
-                # dataless FINs; a retransmitted FIN+data never arises
-                # losslessly)
+                # dataless FINs, including retransmitted ones —
+                # _retransmit_one regenerates the FIN at length 0)
                 bad, why = _flag(bad, why,
                                  (finp & (words[:, pf.W_LEN] != 0)), 1 << 44)
-                # arriving SACK blocks = upstream loss artifacts
-                sack_any = (
-                    (words[:, pf.W_SACKL] != 0) | (words[:, pf.W_SACKR] != 0)
-                    | (words[:, pf.W_SACKL2] != 0) | (words[:, pf.W_SACKR2] != 0)
-                    | (words[:, pf.W_SACKL3] != 0) | (words[:, pf.W_SACKR3] != 0))
-                bad, why = _flag(bad, why, (is_pkt & sack_any), 8)
 
                 src_port, dst_port = pf.ports_of(words)
                 dst_ip = words[:, pf.W_DSTIP].astype(jnp.uint32).astype(I64)
@@ -349,27 +367,23 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     | (st == TcpSt.FIN_WAIT_2))), 1 << 45)
                 is_data = is_data & ~bad
 
-                # loss / reorder artifacts abort: the model only covers the
-                # exactly-in-order case (seq == rcv_nxt), for data AND FINs
+                # loss artifacts are IN model (old data, out-of-order
+                # parking + SACK, dup-ACKs, fast retransmit, recovery,
+                # RTO) — the reference's steady state on lossy paths
+                # (ref: tcp.c:854-1027 retransmit machinery,
+                # tcp.c:84-89 recovery states). Out of model: a FIN at
+                # the wrong seq (teardown-under-loss stays serial).
                 rcv_nxt = gather_hs(tcp.rcv_nxt, slot)
-                bad, why = _flag(bad, why, (is_data & (seqno != rcv_nxt)), 64)
                 bad, why = _flag(bad, why, (finp & (seqno != rcv_nxt)),
                                  1 << 46)
-                # socket-level out-of-model state
                 sc = jnp.clip(slot, 0, S - 1)
-                oo_any = jnp.any(tcp.oo_r[rows, sc] > tcp.oo_l[rows, sc],
-                                 axis=1)
-                sk_any = jnp.any(tcp.sack_r[rows, sc] > tcp.sack_l[rows, sc],
-                                 axis=1)
-                bad, why = _flag(bad, why, (pkt & (oo_any | sk_any)), 128)
                 # pure ACKs to a socket whose peer already FINed are fine
                 # (the final ACK of our FIN in LAST_ACK/CLOSING); data or a
-                # re-FIN after the peer's FIN are not
+                # re-FIN after the peer's FIN are not (deferred FIN
+                # consumption on later arrivals stays serial)
                 bad, why = _flag(bad, why, ((is_data | finp)
                                             & gather_hs(tcp.fin_rcvd, slot)),
                                  256)
-                bad, why = _flag(bad, why, (pkt & (gather_hs(tcp.dup_acks, slot) > 0)), 512)
-                bad, why = _flag(bad, why, (pkt & gather_hs(tcp.in_recovery, slot)), 1024)
                 pkt = pkt & ~bad
                 is_data = is_data & ~bad
                 is_ack = is_ack & ~bad
@@ -424,20 +438,41 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     tcp.ts_recent, pkt & (seqno <= rcv_nxt) & (tsval >= tsr),
                     slot, tsval))
 
-                # snd_wnd + (empty) SACK scoreboard replacement
+                # snd_wnd + SACK scoreboard replacement (ref: tcp.c ACK
+                # path; scoreboard = the advertised list, an empty list
+                # clears it — tcp.py:962-975)
                 wnd_prev = gather_hs(tcp.snd_wnd, slot)
                 tcp = tcp.replace(snd_wnd=set_hs(tcp.snd_wnd, pkt, slot,
                                                  peer_win))
+                sack_l3 = jnp.stack(
+                    [words[:, pf.W_SACKL], words[:, pf.W_SACKL2],
+                     words[:, pf.W_SACKL3]], axis=1)
+                sack_r3 = jnp.stack(
+                    [words[:, pf.W_SACKR], words[:, pf.W_SACKR2],
+                     words[:, pf.W_SACKR3]], axis=1)
+                sel_sk = pkt[:, None] & (
+                    jnp.arange(S)[None, :] == slot[:, None])
+                tcp = tcp.replace(
+                    sack_l=jnp.where(sel_sk[..., None], sack_l3[:, None, :],
+                                     tcp.sack_l),
+                    sack_r=jnp.where(sel_sk[..., None], sack_r3[:, None, :],
+                                     tcp.sack_r),
+                )
 
                 una = gather_hs(tcp.snd_una, slot)
                 nxt = gather_hs(tcp.snd_nxt, slot)
                 smax = gather_hs(tcp.snd_max, slot)
                 new_ack = pkt & (ackno > una) & (ackno <= smax)
                 bad, why = _flag(bad, why, (pkt & (ackno > smax)), 4096)
-                bad, why = _flag(bad, why, (new_ack & (ackno > nxt)), 8192)
+                # healing ACK past a rewound snd_nxt: those bytes arrived
+                # from the pre-rewind transmission — jump forward
+                # (ref: tcp.py:979-983)
+                heal = new_ack & (ackno > nxt)
+                tcp = tcp.replace(snd_nxt=set_hs(tcp.snd_nxt, heal, slot,
+                                                 ackno))
+                nxt = jnp.where(heal, ackno, nxt)
                 dup_ack = pkt & (ackno == una) & (una < nxt) & (length == 0) \
                     & (peer_win == wnd_prev) & ~finp   # ~f_fin per RFC 5681
-                bad, why = _flag(bad, why, dup_ack, 16384)
                 # a DATA segment whose embedded ack also advances our send
                 # side (bidirectional stream on one socket) would need two
                 # flush targets in one iteration — out of model
@@ -464,19 +499,28 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                    jnp.zeros((H,), I32)),
                 )
 
-                # congestion growth — same hook code as the serial path
+                # congestion hooks — same code path as the serial engine
+                # incl. fast-recovery transitions (ref: tcp.py:1011-1047)
+                in_rec = gather_hs(tcp.in_recovery, slot)
+                recover = gather_hs(tcp.recover, slot)
                 cwnd = gather_hs(tcp.cwnd, slot)
                 ssth = gather_hs(tcp.ssthresh, slot)
                 ca = gather_hs(tcp.ca_acc, slot)
                 n_acked = jnp.where(new_ack, (ackno - una + MSS - 1) // MSS, 0)
-                ss = new_ack & (cwnd < ssth)
+                full_rec = new_ack & in_rec & (ackno >= recover)
+                partial = new_ack & in_rec & (ackno < recover)
+                normal = new_ack & ~in_rec
+                ss = normal & (cwnd < ssth)
                 grown = cwnd + n_acked
                 spill = ss & (grown >= ssth)
                 cwnd1 = jnp.where(ss, jnp.minimum(grown, ssth), cwnd)
+                # leaving fast recovery deflates to ssthresh
+                cwnd1 = jnp.where(full_rec, ssth, cwnd1)
                 ca_in = jnp.where(spill, grown - ssth,
-                                  jnp.where(new_ack & ~ss, n_acked, 0))
-                in_ca = (new_ack & ~ss) | spill
-                ca_base = jnp.where(spill, 0, ca)
+                                  jnp.where(full_rec | (normal & ~ss),
+                                            n_acked, 0))
+                in_ca = (normal & ~ss) | spill | full_rec
+                ca_base = jnp.where(spill | full_rec, 0, ca)
                 cwnd1, ca1, epoch1 = cong.ca_update(
                     alg, in_ca, cwnd1, jnp.where(in_ca, ca_base, ca), ca_in,
                     gather_hs(tcp.cub_wmax, slot),
@@ -485,6 +529,10 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     cwnd=set_hs(tcp.cwnd, new_ack, slot, cwnd1),
                     ca_acc=set_hs(tcp.ca_acc, new_ack, slot, ca1),
                     cub_epoch_ms=set_hs(tcp.cub_epoch_ms, in_ca, slot, epoch1),
+                    in_recovery=set_hs(tcp.in_recovery, full_rec, slot,
+                                       False),
+                    dup_acks=set_hs(tcp.dup_acks, new_ack, slot,
+                                    jnp.zeros((H,), I32)),
                     snd_una=set_hs(tcp.snd_una, new_ack, slot, ackno),
                 )
                 una2 = jnp.where(new_ack, ackno, una)
@@ -555,6 +603,51 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
                 net = set_writable(net, wroom, slot, True)
 
+                # dup-ack counting / fast retransmit entry (ref:
+                # tcp.py:1110-1129 — ssthresh/entry cwnd from the
+                # configured algorithm). The retransmission itself is
+                # wired FIRST in the wire stage below (serial emission
+                # order: _retransmit_one precedes the flush).
+                def _dupack_sec(ops):
+                    tcp, _ = ops
+                    da = gather_hs(tcp.dup_acks, slot) + 1
+                    tcp = tcp.replace(dup_acks=set_hs(
+                        tcp.dup_acks, dup_ack, slot, da))
+                    enter_fr = dup_ack & (da == 3) & ~in_rec
+                    ssth_fr = cong.ssthresh_on_loss(alg, cwnd)
+                    tcp = tcp.replace(
+                        ssthresh=set_hs(tcp.ssthresh, enter_fr, slot,
+                                        ssth_fr),
+                        cwnd=set_hs(tcp.cwnd, enter_fr, slot,
+                                    cong.cwnd_on_recovery_entry(alg,
+                                                                ssth_fr)))
+                    wmax1, ep1 = cong.on_loss_event(
+                        alg, enter_fr, cwnd, gather_hs(tcp.cub_wmax, slot),
+                        gather_hs(tcp.cub_epoch_ms, slot))
+                    tcp = tcp.replace(
+                        cub_wmax=set_hs(tcp.cub_wmax, enter_fr, slot, wmax1),
+                        cub_epoch_ms=set_hs(tcp.cub_epoch_ms, enter_fr, slot,
+                                            ep1),
+                        in_recovery=set_hs(tcp.in_recovery, enter_fr, slot,
+                                           True),
+                        recover=set_hs(tcp.recover, enter_fr, slot, nxt),
+                        fr_entries=tcp.fr_entries + enter_fr.astype(I64))
+                    if alg != cong.AIMD:
+                        # window inflation while in recovery (entry
+                        # iteration excluded — in_rec is the pre-entry
+                        # value, matching serial)
+                        inflate = dup_ack & in_rec
+                        tcp = tcp.replace(cwnd=set_hs(
+                            tcp.cwnd, inflate, slot,
+                            gather_hs(tcp.cwnd, slot) + 1))
+                    return tcp, enter_fr
+
+                tcp, enter_fr = _gate(jnp.any(dup_ack), _dupack_sec,
+                                      (tcp, jnp.zeros((H,), bool)))
+                # the segment at snd_una re-sends on recovery entry and
+                # on every partial ACK (ref: tcp.py:1132)
+                retx_ack = (enter_fr | partial) & ~bad
+
                 # RTO deadline after progress (ref: tcp.c ACK path)
                 still_out = new_ack & (ackno < smax)
                 done_ack = new_ack & (ackno >= smax)
@@ -614,25 +707,100 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     jnp.any(fin_ever_any), _fin_acked_sec,
                     (net, tcp, q, seq_ctr, bad, why))
 
-                # ===== in-order data receive ==============================
+                # ===== data receive (ref: tcp.py:1174-1247) ===============
+                # old segments re-ACK; fresh segments that fit deliver
+                # in order (merging parked reassembly ranges) or park
+                # out of order; overfull segments drop + re-ACK — the
+                # serial data path in full, minus TIME_WAIT stragglers
+                seg_end = seqno + length
+                old_d = is_data & (seg_end <= rcv_nxt)
+                fresh = is_data & ~old_d
+                oo_bytes = jnp.sum(tcp.oo_r[rows, sc] - tcp.oo_l[rows, sc],
+                                   axis=1, dtype=I32)
                 freeb = gather_hs(net.sk_rcvbuf, slot) \
-                    - gather_hs(tcp.app_rbytes, slot)
-                bad, why = _flag(bad, why, (is_data & (length > freeb)), 65536)
-                is_data = is_data & ~bad
+                    - gather_hs(tcp.app_rbytes, slot) - oo_bytes
+                fits = fresh & (length <= freeb)
+                tcp = tcp.replace(drop_rwin=tcp.drop_rwin
+                                  + (fresh & ~fits).astype(I64))
+                inorder = fits & (seqno <= rcv_nxt)
+                adv = jnp.where(inorder, seg_end - rcv_nxt, 0)
+                rcv1 = rcv_nxt + adv
                 rb0 = gather_hs(tcp.app_rbytes, slot)
+                rbytes = rb0 + adv
+
+                def _oo_sec(ops):
+                    tcp, rcv1, rbytes, _ = ops
+                    # merge any reassembly range now contiguous
+                    # (unrolled bounded scan, ref: tcp.py:1198-1212)
+                    NR = tcp.oo_l.shape[2]
+                    for _i in range(NR):
+                        ool = tcp.oo_l[rows, sc]          # [H,NR]
+                        oor = tcp.oo_r[rows, sc]
+                        hit = (ool <= rcv1[:, None]) & (oor > ool)
+                        take = jnp.any(hit & inorder[:, None], axis=1)
+                        pick = jnp.argmax(hit, axis=1)
+                        new_r = oor[rows, pick]
+                        gain = jnp.where(take & (new_r > rcv1),
+                                         new_r - rcv1, 0)
+                        rcv1 = rcv1 + gain
+                        rbytes = rbytes + gain
+                        tcp = tcp.replace(
+                            oo_l=set_ring(tcp.oo_l, take & inorder, slot,
+                                          pick, 0),
+                            oo_r=set_ring(tcp.oo_r, take & inorder, slot,
+                                          pick, 0),
+                        )
+                    # out-of-order: park [seq, seg_end) in a range
+                    # (ref: tcp.py:1217-1236)
+                    ooseg = fits & (seqno > rcv_nxt)
+                    ool = tcp.oo_l[rows, sc]
+                    oor = tcp.oo_r[rows, sc]
+                    overlap = (seqno[:, None] <= oor) \
+                        & (seg_end[:, None] >= ool) & (oor > ool)
+                    mergeable = jnp.any(overlap, axis=1)
+                    mpick = jnp.argmax(overlap, axis=1)
+                    empty_rng = oor <= ool
+                    has_empty = jnp.any(empty_rng, axis=1)
+                    epick = jnp.argmax(empty_rng, axis=1)
+                    do_merge = ooseg & mergeable
+                    do_new = ooseg & ~mergeable & has_empty
+                    dropped_oo = ooseg & ~mergeable & ~has_empty
+                    tcp = tcp.replace(drop_oo_full=tcp.drop_oo_full
+                                      + dropped_oo.astype(I64))
+                    pick = jnp.where(do_merge, mpick, epick)
+                    nl = jnp.where(do_merge,
+                                   jnp.minimum(ool[rows, pick], seqno), seqno)
+                    nr = jnp.where(do_merge,
+                                   jnp.maximum(oor[rows, pick], seg_end),
+                                   seg_end)
+                    tcp = tcp.replace(
+                        oo_l=set_ring(tcp.oo_l, do_merge | do_new, slot,
+                                      pick, nl),
+                        oo_r=set_ring(tcp.oo_r, do_merge | do_new, slot,
+                                      pick, nr),
+                    )
+                    return tcp, rcv1, rbytes, ooseg
+
+                tcp, rcv1, rbytes, ooseg = _gate(
+                    jnp.any(fits & (seqno > rcv_nxt))
+                    | jnp.any((oo_bytes > 0) & inorder),
+                    _oo_sec, (tcp, rcv1, rbytes, jnp.zeros((H,), bool)))
                 tcp = tcp.replace(
-                    rcv_nxt=set_hs(tcp.rcv_nxt, is_data, slot,
-                                   rcv_nxt + length),
-                    app_rbytes=set_hs(tcp.app_rbytes, is_data, slot,
-                                      rb0 + length),
+                    rcv_nxt=set_hs(tcp.rcv_nxt, inorder, slot, rcv1),
+                    app_rbytes=set_hs(tcp.app_rbytes, inorder, slot,
+                                      rbytes),
                 )
+                readable = inorder & (gather_hs(tcp.app_rbytes, slot) > 0)
                 fl_r = gather_hs(net.sk_flags, slot)
                 net = net.replace(
-                    sk_flags=set_hs(net.sk_flags, is_data, slot,
+                    sk_flags=set_hs(net.sk_flags, readable, slot,
                                     fl_r | SocketFlags.READABLE),
-                    sk_in_gen=set_hs(net.sk_in_gen, is_data, slot,
+                    sk_in_gen=set_hs(net.sk_in_gen, readable, slot,
                                      gather_hs(net.sk_in_gen, slot) + 1),
                 )
+                # loss-signalling ACKs go out immediately with the SACK
+                # advertisement (ref: tcp.py:1289-1297 `immediate`)
+                imm_ack = (old_d | ooseg | (fresh & ~fits)) & ~bad
 
                 # ===== peer FIN (ref: tcp.c FIN processing) ===============
                 # in-order only (seq == rcv_nxt checked above), so the FIN
@@ -692,7 +860,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # is the FIRST emission of this micro-step's ACK-generation
                 # stage (seq order); a consumed FIN coalesces its ACK like
                 # in-order data (tcp.c:2066-2091 `delayed = inorder|fin`)
-                ackable = is_data | (fin_now & ~bad)
+                ackable = inorder | (fin_now & ~bad)
                 cnt = gather_hs(tcp.dack_counter, slot) + 1
                 tcp = tcp.replace(dack_counter=set_hs(
                     tcp.dack_counter, ackable, slot, cnt))
@@ -722,18 +890,20 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                              (q, seq_ctr, bad, why))
 
                 # ===== app consume + forward ==============================
-                app, app_okm, fwd_mask, fwd_slot, fwd_bytes = app_bulk.on_data(
-                    cfg, app, is_data, slot, length, t)
-                bad, why = _flag(bad, why, (is_data & ~app_okm), 262144)
-                is_data = is_data & ~bad
-                fwd_mask = fwd_mask & is_data
-                # tcp_recv semantics: read EVERYTHING available
+                # tcp_recv semantics: read EVERYTHING available — the
+                # delivered amount includes any merged reassembly gain,
+                # exactly the serial tcp_recv return
                 avail = gather_hs(tcp.app_rbytes, slot)
                 win_before = gather_hs(net.sk_rcvbuf, slot) - avail
+                app, app_okm, fwd_mask, fwd_slot, fwd_bytes = app_bulk.on_data(
+                    cfg, app, inorder, slot, avail, t)
+                bad, why = _flag(bad, why, (inorder & ~app_okm), 262144)
+                inorder = inorder & ~bad
+                fwd_mask = fwd_mask & inorder
                 tcp = tcp.replace(app_rbytes=set_hs(
-                    tcp.app_rbytes, is_data, slot, jnp.zeros((H,), I32)))
+                    tcp.app_rbytes, inorder, slot, jnp.zeros((H,), I32)))
                 # Linux-DRS receive autotune (ref: tcp.c:535-564)
-                at_on = is_data & net.autotune_rcv
+                at_on = inorder & net.autotune_rcv
                 copied = gather_hs(tcp.at_copied, slot) + avail
                 space = jnp.maximum(2 * copied, gather_hs(tcp.at_space, slot))
                 cur_r = gather_hs(net.sk_rcvbuf, slot)
@@ -764,11 +934,11 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # drained -> clear READABLE (no EOF in the eligible regime)
                 fl_d = gather_hs(net.sk_flags, slot)
                 net = net.replace(sk_flags=set_hs(
-                    net.sk_flags, is_data, slot,
+                    net.sk_flags, inorder, slot,
                     fl_d & ~SocketFlags.READABLE))
                 # receiver silly-window update ACK => out of model
                 win_after = gather_hs(net.sk_rcvbuf, slot)
-                bad, why = _flag(bad, why, (is_data & (win_before < 2 * MSS) & (win_after - win_before >= MSS)), 524288)
+                bad, why = _flag(bad, why, (inorder & (win_before < 2 * MSS) & (win_after - win_before >= MSS)), 524288)
 
                 # ===== app EOF: the teardown cascade ======================
                 # The serial app observes eof in its tcp_recv on the FIN's
@@ -934,11 +1104,10 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     in_flight = gather_hs(tcp.rtx_event, fslot)
                     earlier = need & in_flight & (
                         deadline < gather_hs(tcp.rtx_fire, fslot))
+                    # (an in-window deadline is fine: the pushed event
+                    # pops later in this scan and the RTX fire section
+                    # handles pending/due alike)
                     need_event = (need & ~in_flight) | earlier
-                    bad, why = _flag(
-                        bad, why, (need_event & (deadline < wend64)),
-                        67108864)
-                    need_event = need_event & ~bad
                     gen = gather_hs(tcp.rtx_gen, fslot) + 1
                     tcp = tcp.replace(
                         rtx_gen=set_hs(tcp.rtx_gen, need_event, fslot, gen),
@@ -996,9 +1165,6 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     earl2 = need2 & inflt2 & (
                         dl2 < gather_hs(tcp.rtx_fire, c2_slot))
                     nev2 = (need2 & ~inflt2) | earl2
-                    bad, why = _flag(bad, why, (nev2 & (dl2 < wend64)),
-                                     1 << 54)
-                    nev2 = nev2 & ~bad
                     gen2 = gather_hs(tcp.rtx_gen, c2_slot) + 1
                     tcp = tcp.replace(
                         rtx_gen=set_hs(tcp.rtx_gen, nev2, c2_slot, gen2),
@@ -1039,19 +1205,22 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # ===== RTX timer fire (ref: handle_tcp_rtx) ===============
                 # stale generations die; a disarmed deadline clears the
                 # in-flight flag; a deadline that MOVED later re-emits the
-                # covering event. A DUE deadline is a real RTO — loss
-                # recovery is out of model.
+                # covering event. A DUE deadline runs the full timeout
+                # machinery (ref: tcp.py:1349-1401): collapse to slow
+                # start, backoff, go-back-N retransmit of the snd_una
+                # segment (wired in the wire stage below), re-arm.
+                # Only the zero-window persist probe stays out of model.
+                rslot = jnp.where(is_rtx, p.word(0), 0)
+
                 def _rtx_fire_sec(ops):
-                    tcp, q, seq_ctr, bad, why = ops
+                    tcp, q, seq_ctr, bad, why, _ = ops
                     rgen = p.word(1)
-                    rslot = jnp.where(is_rtx, p.word(0), 0)
                     live_rtx = is_rtx & (rgen == gather_hs(tcp.rtx_gen,
                                                            rslot))
                     rdl = gather_hs(tcp.rtx_expire, rslot)
                     r_disarm = live_rtx & (rdl == simtime.INVALID)
                     r_pending = live_rtx & ~r_disarm & (t < rdl)
                     r_due = live_rtx & ~r_disarm & ~r_pending
-                    bad, why = _flag(bad, why, r_due, 1 << 40)
                     tcp = tcp.replace(rtx_event=set_hs(
                         tcp.rtx_event, r_disarm, rslot, False))
                     r_emit = r_pending & ~bad
@@ -1066,20 +1235,131 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     seq_ctr = seq_ctr + r_emit.astype(I32)
                     tcp = tcp.replace(rtx_fire=set_hs(
                         tcp.rtx_fire, r_emit, rslot, rdl))
-                    return tcp, q, seq_ctr, bad, why
 
-                tcp, q, seq_ctr, bad, why = _gate(
+                    # ---- timeout (ref: tcp.py:1349-1401) -----------------
+                    r_una = gather_hs(tcp.snd_una, rslot)
+                    r_nxt = gather_hs(tcp.snd_nxt, rslot)
+                    r_live = r_due & (r_una < r_nxt)
+                    r_probe = r_due & (r_una == r_nxt) \
+                        & (gather_hs(tcp.snd_end, rslot) > r_nxt) \
+                        & (gather_hs(tcp.snd_wnd, rslot) == 0)
+                    bad, why = _flag(bad, why, r_probe, 1 << 40)
+                    r_live = r_live & ~bad
+                    r_cwnd = gather_hs(tcp.cwnd, rslot)
+                    tcp = tcp.replace(
+                        ssthresh=set_hs(tcp.ssthresh, r_live, rslot,
+                                        cong.ssthresh_on_loss(alg, r_cwnd)),
+                        cwnd=set_hs(tcp.cwnd, r_live, rslot,
+                                    jnp.full((H,), RESTART_CWND, I32)))
+                    wmax_t, ep_t = cong.on_loss_event(
+                        alg, r_live, r_cwnd, gather_hs(tcp.cub_wmax, rslot),
+                        gather_hs(tcp.cub_epoch_ms, rslot))
+                    tcp = tcp.replace(
+                        cub_wmax=set_hs(tcp.cub_wmax, r_live, rslot, wmax_t),
+                        cub_epoch_ms=set_hs(tcp.cub_epoch_ms, r_live, rslot,
+                                            ep_t),
+                        ca_acc=set_hs(tcp.ca_acc, r_live, rslot,
+                                      jnp.zeros((H,), I32)),
+                        in_recovery=set_hs(tcp.in_recovery, r_live, rslot,
+                                           False),
+                        dup_acks=set_hs(tcp.dup_acks, r_live, rslot,
+                                        jnp.zeros((H,), I32)),
+                        backoff=set_hs(tcp.backoff, r_live, rslot,
+                                       jnp.minimum(
+                                           gather_hs(tcp.backoff, rslot) + 1,
+                                           MAX_BACKOFF)))
+                    tcp = tcp.replace(
+                        rtx_event=set_hs(tcp.rtx_event, r_due, rslot, False),
+                        rtx_expire=set_hs(tcp.rtx_expire, r_due, rslot,
+                                          jnp.full((H,), simtime.INVALID,
+                                                   I64)))
+                    # re-arm with the bumped backoff (_arm_rtx for live;
+                    # the retransmit segment itself wires below in
+                    # serial order). After the due-fire cleared
+                    # rtx_event, need_event is always true for r_live.
+                    rto_r = (gather_hs(tcp.rto_ms, rslot).astype(I64)
+                             << jnp.minimum(gather_hs(tcp.backoff, rslot),
+                                            MAX_BACKOFF).astype(I64)) \
+                        * simtime.ONE_MILLISECOND
+                    rto_r = jnp.minimum(
+                        rto_r, I64(RTO_MAX_MS) * simtime.ONE_MILLISECOND)
+                    rdl_new = t + rto_r
+                    tcp = tcp.replace(rtx_expire=set_hs(
+                        tcp.rtx_expire, r_live, rslot, rdl_new))
+                    gen_r = gather_hs(tcp.rtx_gen, rslot) + 1
+                    tcp = tcp.replace(
+                        rtx_gen=set_hs(tcp.rtx_gen, r_live, rslot, gen_r),
+                        rtx_event=set_hs(tcp.rtx_event, r_live, rslot, True),
+                        rtx_fire=set_hs(tcp.rtx_fire, r_live, rslot,
+                                        rdl_new))
+                    rw_r = (jnp.zeros((H, W), I32)
+                            .at[:, 0].set(rslot.astype(I32))
+                            .at[:, 1].set(gen_r))
+                    free_r = jnp.any(q.time == simtime.INVALID, axis=1)
+                    bad, why = _flag(bad, why, r_live & ~free_r, 8)
+                    r_live = r_live & ~bad
+                    q = _push_local(q, r_live, rdl_new,
+                                    EventKind.TCP_RTX_TIMER, rw_r, lane,
+                                    seq_ctr)
+                    seq_ctr = seq_ctr + r_live.astype(I32)
+                    return tcp, q, seq_ctr, bad, why, r_live
+
+                tcp, q, seq_ctr, bad, why, retx_rto = _gate(
                     jnp.any(is_rtx), _rtx_fire_sec,
-                    (tcp, q, seq_ctr, bad, why))
+                    (tcp, q, seq_ctr, bad, why, zb))
 
                 # ===== wire: out-ring cycle + stamps + outbox =============
-                # Primary burst: n_seg data segments (+ the FIN tail) on
-                # fslot, or one pure ACK on dslot — mutually exclusive per
-                # lane. A relay dual-close adds ONE secondary FIN on
-                # c2_slot, wired after the primary burst (FIFO priority
-                # order, exactly the serial drain).
-                wslot = jnp.where(fire, dslot, fslot)
-                n_pkt = jnp.where(fire, 1, n_seg + fin1.astype(I32))
+                # Per-lane burst, in serial emission order: [retransmit
+                # segment] -> [n_seg flush data (+ FIN tail)] -> [pure
+                # ACK: dack fire OR loss-signalling immediate ACK] — all
+                # on ONE wslot by construction (retx coexists with flush
+                # only on a partial ACK, where both target the arrival
+                # socket). A relay dual-close adds ONE secondary FIN on
+                # c2_slot, wired last (FIFO priority order, exactly the
+                # serial drain).
+                retx_do = (retx_ack | retx_rto) & ~bad
+                rtslot = jnp.where(retx_rto, rslot, slot)
+                # handshake retransmits (SYN/SYN|ACK) are out of model
+                rt_st = gather_hs(tcp.st, rtslot)
+                bad, why = _flag(bad, why,
+                                 retx_do & (rt_st < TcpSt.ESTABLISHED), 512)
+                retx_do = retx_do & ~bad
+                # regenerate the snd_una segment (ref: _retransmit_one,
+                # tcp.py:767-807): FIN from the state machine, data from
+                # the [snd_una, snd_end) byte range clipped at the first
+                # peer-sacked edge (sack_clip_len)
+                rt_una = gather_hs(tcp.snd_una, rtslot)
+                rt_end = gather_hs(tcp.snd_end, rtslot)
+                rt_nxt = gather_hs(tcp.snd_nxt, rtslot)
+                rt_fin_ever = gather_hs(tcp.fin_pending, rtslot) & (
+                    gather_hs(tcp.snd_max, rtslot) == rt_end + 1)
+                retx_fin = retx_do & rt_fin_ever & (rt_una == rt_end)
+                retx_data = retx_do & ~retx_fin & (rt_una < rt_end)
+                rtsc = jnp.clip(rtslot, 0, S - 1)
+                rt_len = sack_clip_len(
+                    rt_una, jnp.minimum(rt_end - rt_una, MSS),
+                    tcp.sack_l[rows, rtsc], tcp.sack_r[rows, rtsc])
+                rt_len = jnp.where(retx_data, rt_len, 0).astype(I32)
+                retx_sent = retx_fin | retx_data
+                rt_flags = jnp.where(retx_fin, pf.TCPF_FIN | pf.TCPF_ACK,
+                                     pf.TCPF_ACK)
+                tcp = tcp.replace(retx_segs=tcp.retx_segs
+                                  + retx_sent.astype(I64))
+                # go-back-N: an RTO rewinds snd_nxt to just past the
+                # resent segment (ref: tcp.py:1394-1399)
+                resent_end = jnp.where(retx_data, rt_una + rt_len,
+                                       rt_una + 1)
+                rewind = retx_rto & retx_sent & (resent_end < rt_nxt)
+                tcp = tcp.replace(snd_nxt=set_hs(tcp.snd_nxt, rewind,
+                                                 rtslot, resent_end))
+
+                pure_ack = (fire | imm_ack) & ~bad
+                wslot = jnp.where(fire, dslot,
+                                  jnp.where(retx_rto, rslot,
+                                            jnp.where(imm_ack, slot,
+                                                      fslot)))
+                n_pkt = retx_sent.astype(I32) + n_seg + fin1.astype(I32) \
+                    + pure_ack.astype(I32)
                 # the serial NIC wires at most nic_drain (== FLUSH_SEGMENTS)
                 # packets per micro-step and chains a NIC_SEND for the rest
                 # — a burst past that bound (4 data + FIN, or a dual-close
@@ -1087,7 +1367,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 bad, why = _flag(bad, why,
                                  (n_pkt + fin2.astype(I32) > FLUSH_SEGMENTS),
                                  1 << 39)
-                sending = (fire | (n_seg > 0) | fin1) & ~bad
+                sending = (retx_sent | pure_ack | (n_seg > 0) | fin1) & ~bad
                 fin2 = fin2 & ~bad
                 n_pkt = jnp.where(sending, n_pkt, 0)
 
@@ -1132,8 +1412,6 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 tcp = tcp.replace(dack_counter=set_hs(
                     tcp.dack_counter, sending, wslot, jnp.zeros((H,), I32)))
 
-                seg_base = jnp.where(fire, gather_hs(tcp.snd_nxt, wslot),
-                                     g_nxt)
                 out = sim.outbox
                 M = out.capacity
                 drops = jnp.zeros((H,), I32)
@@ -1144,17 +1422,20 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 emitted = jnp.zeros((H,), I32)
                 ob_count = out.count
                 ob_over = jnp.zeros((H,), bool)
-                def wire_one(state, pj, lenj, seqj, flagsj, stamps, j_ctr):
+                def wire_one(state, pj, lenj, seqj, flagsj, stamps, j_ctr,
+                             extraj=0):
                     """Wire ONE packet per masked lane: token policing,
-                    enqueue-time words + wire stamps, the reliability draw
-                    at the running counter, the outbox append. `state` =
-                    (out, bad, why, last_drop, drops, tx_wl, emitted,
-                    ob_over); stamps = (ack, win, tse, sport, dport, dip,
-                    dsth, lat, rel)."""
+                    enqueue-time words + wire stamps (incl. the SACK
+                    advertisement — stamp_at_wire parity), the
+                    reliability draw at the running counter, the outbox
+                    append. `state` = (out, bad, why, last_drop, drops,
+                    tx_wl, emitted, ob_over); stamps = (ack, win, tse,
+                    sport, dport, dip, dsth, lat, rel, sack3); extraj =
+                    extra audit-status bits (retransmit stages)."""
                     (out, bad, why, last_drop, drops, tx_wl, emitted,
                      ob_over) = state
                     (s_ack, s_win, s_tse, s_sport, s_dport, s_dip, s_dsth,
-                     s_lat, s_rel) = stamps
+                     s_lat, s_rel, s_sk) = stamps
                     wlj = pf.wire_length(jnp.full((H,), pf.PROTO_TCP, I32),
                                          lenj).astype(I64)
                     # token policing before EACH wire (serial `can` check)
@@ -1178,15 +1459,24 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                         s_dip.astype(jnp.uint32).astype(I32))
                     ring_w = ring_w.at[:, pf.W_STATUS].set(
                         pf.PDS_SND_CREATED | pf.PDS_SND_TCP_ENQUEUE_THROTTLED
-                        | pf.PDS_SND_SOCKET_BUFFERED)
+                        | pf.PDS_SND_SOCKET_BUFFERED | extraj)
                     wire_w = ring_w.at[:, pf.W_ACK].set(s_ack)
                     wire_w = wire_w.at[:, pf.W_WIN].set(s_win)
                     wire_w = wire_w.at[:, pf.W_TSVAL].set(_ms(t))
                     wire_w = wire_w.at[:, pf.W_TSECHO].set(s_tse)
+                    (sk1l, sk1r), (sk2l, sk2r), (sk3l, sk3r) = s_sk
+                    wire_w = wire_w.at[:, pf.W_SACKL].set(sk1l)
+                    wire_w = wire_w.at[:, pf.W_SACKR].set(sk1r)
+                    wire_w = wire_w.at[:, pf.W_SACKL2].set(sk2l)
+                    wire_w = wire_w.at[:, pf.W_SACKR2].set(sk2r)
+                    wire_w = wire_w.at[:, pf.W_SACKL3].set(sk3l)
+                    wire_w = wire_w.at[:, pf.W_SACKR3].set(sk3r)
                     wire_w = wire_w.at[:, pf.W_STATUS].set(
                         ring_w[:, pf.W_STATUS] | pf.PDS_SND_INTERFACE_SENT)
                     # reliability draw at the exact serial counter
-                    u = rng.uniform_at(net.rng_keys, rngc + j_ctr)
+                    u = rng.uniform_at(net.rng_keys,
+                                       rngc + jnp.asarray(j_ctr,
+                                                          jnp.uint32))
                     dropj = pj & (lenj > 0) & (u > s_rel)
                     sendj = pj & ~dropj
                     wire_sent = wire_w.at[:, pf.W_STATUS].set(
@@ -1222,22 +1512,49 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                             ob_over)
 
                 stamps1 = (stamp_ack, stamp_win, stamp_tse, w_sport,
-                           w_dport, w_dip, w_dsth, w_lat, w_rel)
+                           w_dport, w_dip, w_dsth, w_lat, w_rel,
+                           sack_advert(tcp, wslot))
                 state = (out, bad, why, last_drop, drops, tx_wl, emitted,
                          ob_over)
+                # 1) the retransmitted snd_una segment (serial order:
+                #    _retransmit_one precedes the flush)
+                retx_status = jnp.where(
+                    retx_sent,
+                    pf.PDS_SND_TCP_ENQUEUE_RETRANSMIT
+                    | pf.PDS_SND_TCP_DEQUEUE_RETRANSMIT
+                    | pf.PDS_SND_TCP_RETRANSMITTED, 0)
+                state = _gate(
+                    jnp.any(retx_sent),
+                    lambda s: wire_one(s, retx_sent & sending, rt_len,
+                                       rt_una, rt_flags, stamps1,
+                                       jnp.zeros((H,), I32), retx_status),
+                    state)
+                rt_n = retx_sent.astype(I32)
+                # 2) the flush burst: n_seg data segments + the FIN tail
                 for j in range(FLUSH_SEGMENTS + 1):
-                    pj = sending & (j < n_pkt)
-                    is_fin_j = ~fire & fin1 & (j == n_seg)
+                    pj = sending & (j < n_seg + fin1.astype(I32))
+                    is_fin_j = fin1 & (j == n_seg)
                     lenj = jnp.where(
-                        fire | is_fin_j, 0,
+                        is_fin_j, 0,
                         jnp.clip(A_now - j * MSS, 0, MSS)).astype(I32)
                     seqj = jnp.where(is_fin_j, g_nxt + A_now,
-                                     seg_base + j * MSS)
+                                     g_nxt + j * MSS)
                     flagsj = jnp.where(is_fin_j,
                                        pf.TCPF_FIN | pf.TCPF_ACK,
                                        pf.TCPF_ACK)
                     state = wire_one(state, pj, lenj, seqj, flagsj,
-                                     stamps1, j)
+                                     stamps1, rt_n + j)
+                # 3) the pure ACK: a fired delayed ACK, or the immediate
+                #    loss-signalling ACK (old/out-of-order/dropped data)
+                state = _gate(
+                    jnp.any(pure_ack),
+                    lambda s: wire_one(s, pure_ack & sending,
+                                       jnp.zeros((H,), I32),
+                                       gather_hs(tcp.snd_nxt, wslot),
+                                       jnp.full((H,), pf.TCPF_ACK, I32),
+                                       stamps1,
+                                       rt_n + n_seg + fin1.astype(I32)),
+                    state)
                 # secondary FIN (dual close) after the whole primary burst
                 def _wire2_sec(ops):
                     state, tcp, fin2v = ops
@@ -1251,7 +1568,8 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                gather_hs(net.sk_peer_ip, c2_slot),
                                gather_hs(peer_h, c2_slot),
                                gather_hs(lat_s, c2_slot),
-                               gather_hs(rel_s, c2_slot))
+                               gather_hs(rel_s, c2_slot),
+                               sack_advert(tcp, c2_slot))
                     (out, bad, why, last_drop, drops, tx_wl, emitted,
                      ob_over) = state
                     bad, why = _flag(
@@ -1300,7 +1618,12 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     ctr_tx_bytes=net.ctr_tx_bytes
                     + jnp.where(wired, tx_wl, 0),
                     ctr_tx_data_bytes=net.ctr_tx_data_bytes
-                    + jnp.where(sending, A_now, 0).astype(I64),
+                    + jnp.where(sending, A_now + rt_len, 0).astype(I64),
+                    ctr_tx_retx_bytes=net.ctr_tx_retx_bytes
+                    + jnp.where(wired & retx_sent,
+                                pf.wire_length(
+                                    jnp.full((H,), pf.PROTO_TCP, I32),
+                                    rt_len).astype(I64), 0),
                     ctr_drop_reliability=net.ctr_drop_reliability
                     + drops.astype(I64),
                     last_drop_status=last_drop,
@@ -1312,17 +1635,58 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
                 sim = sim.replace(events=q, outbox=out, net=net, tcp=tcp,
                                   app=app)
+
+                # ---- prefix-commit revert -----------------------------
+                # lanes that hit an out-of-model boundary THIS iteration
+                # roll their rows back to the iteration-start snapshot:
+                # the offending event stays queued for the serial
+                # fixpoint and everything before it stays committed.
+                # Unmutated leaves are identical tracers (functional
+                # updates), so the tree-map only selects on arrays the
+                # body actually wrote (~a few MB), and only on
+                # iterations where some lane stopped.
+                stopped_now = bad & ~bad_prev
+                # select only the leaves this iteration actually wrote
+                # (unmutated leaves are the SAME tracer, `is`-testable
+                # outside any cond) so the gated revert never touches
+                # the big dead planes (out_words etc.)
+                prev_leaves, treedef = jax.tree_util.tree_flatten(
+                    (sim_prev, seq_prev))
+                new_leaves, _ = jax.tree_util.tree_flatten((sim, seq_ctr))
+                idx = [i for i, (a, b) in enumerate(
+                    zip(prev_leaves, new_leaves))
+                    if a is not b and b.ndim >= 1 and b.shape[0] == H]
+
+                def _revert(pairs):
+                    return tuple(
+                        jnp.where(stopped_now.reshape(
+                            (H,) + (1,) * (b.ndim - 1)), a, b)
+                        for a, b in pairs)
+
+                reverted = jax.lax.cond(
+                    jnp.any(stopped_now), _revert,
+                    lambda pairs: tuple(b for _, b in pairs),
+                    tuple((prev_leaves[i], new_leaves[i]) for i in idx))
+                for i, vnew in zip(idx, reverted):
+                    new_leaves[i] = vnew
+                sim, seq_ctr = jax.tree_util.tree_unflatten(
+                    treedef, new_leaves)
                 return _Carry(sim, bad, why, seq_ctr, it + 1)
 
             init = _Carry(sim, ~elig, why0,
                           q0.next_seq, jnp.zeros((), I32))
             final = jax.lax.while_loop(cond, body, init)
             sim_c, bad, why = final.sim, final.bad, final.why
-            # anything still pending in-window (iteration-guard trip, or a
-            # lane that went bad mid-stream) aborts — the serial fixpoint
-            # picks those hosts up from their ORIGINAL state
-            bad, why = _flag(bad, why, jnp.any(sim_c.events.time < wend64, axis=1), 2147483648)
-            commit = elig & ~bad
+            # prefix-commit: EVERY eligible lane merges its candidate
+            # state — a stopped lane's rows hold the clean prefix with
+            # the out-of-model event (and any later ones) still queued,
+            # and the serial fixpoint continues from exactly there. The
+            # debug `commit` mask reports lanes whose WHOLE window
+            # stayed in model (leftovers = guard trip, counted bad).
+            bad, why = _flag(bad, why,
+                             jnp.any(sim_c.events.time < wend64, axis=1),
+                             2147483648)
+            commit = elig
 
             # ---- merge candidate state for committed hosts ----------------
             def merge(orig, cand):
@@ -1351,7 +1715,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 dtype=I64)
             sim = sim.replace(events=q_m, outbox=out_m, net=net_m, tcp=tcp_m,
                               app=app_m)
-            return sim, n, bad, why, commit, final.it
+            return sim, n, bad, why, elig & ~bad, final.it
 
         def _skip_pass(sim):
             return (sim, jnp.zeros((), I64), ~elig, why0,

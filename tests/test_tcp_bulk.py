@@ -21,12 +21,14 @@ from shadow_tpu.net.state import NetConfig
 
 GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
   <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="packetloss" attr.type="double" for="edge" id="pl" />
   <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
   <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
   <graph edgedefault="undirected">
     <node id="v0"><data key="up">%(bw)d</data><data key="dn">%(bw)d</data>
     </node>
-    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+    <edge source="v0" target="v0"><data key="lat">50.0</data>
+    <data key="pl">%(loss)s</data></edge>
   </graph>
 </graphml>"""
 
@@ -37,7 +39,7 @@ DEAD = {
 }
 
 
-def _build_relay(H, hop, total, sim_s, seed=1, bw=102400):
+def _build_relay(H, hop, total, sim_s, seed=1, bw=102400, loss=0.0):
     cap = 64
     cfg = NetConfig(num_hosts=H, seed=seed,
                     end_time=sim_s * simtime.ONE_SECOND,
@@ -45,7 +47,7 @@ def _build_relay(H, hop, total, sim_s, seed=1, bw=102400):
                     outbox_capacity=cap, router_ring=cap)
     hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
              for i in range(H)]
-    b = build(cfg, GRAPH % {"bw": bw}, hosts)
+    b = build(cfg, GRAPH % {"bw": bw, "loss": loss}, hosts)
     ncirc = H // hop
     circuits = [list(range(c * hop, (c + 1) * hop)) for c in range(ncirc)]
     b.sim = relay.setup(b.sim, circuits=circuits, total_bytes=total)
@@ -123,4 +125,45 @@ def test_tcp_bulk_pairwise_bit_identical():
     b2 = _build_relay(H, hop, total, sim_s, seed=3)
     sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
                               app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+    _compare(sim_a, sim_b, st_a, st_b)
+
+
+@pytest.mark.parametrize("seed,loss", [(1, 0.02), (7, 0.05)])
+def test_tcp_bulk_lossy_bit_identical(seed, loss):
+    """The r5 loss-aware widening: per-packet Bernoulli loss drives
+    dup-ACKs, SACK, out-of-order parking, fast retransmit, recovery,
+    and RTOs through the pass — the final state must still be
+    bit-identical to the serial engine, and the transfers must
+    actually complete (retransmission recovers every hole)."""
+    H, hop, total, sim_s = 8, 2, 60_000, 12
+    b1 = _build_relay(H, hop, total, sim_s, seed, loss=loss)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed, loss=loss)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+    assert int(sim_a.events.overflow) == 0
+    # loss machinery actually engaged in the serial reference run
+    assert int(np.asarray(sim_a.tcp.retx_segs).sum()) > 0
+    servers = np.asarray(sim_a.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
+    _compare(sim_a, sim_b, st_a, st_b)
+    # ... and the pass still engages under loss
+    assert int(st_b.micro_steps) < int(st_a.micro_steps), (
+        int(st_b.micro_steps), int(st_a.micro_steps))
+
+
+@pytest.mark.parametrize("seed", [2])
+def test_tcp_bulk_lossy_relay_chain_bit_identical(seed):
+    """5-hop relay circuits under loss (config #3's shape on a lossy
+    path): the forward path, EOF cascade, and dual closes must all
+    survive interleaving with retransmissions bit-identically."""
+    H, hop, total, sim_s = 10, 5, 30_000, 12
+    b1 = _build_relay(H, hop, total, sim_s, seed, loss=0.02)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed, loss=0.02)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+    assert int(np.asarray(sim_a.tcp.retx_segs).sum()) > 0
+    servers = np.asarray(sim_a.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
     _compare(sim_a, sim_b, st_a, st_b)

@@ -34,7 +34,7 @@ def why_legend() -> dict[int, str]:
             r"_flag\(\s*bad,\s*why,\s*(.*?),\s*(\d+|1 << \d+)\)", src, re.DOTALL):
         cond = " ".join(m.group(1).split())[:64]
         legend[eval(m.group(2))] = cond  # noqa: S307 — '1 << N' literals
-    for bit, name in ((56, "precheck:kind"), (57, "precheck:bootstrap"),
+    for bit, name in ((57, "precheck:bootstrap"),
                       (58, "precheck:quiesced"), (59, "precheck:codel"),
                       (60, "precheck:app"), (61, "precheck:no-work")):
         legend[1 << bit] = name
@@ -104,20 +104,27 @@ def main() -> int:
     @jax.jit
     def one_window(sim, wstart):
         wend = jnp.minimum(wstart + b.min_jump, cfg.end_time + 1)
+        # in-window event-kind census BEFORE the pass (what a
+        # precheck:kind abort actually saw)
+        inwin = sim.events.time < wend
+        kind_census = jnp.zeros((32,), jnp.int32).at[
+            jnp.clip(sim.events.kind, 0, 31)].add(inwin.astype(jnp.int32))
         sim, n_bulk, diag = dbg_bulk(sim, wend)
         stats = EngineStats.create()
         sim, stats, next_min = step_window(
             sim, stats, step, wend, emit_capacity=cfg.emit_capacity,
             lane_id=sim.net.lane_id)
-        return sim, stats, next_min, n_bulk, diag
+        return sim, stats, next_min, n_bulk, diag, kind_census
 
     sim = b.sim
     wstart = jnp.min(sim.events.min_time())
     total_bulk = total_serial = total_micro = 0
     w = 0
     agg: dict[int, int] = {}
+    kind_tot = np.zeros(32, np.int64)
     while w < args.windows_max and int(wstart) <= cfg.end_time:
-        sim, stats, next_min, n_bulk, diag = one_window(sim, wstart)
+        sim, stats, next_min, n_bulk, diag, census = one_window(sim, wstart)
+        kind_tot += np.asarray(census)
         n_bulk = int(n_bulk)
         micro = int(stats.micro_steps)
         serial_ev = int(stats.events_processed)
@@ -125,7 +132,7 @@ def main() -> int:
         why = np.asarray(diag["why"])
         has_work = (why & (1 << 61)) == 0
         aborted = has_work & ~np.asarray(diag["commit"])
-        PRECHECK = sum(1 << b for b in range(56, 62))
+        PRECHECK = sum(1 << b for b in range(57, 62))
         GUARD = 1 << 31
         hist = {}
         for h in np.nonzero(aborted)[0][:100000]:
@@ -153,6 +160,14 @@ def main() -> int:
     print("aggregate first-abort reasons:")
     for k, v in sorted(agg.items(), key=lambda kv: -kv[1]):
         print(f"  {v:8d}  {legend.get(k, hex(k))}")
+    from shadow_tpu.core.events import EventKind
+
+    names = {getattr(EventKind, n): n for n in dir(EventKind)
+             if not n.startswith("_")
+             and isinstance(getattr(EventKind, n), int)}
+    print("in-window event kinds (pre-pass census):")
+    for k in np.nonzero(kind_tot)[0]:
+        print(f"  {int(kind_tot[k]):8d}  {names.get(int(k), k)}")
     return 0
 
 
