@@ -250,6 +250,14 @@ class Outbox:
     words: jax.Array  # [H, M, NWORDS] i32
     count: jax.Array  # [H] i32
     overflow: jax.Array  # [] i32
+    # narrow-tier telemetry (VERDICT r4 #10): how often the route /
+    # exchange took the narrow vs full-width branch, and the largest
+    # occupancy the gate ever measured — a new workload that silently
+    # overflows the tier shows up as narrow_miss > 0 instead of an
+    # invisible slow branch. Running totals survive clear_outbox.
+    narrow_hit: jax.Array   # [] i32 windows on the narrow branch
+    narrow_miss: jax.Array  # [] i32 windows forced to full width
+    max_occupied: jax.Array  # [] i32 max occupancy the gate measured
 
     @property
     def num_hosts(self) -> int:
@@ -271,6 +279,9 @@ class Outbox:
             words=jnp.zeros((num_hosts, capacity, nwords), I32),
             count=jnp.zeros((num_hosts,), I32),
             overflow=jnp.zeros((), I32),
+            narrow_hit=jnp.zeros((), I32),
+            narrow_miss=jnp.zeros((), I32),
+            max_occupied=jnp.zeros((), I32),
         )
 
 
@@ -670,8 +681,13 @@ def route_outbox(q: EventQueue, out: Outbox, impl: str | None = None,
         occupied_width = jnp.max(
             jnp.where(out.dst >= 0, jnp.arange(M, dtype=I32)[None, :] + 1,
                       0))
+        hit = occupied_width <= width
+        out = out.replace(
+            narrow_hit=out.narrow_hit + hit.astype(I32),
+            narrow_miss=out.narrow_miss + (~hit).astype(I32),
+            max_occupied=jnp.maximum(out.max_occupied, occupied_width))
         q = jax.lax.cond(
-            occupied_width <= width,
+            hit,
             lambda qq: _route_width(qq, out, width, impl),
             lambda qq: _route_width(qq, out, M, impl),
             q)

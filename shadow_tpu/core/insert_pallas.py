@@ -52,7 +52,13 @@ def mailbox_available(num_hosts: int) -> bool:
     """True when the Pallas TPU kernel can be used for `num_hosts`
     destination rows. The stream itself stays in HBM (no size
     ceiling); the gate is the [H] SMEM start table — callers past the
-    bound take the XLA gather path instead of failing to compile."""
+    bound take the XLA gather path instead of failing to compile.
+    SHADOW_NO_PALLAS=1 disables the kernel (device-fault bisection;
+    values are bit-identical either way)."""
+    import os
+
+    if os.environ.get("SHADOW_NO_PALLAS") == "1":
+        return False
     return HAVE_PALLAS and num_hosts <= _MAX_SMEM_START_ROWS
 
 

@@ -260,11 +260,18 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
         # ---- host-level static eligibility ---------------------------
         inwin0 = q0.time < wend64
         nonboot = jnp.all(~inwin0 | (q0.time >= cfg.bootstrap_end), axis=1)
+        # send-side NIC backlog (queued output ring + a pending
+        # NIC_SEND covering event) is IN model since r5 — the steady
+        # state of token-limited (slow-link) senders. The receive side
+        # (router queue, rx drain retries) is not (yet): those hosts
+        # stay serial.
+        out_backlog = jnp.sum(net0.out_count, axis=1) > 0
+        send_consistent = ~out_backlog | net0.nic_send_pending
         quiesced = (
             (net0.rq_count == 0)
-            & ~net0.nic_recv_pending & ~net0.nic_send_pending
+            & ~net0.nic_recv_pending
             & ~net0.nic_send_now
-            & (jnp.sum(net0.out_count, axis=1) == 0)
+            & send_consistent
             & (jnp.sum(net0.in_count, axis=1) == 0)
             & ~net0.proc_stopped)
         codel_ok = ~net0.codel_dropping & (net0.codel_interval_expire == 0)
@@ -319,8 +326,10 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 is_dk = v & (p.kind == EventKind.TCP_DACK_TIMER)
                 is_fl = v & (p.kind == EventKind.TCP_FLUSH)
                 is_rtx = v & (p.kind == EventKind.TCP_RTX_TIMER)
+                is_ns = v & (p.kind == EventKind.NIC_SEND)
                 bad, why = _flag(bad, why,
-                                 (v & ~(is_pkt | is_dk | is_fl | is_rtx)), 1)
+                                 (v & ~(is_pkt | is_dk | is_fl | is_rtx
+                                        | is_ns)), 1)
 
                 # ===== packet classification =============================
                 proto = pf.proto_of(words)
@@ -404,7 +413,9 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # analytic refill at the arrival instant, then the charge
                 dq = jnp.maximum(t // simtime.ONE_MILLISECOND - net.tb_quantum,
                                  0)
-                refresh = pkt & (dq > 0)
+                # a popped NIC_SEND refills at entry exactly like the
+                # serial handler (refill_tokens, nic.py:64-77)
+                refresh = (pkt | is_ns) & (dq > 0)
                 recv_tok = jnp.minimum(net.tb_recv_refill + pf.MTU,
                                        net.tb_recv_tokens
                                        + dq * net.tb_recv_refill)
@@ -1059,8 +1070,32 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     snd_max=set_hs(tcp.snd_max, fl_mask, fslot,
                                    jnp.maximum(gather_hs(tcp.snd_max, fslot),
                                                nxt_after)))
-                chain = fl_mask & (rest > 0) & ~gather_hs(
-                    tcp.flush_pending, fslot)
+                # the serial chain decision also requires ring + sndbuf
+                # room AT THIS POINT of the micro-step — i.e. counting
+                # the backlog plus the packets this event has enqueued
+                # so far (the retransmit and this flush's burst;
+                # ref: tcp_flush room2, tcp.py:729-734). The retransmit
+                # length is not yet clipped here, so when the room
+                # verdict depends on it (a 1..MSS-byte uncertainty,
+                # only possible on a near-full send buffer) the lane
+                # conservatively stops.
+                seg2 = jnp.minimum(
+                    jnp.minimum(g_end - nxt_after, MSS),
+                    g_una + g_wnd - nxt_after)
+                ob_cnt0 = gather_hs(net.out_count, fslot)
+                ob_byt0 = gather_hs(net.out_bytes, fslot)
+                sb0 = gather_hs(net.sk_sndbuf, fslot)
+                cnt_extra = retx_ack.astype(I32) + n_seg + fin1.astype(I32)
+                room_no_rt = (ob_cnt0 + cnt_extra < BO) \
+                    & (ob_byt0 + A_now + seg2 <= sb0)
+                room_max_rt = (ob_cnt0 + cnt_extra < BO) \
+                    & (ob_byt0 + A_now + jnp.where(retx_ack, MSS, 0)
+                       + seg2 <= sb0)
+                bad, why = _flag(bad, why,
+                                 fl_mask & (rest > 0)
+                                 & (room_no_rt != room_max_rt), 1 << 39)
+                chain = fl_mask & (rest > 0) & room_max_rt & ~bad \
+                    & ~gather_hs(tcp.flush_pending, fslot)
 
                 def _chain_push(ops):
                     tcp, q, seq_ctr, bad, why = ops
@@ -1360,13 +1395,6 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                                       fslot)))
                 n_pkt = retx_sent.astype(I32) + n_seg + fin1.astype(I32) \
                     + pure_ack.astype(I32)
-                # the serial NIC wires at most nic_drain (== FLUSH_SEGMENTS)
-                # packets per micro-step and chains a NIC_SEND for the rest
-                # — a burst past that bound (4 data + FIN, or a dual-close
-                # FIN pair on top of data) is out of model
-                bad, why = _flag(bad, why,
-                                 (n_pkt + fin2.astype(I32) > FLUSH_SEGMENTS),
-                                 1 << 39)
                 sending = (retx_sent | pure_ack | (n_seg > 0) | fin1) & ~bad
                 fin2 = fin2 & ~bad
                 n_pkt = jnp.where(sending, n_pkt, 0)
@@ -1391,6 +1419,44 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                          t // simtime.ONE_MILLISECOND,
                                          net.tb_quantum))
 
+                # ---- lane mode: fused fast path vs NIC ring path -----
+                # The fused path (the pre-r5 wire_one sequence) models
+                # enqueue + same-instant full drain — valid only when
+                # the ring is empty, every burst packet clears the
+                # per-packet token check, and the burst fits one serial
+                # drain (nic_drain). Otherwise the lane takes the RING
+                # path: enqueue to the real socket output ring, drain
+                # through the token bucket, chain/wait NIC_SEND exactly
+                # like handle_nic_send (nic.py:444-490) — the
+                # token-limited (slow-link) sender regime.
+                flush_len = []
+                for j in range(FLUSH_SEGMENTS + 1):
+                    pj_ = sending & (j < n_seg + fin1.astype(I32))
+                    is_fin_j_ = fin1 & (j == n_seg)
+                    flush_len.append((pj_, jnp.where(
+                        is_fin_j_, 0,
+                        jnp.clip(A_now - j * MSS, 0, MSS)).astype(I32)))
+                afford = jnp.ones((H,), bool)
+                cum_wl = jnp.zeros((H,), I64)
+                for m_k, len_k in ([(retx_sent & sending, rt_len)]
+                                   + flush_len
+                                   + [(pure_ack & sending,
+                                       jnp.zeros((H,), I32)),
+                                      (fin2, jnp.zeros((H,), I32))]):
+                    short_k = m_k & (net.tb_send_tokens - cum_wl < pf.MTU)
+                    afford = afford & ~short_k
+                    cum_wl = cum_wl + jnp.where(
+                        m_k,
+                        pf.wire_length(jnp.full((H,), pf.PROTO_TCP, I32),
+                                       len_k).astype(I64), 0)
+                backlog0 = jnp.sum(net.out_count, axis=1) > 0
+                overbound = (n_pkt + fin2.astype(I32)) > cfg.nic_drain
+                ring_lane = (sending | fin2) & (backlog0 | ~afford
+                                                | overbound)
+                fast = ~ring_lane
+                fast_s = sending & fast
+                drain_m = is_ns & ~bad
+
                 # stamps shared by every packet of the burst (state does
                 # not change between same-instant wires)
                 stamp_ack = gather_hs(tcp.rcv_nxt, wslot)
@@ -1403,14 +1469,22 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 w_dip = gather_hs(net.sk_peer_ip, wslot)
                 w_dsth = gather_hs(peer_h, wslot)
                 bad, why = _flag(bad, why, (sending & (w_dsth < 0)), 268435456)
+                # loopback connections route via PACKET_LOCAL +1ns in
+                # the serial NIC — not modeled here
+                bad, why = _flag(bad, why, (sending & (w_dsth == lane)),
+                                 1 << 38)
                 sending = sending & ~bad
+                fast_s = fast_s & ~bad
+                ring_lane = ring_lane & ~bad
+                drain_m = drain_m & ~bad
                 n_pkt = jnp.where(sending, n_pkt, 0)
                 w_lat = gather_hs(lat_s, wslot)
                 w_rel = gather_hs(rel_s, wslot)
                 # the wired ACK cancels any pending delayed ACK on ITS
-                # socket (ref: tcp.c:1105-1108 via nic wire_ack_departed)
+                # socket (ref: tcp.c:1105-1108 via nic wire_ack_departed);
+                # ring-path packets cancel at their actual drain instead
                 tcp = tcp.replace(dack_counter=set_hs(
-                    tcp.dack_counter, sending, wslot, jnp.zeros((H,), I32)))
+                    tcp.dack_counter, fast_s, wslot, jnp.zeros((H,), I32)))
 
                 out = sim.outbox
                 M = out.capacity
@@ -1516,23 +1590,23 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                            sack_advert(tcp, wslot))
                 state = (out, bad, why, last_drop, drops, tx_wl, emitted,
                          ob_over)
-                # 1) the retransmitted snd_una segment (serial order:
-                #    _retransmit_one precedes the flush)
                 retx_status = jnp.where(
                     retx_sent,
                     pf.PDS_SND_TCP_ENQUEUE_RETRANSMIT
                     | pf.PDS_SND_TCP_DEQUEUE_RETRANSMIT
                     | pf.PDS_SND_TCP_RETRANSMITTED, 0)
+                # 1) the retransmitted snd_una segment (serial order:
+                #    _retransmit_one precedes the flush)
                 state = _gate(
-                    jnp.any(retx_sent),
-                    lambda s: wire_one(s, retx_sent & sending, rt_len,
+                    jnp.any(retx_sent & fast_s),
+                    lambda s: wire_one(s, retx_sent & fast_s, rt_len,
                                        rt_una, rt_flags, stamps1,
                                        jnp.zeros((H,), I32), retx_status),
                     state)
                 rt_n = retx_sent.astype(I32)
                 # 2) the flush burst: n_seg data segments + the FIN tail
                 for j in range(FLUSH_SEGMENTS + 1):
-                    pj = sending & (j < n_seg + fin1.astype(I32))
+                    pj = fast_s & (j < n_seg + fin1.astype(I32))
                     is_fin_j = fin1 & (j == n_seg)
                     lenj = jnp.where(
                         is_fin_j, 0,
@@ -1547,15 +1621,18 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # 3) the pure ACK: a fired delayed ACK, or the immediate
                 #    loss-signalling ACK (old/out-of-order/dropped data)
                 state = _gate(
-                    jnp.any(pure_ack),
-                    lambda s: wire_one(s, pure_ack & sending,
+                    jnp.any(pure_ack & fast_s),
+                    lambda s: wire_one(s, pure_ack & fast_s,
                                        jnp.zeros((H,), I32),
                                        gather_hs(tcp.snd_nxt, wslot),
                                        jnp.full((H,), pf.TCPF_ACK, I32),
                                        stamps1,
                                        rt_n + n_seg + fin1.astype(I32)),
                     state)
-                # secondary FIN (dual close) after the whole primary burst
+                # secondary FIN (dual close) after the whole primary
+                # burst — fast lanes only; ring lanes enqueue it below
+                fin2f = fin2 & fast
+
                 def _wire2_sec(ops):
                     state, tcp, fin2v = ops
                     stamps2 = (gather_hs(tcp.rcv_nxt, c2_slot),
@@ -1592,46 +1669,321 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                         jnp.zeros((H,), I32)))
                     return state, tcp, fin2v
 
-                state, tcp, fin2 = _gate(jnp.any(fin2), _wire2_sec,
-                                         (state, tcp, fin2))
+                state, tcp, fin2f = _gate(jnp.any(fin2f), _wire2_sec,
+                                          (state, tcp, fin2f))
                 (out, bad, why, last_drop, drops, tx_wl, emitted,
                  ob_over) = state
 
+                # ===== NIC ring path (r5): enqueue + token drain ==========
+                # Ring-mode lanes put the burst on the REAL socket
+                # output ring (sk_enqueue_out parity: plane words,
+                # priority stamps, count/bytes) and then drain through
+                # the token bucket exactly like handle_nic_send
+                # (nic.py:444-604): FIFO head-priority selection,
+                # wire-time stamping, per-packet token check, chain /
+                # next-refill-wait NIC_SEND continuation events.
+                def _mk_ring_w(lenj, seqj, flagsj, sportj, dportj, dipj,
+                               extraj):
+                    rw_ = jnp.zeros((H, W), I32)
+                    rw_ = rw_.at[:, pf.W_PROTO].set(
+                        pf.PROTO_TCP | (flagsj << 8))
+                    rw_ = rw_.at[:, pf.W_LEN].set(lenj)
+                    rw_ = rw_.at[:, pf.W_PORTS].set(
+                        pf.pack_ports(sportj, dportj))
+                    rw_ = rw_.at[:, pf.W_SEQ].set(seqj)
+                    rw_ = rw_.at[:, pf.W_PAYREF].set(pf.PAYREF_NONE)
+                    rw_ = rw_.at[:, pf.W_DSTIP].set(
+                        dipj.astype(jnp.uint32).astype(I32))
+                    return rw_.at[:, pf.W_STATUS].set(
+                        pf.PDS_SND_CREATED
+                        | pf.PDS_SND_TCP_ENQUEUE_THROTTLED
+                        | pf.PDS_SND_SOCKET_BUFFERED | extraj)
+
+                enq = jnp.zeros((H,), I32)
+
+                def _enqueue_sec(ops):
+                    net, tcp, bad, why, enq = ops
+                    from shadow_tpu.net.rings import ring_push_at
+
+                    c2_sport = gather_hs(net.sk_bound_port, c2_slot)
+                    c2_dport = gather_hs(net.sk_peer_port, c2_slot)
+                    c2_dip = gather_hs(net.sk_peer_ip, c2_slot)
+                    c2_dsth = gather_hs(peer_h, c2_slot)
+                    fin2r = fin2 & ring_lane
+                    bad, why = _flag(bad, why, fin2r & (c2_dsth < 0),
+                                     1 << 62)
+                    bad, why = _flag(bad, why, fin2r & (c2_dsth == lane),
+                                     1 << 38)
+                    comps = [(retx_sent & ring_lane, rt_len, rt_una,
+                              rt_flags, wslot, w_sport, w_dport, w_dip,
+                              retx_status)]
+                    for j, (pj_, len_j) in enumerate(flush_len):
+                        is_fin_j = fin1 & (j == n_seg)
+                        comps.append((pj_ & ring_lane, len_j,
+                                      jnp.where(is_fin_j, g_nxt + A_now,
+                                                g_nxt + j * MSS),
+                                      jnp.where(is_fin_j,
+                                                pf.TCPF_FIN | pf.TCPF_ACK,
+                                                pf.TCPF_ACK),
+                                      wslot, w_sport, w_dport, w_dip, 0))
+                    comps.append((pure_ack & ring_lane,
+                                  jnp.zeros((H,), I32),
+                                  gather_hs(tcp.snd_nxt, wslot),
+                                  jnp.full((H,), pf.TCPF_ACK, I32),
+                                  wslot, w_sport, w_dport, w_dip, 0))
+                    comps.append((fin2 & ring_lane, jnp.zeros((H,), I32),
+                                  g2_nxt,
+                                  jnp.full((H,), pf.TCPF_FIN | pf.TCPF_ACK,
+                                           I32),
+                                  c2_slot, c2_sport, c2_dport, c2_dip, 0))
+                    for (m_k, len_k, seq_k, flags_k, slot_k, sport_k,
+                         dport_k, dip_k, extra_k) in comps:
+                        ek = m_k & ~bad
+                        # sk_enqueue_out admission (sndbuf + ring room);
+                        # a failed serial enqueue stalls the segment
+                        # with snd_nxt already advanced here — out of
+                        # model, stop the lane instead
+                        sp_ok = (gather_hs(net.out_bytes, slot_k) + len_k
+                                 <= gather_hs(net.sk_sndbuf, slot_k))
+                        bad, why = _flag(bad, why, ek & ~sp_ok, 1 << 36)
+                        ek = ek & ~bad
+                        okp, pos = ring_push_at(net.out_head,
+                                                net.out_count, BO, ek,
+                                                slot_k)
+                        bad, why = _flag(bad, why, ek & ~okp, 1 << 37)
+                        ek = ek & okp & ~bad
+                        rw_ = _mk_ring_w(len_k, seq_k, flags_k, sport_k,
+                                         dport_k, dip_k, extra_k)
+                        net = net.replace(
+                            out_words=set_ring(net.out_words, ek, slot_k,
+                                               pos, rw_),
+                            out_priority=set_ring(
+                                net.out_priority, ek, slot_k, pos,
+                                (net.priority_ctr
+                                 + enq.astype(I64)).astype(
+                                     net.out_priority.dtype)),
+                            out_count=set_hs(net.out_count, ek, slot_k,
+                                             gather_hs(net.out_count,
+                                                       slot_k) + 1),
+                            out_bytes=set_hs(net.out_bytes, ek, slot_k,
+                                             gather_hs(net.out_bytes,
+                                                       slot_k) + len_k),
+                        )
+                        enq = enq + ek.astype(I32)
+                    return net, tcp, bad, why, enq
+
+                net, tcp, bad, why, enq = _gate(
+                    jnp.any(ring_lane), _enqueue_sec,
+                    (net, tcp, bad, why, enq))
+
+                drain_m2 = (drain_m | (ring_lane & (enq > 0))) & ~bad
+                # a popped NIC_SEND clears its pending flag at entry
+                # (handle_nic_send, nic.py:464)
+                net = net.replace(
+                    nic_send_pending=net.nic_send_pending & ~is_ns)
+                d_active = jnp.zeros((H,), I32)
+                d_data = jnp.zeros((H,), I64)
+                d_retxb = jnp.zeros((H,), I64)
+                d_nosock = jnp.zeros((H,), I32)
+                drawn = jnp.zeros((H,), I32)
+
+                def _drain_sec(ops):
+                    (net, tcp, out, bad, why, last_drop, drops, tx_wl,
+                     emitted, ob_over, d_active, d_data, d_retxb,
+                     d_nosock, drawn) = ops
+                    big64 = jnp.iinfo(net.out_priority.dtype).max
+                    for _k in range(cfg.nic_drain):
+                        can = (net.tb_send_tokens - tx_wl) >= pf.MTU
+                        nonempty = net.out_count > 0            # [H,S]
+                        hp_all = net.out_head % BO
+                        head_pri = jnp.take_along_axis(
+                            net.out_priority, hp_all[..., None],
+                            axis=2)[..., 0]
+                        key = jnp.where(nonempty, head_pri, big64)
+                        sel = jnp.argmin(key, axis=1).astype(I32)
+                        found = jnp.any(nonempty, axis=1)
+                        active = drain_m2 & can & found & ~bad
+                        hp = net.out_head[rows, sel] % BO
+                        wds = net.out_words[rows, sel, hp]      # [H,W]
+                        lenk = wds[:, pf.W_LEN]
+                        net = net.replace(
+                            out_head=set_hs(net.out_head, active, sel,
+                                            (net.out_head[rows, sel] + 1)
+                                            % BO),
+                            out_count=set_hs(net.out_count, active, sel,
+                                             net.out_count[rows, sel] - 1),
+                            out_bytes=set_hs(net.out_bytes, active, sel,
+                                             net.out_bytes[rows, sel]
+                                             - lenk),
+                        )
+                        # wire-time stamps (stamp_at_wire parity)
+                        # the REAL serial wire-time stampers — one
+                        # formula, zero drift (sack_advert rationale)
+                        from shadow_tpu.net.tcp import (
+                            stamp_at_wire, wire_ack_departed)
+
+                        wds = stamp_at_wire(net, tcp, active, sel, wds, t)
+                        wds = wds.at[:, pf.W_STATUS].set(jnp.where(
+                            active,
+                            wds[:, pf.W_STATUS]
+                            | pf.PDS_SND_INTERFACE_SENT,
+                            wds[:, pf.W_STATUS]))
+                        # the departing ACK cancels the delayed ACK
+                        tcp = wire_ack_departed(tcp, active, sel)
+                        wlk = pf.wire_length(pf.proto_of(wds),
+                                             lenk).astype(I64)
+                        dipk = wds[:, pf.W_DSTIP].astype(
+                            jnp.uint32).astype(I64)
+                        dsth = host_of_ip(net, dipk)
+                        bad, why = _flag(bad, why,
+                                         active & (dsth == lane), 1 << 38)
+                        active = active & ~bad
+                        known = active & (dsth >= 0)
+                        d_nosock = d_nosock + (active & ~known).astype(I32)
+                        u = rng.uniform_at(
+                            net.rng_keys,
+                            rngc + jnp.asarray(drawn, jnp.uint32))
+                        drawn = drawn + active.astype(I32)
+                        vdst_k = net.vertex_of_host[
+                            jnp.clip(dsth, 0, GH - 1)]
+                        vsrc_k = net.vertex_of_host[lane]
+                        relk = net.reliability[vsrc_k, vdst_k]
+                        latk = net.latency_ns[vsrc_k, vdst_k]
+                        dropk = known & (lenk > 0) & (u > relk)
+                        sendk = known & ~dropk
+                        wire_sent = wds.at[:, pf.W_STATUS].set(
+                            wds[:, pf.W_STATUS] | pf.PDS_INET_SENT)
+                        last_drop = jnp.where(
+                            dropk,
+                            wds[:, pf.W_STATUS] | pf.PDS_INET_DROPPED,
+                            last_drop)
+                        drops = drops + dropk.astype(I32)
+                        tx_wl = tx_wl + jnp.where(active, wlk, 0)
+                        col = ob_count + emitted
+                        okb = sendk & (col < M)
+                        ob_over = ob_over | (sendk & ~(col < M))
+                        colc = jnp.clip(col, 0, M - 1)
+                        out = out.replace(
+                            dst=out.dst.at[rows, colc].set(
+                                jnp.where(okb, dsth,
+                                          out.dst[rows, colc])),
+                            time=out.time.at[rows, colc].set(
+                                jnp.where(okb, t + latk,
+                                          out.time[rows, colc])),
+                            kind=out.kind.at[rows, colc].set(
+                                jnp.where(okb, EventKind.PACKET,
+                                          out.kind[rows, colc])),
+                            src=out.src.at[rows, colc].set(
+                                jnp.where(okb, lane,
+                                          out.src[rows, colc])),
+                            seq=out.seq.at[rows, colc].set(
+                                jnp.where(okb, seq_ctr + emitted,
+                                          out.seq[rows, colc])),
+                            words=out.words.at[rows, colc].set(
+                                jnp.where(okb[:, None], wire_sent,
+                                          out.words[rows, colc])),
+                        )
+                        emitted = emitted + sendk.astype(I32)
+                        is_rexk = (wds[:, pf.W_STATUS]
+                                   & pf.PDS_SND_TCP_RETRANSMITTED) != 0
+                        d_active = d_active + active.astype(I32)
+                        d_data = d_data + jnp.where(active, lenk,
+                                                    0).astype(I64)
+                        d_retxb = d_retxb + jnp.where(
+                            active & is_rexk, wlk, 0)
+                    return (net, tcp, out, bad, why, last_drop, drops,
+                            tx_wl, emitted, ob_over, d_active, d_data,
+                            d_retxb, d_nosock, drawn)
+
+                (net, tcp, out, bad, why, last_drop, drops, tx_wl,
+                 emitted, ob_over, d_active, d_data, d_retxb, d_nosock,
+                 drawn) = _gate(
+                    jnp.any(drain_m2), _drain_sec,
+                    (net, tcp, out, bad, why, last_drop, drops, tx_wl,
+                     emitted, ob_over, d_active, d_data, d_retxb,
+                     d_nosock, drawn))
+
                 bad, why = _flag(bad, why, ob_over, 1073741824)
-                wired = (sending | fin2) & ~bad
-                out = out.replace(count=jnp.where(wired,
+                fast_w = (fast_s | fin2f) & ~bad
+                ring_w_lanes = (ring_lane | drain_m2) & ~bad
+                wired_any = fast_w | ring_w_lanes
+                out = out.replace(count=jnp.where(wired_any,
                                                   ob_count + emitted,
                                                   out.count))
-                seq_ctr = seq_ctr + jnp.where(wired, emitted, 0)
-                n_tot = n_pkt + fin2.astype(I32)
+                seq_ctr = seq_ctr + jnp.where(wired_any, emitted, 0)
+                n_tot_f = jnp.where(fast_w,
+                                    n_pkt + fin2f.astype(I32), 0)
                 net = net.replace(
-                    out_head=set_hs(net.out_head, sending, wslot,
+                    out_head=set_hs(net.out_head, fast_s & ~bad, wslot,
                                     (ring_head0 + n_pkt) % BO),
                     priority_ctr=net.priority_ctr
-                    + jnp.where(wired, n_tot, 0).astype(I64),
-                    rng_ctr=rngc + jnp.where(wired, n_tot, 0).astype(
+                    + n_tot_f.astype(I64)
+                    + jnp.where(ring_lane & ~bad, enq, 0).astype(I64),
+                    rng_ctr=rngc
+                    + jnp.where(fast_w, n_tot_f, 0).astype(jnp.uint32)
+                    + jnp.where(ring_w_lanes, drawn, 0).astype(
                         jnp.uint32),
                     tb_send_tokens=jnp.maximum(
-                        net.tb_send_tokens - jnp.where(wired, tx_wl, 0), 0),
+                        net.tb_send_tokens
+                        - jnp.where(wired_any, tx_wl, 0), 0),
                     ctr_tx_packets=net.ctr_tx_packets
-                    + jnp.where(wired, n_tot, 0).astype(I64),
+                    + n_tot_f.astype(I64)
+                    + jnp.where(ring_w_lanes, d_active, 0).astype(I64),
                     ctr_tx_bytes=net.ctr_tx_bytes
-                    + jnp.where(wired, tx_wl, 0),
+                    + jnp.where(wired_any, tx_wl, 0),
                     ctr_tx_data_bytes=net.ctr_tx_data_bytes
-                    + jnp.where(sending, A_now + rt_len, 0).astype(I64),
+                    + jnp.where(fast_s & ~bad, A_now + rt_len,
+                                0).astype(I64)
+                    + jnp.where(ring_w_lanes, d_data, 0),
                     ctr_tx_retx_bytes=net.ctr_tx_retx_bytes
-                    + jnp.where(wired & retx_sent,
+                    + jnp.where(fast_w & retx_sent,
                                 pf.wire_length(
                                     jnp.full((H,), pf.PROTO_TCP, I32),
-                                    rt_len).astype(I64), 0),
+                                    rt_len).astype(I64), 0)
+                    + jnp.where(ring_w_lanes, d_retxb, 0),
+                    ctr_drop_nosocket=net.ctr_drop_nosocket
+                    + jnp.where(ring_w_lanes, d_nosock, 0).astype(I64),
                     ctr_drop_reliability=net.ctr_drop_reliability
                     + drops.astype(I64),
                     last_drop_status=last_drop,
                     ctr_events_exec=net.ctr_events_exec + v.astype(I64),
                 )
                 net = net.replace(out_head=set_hs(
-                    net.out_head, fin2, c2_slot,
+                    net.out_head, fin2f & ~bad, c2_slot,
                     (gather_hs(net.out_head, c2_slot) + 1) % BO))
+
+                # chain / wait continuation (handle_nic_send tail,
+                # nic.py:478-489) — emitted AFTER the drained packets,
+                # matching the serial per-micro-step emission order
+                def _chain_ns(ops):
+                    net, q, seq_ctr, bad, why = ops
+                    more = jnp.any(net.out_count > 0, axis=1)
+                    can_next = net.tb_send_tokens >= pf.MTU
+                    base = drain_m2 & ~bad & ~net.nic_send_pending
+                    ch_now = base & more & can_next
+                    ch_wait = base & more & ~can_next
+                    free_n = jnp.any(q.time == simtime.INVALID, axis=1)
+                    bad, why = _flag(bad, why,
+                                     (ch_now | ch_wait) & ~free_n, 1 << 35)
+                    ch_now = ch_now & ~bad
+                    ch_wait = ch_wait & ~bad
+                    zw = jnp.zeros((H, W), I32)
+                    q = _push_local(q, ch_now, t, EventKind.NIC_SEND, zw,
+                                    lane, seq_ctr)
+                    seq_ctr = seq_ctr + ch_now.astype(I32)
+                    from shadow_tpu.net.nic import next_refill_time
+
+                    q = _push_local(q, ch_wait, next_refill_time(t),
+                                    EventKind.NIC_SEND, zw, lane, seq_ctr)
+                    seq_ctr = seq_ctr + ch_wait.astype(I32)
+                    net = net.replace(
+                        nic_send_pending=net.nic_send_pending | ch_now
+                        | ch_wait)
+                    return net, q, seq_ctr, bad, why
+
+                net, q, seq_ctr, bad, why = _gate(
+                    jnp.any(drain_m2), _chain_ns,
+                    (net, q, seq_ctr, bad, why))
 
                 sim = sim.replace(events=q, outbox=out, net=net, tcp=tcp,
                                   app=app)

@@ -176,8 +176,14 @@ def route_outbox_sharded(
         # +1 so rank == C_n-1 fits; empty windows give gmax == 0
         gmax = lax.pmax(
             jnp.max(jnp.where(ok, rank, -1)) + 1, axis)
+        hit = gmax <= C_n
+        out = out.replace(
+            narrow_hit=out.narrow_hit + hit.astype(I32),
+            narrow_miss=out.narrow_miss + (~hit).astype(I32),
+            max_occupied=jnp.maximum(out.max_occupied,
+                                     gmax.astype(I32)))
         q = lax.cond(
-            gmax <= C_n,
+            hit,
             lambda qq: exchange(qq, C_n),
             lambda qq: exchange(qq, C_full),
             q)
@@ -196,11 +202,22 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     psumming it directly would multiply a nonzero starting count by the
     shard count. stats.windows is identical on every shard (lockstep
     outer loop), so pmax is the identity there."""
+    # the narrow-tier telemetry is pmax'd, not delta-psummed: the
+    # exchange gate's own pmax makes the branch (and so hit/miss)
+    # identical on every shard, and a sum of per-shard maxima would be
+    # meaningless for max_occupied — pin all three, overwrite after.
+    ob = sim.outbox
+    narrow_pinned = (lax.pmax(ob.narrow_hit, axis),
+                     lax.pmax(ob.narrow_miss, axis),
+                     lax.pmax(ob.max_occupied, axis))
     sim = jax.tree.map(
         lambda leaf, init: init + lax.psum(leaf - init, axis)
         if jnp.ndim(leaf) == 0 else leaf,
         sim, initial_sim,
     )
+    sim = sim.replace(outbox=sim.outbox.replace(
+        narrow_hit=narrow_pinned[0], narrow_miss=narrow_pinned[1],
+        max_occupied=narrow_pinned[2]))
     stats = EngineStats(
         events_processed=lax.psum(stats.events_processed, axis),
         micro_steps=lax.psum(stats.micro_steps, axis),

@@ -90,6 +90,20 @@ def test_sharded_matches_single(single, nshards):
     # event queue contents identical (same times in each row set)
     np.testing.assert_array_equal(np.sort(np.asarray(sim1.events.time)),
                                   np.sort(np.asarray(sim2.events.time)))
+    # narrow-exchange telemetry (VERDICT r4 #10): every window's gate
+    # decision is recorded, traffic was measured, and this workload's
+    # bursts fit the narrow tier (a regression that overflows the tier
+    # flips hit -> miss loudly instead of taking a silent slow branch).
+    # At Hl == 1 host/shard the tier is structurally inactive
+    # (C_n == C_full), so no decisions exist to record.
+    hit = int(sim2.outbox.narrow_hit)
+    miss = int(sim2.outbox.narrow_miss)
+    if H // nshards > 1:
+        assert hit + miss == int(stats2.windows), (hit, miss)
+        assert miss == 0, f"narrow tier overflowed {miss} windows"
+        assert int(sim2.outbox.max_occupied) > 0
+    else:
+        assert hit == 0 and miss == 0
 
 
 def test_exchange_capacity_counts_overflow(single):

@@ -62,6 +62,22 @@ def _compare(sim_a, sim_b, stats_a, stats_b):
         np.testing.assert_array_equal(
             np.asarray(getattr(na, f)), np.asarray(getattr(nb, f)),
             err_msg=f"net.{f} diverged")
+    # live output-ring regions (r5 NIC ring path): planes in
+    # [head, head+count) are real queued packets and must match
+    # byte-for-byte; planes outside are dead storage (the pre-r5
+    # convention, still excluded via DEAD above)
+    head = np.asarray(na.out_head)
+    cnt = np.asarray(na.out_count)
+    BO = np.asarray(na.out_words).shape[2]
+    off = (np.arange(BO)[None, None, :] - head[..., None]) % BO
+    live = off < cnt[..., None]
+    for f in ("out_words", "out_priority"):
+        a = np.asarray(getattr(na, f))
+        b = np.asarray(getattr(nb, f))
+        lv = live[..., None] if a.ndim == 4 else live
+        np.testing.assert_array_equal(
+            np.where(lv, a, 0), np.where(lv, b, 0),
+            err_msg=f"net.{f} live ring region diverged")
     ta, tb = sim_a.tcp, sim_b.tcp
     for f in type(ta).__dataclass_fields__:
         np.testing.assert_array_equal(
@@ -148,6 +164,28 @@ def test_tcp_bulk_lossy_bit_identical(seed, loss):
     assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
     _compare(sim_a, sim_b, st_a, st_b)
     # ... and the pass still engages under loss
+    assert int(st_b.micro_steps) < int(st_a.micro_steps), (
+        int(st_b.micro_steps), int(st_a.micro_steps))
+
+
+@pytest.mark.parametrize("seed,bw,loss", [(4, 1500, 0.0), (9, 2500, 0.02)])
+def test_tcp_bulk_slow_link_bit_identical(seed, bw, loss):
+    """The r5 NIC ring path: interface bandwidth low enough that the
+    token bucket throttles every burst — the steady state is a queued
+    output ring drained at 1 ms refill quanta through chained NIC_SEND
+    events. The pass must reproduce the serial NIC byte-for-byte
+    (plane writes, priority stamps, wire-time stamps, chain/wait
+    events) and still engage."""
+    H, hop, total, sim_s = 8, 2, 60_000, 12
+    b1 = _build_relay(H, hop, total, sim_s, seed, bw=bw, loss=loss)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed, bw=bw, loss=loss)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b2.sim)
+    assert int(sim_a.events.overflow) == 0
+    servers = np.asarray(sim_a.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
+    _compare(sim_a, sim_b, st_a, st_b)
     assert int(st_b.micro_steps) < int(st_a.micro_steps), (
         int(st_b.micro_steps), int(st_a.micro_steps))
 

@@ -48,6 +48,14 @@ def main() -> int:
                          "worst paths — the CPU floor can't afford "
                          "long ones)")
     ap.add_argument("--sim-seconds", type=int, default=2)
+    ap.add_argument("--runahead", type=int, default=0,
+                    help="minimum window in ms, 0 = the topology's "
+                         "honest min path latency. Raising it runs "
+                         "fewer, larger windows — the reference's "
+                         "--runahead fidelity/throughput trade "
+                         "(master.c:133-159): events may execute up to "
+                         "this much sim-time later than their causal "
+                         "earliest point")
     ap.add_argument("--seed", type=int, default=1)
     ap.add_argument("--cap", type=int, default=0,
                     help="event/outbox/router queue capacity override "
@@ -208,6 +216,11 @@ def main() -> int:
     cap = args.cap or (0 if args.workload == "phold" else 64)
     for attempt in range(4):
         b, kw, verify = build_workload(args.seed, cap or None)
+        if args.runahead:
+            # raise-only: below the topology's honest minimum there is
+            # no fidelity to regain, only more windows
+            b.min_jump = max(b.min_jump,
+                             args.runahead * simtime.ONE_MILLISECOND)
         fn = bench.make_shard_aware_runner(b, args.shards, **kw)
 
         t0 = time.perf_counter()
@@ -248,6 +261,7 @@ def main() -> int:
            if fraction < 1.0 else {}),
         "hosts": args.hosts,
         "workload": args.workload,
+        **({"runahead_ms": args.runahead} if args.runahead else {}),
         "topology": args.topology,
         "shards": args.shards,
         "platform": jax.devices()[0].platform,

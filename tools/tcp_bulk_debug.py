@@ -50,11 +50,17 @@ def main() -> int:
     ap.add_argument("--windows-max", type=int, default=10_000)
     ap.add_argument("--seed", type=int, default=2)
     ap.add_argument("--topology", default="one", choices=["one", "ref"])
+    ap.add_argument("--device", action="store_true",
+                    help="run on the accelerator instead of forcing "
+                         "CPU — the per-window host loop makes a "
+                         "device-side hang/fault attributable to a "
+                         "specific window")
     args = ap.parse_args()
 
     import jax
 
-    jax.config.update("jax_platforms", "cpu")
+    if not args.device:
+        jax.config.update("jax_platforms", "cpu")
     sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
     from shadow_tpu.utils.compcache import enable_compile_cache
 
