@@ -182,6 +182,343 @@ class RelayTcpBulk:
 TCP_BULK = RelayTcpBulk()
 
 
+# ---------------------------------------------------------------------
+# shared-relay (multiplexed) model — VERDICT r4 #2
+# ---------------------------------------------------------------------
+# Real Tor-in-Shadow relays carry MANY circuits over many sockets per
+# host (the reference's server-child socket multiplexing,
+# tcp.c:91-113,260-321, exists for exactly this). The multiplexed
+# model gives every host C circuit SLOTS: slot arrays are [H, C], a
+# relay stream-forwards each slot's upstream child onto that slot's
+# downstream connection, and accepted children are matched to slots by
+# the circuit's expected previous-hop IP (deterministic first-free
+# rule among same-prev-hop slots; all circuits carry equal bytes, so
+# any within-group permutation delivers identical totals).
+
+
+@struct.dataclass
+class RelayMuxApp:
+    """Multiplexed relay state; [H, C] per-circuit-slot columns plus
+    [H] host-level fields."""
+
+    lsock: jax.Array       # [H] i32 listener (-1 none)
+    nslots: jax.Array      # [H] i32 live circuit slots this host
+    s_role: jax.Array      # [H,C] i32 slot role at THIS host
+    up_conn: jax.Array     # [H,C] i32 accepted upstream child (-1)
+    exp_prev_ip: jax.Array  # [H,C] i64 expected prev-hop ip (0 none)
+    down_sock: jax.Array   # [H,C] i32 downstream connection (-1)
+    next_ip: jax.Array     # [H,C] i64 downstream hop ip (0 none)
+    connected: jax.Array   # [H,C] bool downstream connect issued
+    to_send: jax.Array     # [H,C] i32 client payload left to submit
+    fwd_pending: jax.Array  # [H,C] i32 relay bytes read, unsent
+    up_eof: jax.Array      # [H,C] bool upstream finished
+    closed_down: jax.Array  # [H,C] bool downstream closed
+    rcvd: jax.Array        # [H,C] i64 server bytes received
+    done_at: jax.Array     # [H,C] i64 server EOF time (-1)
+
+
+def setup_shared(sim, *, circuits: list[list[int]], total_bytes: int,
+                 max_slots: int):
+    """circuits: host-index chains [client, r1, ..., server] that MAY
+    share relay/server hosts (a host may appear in many circuits, in
+    different positions). Each host gets one slot per appearance;
+    `max_slots` bounds C (raise sockets_per_host to >= 1 + 2*C)."""
+    H = sim.net.host_ip.shape[0]
+    host_ips = np.asarray(sim.net.host_ip)
+    C = max_slots
+    s_role = np.zeros((H, C), np.int32)
+    exp_prev = np.zeros((H, C), np.int64)
+    next_ip = np.zeros((H, C), np.int64)
+    to_send = np.zeros((H, C), np.int32)
+    nslots = np.zeros(H, np.int32)
+
+    def add_slot(h, role, prev_h, next_h):
+        c = nslots[h]
+        if c >= C:
+            raise ValueError(
+                f"host {h} exceeds max_slots={C}; raise max_slots")
+        s_role[h, c] = role
+        if prev_h is not None:
+            exp_prev[h, c] = host_ips[prev_h]
+        if next_h is not None:
+            next_ip[h, c] = host_ips[next_h]
+        if role == ROLE_CLIENT:
+            to_send[h, c] = total_bytes
+        nslots[h] = c + 1
+
+    for chain in circuits:
+        add_slot(chain[0], ROLE_CLIENT, None, chain[1])
+        for i, r in enumerate(chain[1:-1], start=1):
+            add_slot(r, ROLE_RELAY, chain[i - 1], chain[i + 1])
+        add_slot(chain[-1], ROLE_SERVER, chain[-2], None)
+
+    is_listener = np.any(
+        (s_role == ROLE_RELAY) | (s_role == ROLE_SERVER), axis=1)
+    net, lsock = sk_create(sim.net, jnp.asarray(is_listener),
+                           SocketType.TCP)
+    net, _ = sk_bind(net, jnp.asarray(is_listener), lsock, 0, PORT)
+    sim = sim.replace(net=net)
+    sim = tcp.tcp_listen(sim, jnp.asarray(is_listener), lsock)
+    down = np.full((H, C), -1, np.int32)
+    for c in range(C):
+        has_down = jnp.asarray(next_ip[:, c] != 0)
+        net, d = sk_create(sim.net, has_down, SocketType.TCP)
+        sim = sim.replace(net=net)
+        down[:, c] = np.where(np.asarray(has_down), np.asarray(d), -1)
+
+    app = RelayMuxApp(
+        lsock=jnp.where(jnp.asarray(is_listener), lsock, -1),
+        nslots=jnp.asarray(nslots),
+        s_role=jnp.asarray(s_role),
+        up_conn=jnp.full((H, C), -1, I32),
+        exp_prev_ip=jnp.asarray(exp_prev),
+        down_sock=jnp.asarray(down),
+        next_ip=jnp.asarray(next_ip),
+        connected=jnp.zeros((H, C), bool),
+        to_send=jnp.asarray(to_send),
+        fwd_pending=jnp.zeros((H, C), I32),
+        up_eof=jnp.zeros((H, C), bool),
+        closed_down=jnp.zeros((H, C), bool),
+        rcvd=jnp.zeros((H, C), I64),
+        done_at=jnp.full((H, C), -1, I64),
+    )
+    return sim.replace(app=app)
+
+
+def _mux_cols(app):
+    return app.s_role.shape[1]
+
+
+def mux_handler(cfg: NetConfig, sim, popped, buf):
+    """Serial per-micro-step handler for the multiplexed model: the
+    disjoint handler's phases, per circuit slot (one bounded loop over
+    C — the slots are a static axis, so every phase stays a masked
+    batch update)."""
+    now = popped.time
+    woke = popped.valid
+    H = woke.shape[0]
+    C = _mux_cols(sim.app)
+
+    # ---- connect downstreams at PROC_START ---------------------------
+    for c in range(C):
+        app = sim.app
+        start = woke & (popped.kind == EventKind.PROC_START) \
+            & (app.down_sock[:, c] >= 0) & ~app.connected[:, c]
+        sim, buf = tcp.tcp_connect(cfg, sim, start, app.down_sock[:, c],
+                                   app.next_ip[:, c],
+                                   jnp.full((H,), PORT, I32), now, buf)
+        app = sim.app
+        sim = sim.replace(app=app.replace(
+            connected=app.connected.at[:, c].set(
+                app.connected[:, c] | start)))
+
+    # ---- accept one upstream child, match it to a slot ---------------
+    app = sim.app
+    lready = (gather_hs(sim.net.sk_flags, app.lsock)
+              & SocketFlags.READABLE) != 0
+    any_free = jnp.any((app.s_role != ROLE_CLIENT)
+                       & (app.s_role != ROLE_NONE)
+                       & (app.up_conn < 0), axis=1)
+    acc = woke & (app.lsock >= 0) & any_free & lready
+    sim, got, child = tcp.tcp_accept(sim, acc, app.lsock)
+    app = sim.app
+    peer = gather_hs(sim.net.sk_peer_ip, jnp.maximum(child, 0))
+    # first free slot whose expected prev-hop matches the child's peer
+    cand = (app.up_conn < 0) & (app.exp_prev_ip == peer[:, None]) \
+        & ((app.s_role == ROLE_RELAY) | (app.s_role == ROLE_SERVER))
+    pick = jnp.argmax(cand, axis=1)
+    matched = got & jnp.any(cand, axis=1)
+    sel = matched[:, None] & (jnp.arange(C)[None, :] == pick[:, None])
+    sim = sim.replace(app=app.replace(
+        up_conn=jnp.where(sel, child[:, None], app.up_conn)))
+
+    # ---- per-slot phases ---------------------------------------------
+    for c in range(C):
+        app = sim.app
+        role = app.s_role[:, c]
+        up = app.up_conn[:, c]
+        down = app.down_sock[:, c]
+        # client: feed the stream
+        feeding = woke & (role == ROLE_CLIENT) & app.connected[:, c] \
+            & (app.to_send[:, c] > 0)
+        sim, buf, accepted = tcp.tcp_send(
+            cfg, sim, feeding, down,
+            jnp.minimum(app.to_send[:, c], CHUNK), now, buf)
+        app = sim.app
+        app = app.replace(to_send=app.to_send.at[:, c].set(
+            app.to_send[:, c] - accepted))
+        sim = sim.replace(app=app)
+        fin_client = woke & (role == ROLE_CLIENT) & app.connected[:, c] \
+            & (app.to_send[:, c] == 0) & ~app.closed_down[:, c]
+        sim, buf = tcp.tcp_close(cfg, sim, fin_client, down, now, buf)
+        app = sim.app
+        app = app.replace(closed_down=app.closed_down.at[:, c].set(
+            app.closed_down[:, c] | fin_client))
+        sim = sim.replace(app=app)
+
+        # relay/server: drain upstream
+        drain = woke & (up >= 0) & ~app.up_eof[:, c]
+        sim, buf, nread, eof = tcp.tcp_recv(
+            sim, drain, up, jnp.full((H,), CHUNK, I32), now, buf)
+        app = sim.app
+        is_srv = role == ROLE_SERVER
+        app = app.replace(
+            fwd_pending=app.fwd_pending.at[:, c].set(
+                app.fwd_pending[:, c]
+                + jnp.where(is_srv, 0, nread).astype(I32)),
+            rcvd=app.rcvd.at[:, c].set(
+                app.rcvd[:, c] + jnp.where(is_srv, nread, 0).astype(I64)),
+            up_eof=app.up_eof.at[:, c].set(app.up_eof[:, c] | eof),
+            done_at=app.done_at.at[:, c].set(
+                jnp.where(eof & is_srv & (app.done_at[:, c] < 0), now,
+                          app.done_at[:, c])),
+        )
+        sim = sim.replace(app=app)
+        sim, buf = tcp.tcp_close(cfg, sim, eof & is_srv, up, now, buf)
+
+        # relay: forward downstream
+        app = sim.app
+        fwd = woke & (role == ROLE_RELAY) & (app.fwd_pending[:, c] > 0) \
+            & app.connected[:, c]
+        sim, buf, fsent = tcp.tcp_send(cfg, sim, fwd, down,
+                                       app.fwd_pending[:, c], now, buf)
+        app = sim.app
+        app = app.replace(fwd_pending=app.fwd_pending.at[:, c].set(
+            app.fwd_pending[:, c] - fsent))
+        sim = sim.replace(app=app)
+        relay_fin = woke & (role == ROLE_RELAY) & app.up_eof[:, c] \
+            & (app.fwd_pending[:, c] == 0) & ~app.closed_down[:, c]
+        sim, buf = tcp.tcp_close(cfg, sim, relay_fin, down, now, buf)
+        app = sim.app
+        app = app.replace(closed_down=app.closed_down.at[:, c].set(
+            app.closed_down[:, c] | relay_fin))
+        sim = sim.replace(app=app)
+        sim, buf = tcp.tcp_close(cfg, sim, relay_fin, up, now, buf)
+    return sim, buf
+
+
+class RelayMuxTcpBulk:
+    """TcpAppBulk contract for the multiplexed model: identical
+    steady-state semantics per circuit slot; the delivered socket is
+    located across the [H, C] slot axis."""
+
+    def precheck(self, cfg, sim):
+        app = sim.app
+        live = app.s_role != ROLE_NONE
+        client = app.s_role == ROLE_CLIENT
+        rel = app.s_role == ROLE_RELAY
+        listener = (app.s_role == ROLE_RELAY) | (app.s_role == ROLE_SERVER)
+        ok2 = jnp.where(live & listener, app.up_conn >= 0, True)
+        ok2 = ok2 & jnp.where(live & client,
+                              (app.to_send == 0) & app.closed_down, True)
+        ok2 = ok2 & (app.fwd_pending == 0)
+        ok2 = ok2 & jnp.where(live & (rel | client), app.connected, True)
+        S = sim.tcp.st.shape[1]
+        up = jnp.clip(app.up_conn, 0, S - 1)
+        rows = jnp.arange(up.shape[0])[:, None]
+        up_st = sim.tcp.st[rows, up]
+        up_done = (up_st != tcp.TcpSt.ESTABLISHED) \
+            & (up_st != tcp.TcpSt.CLOSE_WAIT)
+        ok2 = ok2 & jnp.where(
+            live & app.up_eof,
+            jnp.where(rel, app.closed_down, up_done), True)
+        return jnp.all(ok2, axis=1)
+
+    def on_data(self, cfg, app, mask, slot, nread, now):
+        hit = app.up_conn == slot[:, None]           # [H,C]
+        any_hit = jnp.any(hit, axis=1)
+        ok = ~mask | (any_hit & (nread <= CHUNK))
+        m = mask & any_hit
+        pick = jnp.argmax(hit, axis=1)
+        C = _mux_cols(app)
+        sel = m[:, None] & (jnp.arange(C)[None, :] == pick[:, None])
+        rows = jnp.arange(app.s_role.shape[0])
+        role_c = app.s_role[rows, pick]
+        server = m & (role_c == ROLE_SERVER)
+        rel = m & (role_c == ROLE_RELAY)
+        app = app.replace(rcvd=jnp.where(
+            sel & server[:, None], app.rcvd + nread[:, None].astype(I64),
+            app.rcvd))
+        fwd_mask = rel
+        fwd_slot = app.down_sock[rows, pick]
+        return app, ok, fwd_mask, fwd_slot, jnp.where(fwd_mask, nread, 0)
+
+    def on_eof(self, cfg, app, mask, slot, now):
+        hit = app.up_conn == slot[:, None]
+        any_hit = jnp.any(hit, axis=1)
+        rows = jnp.arange(app.s_role.shape[0])
+        pick = jnp.argmax(hit, axis=1)
+        C = _mux_cols(app)
+        sel_c = jnp.arange(C)[None, :] == pick[:, None]
+        m = mask & any_hit & ~app.up_eof[rows, pick]
+        ok = jnp.ones(mask.shape, bool)
+        role_c = app.s_role[rows, pick]
+        server = m & (role_c == ROLE_SERVER)
+        rel = m & (role_c == ROLE_RELAY)
+        ok = ok & ~(rel & ((app.fwd_pending[rows, pick] > 0)
+                           | ~app.connected[rows, pick]
+                           | app.closed_down[rows, pick]))
+        sel = m[:, None] & sel_c
+        app = app.replace(
+            up_eof=jnp.where(sel, True, app.up_eof),
+            done_at=jnp.where(
+                sel & server[:, None] & (app.done_at < 0),
+                now[:, None], app.done_at),
+        )
+        c1_mask = server | rel
+        c1_slot = jnp.where(server, slot, app.down_sock[rows, pick])
+        c2_mask = rel
+        c2_slot = slot
+        app = app.replace(closed_down=jnp.where(
+            sel & rel[:, None], True, app.closed_down))
+        return app, ok, c1_mask & ok, c1_slot, c2_mask & ok, c2_slot
+
+
+MUX_TCP_BULK = RelayMuxTcpBulk()
+
+
+def consensus_circuits(rng, n_circuits: int, clients, relays, servers,
+                       hops: int = 3, max_slots: int = 8):
+    """Sample circuit chains the way Tor clients build paths: relays
+    drawn by consensus weight (Zipf-ish here — weight IS capacity in
+    the consensus, so heavy relays legitimately carry many circuits),
+    distinct relays within one circuit, shared freely across circuits
+    up to each host's `max_slots` capacity (rejection keeps the draw
+    feasible while preserving the skew). Returns host-index chains
+    [client, r1..r_hops, server]."""
+    relays = list(relays)
+    w = np.asarray([1.0 / (i + 1) ** 0.5 for i in range(len(relays))])
+    w = w / w.sum()
+    used: dict[int, int] = {}
+    chains = []
+    clients = list(clients)
+    servers = list(servers)
+    for k in range(n_circuits):
+        cl = clients[k % len(clients)]
+        sv = None
+        for _ in range(64):
+            cand_sv = servers[int(rng.integers(len(servers)))]
+            if used.get(cand_sv, 0) < max_slots:
+                sv = cand_sv
+                break
+        if sv is None:
+            break  # server capacity exhausted: fewer circuits
+        rs: list[int] = []
+        tries = 0
+        while len(rs) < hops and tries < 256:
+            tries += 1
+            r = relays[int(rng.choice(len(relays), p=w))]
+            if r not in rs and used.get(r, 0) + 1 <= max_slots:
+                rs.append(r)
+        if len(rs) < hops:
+            break  # relay capacity exhausted
+        for h in rs:
+            used[h] = used.get(h, 0) + 1
+        used[sv] = used.get(sv, 0) + 1
+        chains.append([cl] + rs + [sv])
+    return chains
+
+
 def handler(cfg: NetConfig, sim, popped, buf):
     app = sim.app
     now = popped.time

@@ -159,6 +159,23 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
     )
 
 
+def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk):
+    """One bulk-pass selection rule for every runner flavor (the UDP
+    bulk wins when both are given; make_bulk_fn's order_impl is a
+    separate knob with its own vocabulary, not forwarded)."""
+    if app_bulk is not None:
+        from shadow_tpu.net.bulk import make_bulk_fn
+
+        fn = make_bulk_fn(bundle.cfg, app_bulk)
+        if fn is not None:
+            return fn
+    if app_tcp_bulk is not None:
+        from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
+
+        return make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
+    return None
+
+
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
                 app_tcp_bulk=None,
@@ -188,17 +205,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
 
     step = make_step_fn(bundle.cfg, app_handlers)
     end = end_time if end_time is not None else bundle.cfg.end_time
-    bulk_fn = None
-    if app_bulk is not None:
-        from shadow_tpu.net.bulk import make_bulk_fn
-
-        # (make_bulk_fn's order_impl is a separate knob with its own
-        # vocabulary, "cube"/"sort" — not forwarded from route_impl)
-        bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
-    if bulk_fn is None and app_tcp_bulk is not None:
-        from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
-
-        bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
+    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk)
     route_fn = _default_route
     if route_impl is not None:
         from shadow_tpu.core.events import route_outbox
@@ -217,6 +224,63 @@ def make_runner(bundle: SimBundle, app_handlers=(),
         )
 
     return jax.jit(_go)
+
+
+def make_chunked_runner(bundle: SimBundle, app_handlers=(),
+                        end_time: int | None = None, app_bulk=None,
+                        app_tcp_bulk=None, chunk_windows: int = 256):
+    """make_runner variant that executes `chunk_windows` windows per
+    device call with a host-side outer loop — window-for-window the
+    SAME sequence engine.run's single while_loop produces (advance
+    rule newStart = minNext, master.c:450-480), so results are
+    bit-identical.
+
+    Why it exists: one device call covering a whole long simulation
+    (the real-topology regime: 200 windows per sim-second) can exceed
+    a backend's per-execution limits (observed on the tunneled v5e:
+    relay runs on the reference topology die with UNAVAILABLE while
+    the identical computation split into shorter calls completes).
+    Chunking bounds single-call execution time at a few hundred
+    windows and costs one dispatch per chunk."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.engine import EngineStats, step_window
+
+    step = make_step_fn(bundle.cfg, app_handlers)
+    end = end_time if end_time is not None else bundle.cfg.end_time
+    end = jnp.asarray(end, simtime.DTYPE)
+    min_jump = max(int(bundle.min_jump), 1)
+    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk)
+
+    @jax.jit
+    def k_windows(sim, stats, wstart):
+        def body(_i, c):
+            sim, stats, wstart = c
+
+            def run_one(ops):
+                sim, stats, wstart = ops
+                wend = jnp.minimum(wstart + min_jump, end + 1)
+                return step_window(
+                    sim, stats, step, wend,
+                    emit_capacity=bundle.cfg.emit_capacity,
+                    lane_id=sim.net.lane_id, bulk_fn=bulk_fn)
+
+            return jax.lax.cond(wstart <= end, run_one,
+                                lambda ops: ops, (sim, stats, wstart))
+
+        return jax.lax.fori_loop(0, chunk_windows, body,
+                                 (sim, stats, wstart))
+
+    def go(sim):
+        stats = EngineStats.create()
+        wstart = jnp.min(sim.events.min_time())
+        while int(jax.device_get(wstart)) <= int(end):
+            sim, stats, wstart = k_windows(sim, stats, wstart)
+        return sim, stats
+
+    return go
 
 
 def run(bundle: SimBundle, app_handlers=(), end_time: int | None = None,

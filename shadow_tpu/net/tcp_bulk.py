@@ -232,15 +232,17 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
     if cfg.cpu_threshold_ns >= 0:
         return None
     if cfg.nic_drain != FLUSH_SEGMENTS:
-        # the no-chain invariant below pairs one flush's segments with
-        # one drain pass; unequal bounds would chain NIC_SEND events
+        # the FAST (fused) wire path models one flush burst + one full
+        # drain as a single step; its lane-mode decision (`overbound`)
+        # and the static wire unroll are sized on this equality. Other
+        # drain bounds would need the unrolls re-derived — the ring
+        # path could handle them, but the fast path is the common case
         return None
     if cfg.out_ring <= FLUSH_SEGMENTS:
-        # serial tcp_flush's chain decision includes an out-ring room
-        # check (room2); with out_ring == FLUSH_SEGMENTS the ring is
-        # still full of the just-packetized burst at that moment and
-        # serial STALLS the remainder — the bulk chain-on-rest rule
-        # assumes room, so it needs strictly more ring than one burst
+        # one burst must fit the ring with room to spare or even the
+        # ring path stops on every flush (ek & ~okp); serial instead
+        # STALLS the remainder inside tcp_flush — a regime this pass
+        # does not model
         return None
 
     R = cfg.router_ring

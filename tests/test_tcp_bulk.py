@@ -190,6 +190,23 @@ def test_tcp_bulk_slow_link_bit_identical(seed, bw, loss):
         int(st_b.micro_steps), int(st_a.micro_steps))
 
 
+def test_chunked_runner_bit_identical():
+    """make_chunked_runner (k windows per device call, host outer
+    loop) must produce exactly the monolithic program's state — the
+    long-sim escape hatch for backends with per-execution limits."""
+    from shadow_tpu.net.build import make_chunked_runner
+
+    H, hop, total, sim_s = 8, 2, 40_000, 8
+    b1 = _build_relay(H, hop, total, sim_s, seed=6, loss=0.02)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK)(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed=6, loss=0.02)
+    sim_b, st_b = make_chunked_runner(
+        b2, app_handlers=(relay.handler,), app_tcp_bulk=relay.TCP_BULK,
+        chunk_windows=7)(b2.sim)
+    _compare(sim_a, sim_b, st_a, st_b)
+
+
 @pytest.mark.parametrize("seed", [2])
 def test_tcp_bulk_lossy_relay_chain_bit_identical(seed):
     """5-hop relay circuits under loss (config #3's shape on a lossy
