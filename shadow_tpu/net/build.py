@@ -245,6 +245,11 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
     import jax
     import jax.numpy as jnp
 
+    if chunk_windows < 1:
+        raise ValueError(
+            f"chunk_windows must be >= 1, got {chunk_windows} "
+            "(0 iterations would spin the host loop forever)")
+
     from shadow_tpu.core import simtime
     from shadow_tpu.core.engine import EngineStats, step_window
 

@@ -33,9 +33,9 @@ def _build_mux(H, chains, total, sim_s, seed=1, bw=102400, loss=0.0):
     return b
 
 
-def _chains(H):
-    """6 clients, 3 relays, 1 server; 2-relay circuits drawn by
-    consensus weight — relays MUST end up shared."""
+def _chains():
+    """6 clients, 3 relays, 1 server (10 hosts); 2-relay circuits
+    drawn by consensus weight — relays MUST end up shared."""
     rng = np.random.default_rng(5)
     chains = relay.consensus_circuits(
         rng, n_circuits=4, clients=list(range(6)),
@@ -51,7 +51,7 @@ def _chains(H):
 
 def test_mux_relay_completes_and_shares():
     H, total, sim_s = 10, 30_000, 8
-    chains = _chains(H)
+    chains = _chains()
     b = _build_mux(H, chains, total, sim_s)
     sim, stats = make_runner(b, app_handlers=(relay.mux_handler,))(b.sim)
     assert int(sim.events.overflow) == 0
@@ -64,7 +64,7 @@ def test_mux_relay_completes_and_shares():
 @pytest.mark.parametrize("loss", [0.0, 0.02])
 def test_mux_relay_bulk_bit_identical(loss):
     H, total, sim_s = 10, 20_000, 10
-    chains = _chains(H)
+    chains = _chains()
     b1 = _build_mux(H, chains, total, sim_s, loss=loss)
     sim_a, st_a = make_runner(b1, app_handlers=(relay.mux_handler,))(
         b1.sim)
