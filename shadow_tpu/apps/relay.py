@@ -300,7 +300,11 @@ def mux_handler(cfg: NetConfig, sim, popped, buf):
     C = _mux_cols(sim.app)
 
     # ---- connect downstreams at PROC_START ---------------------------
-    for c in range(C):
+    # (slot loops run as lax.fori_loop so the heavy tcp_* call graphs
+    # are traced ONCE, not once per slot — at C=8 the unrolled form
+    # compiles for tens of minutes)
+    def _connect_one(c, carry):
+        sim, buf = carry
         app = sim.app
         start = woke & (popped.kind == EventKind.PROC_START) \
             & (app.down_sock[:, c] >= 0) & ~app.connected[:, c]
@@ -311,6 +315,9 @@ def mux_handler(cfg: NetConfig, sim, popped, buf):
         sim = sim.replace(app=app.replace(
             connected=app.connected.at[:, c].set(
                 app.connected[:, c] | start)))
+        return sim, buf
+
+    sim, buf = jax.lax.fori_loop(0, C, _connect_one, (sim, buf))
 
     # ---- accept one upstream child, match it to a slot ---------------
     app = sim.app
@@ -333,7 +340,8 @@ def mux_handler(cfg: NetConfig, sim, popped, buf):
         up_conn=jnp.where(sel, child[:, None], app.up_conn)))
 
     # ---- per-slot phases ---------------------------------------------
-    for c in range(C):
+    def _slot_one(c, carry):
+        sim, buf = carry
         app = sim.app
         role = app.s_role[:, c]
         up = app.up_conn[:, c]
@@ -394,6 +402,9 @@ def mux_handler(cfg: NetConfig, sim, popped, buf):
             app.closed_down[:, c] | relay_fin))
         sim = sim.replace(app=app)
         sim, buf = tcp.tcp_close(cfg, sim, relay_fin, up, now, buf)
+        return sim, buf
+
+    sim, buf = jax.lax.fori_loop(0, C, _slot_one, (sim, buf))
     return sim, buf
 
 
@@ -493,6 +504,17 @@ def consensus_circuits(rng, n_circuits: int, clients, relays, servers,
     chains = []
     clients = list(clients)
     servers = list(servers)
+    # weighted draws come in vectorized batches: one rng.choice call
+    # per 64k picks instead of one O(len(relays)) call per pick (the
+    # 100k-host build draws hundreds of thousands)
+    batch: list[int] = []
+
+    def draw_relay() -> int:
+        if not batch:
+            batch.extend(
+                rng.choice(len(relays), size=65536, p=w).tolist())
+        return relays[batch.pop()]
+
     for k in range(n_circuits):
         cl = clients[k % len(clients)]
         sv = None
@@ -507,7 +529,7 @@ def consensus_circuits(rng, n_circuits: int, clients, relays, servers,
         tries = 0
         while len(rs) < hops and tries < 256:
             tries += 1
-            r = relays[int(rng.choice(len(relays), p=w))]
+            r = draw_relay()
             if r not in rs and used.get(r, 0) + 1 <= max_slots:
                 rs.append(r)
         if len(rs) < hops:

@@ -31,19 +31,23 @@ so a session that dies mid-campaign resumes where it left off. The
 parent exits when every job has a result. All probe/job activity is
 timestamped into .tpu_watch/watch.log (the probe-cadence record).
 
-The campaign (in strike order — cheapest/most valuable first):
-  bench_1k_quick   1,024-host PHOLD, 2 sim-s — smallest real TPU row,
-                   lands within ~1 min of a window opening
-  bench_10k        the driver's exact end-of-round shape (10,240-host
-                   PHOLD load 8, 5 sim-s) — warms the cache key the
-                   driver's bench.py run will hit
-  bench_ref_topo   PHOLD on the real 183-vertex reference graph
-  relay_10240      BASELINE config #3 (Tor-relay shape)
-  gossip_5120      BASELINE config #4 (Bitcoin gossip)
-  bench_1k_x8      ensemble mode: 8 independent 1k replicas in one
-                   program (BENCH_REPLICAS) — the small-config row
-  bench_100k       BASELINE config #5 at spec scale (the biggest
-                   compile, so it goes last)
+The campaign (in strike order — the driver-critical cache warm first,
+then the cheapest banker, then the r5 headline rows, heaviest last):
+  bench_10k          the driver's exact end-of-round shape (10,240-host
+                     PHOLD load 8, 5 sim-s) — warms the cache key the
+                     driver's bench.py run will hit; nothing matters
+                     more than BENCH_r{N} landing on the chip
+  bench_1k_quick     smallest real TPU row, lands within ~1 min warm
+  relay_ref_1024     BASELINE config #2 PROPER (lossy ref-topology TCP
+                     relay, chunked) + a --runahead 50 variant
+  tor_10240          shared-relay Tor shape (r5 multiplexed circuits)
+  bench_ref_topo     PHOLD on the real 183-vertex reference graph
+  relay_10240        BASELINE config #3 (disjoint Tor-relay shape)
+  gossip_5120        BASELINE config #4 (Bitcoin gossip)
+  bench_1k_x8        ensemble mode: 8 independent 1k replicas
+  bench_100k         BASELINE config #5 at spec scale
+  tor_102400         the north-star Tor shape at 100k (heaviest
+                     compile, so it goes last)
 
 A job that fails the same way twice is terminal (recorded ok=false,
 attempts>=2) so one deterministic failure can't pin the campaign in a
@@ -75,9 +79,25 @@ LOG = STATE / "watch.log"
 # harness run. kind 'bench' specs are env for bench.main; kind
 # 'scale' specs are scale_run argv.
 JOBS = [
+    ("bench_10k", "bench", {}, 1800),  # driver defaults: 10240 hosts
     ("bench_1k_quick", "bench",
      {"BENCH_HOSTS": "1024", "BENCH_SIM_SECONDS": "2"}, 900),
-    ("bench_10k", "bench", {}, 1800),  # driver defaults: 10240 hosts
+    # config #2 PROPER (r5): the lossy reference-topology TCP relay,
+    # chunked (the monolithic program exceeds the backend's
+    # per-execution limit on this shape — see make_chunked_runner)
+    ("relay_ref_1024", "scale",
+     ["--workload", "relay", "--hosts", "1024", "--hop", "2",
+      "--bytes", "100000", "--sim-seconds", "20", "--topology", "ref",
+      "--allow-partial", "--chunk", "32"], 3600),
+    # ... and the same with the reference's runahead fidelity trade
+    ("relay_ref_1024_ra50", "scale",
+     ["--workload", "relay", "--hosts", "1024", "--hop", "2",
+      "--bytes", "100000", "--sim-seconds", "20", "--topology", "ref",
+      "--allow-partial", "--chunk", "32", "--runahead", "50"], 3600),
+    # shared-relay Tor shape (r5, VERDICT #2): multiplexed circuits
+    ("tor_10240", "scale",
+     ["--workload", "tor", "--hosts", "10240", "--bytes", "100000",
+      "--sim-seconds", "30", "--allow-partial", "--chunk", "64"], 3600),
     ("bench_ref_topo", "bench",
      {"BENCH_TOPO": "ref", "BENCH_HOSTS": "1024",
       "BENCH_SIM_SECONDS": "2"}, 1800),
@@ -93,6 +113,11 @@ JOBS = [
      {"BENCH_HOSTS": "1024", "BENCH_REPLICAS": "8"}, 1800),
     ("bench_100k", "bench",
      {"BENCH_HOSTS": "102400", "BENCH_SIM_SECONDS": "2"}, 3600),
+    # the north-star Tor shape at spec scale (heaviest compile: last)
+    ("tor_102400", "scale",
+     ["--workload", "tor", "--hosts", "102400", "--bytes", "20000",
+      "--sim-seconds", "2", "--allow-partial", "--chunk", "16",
+      "--slots", "4"], 5400),
 ]
 ALL_JOBS = [j[0] for j in JOBS]
 MAX_ATTEMPTS = 2
