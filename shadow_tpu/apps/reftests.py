@@ -260,3 +260,161 @@ def epoll_writeable_main(env):
             recvd += n
         assert recvd == TOTAL, recvd
         yield vproc.close(fd)
+
+
+# ---------------------------------------------------------------------
+# r5 surface breadth (VERDICT r4 #4): file / random / signal /
+# pthreads / unistd — the five syscall dirs r4 could not run verbatim
+# ---------------------------------------------------------------------
+
+def file_main(env):
+    """test_file.c: _test_newfile (:40-45), _test_write (:47-58),
+    _test_read (:60-74), _test_fwrite/_test_fread (:76-100 — the
+    stdio forms reduce to the same read/write surface), plus the
+    unlink/ENOENT and lseek/fstat semantics those helpers rely on
+    (tmpfile_make/tmpfile_delete). The iovec sub-test
+    (_test_iov, :101-160) exercises readv/writev argument validation
+    against glibc internals — no analog surface, skipped (the
+    reference runs it primarily in native mode)."""
+    # _test_newfile: create, close, unlink
+    fd = yield vproc.fopen("testfile", "w")
+    assert fd >= 0, "fopen(w) must create"
+    yield vproc.close(fd)
+    r = yield vproc.funlink("testfile")
+    assert r == 0
+    r = yield vproc.fopen("missing", "r")
+    assert r == -1, "fopen(r) on a missing file must fail (ENOENT)"
+
+    # tmpfile_make("testfile", "test") + _test_write
+    fd = yield vproc.fopen("testfile", "w")
+    n = yield vproc.write(fd, b"test")
+    assert n == 4
+    yield vproc.close(fd)
+    fd = yield vproc.fopen("testfile", "r+")
+    assert fd >= 0
+    n = yield vproc.write(fd, b"test")
+    assert n == 4
+    yield vproc.close(fd)
+
+    # _test_read / _test_fread
+    fd = yield vproc.fopen("testfile", "r")
+    data = yield vproc.read(fd, 4)
+    assert data == b"test", data
+    # lseek + re-read (the rewind fread depends on)
+    pos = yield vproc.fseek(fd, 0, vproc.SEEK_SET)
+    assert pos == 0
+    data = yield vproc.read(fd, 4)
+    assert data == b"test", data
+    size = yield vproc.fstat_size(fd)
+    assert size == 4, size
+    yield vproc.close(fd)
+
+    # write via a bad fd is EBADF
+    n = yield vproc.write(1923 + vproc.FILE_FD_BASE, b"x")
+    assert n == -1, "EBADF write must fail (test_file.c:124)"
+    r = yield vproc.funlink("testfile")
+    assert r == 0
+
+
+def random_main(env):
+    """test_random.c: _test_dev_urandom (:17-50 — 100 4-byte draws
+    from the host random source; both distribution tails must be
+    seen) and _test_rand (:52-60 — 100 rand() draws in
+    [0, RAND_MAX])."""
+    yield vproc.write(1, b"########## random test starting ##########\n")
+    num_low = num_high = 0
+    for _ in range(100):
+        data = yield vproc.getrandom(4)
+        assert len(data) == 4
+        v = int.from_bytes(data, "little")
+        frac = v / 0xFFFFFFFF
+        if frac < 0.1:
+            num_low += 1
+        elif frac > 0.9:
+            num_high += 1
+    assert num_low > 0 and num_high > 0, (num_low, num_high)
+    for _ in range(100):
+        v = yield vproc.c_rand()
+        assert 0 <= v < (1 << 31)
+    # the C test's stdout banner rides the per-process stdout file
+    # (ref: process.c's <data>/hosts/<name>/*.stdout)
+    yield vproc.write(1, b"########## random test passed! ##########\n")
+
+
+def signal_main(env):
+    """test_signal.c: install a SIGSEGV handler via sigaction
+    (main:28-34), trigger the signal (:37-39 — the null-call fault
+    becomes an explicit raise on this surface), and succeed from the
+    handler exactly once (signal_handled_func:12-24)."""
+    yield vproc.write(1, b"########## signal test starting ##########\n")
+    handled = []
+    yield vproc.sigaction(vproc.SIGSEGV, lambda sig: handled.append(sig))
+    r = yield vproc.raise_sig(vproc.SIGSEGV)
+    assert r == 0, "installed handler must run"
+    assert handled == [vproc.SIGSEGV], handled
+    yield vproc.write(1, b"########## signal test passed! ##########\n")
+
+
+def pthreads_main(env):
+    """test_pthreads.c: _test_thread_returnOne joined through
+    _test_joinThreads (:27-31,106-123 — join returns the thread's
+    value), and the mutex lock/trylock protocol
+    (_test_mutex_lock:162-216, _test_mutex_trylock:218-278): a held
+    mutex fails trylock and blocks lock until the holder releases."""
+    def t_return_one(host):
+        yield vproc.gettime()
+        return 1
+
+    tids = []
+    for _ in range(4):    # NUM_THREADS join loop (:106-123)
+        tids.append((yield vproc.thread_create(t_return_one)))
+    for tid in tids:
+        r = yield vproc.thread_join(tid)
+        assert r == 1, r
+
+    mid = yield vproc.mutex_init()
+    r = yield vproc.mutex_lock(mid)
+    assert r == 0
+    state = {"thread_got_lock": False}
+
+    def t_contender(host):
+        got = yield vproc.mutex_trylock(mid)
+        assert got is False, "trylock of a held mutex must fail (EBUSY)"
+        yield vproc.mutex_lock(mid)       # blocks until main unlocks
+        state["thread_got_lock"] = True
+        yield vproc.mutex_unlock(mid)
+
+    tid = yield vproc.thread_create(t_contender)
+    yield vproc.sleep(1 * S_TO_NS)        # let the contender hit the lock
+    assert not state["thread_got_lock"]
+    yield vproc.mutex_unlock(mid)
+    yield vproc.thread_join(tid)
+    assert state["thread_got_lock"]
+
+
+def unistd_main(env):
+    """test_unistd.c: _test_getpid_nodeps (:13-17 — positive and
+    stable), _test_getpid_kill (:27-36 — kill(getpid(), SIGUSR1)
+    runs the installed handler exactly once; the reference skips
+    this under shadow pending kill support, main:100-104 — this
+    surface has it), and _test_gethostname (:38-70 — matches the
+    configured node name passed as argv nodename). uname is skipped
+    like the reference's TODO (main:110-113)."""
+    pid = yield vproc.getpid()
+    assert pid > 0
+    pid2 = yield vproc.getpid()
+    assert pid2 == pid
+
+    counts = [0]
+
+    def inc(sig):
+        counts[0] += 1
+
+    yield vproc.sigaction(vproc.SIGUSR1, inc)
+    r = yield vproc.kill(pid, vproc.SIGUSR1)
+    assert r == 0
+    assert counts[0] == 1, counts
+
+    name = yield vproc.gethostname()
+    expected = env["args"][1] if len(env["args"]) > 1 else env["host"]
+    assert name == expected, (name, expected)

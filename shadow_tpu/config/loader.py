@@ -340,6 +340,16 @@ def _register_reftests():
          rt.sleep_main, no_tcp),
         (("testshutdown", "libshadow-plugin-test-shutdown.so"),
          rt.shutdown_main, stream),
+        # r5 surface breadth (VERDICT r4 #4)
+        (("testfile", "libshadow-plugin-test-file.so"),
+         rt.file_main, no_tcp),
+        (("testrandom", "shadow-plugin-test-random"),
+         rt.random_main, no_tcp),
+        (("testsignal", "libshadow-plugin-test-signal.so"),
+         rt.signal_main, no_tcp),
+        (("testpthreads", "libshadow-plugin-test-pthreads.so"),
+         rt.pthreads_main, no_tcp),
+        (("test-unistd", "testunistd"), rt.unistd_main, no_tcp),
     ):
         cfgfn = _vproc_plugin(fn, hints)
         for name in names:

@@ -295,6 +295,130 @@ class EPOLL:
 
 EPOLL_FD_BASE = 1 << 16   # epoll fds live above the socket-slot space
 PIPE_FD_BASE = 1 << 17    # pipe/socketpair fds above the epoll space
+FILE_FD_BASE = 1 << 18    # virtual-filesystem fds above the pipe space
+
+
+# ---------------------------------------------------------------------
+# r5 surface breadth (VERDICT r4 #4): files, random, signals, threads
+# (ref: process.h:103-437 — the process_emu_{open,read,write,rand,
+# kill,sigaction,...} families, and rpth's pthread layer,
+# src/external/rpth/pthread.c)
+# ---------------------------------------------------------------------
+
+# signal numbers the reference tests exercise (src/test/signal,
+# src/test/unistd)
+SIGUSR1 = 10
+SIGSEGV = 11
+SIGUSR2 = 12
+
+SEEK_SET, SEEK_CUR, SEEK_END = 0, 1, 2
+
+
+def fopen(path: str, mode: str = "r"):
+    """open/fopen analog on the host's virtual filesystem (ref:
+    process_emu_open/fopen; the reference redirects relative paths
+    into the host's data directory, process.c). Returns fd or -1
+    (ENOENT for "r" on a missing file). Files are per-HOST like
+    channels (the fork-inherited-descriptor analog)."""
+    return Sys("fopen", (path, mode))
+
+
+def funlink(path: str):
+    """unlink(2) analog; returns 0 or -1 (ENOENT)."""
+    return Sys("funlink", (path,))
+
+
+def fseek(fd, off: int, whence: int = SEEK_SET):
+    """lseek(2) analog; returns the new offset or -1."""
+    return Sys("fseek", (fd, off, whence))
+
+
+def fstat_size(fd):
+    """fstat(2) st_size; returns the size or -1 (EBADF)."""
+    return Sys("fstat_size", (fd,))
+
+
+def getrandom(n: int):
+    """getrandom(2) / read of /dev/urandom: n bytes from the host's
+    deterministic random source (ref: the reference seeds each host's
+    random from the master seed hierarchy, host.c random; two runs of
+    one seed return identical streams)."""
+    return Sys("getrandom", (n,))
+
+
+def c_rand():
+    """rand(3) analog from the same per-host source: [0, 2**31)."""
+    return Sys("c_rand", ())
+
+
+def getpid():
+    """Returns the virtual pid (spawn order, 1-based — the reference
+    hands plugins their per-process id the same way)."""
+    return Sys("getpid", ())
+
+
+def gethostname():
+    """Returns the host's configured name (ref:
+    process_emu_gethostname reads the Host's name, process.c)."""
+    return Sys("gethostname", ())
+
+
+def sigaction(sig: int, handler):
+    """Install `handler(signum)` for sig (ref: process_emu_sigaction;
+    handlers run host-side at delivery, the pth-dispatched handler
+    analog). Returns 0."""
+    return Sys("sigaction", (sig, handler))
+
+
+def raise_sig(sig: int):
+    """raise(3): deliver sig to the calling process — the installed
+    handler runs before this returns. An unhandled signal kills the
+    process (the plugin-error path, slave.c:468-473). Returns 0 if
+    handled."""
+    return Sys("raise_sig", (sig,))
+
+
+def kill(pid: int, sig: int):
+    """kill(2) to a virtual pid on the SAME host (ref:
+    process_emu_kill; cross-host signals don't exist). Returns 0, or
+    -1 (ESRCH) for an unknown/foreign pid."""
+    return Sys("kill", (pid, sig))
+
+
+def thread_create(fn):
+    """pthread_create analog: start `fn(host)` — a generator yielding
+    vproc syscalls — as another coroutine of the SAME process context
+    (shared host fds/channels/files; ref: rpth pthread_create spawns
+    a green thread in the process's pth scheduler). Returns its tid."""
+    return Sys("thread_create", (fn,))
+
+
+def thread_join(tid: int):
+    """pthread_join analog: blocks until the thread's coroutine
+    completes; returns its StopIteration value (or None)."""
+    return Sys("thread_join", (tid,))
+
+
+def mutex_init():
+    """pthread_mutex_init analog (host-scoped like fds); returns a
+    mutex id."""
+    return Sys("mutex_init", ())
+
+
+def mutex_lock(mid: int):
+    """Blocks until acquired (ref: rpth pth_mutex_acquire — green
+    threads interleave only at yield points, so the lock serializes
+    critical sections across this host's coroutines)."""
+    return Sys("mutex_lock", (mid,))
+
+
+def mutex_trylock(mid: int):
+    """Returns True if acquired, False if held (EBUSY)."""
+    return Sys("mutex_trylock", (mid,))
+
+
+def mutex_unlock(mid: int):
+    return Sys("mutex_unlock", (mid,))
 
 
 def pipe():
@@ -415,6 +539,11 @@ class _Proc:
     # per-process epoll instances (epfd -> _Epoll)
     epolls: "dict[int, _Epoll]" = field(default_factory=dict)
     next_epfd: int = EPOLL_FD_BASE
+    # r5 surface breadth: virtual pid, installed signal handlers,
+    # and the generator's return value (pthread_join's result)
+    pid: int = 0
+    sig_handlers: dict = field(default_factory=dict)
+    result: object = None
 
 
 class ProcessRuntime:
@@ -468,6 +597,26 @@ class ProcessRuntime:
         # them (the fork-inherited-descriptor analog, channel.c)
         self._channels: dict[tuple, _ChanEnd] = {}
         self._next_pipe_fd: dict[int, int] = {}
+        # r5 surface breadth (VERDICT r4 #4) ---------------------------
+        # virtual filesystem: per-host files + per-(host,fd) cursors
+        # (ref: process_emu_open/read/write redirect into the host's
+        # data dir; real bytes live host-side like the payload pool)
+        self._fs: dict[tuple, bytearray] = {}          # (host, path)
+        self._file_fds: dict[tuple, dict] = {}         # (host, fd)
+        self._next_file_fd: dict[int, int] = {}
+        # per-host deterministic random source (ref: the master seed
+        # hierarchy hands each host its own Random, host.c; two runs
+        # of one seed must produce identical streams)
+        self._rand: dict[int, np.random.Generator] = {}
+        # pids, host mutexes, per-process stdout/stderr
+        self._next_pid = 1
+        self._mutexes: dict[tuple, int] = {}           # (host,mid)->pid|0
+        self._next_mutex: dict[int, int] = {}
+        self._stdio: dict[tuple, bytearray] = {}       # (host,pid,fd)
+        # host data directory for per-process stdout/stderr files
+        # (ref: process.c maintains <data>/hosts/<name>/*.stdout);
+        # None = keep in memory only (stdio_of reads either way)
+        self.data_dir = None
         # host-side copy of the (static) IP tables for addr -> host id
         self._ip_sorted = np.asarray(self.sim.net.ip_sorted)
         self._host_of_ip_sorted = np.asarray(self.sim.net.host_of_ip_sorted)
@@ -500,7 +649,9 @@ class ProcessRuntime:
                 f"syscalls)")
         self.procs.append(_Proc(host=host, gen=gen,
                                 start_time=start_time,
-                                stop_time=stop_time))
+                                stop_time=stop_time,
+                                pid=self._next_pid))
+        self._next_pid += 1
 
     # -- device side ----------------------------------------------------
 
@@ -829,6 +980,106 @@ class ProcessRuntime:
             if child is not None and child >= 0:
                 return True, child
             return False, None
+        # ---- r5 surface breadth: files / random / signals / threads --
+        if op == "fopen":
+            path, mode = a
+            exists = (h, path) in self._fs
+            if mode.startswith("r") and not exists:
+                return True, -1           # ENOENT ("r" and "r+" both
+                                          # require the file to exist)
+            if mode in ("w", "w+") or not exists:
+                self._fs[(h, path)] = bytearray()
+            fd = self._next_file_fd.get(h, FILE_FD_BASE)
+            self._next_file_fd[h] = fd + 1
+            self._file_fds[(h, fd)] = {
+                "path": path, "pos": 0,
+                "rd": mode in ("r", "r+", "w+", "a+"),
+                "wr": mode not in ("r",)}
+            if mode in ("a", "a+"):
+                self._file_fds[(h, fd)]["pos"] = len(self._fs[(h, path)])
+            return True, fd
+        if op == "funlink":
+            return True, (0 if self._fs.pop((h, a[0]), None) is not None
+                          else -1)
+        if op == "fseek":
+            ent = self._file_fds.get((h, a[0]))
+            if ent is None:
+                return True, -1           # EBADF
+            off, whence = a[1], a[2]
+            size = len(self._fs.get((h, ent["path"]), b""))
+            base = (0 if whence == SEEK_SET
+                    else ent["pos"] if whence == SEEK_CUR else size)
+            if base + off < 0:
+                return True, -1           # EINVAL
+            ent["pos"] = base + off
+            return True, ent["pos"]
+        if op == "fstat_size":
+            ent = self._file_fds.get((h, a[0]))
+            if ent is None:
+                return True, -1
+            return True, len(self._fs.get((h, ent["path"]), b""))
+        if op == "getrandom":
+            return True, self._host_rand(h).bytes(a[0])
+        if op == "c_rand":
+            return True, int(self._host_rand(h).integers(0, 1 << 31))
+        if op == "getpid":
+            return True, p.pid
+        if op == "gethostname":
+            return True, self.bundle.host_names[h]
+        if op == "sigaction":
+            p.sig_handlers[a[0]] = a[1]
+            return True, 0
+        if op == "raise_sig":
+            return True, self._deliver_signal(p, a[0])
+        if op == "kill":
+            pid, sig = a
+            tgt = next((q for q in self.procs
+                        if q.pid == pid and q.host == h and not q.done),
+                       None)
+            if tgt is None:
+                return True, -1           # ESRCH
+            return True, self._deliver_signal(tgt, sig)
+        if op == "thread_create":
+            gen = a[0](h)
+            t = _Proc(host=h, gen=gen, start_time=now,
+                      pid=self._next_pid)
+            self._next_pid += 1
+            self.procs.append(t)
+            return True, t.pid
+        if op == "thread_join":
+            tgt = next((q for q in self.procs if q.pid == a[0]
+                        and q.host == h), None)
+            if tgt is None:
+                return True, None         # ESRCH -> join returns
+            if not tgt.done:
+                return False, None        # block until it completes
+            return True, tgt.result
+        if op == "mutex_init":
+            mid = self._next_mutex.get(h, 1)
+            self._next_mutex[h] = mid + 1
+            self._mutexes[(h, mid)] = 0
+            return True, mid
+        if op == "mutex_lock":
+            owner = self._mutexes.get((h, a[0]))
+            if owner is None:
+                return True, -1           # EINVAL
+            if owner and owner != p.pid:
+                return False, None        # block until released
+            self._mutexes[(h, a[0])] = p.pid
+            return True, 0
+        if op == "mutex_trylock":
+            owner = self._mutexes.get((h, a[0]))
+            if owner is None:
+                return True, -1
+            if owner and owner != p.pid:
+                return True, False        # EBUSY
+            self._mutexes[(h, a[0])] = p.pid
+            return True, True
+        if op == "mutex_unlock":
+            if self._mutexes.get((h, a[0])) != p.pid:
+                return True, -1            # EPERM
+            self._mutexes[(h, a[0])] = 0
+            return True, 0
         if op == "pipe":
             base = self._next_pipe_fd.setdefault(h, PIPE_FD_BASE)
             rfd, wfd = base, base + 1
@@ -847,6 +1098,12 @@ class ProcessRuntime:
             return True, (fd1, fd2)
         if op == "write":
             fd, data = a
+            if fd in (1, 2):
+                # per-process stdout/stderr (ref: process.c's
+                # <data>/hosts/<name>/<plugin>.stdout files)
+                return True, self._stdio_write(p, fd, data)
+            if FILE_FD_BASE <= fd < TIMER_FD_BASE:
+                return True, self._file_write(h, fd, data)
             ep = self._channels.get((h, fd))
             if ep is None or ep.send_q is None:
                 return True, -1          # EBADF
@@ -863,6 +1120,8 @@ class ProcessRuntime:
             return True, n
         if op == "read":
             fd, maxb = a
+            if FILE_FD_BASE <= fd < TIMER_FD_BASE:
+                return True, self._file_read(h, fd, maxb)
             ep = self._channels.get((h, fd))
             if ep is None or ep.recv_q is None:
                 return True, b""         # EBADF-ish: nothing to read
@@ -1287,12 +1546,88 @@ class ProcessRuntime:
 
         raise ValueError(f"op {op} is not batchable")
 
+    # -- r5 surface-breadth helpers -------------------------------------
+
+    def _host_rand(self, h: int) -> "np.random.Generator":
+        """The host's deterministic random source (ref: each Host gets
+        its own Random seeded from the master seed, host.c) — derived
+        from (cfg.seed, host), so runs of one seed are bit-identical
+        and hosts are independent."""
+        g = self._rand.get(h)
+        if g is None:
+            g = np.random.default_rng(
+                np.random.SeedSequence([int(self.cfg.seed), 0x5EED, h]))
+            self._rand[h] = g
+        return g
+
+    def _deliver_signal(self, p: _Proc, sig: int) -> int:
+        """Run the installed handler host-side (the pth-dispatched
+        handler analog); an unhandled signal kills the process like a
+        plugin fault (slave.c:468-473)."""
+        handler = p.sig_handlers.get(sig)
+        if handler is None:
+            p.gen.close()
+            p.done = True
+            p.pending = None
+            p.block = None
+            return -1
+        handler(sig)
+        return 0
+
+    def _file_write(self, h: int, fd: int, data: bytes) -> int:
+        ent = self._file_fds.get((h, fd))
+        if ent is None or not ent["wr"]:
+            return -1                      # EBADF
+        buf = self._fs.setdefault((h, ent["path"]), bytearray())
+        pos = ent["pos"]
+        if pos > len(buf):
+            buf.extend(b"\0" * (pos - len(buf)))
+        buf[pos:pos + len(data)] = data
+        ent["pos"] = pos + len(data)
+        return len(data)
+
+    def _file_read(self, h: int, fd: int, maxb: int) -> bytes | int:
+        ent = self._file_fds.get((h, fd))
+        if ent is None or not ent["rd"]:
+            return -1                      # EBADF
+        buf = self._fs.get((h, ent["path"]), b"")
+        pos = ent["pos"]
+        out = bytes(buf[pos:pos + maxb])
+        ent["pos"] = pos + len(out)
+        return out
+
+    def _stdio_write(self, p: _Proc, fd: int, data: bytes) -> int:
+        """Per-process stdout/stderr (ref: process.c's per-process
+        <data>/hosts/<name>/*.stdout|stderr files): buffered in
+        memory, appended to real files when data_dir is set."""
+        key = (p.host, p.pid, fd)
+        self._stdio.setdefault(key, bytearray()).extend(data)
+        if self.data_dir is not None:
+            import os
+
+            name = self.bundle.host_names[p.host]
+            d = os.path.join(self.data_dir, "hosts", name)
+            os.makedirs(d, exist_ok=True)
+            suffix = "stdout" if fd == 1 else "stderr"
+            with open(os.path.join(
+                    d, f"proc{p.pid}.{suffix}"), "ab") as f:
+                f.write(data)
+        return len(data)
+
+    def stdio_of(self, host: int, pid: int, fd: int = 1) -> bytes:
+        return bytes(self._stdio.get((host, pid, fd), b""))
+
     def _close_special(self, p: _Proc, fd: int):
         """close() of a non-socket fd: pipe/socketpair ends (status
         flips for the peer — last writer gone -> reader sees EOF,
         last reader gone -> writer sees EPIPE, ref: channel.c
-        close/free), or an epoll descriptor. Pure host-side."""
+        close/free), an epoll descriptor, or a virtual file. Pure
+        host-side."""
         h = p.host
+        if FILE_FD_BASE <= fd < TIMER_FD_BASE:
+            return (True,
+                    0 if self._file_fds.pop((h, fd), None) is not None
+                    else -1)
         if fd >= PIPE_FD_BASE:
             ep = self._channels.pop((h, fd), None)
             for epl in p.epolls.values():
@@ -1329,14 +1664,19 @@ class ProcessRuntime:
         epoll.c:583-680). Only channels need this — every other
         cross-process path rides device events, which land in a
         later window."""
-        chan_ops = ("pipe", "socketpair", "write", "read")
+        # ops whose completion can UNBLOCK another parked coroutine on
+        # the same host (channel byte movement, mutex handover) — they
+        # trigger another sweep, exactly like pth's scheduler re-runs
+        # ready green threads until quiescence
+        chan_ops = ("pipe", "socketpair", "write", "read",
+                    "mutex_unlock", "thread_create")
         # syscalls whose blocking state channel activity can change;
         # later sweeps retry ONLY processes blocked on these (cheap,
         # host-side) — re-running device-side blocked ops (tcp_send,
         # accept, ...) every sweep would cost a device dispatch per
         # blocked process per sweep for state that cannot have changed
         retry_ops = ("read", "write", "wait_readable", "epoll_wait",
-                     "poll", "select")
+                     "poll", "select", "thread_join", "mutex_lock")
 
         def advance(p, idx, ready, result, parked):
             """Feed one syscall result back into its coroutine."""
@@ -1352,9 +1692,13 @@ class ProcessRuntime:
             p.block = None
             try:
                 p.pending = p.gen.send(result)
-            except StopIteration:
+            except StopIteration as e:
                 p.done = True
                 p.pending = None
+                p.result = e.value
+                # a completed coroutine unblocks thread_join waiters —
+                # that's sweep-worthy activity
+                advance.chan_activity = True
             return True
 
         sweep = 0
@@ -1384,11 +1728,16 @@ class ProcessRuntime:
                         p.started = True
                         try:
                             p.pending = next(p.gen)
-                        except StopIteration:
+                        except StopIteration as e:
                             p.done = True
+                            p.result = e.value
                             # a finished process IS progress: its host
-                            # is claimable by a successor next round
+                            # is claimable by a successor next round —
+                            # and sweep-worthy activity (a same-host
+                            # thread_join parked earlier this sweep
+                            # must see the completion)
                             progress = True
+                            advance.chan_activity = True
                             continue
                         p.block = None
                     if p.pending is None:

@@ -190,5 +190,9 @@ def test_plot_shadow_multi_experiment(tmp_path):
     pdf = (tmp_path / "cmp.pdf").read_bytes()
     m = re.search(rb"/Count (\d+)", pdf)
     assert m, "no page count in PDF"
-    # 4 metric pages + CDF + progress + rate bars = 7
-    assert int(m.group(1)) >= 6, pdf[:200]
+    # the reference plotter's page families (r5 parity): per
+    # direction {throughput, goodput, fractional goodput, control,
+    # fractional control} x 3 views (30) + send retrans x2 families
+    # x3 (6) + retransmitted segments x3 + RAM x3 + 3 CDFs +
+    # progress + rate bars = 44+
+    assert int(m.group(1)) >= 40, int(m.group(1))

@@ -77,6 +77,14 @@ def parse(stream):
             if "send_retransmit_bytes" in rec:
                 node.setdefault("retransmit_bytes_by_second", {})[t] = \
                     rec["send_retransmit_bytes"]
+            # the full byte/packet splits drive the reference
+            # plotter's goodput / control-overhead / retransmit page
+            # families (plot-shadow.py) — store every split present
+            for k in ("recv_data_bytes", "send_data_bytes",
+                      "recv_control_bytes", "send_control_bytes",
+                      "recv_packets", "send_packets"):
+                if k in rec:
+                    node.setdefault(f"{k}_by_second", {})[t] = rec[k]
             continue
         m = RAM_RE.match(line)
         if m:

@@ -49,6 +49,10 @@ def main(argv=None) -> int:
     ap.add_argument("-d", "--data", nargs=2, action="append",
                     metavar=("FILE", "LABEL"), required=True)
     ap.add_argument("-o", "--output-prefix", default="shadow.results")
+    ap.add_argument("--max-node-lines", type=int, default=100,
+                    help="cap per-node lines on 'each node' pages "
+                         "(the reference plots every node; huge runs "
+                         "drown the page)")
     args = ap.parse_args(argv)
 
     try:
@@ -66,51 +70,157 @@ def main(argv=None) -> int:
         with open(path) as f:
             experiments.append((label, json.load(f)))
 
-    pages = [
-        ("total recv throughput", "recv_bytes_by_second",
-         "MiB/interval", 1 << 20),
-        ("total send throughput", "send_bytes_by_second",
-         "MiB/interval", 1 << 20),
-        ("retransmitted segments", "retransmits_by_second",
-         "segments/interval", 1),
-        ("buffered RAM (all nodes)", "ram_bytes_by_second",
-         "MiB", 1 << 20),
-    ]
+    def has_key(key):
+        return any(key in blk for _, stats in experiments
+                   for blk in stats["nodes"].values())
+
+    def moving_avg(xs, ys, seconds=60):
+        """60 SECOND moving average (the reference's smoothing): the
+        window is derived from the tick spacing, so 10 s heartbeat
+        intervals average 6 samples, not 60."""
+        from collections import deque
+
+        step = min((b - a for a, b in zip(xs, xs[1:]) if b > a),
+                   default=1)
+        w = max(1, round(seconds / step))
+        out_y = []
+        acc = 0.0
+        win: deque = deque()
+        for y in ys:
+            win.append(y)
+            acc += y
+            if len(win) > w:
+                acc -= win.popleft()
+            out_y.append(acc / len(win))
+        return out_y
+
+    def ratio_series(stats, num_key, den_key):
+        num = _aggregate(stats, num_key)
+        den = _aggregate(stats, den_key)
+        xs = sorted(set(num) | set(den))
+        ys = [num.get(x, 0) / den[x] if den.get(x) else 0.0 for x in xs]
+        return xs, ys
 
     out = f"{args.output_prefix}.pdf"
+    # The reference plotter's shadow page families
+    # (src/tools/plot-shadow.py plot_shadow_packets): per direction,
+    # {throughput, goodput, fractional goodput, control overhead,
+    # fractional control, retrans overhead, fractional retrans} each
+    # as {60 s moving average all nodes, 1 s all nodes, 1 s each
+    # node}; plus run time, RAM, and per-node CDFs. Pages whose
+    # splits are absent from the parse output (v1 logs) are skipped.
     with PdfPages(out) as pdf:
-        # -- aggregate time-series pages, one metric per page ----------
-        for title, key, ylabel, scale in pages:
-            fig, ax = _new_page(plt, title)
+        def ts_pages(metric, key, ylabel, scale, frac_of=None):
+            """The reference's three views of one metric."""
+            if not (has_key(key) if frac_of is None
+                    else has_key(key) and has_key(frac_of)):
+                return
+            # aggregate ONCE per experiment; both all-nodes views
+            # reuse it (the full per-node walk is O(nodes x samples))
+            agg = []
             for label, stats in experiments:
-                acc = _aggregate(stats, key)
-                xs = sorted(acc)
+                if frac_of is None:
+                    acc = _aggregate(stats, key)
+                    xs = sorted(acc)
+                    ys = [acc[x] / scale for x in xs]
+                else:
+                    xs, ys = ratio_series(stats, key, frac_of)
+                agg.append((label, xs, ys))
+            # 60 s moving average, all nodes
+            fig, ax = _new_page(
+                plt, f"60 second moving average {metric}, all nodes")
+            for label, xs, ys in agg:
                 if xs:
-                    ax.plot(xs, [acc[x] / scale for x in xs], label=label)
-            ax.set_xlabel("sim time (s)")
+                    ax.plot(xs, moving_avg(xs, ys), label=label)
+            ax.set_xlabel("tick (s)")
             ax.set_ylabel(ylabel)
             ax.legend(fontsize=8)
             pdf.savefig(fig)
             plt.close(fig)
+            # 1 second, all nodes
+            fig, ax = _new_page(plt, f"1 second {metric}, all nodes")
+            for label, xs, ys in agg:
+                if xs:
+                    ax.plot(xs, ys, label=label)
+            ax.set_xlabel("tick (s)")
+            ax.set_ylabel(ylabel)
+            ax.legend(fontsize=8)
+            pdf.savefig(fig)
+            plt.close(fig)
+            # 1 second, each node (per-node lines, capped)
+            fig, ax = _new_page(plt, f"1 second {metric}, each node")
+            for label, stats in experiments:
+                for i, (name, blk) in enumerate(
+                        sorted(stats["nodes"].items())):
+                    if i >= args.max_node_lines:
+                        break
+                    if frac_of is None:
+                        xs, ys = _series(blk, key)
+                        ys = [y / scale for y in ys]
+                    else:
+                        nx, ny = _series(blk, key)
+                        dx, dy = _series(blk, frac_of)
+                        den = dict(zip(dx, dy))
+                        xs = nx
+                        ys = [y / den[x] if den.get(x) else 0.0
+                              for x, y in zip(nx, ny)]
+                    if xs:
+                        ax.plot(xs, ys, alpha=0.4, linewidth=0.7)
+            ax.set_xlabel("tick (s)")
+            ax.set_ylabel(ylabel)
+            pdf.savefig(fig)
+            plt.close(fig)
 
-        # -- per-node total CDF (the cross-experiment fairness view) ---
-        fig, ax = _new_page(plt, "per-node total recv (CDF)")
-        for label, stats in experiments:
-            totals = []
-            for blk in stats["nodes"].values():
-                _, ys = _series(blk, "recv_bytes_by_second")
-                if ys:
-                    totals.append(sum(ys))
-            if totals:
-                totals.sort()
-                n = len(totals)
-                ax.plot([b / (1 << 20) for b in totals],
-                        [(i + 1) / n for i in range(n)], label=label)
-        ax.set_xlabel("total recv MiB per node")
-        ax.set_ylabel("CDF")
-        ax.legend(fontsize=8)
-        pdf.savefig(fig)
-        plt.close(fig)
+        for d in ("send", "recv"):
+            ts_pages(f"throughput, {d}", f"{d}_bytes_by_second",
+                     "MiB/s", 1 << 20)
+            ts_pages(f"goodput, {d}", f"{d}_data_bytes_by_second",
+                     "MiB/s", 1 << 20)
+            ts_pages(f"fractional goodput, {d}",
+                     f"{d}_data_bytes_by_second", "fraction", 1,
+                     frac_of=f"{d}_bytes_by_second")
+            ts_pages(f"control overhead, {d}",
+                     f"{d}_control_bytes_by_second", "KiB/s", 1 << 10)
+            ts_pages(f"fractional control overhead, {d}",
+                     f"{d}_control_bytes_by_second", "fraction", 1,
+                     frac_of=f"{d}_bytes_by_second")
+        ts_pages("retrans overhead, send",
+                 "retransmit_bytes_by_second", "KiB/s", 1 << 10)
+        ts_pages("fractional retrans overhead, send",
+                 "retransmit_bytes_by_second", "fraction", 1,
+                 frac_of="send_bytes_by_second")
+        ts_pages("retransmitted segments", "retransmits_by_second",
+                 "segments/s", 1)
+        ts_pages("buffered RAM", "ram_bytes_by_second", "MiB", 1 << 20)
+
+        # -- per-node total CDFs (cross-experiment fairness views) -----
+        for title, key, xlabel in (
+                ("per-node total recv (CDF)", "recv_bytes_by_second",
+                 "total recv MiB per node"),
+                ("per-node total send (CDF)", "send_bytes_by_second",
+                 "total send MiB per node"),
+                ("per-node goodput share (CDF)",
+                 "recv_data_bytes_by_second",
+                 "total recv payload MiB per node")):
+            if not has_key(key):
+                continue
+            fig, ax = _new_page(plt, title)
+            for label, stats in experiments:
+                totals = []
+                for blk in stats["nodes"].values():
+                    _, ys = _series(blk, key)
+                    if ys:
+                        totals.append(sum(ys))
+                if totals:
+                    totals.sort()
+                    n = len(totals)
+                    ax.plot([b / (1 << 20) for b in totals],
+                            [(i + 1) / n for i in range(n)], label=label)
+            ax.set_xlabel(xlabel)
+            ax.set_ylabel("CDF")
+            ax.legend(fontsize=8)
+            pdf.savefig(fig)
+            plt.close(fig)
 
         # -- run-time progress ("tick") pages --------------------------
         # periodic [shadow-progress] records: cumulative sim seconds

@@ -36,6 +36,15 @@ def main() -> int:
                     help="tor: max circuits one relay/server host "
                          "carries (consensus-weighted draw, capacity "
                          "capped); sockets_per_host = 2 + 2*slots")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="ensemble mode (first-class, VERDICT r4 #7): "
+                         "partition --hosts into R independent "
+                         "replicas of H/R hosts in ONE device program "
+                         "— the seed-sweep shape Shadow users run as "
+                         "R processes. Works for every workload: "
+                         "phold/gossip use block-diagonal graphs, "
+                         "relay/tor confine circuits to their block. "
+                         "Reports AGGREGATE events/s")
     ap.add_argument("--hosts", type=int, default=10240)
     ap.add_argument("--load", type=int, default=8)
     ap.add_argument("--hop", type=int, default=5,
@@ -153,11 +162,16 @@ def main() -> int:
     def build_workload(seed, cap):
         """Returns (bundle, runner_kwargs, verify(sim) -> bool)."""
         H = args.hosts
+        R = max(args.replicas, 1)
+        if H % R:
+            raise SystemExit(f"--replicas {R} must divide --hosts {H}")
+        Hr = H // R   # hosts per replica block
         if args.workload == "phold":
             from shadow_tpu.apps import phold
 
             b = bench._build_phold(H, args.load, args.sim_seconds, seed,
-                                   cap, graph=topo_text)
+                                   cap, graph=topo_text,
+                                   replica_size=Hr if R > 1 else None)
             kw = dict(app_handlers=(phold.handler,),
                       app_bulk=None if args.no_bulk else phold.BULK)
             return b, kw, lambda sim: int(
@@ -166,7 +180,6 @@ def main() -> int:
             from shadow_tpu.apps import relay
 
             hop = args.hop
-            ncirc = H // hop
             total = args.bytes   # bytes per circuit
             cfg = NetConfig(num_hosts=H, seed=seed,
                             end_time=args.sim_seconds * simtime.ONE_SECOND,
@@ -176,8 +189,11 @@ def main() -> int:
                               proc_start_time=simtime.ONE_SECOND)
                      for i in range(H)]
             b = build(cfg, topo_text, hosts)
-            circuits = [list(range(c * hop, (c + 1) * hop))
-                        for c in range(ncirc)]
+            # circuits confined to replica blocks (ensemble mode:
+            # identical chains per block, independent traffic)
+            circuits = [
+                [r * Hr + c * hop + k for k in range(hop)]
+                for r in range(R) for c in range(Hr // hop)]
             b.sim = relay.setup(b.sim, circuits=circuits,
                                 total_bytes=total)
 
@@ -199,15 +215,18 @@ def main() -> int:
             # to --slots circuits per host
             from shadow_tpu.apps import relay
 
-            n_cl = int(H * 0.6)
-            n_rl = int(H * 0.3)
-            clients = list(range(n_cl))
-            relays = list(range(n_cl, n_cl + n_rl))
-            servers = list(range(n_cl + n_rl, H))
             rng = np.random.default_rng(seed)
-            chains = relay.consensus_circuits(
-                rng, n_circuits=n_cl, clients=clients, relays=relays,
-                servers=servers, hops=3, max_slots=args.slots)
+            chains = []
+            for r in range(R):
+                base = r * Hr
+                n_cl = int(Hr * 0.6)
+                n_rl = int(Hr * 0.3)
+                chains += relay.consensus_circuits(
+                    rng, n_circuits=n_cl,
+                    clients=list(range(base, base + n_cl)),
+                    relays=list(range(base + n_cl, base + n_cl + n_rl)),
+                    servers=list(range(base + n_cl + n_rl, base + Hr)),
+                    hops=3, max_slots=args.slots)
             total = args.bytes
             cfg = NetConfig(num_hosts=H, seed=seed,
                             end_time=args.sim_seconds * simtime.ONE_SECOND,
@@ -253,7 +272,8 @@ def main() -> int:
         b = build(cfg, topo_text, hosts)
         b.sim = gossip.setup(b.sim, peers_per_host=8,
                              block_interval=2 * simtime.ONE_SECOND,
-                             max_blocks=blocks)
+                             max_blocks=blocks,
+                             replica_size=Hr if R > 1 else None)
 
         def verify(sim):
             return bool(np.asarray(sim.app.tip == blocks - 1).all())
@@ -325,6 +345,7 @@ def main() -> int:
            if fraction < 1.0 else {}),
         "hosts": args.hosts,
         "workload": args.workload,
+        **({"replicas": args.replicas} if args.replicas > 1 else {}),
         **({"runahead_ms": args.runahead} if args.runahead else {}),
         "topology": args.topology,
         "shards": args.shards,
