@@ -255,3 +255,301 @@ def handler(cfg: NetConfig, sim, popped, buf):
     relay = popped.valid & (popped.kind == KIND_RELAY)
     sim, buf = _relay_step(cfg, sim, buf, relay, now)
     return sim, buf
+
+
+# ---------------------------------------------------------------------
+# TCP gossip (r5, VERDICT r4 #5): block flooding over PERSISTENT TCP
+# peer connections — the Bitcoin shape config #4 names (bitcoind's
+# inv/getdata/block ride long-lived TCP links, not datagrams). The
+# UDP model above stays as an option.
+# ---------------------------------------------------------------------
+#
+# Topology: one TCP connection per undirected peer edge, initiated by
+# the lower-id endpoint at PROC_START and matched to its peer slot on
+# accept by source IP. Blocks ride the byte stream (BLOCK_BYTES per
+# block, in adoption order — a host only relays ids ABOVE its tip, so
+# each edge's id sequence is strictly increasing). Block ids travel
+# in a per-edge SPSC sideband: the SENDER appends ids to its own
+# [H, K, F] ring (it owns the write cursor), the RECEIVER gathers the
+# peer's ring and advances its OWN read cursor — no cross-row writes,
+# so the per-host update contract holds. (Cross-row READS make this
+# model single-shard; the UDP model remains the sharded one.)
+
+FIFO = 16                    # ids in flight per edge
+TCPPORT = 8334
+
+
+@struct.dataclass
+class GossipTcpApp:
+    peers: jax.Array        # [H, K] i32 peer graph
+    peer_back: jax.Array    # [H, K] i32 my slot index in peer's table
+    lsock: jax.Array        # [H] i32 listener
+    conn: jax.Array         # [H, K] i32 edge socket (-1 none yet)
+    est: jax.Array          # [H, K] bool edge usable (send side)
+    tip: jax.Array          # [H] i32 highest block id seen
+    next_block: jax.Array   # [H] i32 next id this host mines (-1)
+    relay_block: jax.Array  # [H] i32 id being relayed (-1 idle)
+    relay_next: jax.Array   # [H] i32 next peer slot to push to
+    send_left: jax.Array    # [H, K] i32 bytes of current push unsent
+    fifo: jax.Array         # [H, K, F] i32 ids I sent on this edge
+    wr: jax.Array           # [H, K] i32 my append cursor
+    rd: jax.Array           # [H, K] i32 my READ cursor into the
+                            # PEER's ring for the reverse direction
+    rx_acc: jax.Array       # [H, K] i32 bytes toward the next block
+    blocks_mined: jax.Array  # [H] i64
+    dup_rx: jax.Array       # [H] i64
+    relays: jax.Array       # [H] i64 blocks pushed
+    stalls: jax.Array       # [H] i64 pushes skipped (edge backlog)
+    block_interval: jax.Array  # [] i64
+    max_blocks: jax.Array   # [] i32
+    mine_stride: jax.Array  # [] i32
+    mine_offset: jax.Array  # [] i64 warmup before block 0 (the TCP
+                            # mesh needs PROC_START + handshakes first)
+
+
+def setup_tcp(sim, *, peers_per_host: int = 8,
+              block_interval=10 * simtime.ONE_SECOND,
+              max_blocks: int = 100, graph_seed: int = 42):
+    """Build the peer graph, bind listeners, create the lower-id
+    endpoint's connect socket per edge, seed MINE events."""
+    from shadow_tpu.core.events import push_rows
+    from shadow_tpu.net import tcp as tcpmod
+
+    H = sim.net.host_ip.shape[0]
+    peers = make_peer_graph(H, peers_per_host, graph_seed)
+    K = peers.shape[1]
+    # the TCP model needs SYMMETRIC edges (one connection per edge,
+    # sideband cursors addressed via the reverse slot): drop directed
+    # edges the peer does not reciprocate (make_peer_graph truncates
+    # the symmetrized union back to K columns, leaving ~20% one-way;
+    # the ring base keeps the graph connected regardless)
+    back = np.full((H, K), -1, np.int32)
+    for h in range(H):
+        for k in range(K):
+            p = peers[h, k]
+            if p >= 0:
+                w = np.where(peers[p] == h)[0]
+                if w.size:
+                    back[h, k] = w[0]
+                else:
+                    peers[h, k] = -1
+    every = jnp.ones((H,), bool)
+    net, lsock = sk_create(sim.net, every, SocketType.TCP)
+    net, _ = sk_bind(net, every, lsock, 0, TCPPORT)
+    sim = sim.replace(net=net)
+    sim = tcpmod.tcp_listen(sim, every, lsock)
+    conn = np.full((H, K), -1, np.int32)
+    for k in range(K):
+        initiate = jnp.asarray((peers[:, k] >= 0)
+                               & (peers[:, k] > np.arange(H)))
+        net, fd = sk_create(sim.net, initiate, SocketType.TCP)
+        sim = sim.replace(net=net)
+        conn[:, k] = np.where(np.asarray(initiate), np.asarray(fd), -1)
+
+    # host h mines block h (the miner_stride=1 schedule); only ids
+    # below max_blocks ever fire, so only those seeds are pushed
+    first = np.arange(H, dtype=np.int64)
+    app = GossipTcpApp(
+        peers=jnp.asarray(peers), peer_back=jnp.asarray(back),
+        lsock=lsock, conn=jnp.asarray(conn),
+        est=jnp.zeros((H, K), bool),
+        tip=jnp.full((H,), -1, I32),
+        next_block=jnp.asarray(first, I32),
+        relay_block=jnp.full((H,), -1, I32),
+        relay_next=jnp.zeros((H,), I32),
+        send_left=jnp.zeros((H, K), I32),
+        fifo=jnp.full((H, K, FIFO), -1, I32),
+        wr=jnp.zeros((H, K), I32), rd=jnp.zeros((H, K), I32),
+        rx_acc=jnp.zeros((H, K), I32),
+        blocks_mined=jnp.zeros((H,), I64),
+        dup_rx=jnp.zeros((H,), I64),
+        relays=jnp.zeros((H,), I64),
+        stalls=jnp.zeros((H,), I64),
+        block_interval=jnp.asarray(block_interval, I64),
+        max_blocks=jnp.asarray(max_blocks, I32),
+        mine_stride=jnp.asarray(H, I32),
+        mine_offset=jnp.asarray(2 * simtime.ONE_SECOND, I64),
+    )
+    sim = sim.replace(app=app)
+    have = jnp.asarray(first < max_blocks)
+    t = jnp.asarray(first, I64) * block_interval \
+        + 2 * simtime.ONE_SECOND
+    q = push_rows(
+        sim.events, have, t,
+        jnp.full((H,), KIND_MINE, I32), jnp.arange(H, dtype=I32),
+        jnp.zeros((H,), I32), emit_words(0, num_hosts=H))
+    q = q.replace(next_seq=q.next_seq + have.astype(I32))
+    return sim.replace(events=q)
+
+
+def tcp_handler(cfg: NetConfig, sim, popped, buf):
+    from shadow_tpu.net import tcp as tcpmod
+    from shadow_tpu.net.rings import set_hs
+    from shadow_tpu.net.state import SocketFlags
+
+    now = popped.time
+    woke = popped.valid
+    app = sim.app
+    H, K = app.peers.shape
+    rows = jnp.arange(H)
+
+    # ---- connect the lower-id end of each edge at PROC_START ---------
+    def _conn_one(k, carry):
+        sim, buf = carry
+        app = sim.app
+        fd = app.conn[:, k]
+        start = woke & (popped.kind == EventKind.PROC_START) & (fd >= 0)
+        peer_ip = ip_of_hosts(cfg, sim.net,
+                              jnp.maximum(app.peers[:, k], 0))
+        sim, buf = tcpmod.tcp_connect(
+            cfg, sim, start, fd, peer_ip,
+            jnp.full((H,), TCPPORT, I32), now, buf)
+        app = sim.app
+        sim = sim.replace(app=app.replace(
+            est=app.est.at[:, k].set(app.est[:, k] | start)))
+        return sim, buf
+
+    sim, buf = jax.lax.fori_loop(0, K, _conn_one, (sim, buf))
+
+    # ---- accept: match the child to its peer slot by source ip -------
+    app = sim.app
+    lready = (gather_hs(sim.net.sk_flags, app.lsock)
+              & SocketFlags.READABLE) != 0
+    acc = woke & lready
+    sim, got, child = tcpmod.tcp_accept(sim, acc, app.lsock)
+    app = sim.app
+    peer_ip = gather_hs(sim.net.sk_peer_ip, jnp.maximum(child, 0))
+    pos = jnp.clip(jnp.searchsorted(sim.net.ip_sorted, peer_ip), 0,
+                   sim.net.ip_sorted.shape[0] - 1)
+    peer_host = sim.net.host_of_ip_sorted[pos]
+    hit = (app.peers == peer_host[:, None]) & (app.conn < 0)
+    pick = jnp.argmax(hit, axis=1)
+    matched = got & jnp.any(hit, axis=1)
+    selk = matched[:, None] & (jnp.arange(K)[None, :] == pick[:, None])
+    sim = sim.replace(app=app.replace(
+        conn=jnp.where(selk, child[:, None], app.conn),
+        est=app.est | selk))
+
+    # ---- mine on schedule --------------------------------------------
+    app = sim.app
+    mine = woke & (popped.kind == KIND_MINE) \
+        & (app.next_block >= 0) & (app.next_block < app.max_blocks) \
+        & (app.relay_block < 0)
+    busy = woke & (popped.kind == KIND_MINE) \
+        & (app.next_block >= 0) & (app.next_block < app.max_blocks) \
+        & (app.relay_block >= 0)
+    buf = emit(buf, busy, sim.net.lane_id,
+               now + simtime.ONE_MILLISECOND, KIND_MINE,
+               emit_words(0, num_hosts=H))
+    app = app.replace(
+        tip=jnp.where(mine, jnp.maximum(app.tip, app.next_block),
+                      app.tip),
+        blocks_mined=app.blocks_mined + mine.astype(I64),
+        relay_block=jnp.where(mine, app.next_block, app.relay_block),
+        relay_next=jnp.where(mine, 0, app.relay_next),
+    )
+    buf = emit(buf, mine, sim.net.lane_id, now, KIND_RELAY,
+               emit_words(0, num_hosts=H))
+    nxt = app.next_block + app.mine_stride
+    sched = mine & (nxt < app.max_blocks)
+    buf = emit(buf, sched, sim.net.lane_id,
+               nxt.astype(I64) * app.block_interval + app.mine_offset,
+               KIND_MINE, emit_words(0, num_hosts=H))
+    app = app.replace(next_block=jnp.where(mine, nxt, app.next_block))
+    sim = sim.replace(app=app)
+
+    # ---- per-edge pump + receive -------------------------------------
+    def _recv_one(k, carry):
+        sim, buf = carry
+        app = sim.app
+        fd = app.conn[:, k]
+        live = woke & (fd >= 0)
+        # pump: retry the unsent remainder of a partially-accepted
+        # block push (the initial 16 KiB send buffer is smaller than
+        # one 20 KB block; autotune grows it, but the first pushes
+        # need this, and so does any backpressured edge)
+        pending = live & (app.send_left[:, k] > 0)
+        sim, buf, pumped = tcpmod.tcp_send(
+            cfg, sim, pending, fd, app.send_left[:, k], now, buf)
+        app = sim.app
+        app = app.replace(send_left=app.send_left.at[:, k].set(
+            app.send_left[:, k] - pumped.astype(I32)))
+        sim = sim.replace(app=app)
+        sim, buf, nread, _eof = tcpmod.tcp_recv(
+            sim, live, fd, jnp.full((H,), BLOCK_BYTES, I32), now, buf)
+        app = sim.app
+        acc = app.rx_acc[:, k] + nread.astype(I32)
+        done = acc >= BLOCK_BYTES          # one block per micro-step
+        # the id rides the peer's sideband ring for this edge
+        pk = jnp.maximum(app.peers[:, k], 0)
+        bk = jnp.maximum(app.peer_back[:, k], 0)
+        rd = app.rd[:, k]
+        bid = app.fifo[pk, bk, rd % FIFO]
+        take = done & (bid >= 0)
+        fresh = take & (bid > app.tip)
+        stale = take & ~fresh
+        idle = app.relay_block < 0
+        app = app.replace(
+            rx_acc=app.rx_acc.at[:, k].set(
+                jnp.where(take, acc - BLOCK_BYTES, acc)),
+            rd=app.rd.at[:, k].set(rd + take.astype(I32)),
+            tip=jnp.where(fresh, bid, app.tip),
+            dup_rx=app.dup_rx + stale.astype(I64),
+            relay_block=jnp.where(fresh & idle, bid, app.relay_block),
+            relay_next=jnp.where(fresh & idle, 0, app.relay_next),
+        )
+        sim = sim.replace(app=app)
+        buf = emit(buf, fresh & idle, sim.net.lane_id, now, KIND_RELAY,
+                   emit_words(0, num_hosts=H))
+        return sim, buf
+
+    sim, buf = jax.lax.fori_loop(0, K, _recv_one, (sim, buf))
+
+    # ---- relay chain: push the current block, one edge per step ------
+    relay = woke & (popped.kind == KIND_RELAY)
+    app = sim.app
+    idx = jnp.clip(app.relay_next, 0, K - 1)
+    fd = app.conn[rows, idx]
+    est = app.est[rows, idx]
+    # sideband room: my wr vs the PEER's rd for this edge
+    pk = jnp.maximum(app.peers[rows, idx], 0)
+    bk = jnp.maximum(app.peer_back[rows, idx], 0)
+    peer_rd = app.rd[pk, bk]
+    active = relay & (app.relay_block >= 0) & (app.relay_next < K) \
+        & (app.peers[rows, idx] >= 0)
+    has_room = (app.wr[rows, idx] - peer_rd) < FIFO
+    push = active & est & has_room
+    # one outstanding partial per edge: a still-pumping edge defers
+    # this block (the pump in _recv_one drains send_left first)
+    no_partial = app.send_left[rows, idx] == 0
+    push = push & no_partial
+    skip = active & ~(est & has_room & no_partial)
+    sim, buf, accepted = tcpmod.tcp_send(
+        cfg, sim, push, fd, jnp.full((H,), BLOCK_BYTES, I32), now, buf)
+    app = sim.app
+    # a partial sndbuf accept leaves the remainder in send_left; the
+    # per-edge pump retries it on every wake until the stream carries
+    # the whole block (framing at the receiver needs every byte)
+    sent = push
+    app = app.replace(send_left=app.send_left.at[rows, idx].set(
+        jnp.where(sent, BLOCK_BYTES - accepted.astype(I32),
+                  app.send_left[rows, idx])))
+    wr = app.wr[rows, idx]
+    sel = sent[:, None, None] \
+        & (jnp.arange(K)[None, :, None] == idx[:, None, None]) \
+        & (jnp.arange(FIFO)[None, None, :]
+           == (wr % FIFO)[:, None, None])
+    app = app.replace(
+        fifo=jnp.where(sel, app.relay_block[:, None, None], app.fifo),
+        wr=app.wr.at[rows, idx].set(wr + sent.astype(I32)),
+        relays=app.relays + sent.astype(I64),
+        stalls=app.stalls + skip.astype(I64),
+        relay_next=jnp.where(active, app.relay_next + 1,
+                             app.relay_next),
+    )
+    more = active & (app.relay_next < K)
+    buf = emit(buf, more, sim.net.lane_id, now, KIND_RELAY,
+               emit_words(0, num_hosts=H))
+    app = app.replace(
+        relay_block=jnp.where(relay & ~more, -1, app.relay_block))
+    return sim.replace(app=app), buf

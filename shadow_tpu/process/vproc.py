@@ -1669,7 +1669,11 @@ class ProcessRuntime:
         # trigger another sweep, exactly like pth's scheduler re-runs
         # ready green threads until quiescence
         chan_ops = ("pipe", "socketpair", "write", "read",
-                    "mutex_unlock", "thread_create")
+                    "mutex_unlock", "thread_create",
+                    # an unhandled signal kills its target directly
+                    # (_deliver_signal), which can complete a proc a
+                    # parked thread_join is waiting on
+                    "kill", "raise_sig")
         # syscalls whose blocking state channel activity can change;
         # later sweeps retry ONLY processes blocked on these (cheap,
         # host-side) — re-running device-side blocked ops (tcp_send,

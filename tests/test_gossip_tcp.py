@@ -1,0 +1,68 @@
+"""TCP gossip (apps/gossip.py setup_tcp/tcp_handler, VERDICT r4 #5):
+block flooding over PERSISTENT TCP peer connections — the Bitcoin
+shape BASELINE config #4 names. Checks full propagation with dedup,
+id-sideband framing across partially-accepted pushes (blocks are
+larger than the initial send buffer), and determinism."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from shadow_tpu.apps import gossip
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _run(H=8, blocks=3, k=3, seed=3, sim_s=12):
+    cfg = NetConfig(num_hosts=H, seed=seed,
+                    end_time=sim_s * simtime.ONE_SECOND,
+                    sockets_per_host=4 + 2 * k, event_capacity=64,
+                    outbox_capacity=64, router_ring=64, out_ring=16)
+    hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = gossip.setup_tcp(b.sim, peers_per_host=k,
+                             block_interval=2 * simtime.ONE_SECOND,
+                             max_blocks=blocks)
+    return make_runner(b, app_handlers=(gossip.tcp_handler,))(b.sim)
+
+
+def test_tcp_gossip_floods_all_hosts():
+    blocks = 3
+    sim, stats = _run(blocks=blocks)
+    assert int(sim.events.overflow) == 0
+    tips = np.asarray(sim.app.tip)
+    assert (tips == blocks - 1).all(), tips.tolist()
+    # dedup engaged (a connected graph redelivers) and every stream
+    # framed correctly: no partial blocks left anywhere
+    assert int(np.asarray(sim.app.dup_rx).sum()) > 0
+    assert int(np.asarray(sim.app.send_left).sum()) == 0
+    assert int(np.asarray(sim.app.rx_acc).sum()) == 0
+    # the persistent mesh actually carried TCP traffic
+    assert int(np.asarray(sim.net.ctr_tx_data_bytes).sum()) \
+        >= blocks * gossip.BLOCK_BYTES
+
+
+@pytest.mark.parametrize("seed", [5])
+def test_tcp_gossip_deterministic(seed):
+    s1, _ = _run(seed=seed)
+    s2, _ = _run(seed=seed)
+    np.testing.assert_array_equal(np.asarray(s1.app.tip),
+                                  np.asarray(s2.app.tip))
+    np.testing.assert_array_equal(np.asarray(s1.app.dup_rx),
+                                  np.asarray(s2.app.dup_rx))
+    np.testing.assert_array_equal(np.asarray(s1.net.rng_ctr),
+                                  np.asarray(s2.net.rng_ctr))

@@ -36,6 +36,12 @@ def main() -> int:
                     help="tor: max circuits one relay/server host "
                          "carries (consensus-weighted draw, capacity "
                          "capped); sockets_per_host = 2 + 2*slots")
+    ap.add_argument("--gossip-transport", default="udp",
+                    choices=["udp", "tcp"],
+                    help="gossip: 'tcp' floods blocks over persistent "
+                         "peer connections (the Bitcoin shape, r5); "
+                         "'udp' is the original datagram model (and "
+                         "the sharded/ensemble one)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="ensemble mode (first-class, VERDICT r4 #7): "
                          "partition --hosts into R independent "
@@ -264,6 +270,33 @@ def main() -> int:
         if args.sim_seconds < 5:
             raise SystemExit("gossip needs --sim-seconds >= 5")
         blocks = max(2, (args.sim_seconds - 3) // 2 + 1)
+        if args.gossip_transport == "tcp":
+            # the Bitcoin shape (r5): blocks ride persistent TCP peer
+            # connections; single-shard, no replicas
+            if R > 1:
+                raise SystemExit("gossip tcp transport has no "
+                                 "ensemble mode; use udp")
+            cfg = NetConfig(num_hosts=H, seed=seed,
+                            end_time=args.sim_seconds
+                            * simtime.ONE_SECOND,
+                            sockets_per_host=12, event_capacity=cap,
+                            outbox_capacity=cap, router_ring=cap,
+                            out_ring=16)
+            hosts = [HostSpec(name=f"n{i}",
+                              proc_start_time=simtime.ONE_SECOND)
+                     for i in range(H)]
+            b = build(cfg, topo_text, hosts)
+            b.sim = gossip.setup_tcp(
+                b.sim, peers_per_host=8,
+                block_interval=2 * simtime.ONE_SECOND,
+                max_blocks=blocks)
+
+            def verify(sim):
+                tips = np.asarray(sim.app.tip)
+                verify.fraction = float((tips == blocks - 1).mean())
+                return bool((tips == blocks - 1).all())
+
+            return b, dict(app_handlers=(gossip.tcp_handler,)), verify
         cfg = NetConfig(num_hosts=H, seed=seed, tcp=False,
                         end_time=args.sim_seconds * simtime.ONE_SECOND,
                         event_capacity=cap, outbox_capacity=cap,
