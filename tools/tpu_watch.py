@@ -103,22 +103,24 @@ JOBS = [
     ("gossip_5120", "scale",
      ["--workload", "gossip", "--hosts", "5120", "--sim-seconds", "10"],
      3600),
-    # TCP gossip (r5, VERDICT #5): the Bitcoin shape over persistent
-    # peer connections
-    ("gossip_tcp_5120", "scale",
-     ["--workload", "gossip", "--gossip-transport", "tcp",
-      "--hosts", "5120", "--sim-seconds", "10", "--allow-partial",
-      "--chunk", "32"], 3600),
     # ensemble mode (r4): 8 independent 1k replicas in one program —
     # the small-config row that a lone replica cannot fill lanes for
     ("bench_1k_x8", "bench",
      {"BENCH_HOSTS": "1024", "BENCH_REPLICAS": "8"}, 1800),
     ("bench_100k", "bench",
      {"BENCH_HOSTS": "102400", "BENCH_SIM_SECONDS": "2"}, 3600),
-    # shared-relay Tor shape (r5, VERDICT #2) — LAST: its first
-    # attempt crashed the TPU worker process mid-compile/run, which
-    # poisons every later job in the held session; isolated at the
-    # tail with a small chunk, nothing is lost if it crashes again
+    # CRASH-PRONE TAIL — both of these crashed the TPU worker process
+    # on first attempts (the big-TCP-program crash class,
+    # ROUND5_NOTES), and a crashed worker poisons every later job in
+    # the held session: they go dead last so nothing is lost when
+    # they die.
+    # TCP gossip (r5, VERDICT #5): the Bitcoin shape over persistent
+    # peer connections
+    ("gossip_tcp_5120", "scale",
+     ["--workload", "gossip", "--gossip-transport", "tcp",
+      "--hosts", "5120", "--sim-seconds", "10", "--allow-partial",
+      "--chunk", "4"], 3600),
+    # shared-relay Tor shape (r5, VERDICT #2)
     ("tor_10240", "scale",
      ["--workload", "tor", "--hosts", "10240", "--bytes", "100000",
       "--sim-seconds", "30", "--allow-partial", "--chunk", "8"], 5400),
