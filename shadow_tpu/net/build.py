@@ -159,10 +159,14 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
     )
 
 
-def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk):
+def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk,
+                     tcp_bulk_lossless: bool = False):
     """One bulk-pass selection rule for every runner flavor (the UDP
     bulk wins when both are given; make_bulk_fn's order_impl is a
-    separate knob with its own vocabulary, not forwarded)."""
+    separate knob with its own vocabulary, not forwarded).
+    tcp_bulk_lossless compiles the narrow loss-free TCP pass — see
+    make_tcp_bulk_fn (bit-identical for any workload; faster when the
+    workload is genuinely artifact-free)."""
     if app_bulk is not None:
         from shadow_tpu.net.bulk import make_bulk_fn
 
@@ -172,14 +176,16 @@ def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk):
     if app_tcp_bulk is not None:
         from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
 
-        return make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
+        return make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
+                                lossless=tcp_bulk_lossless)
     return None
 
 
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
                 app_tcp_bulk=None,
-                route_impl: str | None = None):
+                route_impl: str | None = None,
+                tcp_bulk_lossless: bool = False):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
     seconds per call at this op count; a reused jitted callable pays
@@ -205,7 +211,8 @@ def make_runner(bundle: SimBundle, app_handlers=(),
 
     step = make_step_fn(bundle.cfg, app_handlers)
     end = end_time if end_time is not None else bundle.cfg.end_time
-    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk)
+    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
+                               tcp_bulk_lossless)
     route_fn = _default_route
     if route_impl is not None:
         from shadow_tpu.core.events import route_outbox
@@ -228,7 +235,8 @@ def make_runner(bundle: SimBundle, app_handlers=(),
 
 def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                         end_time: int | None = None, app_bulk=None,
-                        app_tcp_bulk=None, chunk_windows: int = 256):
+                        app_tcp_bulk=None, chunk_windows: int = 256,
+                        tcp_bulk_lossless: bool = False):
     """make_runner variant that executes `chunk_windows` windows per
     device call with a host-side outer loop — window-for-window the
     SAME sequence engine.run's single while_loop produces (advance
@@ -257,7 +265,8 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
     end = end_time if end_time is not None else bundle.cfg.end_time
     end = jnp.asarray(end, simtime.DTYPE)
     min_jump = max(int(bundle.min_jump), 1)
-    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk)
+    bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
+                               tcp_bulk_lossless)
 
     @jax.jit
     def k_windows(sim, stats, wstart):

@@ -217,12 +217,25 @@ def _push_local(q, mask, time, kind, words, lane, seq):
 
 
 def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
-                     debug: bool = False) -> Callable | None:
+                     debug: bool = False,
+                     lossless: bool = False) -> Callable | None:
     """Build the TCP bulk window pass, or None when the config cannot
     support it (static preconditions — mirrors bulk.make_bulk_fn).
     debug=True makes bulk_fn return a third value: a dict with the
     per-host eligibility/commit masks and the why bitmask (engine
-    callers must use debug=False)."""
+    callers must use debug=False).
+
+    lossless=True compiles the r4-style narrow pass: every loss
+    artifact (SACK arrival, out-of-order seq, dup-ACK, recovery
+    state, due RTO) STOPS the lane instead of being modeled, and the
+    loss machinery's per-iteration cost (scoreboard replacement, OO
+    merge scans, retransmit regeneration, SACK stamping) is not even
+    traced. Bit-identity holds for ANY workload — prefix-commit hands
+    stopped lanes to the serial fixpoint — so this is purely a perf
+    knob for workloads that are genuinely artifact-free (fast
+    loss-free links); workloads with retransmissions run SLOWER under
+    it (their windows go serial). The NIC ring (token-limited) path
+    is kept either way: slow links are orthogonal to loss."""
     if not cfg.tcp:
         return None
     if cfg.qdisc != QDisc.FIFO or cfg.router_qdisc != RouterQ.CODEL:
@@ -248,6 +261,15 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
     R = cfg.router_ring
     BO = cfg.out_ring
     alg = cfg.tcp_cong
+
+    def _sack_stamps(tcp, at_slot):
+        """The SACK advertisement for a departing packet — identically
+        zero in the lossless model (no reassembly parking exists, and
+        lanes with carried-in parked state stop before wiring)."""
+        if lossless:
+            z = jnp.zeros(at_slot.shape, I32)
+            return ((z, z), (z, z), (z, z))
+        return sack_advert(tcp, at_slot)
 
     def bulk_fn(sim, wend):
         net0 = sim.net
@@ -279,6 +301,20 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
         codel_ok = ~net0.codel_dropping & (net0.codel_interval_expire == 0)
         app_ok = app_bulk.precheck(cfg, sim)
         has_work = jnp.any(inwin0, axis=1)
+        if lossless:
+            # the narrow pass neither models nor STAMPS parked
+            # reassembly/scoreboard state (its SACK advertisement is
+            # identically zero), so a host carrying any such state in
+            # from a serial window is ineligible OUTRIGHT — otherwise
+            # a wire on an unrelated slot of the same host (delayed
+            # ACK, app flush, dual close) would silently advertise an
+            # empty list where the serial engine stamps the parked
+            # ranges
+            no_parked = ~(jnp.any(sim.tcp.oo_r > sim.tcp.oo_l,
+                                  axis=(1, 2))
+                          | jnp.any(sim.tcp.sack_r > sim.tcp.sack_l,
+                                    axis=(1, 2)))
+            app_ok = app_ok & no_parked
         # kind_ok is NOT part of eligibility (r5 prefix-commit): a
         # non-TCP kind mid-window just STOPS that host's scan there —
         # the processed prefix commits and the serial fixpoint takes
@@ -453,24 +489,40 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
                 # snd_wnd + SACK scoreboard replacement (ref: tcp.c ACK
                 # path; scoreboard = the advertised list, an empty list
-                # clears it — tcp.py:962-975)
+                # clears it — tcp.py:962-975). Under lossless, an
+                # arriving SACK block is an upstream loss artifact:
+                # stop instead of modeling.
                 wnd_prev = gather_hs(tcp.snd_wnd, slot)
                 tcp = tcp.replace(snd_wnd=set_hs(tcp.snd_wnd, pkt, slot,
                                                  peer_win))
-                sack_l3 = jnp.stack(
-                    [words[:, pf.W_SACKL], words[:, pf.W_SACKL2],
-                     words[:, pf.W_SACKL3]], axis=1)
-                sack_r3 = jnp.stack(
-                    [words[:, pf.W_SACKR], words[:, pf.W_SACKR2],
-                     words[:, pf.W_SACKR3]], axis=1)
-                sel_sk = pkt[:, None] & (
-                    jnp.arange(S)[None, :] == slot[:, None])
-                tcp = tcp.replace(
-                    sack_l=jnp.where(sel_sk[..., None], sack_l3[:, None, :],
-                                     tcp.sack_l),
-                    sack_r=jnp.where(sel_sk[..., None], sack_r3[:, None, :],
-                                     tcp.sack_r),
-                )
+                if lossless:
+                    sack_any = (
+                        (words[:, pf.W_SACKL] != 0)
+                        | (words[:, pf.W_SACKR] != 0)
+                        | (words[:, pf.W_SACKL2] != 0)
+                        | (words[:, pf.W_SACKR2] != 0)
+                        | (words[:, pf.W_SACKL3] != 0)
+                        | (words[:, pf.W_SACKR3] != 0))
+                    bad, why = _flag(bad, why, (is_pkt & sack_any),
+                                     1 << 32)
+                    pkt = pkt & ~bad
+                    is_data = is_data & ~bad
+                    is_ack = is_ack & ~bad
+                else:
+                    sack_l3 = jnp.stack(
+                        [words[:, pf.W_SACKL], words[:, pf.W_SACKL2],
+                         words[:, pf.W_SACKL3]], axis=1)
+                    sack_r3 = jnp.stack(
+                        [words[:, pf.W_SACKR], words[:, pf.W_SACKR2],
+                         words[:, pf.W_SACKR3]], axis=1)
+                    sel_sk = pkt[:, None] & (
+                        jnp.arange(S)[None, :] == slot[:, None])
+                    tcp = tcp.replace(
+                        sack_l=jnp.where(sel_sk[..., None],
+                                         sack_l3[:, None, :], tcp.sack_l),
+                        sack_r=jnp.where(sel_sk[..., None],
+                                         sack_r3[:, None, :], tcp.sack_r),
+                    )
 
                 una = gather_hs(tcp.snd_una, slot)
                 nxt = gather_hs(tcp.snd_nxt, slot)
@@ -479,11 +531,16 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 bad, why = _flag(bad, why, (pkt & (ackno > smax)), 4096)
                 # healing ACK past a rewound snd_nxt: those bytes arrived
                 # from the pre-rewind transmission — jump forward
-                # (ref: tcp.py:979-983)
-                heal = new_ack & (ackno > nxt)
-                tcp = tcp.replace(snd_nxt=set_hs(tcp.snd_nxt, heal, slot,
-                                                 ackno))
-                nxt = jnp.where(heal, ackno, nxt)
+                # (ref: tcp.py:979-983); rewinds only exist with RTOs
+                if lossless:
+                    bad, why = _flag(bad, why, (new_ack & (ackno > nxt)),
+                                     8192)
+                    new_ack = new_ack & ~bad
+                else:
+                    heal = new_ack & (ackno > nxt)
+                    tcp = tcp.replace(snd_nxt=set_hs(tcp.snd_nxt, heal,
+                                                     slot, ackno))
+                    nxt = jnp.where(heal, ackno, nxt)
                 dup_ack = pkt & (ackno == una) & (una < nxt) & (length == 0) \
                     & (peer_win == wnd_prev) & ~finp   # ~f_fin per RFC 5681
                 # a DATA segment whose embedded ack also advances our send
@@ -513,16 +570,34 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 )
 
                 # congestion hooks — same code path as the serial engine
-                # incl. fast-recovery transitions (ref: tcp.py:1011-1047)
+                # incl. fast-recovery transitions (ref: tcp.py:1011-1047).
+                # Under lossless, recovery state (carried in from a
+                # serial window) and dup-ACKs stop the lane instead.
                 in_rec = gather_hs(tcp.in_recovery, slot)
+                if lossless:
+                    bad, why = _flag(bad, why, (pkt & in_rec), 1024)
+                    bad, why = _flag(
+                        bad, why,
+                        (pkt & (gather_hs(tcp.dup_acks, slot) > 0)),
+                        1 << 33)
+                    bad, why = _flag(bad, why, dup_ack, 16384)
+                    pkt = pkt & ~bad
+                    is_data = is_data & ~bad
+                    is_ack = is_ack & ~bad
+                    new_ack = new_ack & ~bad
                 recover = gather_hs(tcp.recover, slot)
                 cwnd = gather_hs(tcp.cwnd, slot)
                 ssth = gather_hs(tcp.ssthresh, slot)
                 ca = gather_hs(tcp.ca_acc, slot)
                 n_acked = jnp.where(new_ack, (ackno - una + MSS - 1) // MSS, 0)
-                full_rec = new_ack & in_rec & (ackno >= recover)
-                partial = new_ack & in_rec & (ackno < recover)
-                normal = new_ack & ~in_rec
+                if lossless:
+                    full_rec = jnp.zeros((H,), bool)
+                    partial = jnp.zeros((H,), bool)
+                    normal = new_ack
+                else:
+                    full_rec = new_ack & in_rec & (ackno >= recover)
+                    partial = new_ack & in_rec & (ackno < recover)
+                    normal = new_ack & ~in_rec
                 ss = normal & (cwnd < ssth)
                 grown = cwnd + n_acked
                 spill = ss & (grown >= ssth)
@@ -655,8 +730,11 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                             gather_hs(tcp.cwnd, slot) + 1))
                     return tcp, enter_fr
 
-                tcp, enter_fr = _gate(jnp.any(dup_ack), _dupack_sec,
-                                      (tcp, jnp.zeros((H,), bool)))
+                if lossless:
+                    enter_fr = jnp.zeros((H,), bool)
+                else:
+                    tcp, enter_fr = _gate(jnp.any(dup_ack), _dupack_sec,
+                                          (tcp, jnp.zeros((H,), bool)))
                 # the segment at snd_una re-sends on recovery entry and
                 # on every partial ACK (ref: tcp.py:1132)
                 retx_ack = (enter_fr | partial) & ~bad
@@ -724,22 +802,47 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # old segments re-ACK; fresh segments that fit deliver
                 # in order (merging parked reassembly ranges) or park
                 # out of order; overfull segments drop + re-ACK — the
-                # serial data path in full, minus TIME_WAIT stragglers
+                # serial data path in full, minus TIME_WAIT stragglers.
+                # Under lossless, any non-exact seq / parked state /
+                # overfull buffer stops the lane instead.
                 seg_end = seqno + length
-                old_d = is_data & (seg_end <= rcv_nxt)
-                fresh = is_data & ~old_d
-                oo_bytes = jnp.sum(tcp.oo_r[rows, sc] - tcp.oo_l[rows, sc],
-                                   axis=1, dtype=I32)
-                freeb = gather_hs(net.sk_rcvbuf, slot) \
-                    - gather_hs(tcp.app_rbytes, slot) - oo_bytes
-                fits = fresh & (length <= freeb)
-                tcp = tcp.replace(drop_rwin=tcp.drop_rwin
-                                  + (fresh & ~fits).astype(I64))
-                inorder = fits & (seqno <= rcv_nxt)
-                adv = jnp.where(inorder, seg_end - rcv_nxt, 0)
-                rcv1 = rcv_nxt + adv
-                rb0 = gather_hs(tcp.app_rbytes, slot)
-                rbytes = rb0 + adv
+                if lossless:
+                    bad, why = _flag(bad, why,
+                                     (is_data & (seqno != rcv_nxt)), 64)
+                    # no parked reassembly/scoreboard state can exist
+                    # here: hosts carrying any were ineligible at the
+                    # window gate, and this mode never parks
+                    is_data = is_data & ~bad
+                    pkt = pkt & ~bad
+                    freeb = gather_hs(net.sk_rcvbuf, slot) \
+                        - gather_hs(tcp.app_rbytes, slot)
+                    bad, why = _flag(bad, why,
+                                     (is_data & (length > freeb)), 65536)
+                    is_data = is_data & ~bad
+                    old_d = jnp.zeros((H,), bool)
+                    fresh = is_data
+                    fits = is_data
+                    inorder = is_data
+                    adv = jnp.where(inorder, length, 0)
+                    rcv1 = rcv_nxt + adv
+                    rb0 = gather_hs(tcp.app_rbytes, slot)
+                    rbytes = rb0 + adv
+                else:
+                    old_d = is_data & (seg_end <= rcv_nxt)
+                    fresh = is_data & ~old_d
+                    oo_bytes = jnp.sum(
+                        tcp.oo_r[rows, sc] - tcp.oo_l[rows, sc],
+                        axis=1, dtype=I32)
+                    freeb = gather_hs(net.sk_rcvbuf, slot) \
+                        - gather_hs(tcp.app_rbytes, slot) - oo_bytes
+                    fits = fresh & (length <= freeb)
+                    tcp = tcp.replace(drop_rwin=tcp.drop_rwin
+                                      + (fresh & ~fits).astype(I64))
+                    inorder = fits & (seqno <= rcv_nxt)
+                    adv = jnp.where(inorder, seg_end - rcv_nxt, 0)
+                    rcv1 = rcv_nxt + adv
+                    rb0 = gather_hs(tcp.app_rbytes, slot)
+                    rbytes = rb0 + adv
 
                 def _oo_sec(ops):
                     tcp, rcv1, rbytes, _ = ops
@@ -794,10 +897,14 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     )
                     return tcp, rcv1, rbytes, ooseg
 
-                tcp, rcv1, rbytes, ooseg = _gate(
-                    jnp.any(fits & (seqno > rcv_nxt))
-                    | jnp.any((oo_bytes > 0) & inorder),
-                    _oo_sec, (tcp, rcv1, rbytes, jnp.zeros((H,), bool)))
+                if lossless:
+                    ooseg = jnp.zeros((H,), bool)
+                else:
+                    tcp, rcv1, rbytes, ooseg = _gate(
+                        jnp.any(fits & (seqno > rcv_nxt))
+                        | jnp.any((oo_bytes > 0) & inorder),
+                        _oo_sec, (tcp, rcv1, rbytes,
+                                  jnp.zeros((H,), bool)))
                 tcp = tcp.replace(
                     rcv_nxt=set_hs(tcp.rcv_nxt, inorder, slot, rcv1),
                     app_rbytes=set_hs(tcp.app_rbytes, inorder, slot,
@@ -1273,6 +1380,13 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     tcp = tcp.replace(rtx_fire=set_hs(
                         tcp.rtx_fire, r_emit, rslot, rdl))
 
+                    if lossless:
+                        # a DUE deadline is a real RTO: out of the
+                        # lossless model, stop the lane
+                        bad, why = _flag(bad, why, r_due, 1 << 34)
+                        return (tcp, q, seq_ctr, bad, why,
+                                jnp.zeros((H,), bool))
+
                     # ---- timeout (ref: tcp.py:1349-1401) -----------------
                     r_una = gather_hs(tcp.snd_una, rslot)
                     r_nxt = gather_hs(tcp.snd_nxt, rslot)
@@ -1354,41 +1468,54 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                 # socket). A relay dual-close adds ONE secondary FIN on
                 # c2_slot, wired last (FIFO priority order, exactly the
                 # serial drain).
-                retx_do = (retx_ack | retx_rto) & ~bad
-                rtslot = jnp.where(retx_rto, rslot, slot)
-                # handshake retransmits (SYN/SYN|ACK) are out of model
-                rt_st = gather_hs(tcp.st, rtslot)
-                bad, why = _flag(bad, why,
-                                 retx_do & (rt_st < TcpSt.ESTABLISHED), 512)
-                retx_do = retx_do & ~bad
-                # regenerate the snd_una segment (ref: _retransmit_one,
-                # tcp.py:767-807): FIN from the state machine, data from
-                # the [snd_una, snd_end) byte range clipped at the first
-                # peer-sacked edge (sack_clip_len)
-                rt_una = gather_hs(tcp.snd_una, rtslot)
-                rt_end = gather_hs(tcp.snd_end, rtslot)
-                rt_nxt = gather_hs(tcp.snd_nxt, rtslot)
-                rt_fin_ever = gather_hs(tcp.fin_pending, rtslot) & (
-                    gather_hs(tcp.snd_max, rtslot) == rt_end + 1)
-                retx_fin = retx_do & rt_fin_ever & (rt_una == rt_end)
-                retx_data = retx_do & ~retx_fin & (rt_una < rt_end)
-                rtsc = jnp.clip(rtslot, 0, S - 1)
-                rt_len = sack_clip_len(
-                    rt_una, jnp.minimum(rt_end - rt_una, MSS),
-                    tcp.sack_l[rows, rtsc], tcp.sack_r[rows, rtsc])
-                rt_len = jnp.where(retx_data, rt_len, 0).astype(I32)
-                retx_sent = retx_fin | retx_data
-                rt_flags = jnp.where(retx_fin, pf.TCPF_FIN | pf.TCPF_ACK,
-                                     pf.TCPF_ACK)
-                tcp = tcp.replace(retx_segs=tcp.retx_segs
-                                  + retx_sent.astype(I64))
-                # go-back-N: an RTO rewinds snd_nxt to just past the
-                # resent segment (ref: tcp.py:1394-1399)
-                resent_end = jnp.where(retx_data, rt_una + rt_len,
-                                       rt_una + 1)
-                rewind = retx_rto & retx_sent & (resent_end < rt_nxt)
-                tcp = tcp.replace(snd_nxt=set_hs(tcp.snd_nxt, rewind,
-                                                 rtslot, resent_end))
+                if lossless:
+                    # no retransmissions exist in the lossless model
+                    retx_do = jnp.zeros((H,), bool)
+                    retx_sent = retx_do
+                    retx_data = retx_do
+                    rt_len = jnp.zeros((H,), I32)
+                    rt_una = jnp.zeros((H,), I32)
+                    rt_flags = jnp.full((H,), pf.TCPF_ACK, I32)
+                else:
+                    retx_do = (retx_ack | retx_rto) & ~bad
+                    rtslot = jnp.where(retx_rto, rslot, slot)
+                    # handshake retransmits (SYN/SYN|ACK) are out of
+                    # model
+                    rt_st = gather_hs(tcp.st, rtslot)
+                    bad, why = _flag(
+                        bad, why,
+                        retx_do & (rt_st < TcpSt.ESTABLISHED), 512)
+                    retx_do = retx_do & ~bad
+                    # regenerate the snd_una segment (ref:
+                    # _retransmit_one, tcp.py:767-807): FIN from the
+                    # state machine, data from the [snd_una, snd_end)
+                    # byte range clipped at the first peer-sacked edge
+                    # (sack_clip_len)
+                    rt_una = gather_hs(tcp.snd_una, rtslot)
+                    rt_end = gather_hs(tcp.snd_end, rtslot)
+                    rt_nxt = gather_hs(tcp.snd_nxt, rtslot)
+                    rt_fin_ever = gather_hs(tcp.fin_pending, rtslot) & (
+                        gather_hs(tcp.snd_max, rtslot) == rt_end + 1)
+                    retx_fin = retx_do & rt_fin_ever & (rt_una == rt_end)
+                    retx_data = retx_do & ~retx_fin & (rt_una < rt_end)
+                    rtsc = jnp.clip(rtslot, 0, S - 1)
+                    rt_len = sack_clip_len(
+                        rt_una, jnp.minimum(rt_end - rt_una, MSS),
+                        tcp.sack_l[rows, rtsc], tcp.sack_r[rows, rtsc])
+                    rt_len = jnp.where(retx_data, rt_len, 0).astype(I32)
+                    retx_sent = retx_fin | retx_data
+                    rt_flags = jnp.where(retx_fin,
+                                         pf.TCPF_FIN | pf.TCPF_ACK,
+                                         pf.TCPF_ACK)
+                    tcp = tcp.replace(retx_segs=tcp.retx_segs
+                                      + retx_sent.astype(I64))
+                    # go-back-N: an RTO rewinds snd_nxt to just past
+                    # the resent segment (ref: tcp.py:1394-1399)
+                    resent_end = jnp.where(retx_data, rt_una + rt_len,
+                                           rt_una + 1)
+                    rewind = retx_rto & retx_sent & (resent_end < rt_nxt)
+                    tcp = tcp.replace(snd_nxt=set_hs(
+                        tcp.snd_nxt, rewind, rtslot, resent_end))
 
                 pure_ack = (fire | imm_ack) & ~bad
                 wslot = jnp.where(fire, dslot,
@@ -1589,7 +1716,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
 
                 stamps1 = (stamp_ack, stamp_win, stamp_tse, w_sport,
                            w_dport, w_dip, w_dsth, w_lat, w_rel,
-                           sack_advert(tcp, wslot))
+                           _sack_stamps(tcp, wslot))
                 state = (out, bad, why, last_drop, drops, tx_wl, emitted,
                          ob_over)
                 retx_status = jnp.where(
@@ -1648,7 +1775,7 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                                gather_hs(peer_h, c2_slot),
                                gather_hs(lat_s, c2_slot),
                                gather_hs(rel_s, c2_slot),
-                               sack_advert(tcp, c2_slot))
+                               _sack_stamps(tcp, c2_slot))
                     (out, bad, why, last_drop, drops, tx_wl, emitted,
                      ob_over) = state
                     bad, why = _flag(

@@ -369,7 +369,8 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
 def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
                         app_handlers=(), end_time: int | None = None,
                         exchange_capacity: int | None = None,
-                        app_bulk=None, app_tcp_bulk=None):
+                        app_bulk=None, app_tcp_bulk=None,
+                        tcp_bulk_lossless: bool = False):
     """Multi-chip variant of shadow_tpu.net.build.make_runner: a
     REUSABLE jitted sim -> (sim, stats) callable running the whole
     window loop under shard_map (benchmarks must reuse one callable —
@@ -390,7 +391,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
         # into the shard-local window step
         from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
 
-        bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk)
+        bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
+                                   lossless=tcp_bulk_lossless)
     return _make_whole_run(
         mesh, axis, bundle.sim, step,
         end_time=end_time if end_time is not None else bundle.cfg.end_time,

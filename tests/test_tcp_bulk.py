@@ -190,6 +190,30 @@ def test_tcp_bulk_slow_link_bit_identical(seed, bw, loss):
         int(st_b.micro_steps), int(st_a.micro_steps))
 
 
+@pytest.mark.parametrize("loss", [0.0, 0.03])
+def test_tcp_bulk_lossless_mode_bit_identical(loss):
+    """The lossless specialization (make_tcp_bulk_fn lossless=True)
+    must stay bit-identical on ANY workload: artifact-free traffic
+    runs the narrow fast pass; loss artifacts STOP lanes
+    (prefix-commit) and the serial fixpoint models them. Both
+    regimes checked against the serial engine."""
+    H, hop, total, sim_s = 8, 2, 40_000, 10
+    b1 = _build_relay(H, hop, total, sim_s, seed=8, loss=loss)
+    sim_a, st_a = make_runner(b1, app_handlers=(relay.handler,))(b1.sim)
+    b2 = _build_relay(H, hop, total, sim_s, seed=8, loss=loss)
+    sim_b, st_b = make_runner(b2, app_handlers=(relay.handler,),
+                              app_tcp_bulk=relay.TCP_BULK,
+                              tcp_bulk_lossless=True)(b2.sim)
+    servers = np.asarray(sim_a.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_a.app.rcvd)[servers] == total).all()
+    if loss:
+        assert int(np.asarray(sim_a.tcp.retx_segs).sum()) > 0
+    _compare(sim_a, sim_b, st_a, st_b)
+    # artifact-free traffic must still engage the narrow pass
+    if not loss:
+        assert int(st_b.micro_steps) < int(st_a.micro_steps)
+
+
 def test_chunked_runner_bit_identical():
     """make_chunked_runner (k windows per device call, host outer
     loop) must produce exactly the monolithic program's state — the
