@@ -18,6 +18,10 @@ rate measures raw engine throughput. Env knobs:
   BENCH_TOPO=one|ref              'ref' = the reference's real
                                   183-vertex Internet graph instead of
                                   the single-vertex 50 ms fixture
+  BENCH_FAULTS=plan.json          same as --faults: run the workload
+                                  on a degraded network (injected
+                                  loss / flaps / latency spikes; see
+                                  examples/faultplan_degraded.json)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
@@ -85,7 +89,7 @@ def ref_topology_text() -> str:
 
 def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
                  cap: int | None = None, graph: str | None = None,
-                 replica_size: int | None = None):
+                 replica_size: int | None = None, fault_records=None):
     from shadow_tpu.apps import phold
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
@@ -107,6 +111,12 @@ def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
     hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
     b = build(cfg, graph or ONE_VERTEX, hosts)
     b.sim = phold.setup(b.sim, load=load, replica_size=replica_size)
+    if fault_records:
+        # degraded-network scenario: the plan rides the bundle, so the
+        # same runner factories apply it on 1 shard and N shards alike
+        from shadow_tpu import faults
+
+        faults.install(b, fault_records)
     return b
 
 
@@ -135,7 +145,7 @@ def _make_phold_fn(b, shards: int):
 
 def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   graph: str | None = None,
-                  replica_size: int | None = None):
+                  replica_size: int | None = None, fault_records=None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -149,12 +159,15 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     state = {"n": 0, "cap": None, "fn": None, "sims": None}
 
     def build_at(cap):
-        b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size)
+        b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
+                         fault_records)
         fn = _make_phold_fn(b, shards)
         # pre-build distinct-seed inputs so the timed call measures
-        # only the device program, not host-side setup
+        # only the device program, not host-side setup (each carries
+        # its own seeded fault wakeups)
         sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap,
-                                       graph, replica_size).sim
+                                       graph, replica_size,
+                                       fault_records).sim
                           for i in (1, 2)]
         for s in sims:
             jax.block_until_ready(s.net.rng_keys)
@@ -274,7 +287,23 @@ def _probe_backend(tries: int = 3, timeout_s: int = 0) -> int:
     return 0
 
 
-def main() -> None:
+def main(argv=None) -> None:
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        description="shadow-tpu throughput benchmark (env knobs in "
+                    "the module docstring)")
+    ap.add_argument("--faults", default=os.environ.get("BENCH_FAULTS"),
+                    help="JSON fault plan (faults.plan.records_from_json "
+                    "format): measure throughput on a degraded network "
+                    "(injected loss / link flaps / latency spikes)")
+    args = ap.parse_args(argv)
+    fault_records = None
+    if args.faults:
+        from shadow_tpu import faults as faults_mod
+
+        with open(args.faults) as f:
+            fault_records = faults_mod.records_from_json(f.read())
     enable_compile_cache()
     if os.environ.get("BENCH_PLATFORM") == "cpu":
         # explicit CPU run (dev/CI): skip the accelerator probe
@@ -321,11 +350,15 @@ def main() -> None:
     if workload == "phold":
         runner = _phold_runner(H * replicas, load, sim_s, shards=_SHARDS,
                                graph=graph,
-                               replica_size=H if replicas > 1 else None)
+                               replica_size=H if replicas > 1 else None,
+                               fault_records=fault_records)
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
         if replicas > 1:
             name += f"_x{replicas}replicas"
     else:
+        if fault_records:
+            raise SystemExit(
+                "--faults is only wired for BENCH_WORKLOAD=phold")
         if replicas > 1:
             raise SystemExit(
                 "BENCH_REPLICAS is only wired for BENCH_WORKLOAD=phold; "
@@ -335,6 +368,8 @@ def main() -> None:
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
     if topo == "ref":
         name += "_reftopo"
+    if fault_records:
+        name += "_faults"
     if _SHARDS > 1:
         name += f"_{_SHARDS}shards"
 

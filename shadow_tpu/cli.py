@@ -116,6 +116,26 @@ def make_parser() -> argparse.ArgumentParser:
                         "loop; --no-track-paths overrides a config "
                         "that enables it")
     p.add_argument("--event-capacity", type=int, default=None)
+    # --- run supervisor (faults/supervisor.py) -----------------------
+    p.add_argument("--supervise", action="store_true",
+                   help="host-driven window loop with health latches, "
+                        "periodic checkpoints, and checkpoint-backed "
+                        "retry on a latch trip (exit 3 + structured "
+                        "failure report when retries are exhausted)")
+    p.add_argument("--checkpoint-every-windows", type=int, default=64,
+                   help="supervisor snapshot cadence in windows")
+    p.add_argument("--checkpoint-path", default=None,
+                   help="snapshot path prefix (default: "
+                        "<data-directory>/checkpoint)")
+    p.add_argument("--max-retries", type=int, default=2,
+                   help="resume attempts after a latch trip before "
+                        "giving up")
+    p.add_argument("--retry-backoff", type=float, default=0.25,
+                   help="base seconds of exponential backoff between "
+                        "retries")
+    p.add_argument("--stall-windows", type=int, default=512,
+                   help="consecutive zero-event windows before the "
+                        "stall latch trips")
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
     return p
@@ -280,6 +300,45 @@ def main(argv=None) -> int:
                 progress_hook(s, wend)
 
             sim, stats = rt.run(on_window=vproc_hook)
+        elif args.supervise:
+            from shadow_tpu.faults.supervisor import run_supervised
+
+            if mesh is not None:
+                logger.warning(0, "shadow-tpu",
+                               "--supervise uses the serial host-driven "
+                               "window loop; --workers ignored")
+            ckpt_prefix = args.checkpoint_path or os.path.join(
+                args.data_directory, "checkpoint")
+            os.makedirs(os.path.dirname(os.path.abspath(ckpt_prefix)),
+                        exist_ok=True)
+
+            def sup_hook(s, wend, _cap=cap):
+                if _cap is not None:
+                    _cap.drain(s)
+                progress_hook(s, wend)
+
+            result = run_supervised(
+                b, app_handlers=loaded.handlers,
+                checkpoint_path=ckpt_prefix,
+                checkpoint_every_windows=args.checkpoint_every_windows,
+                max_retries=args.max_retries,
+                backoff_s=args.retry_backoff,
+                stall_windows=args.stall_windows,
+                log=lambda m: logger.message(0, "shadow-tpu", m),
+                on_window=sup_hook)
+            if not result.ok:
+                failure = result.failure_report()
+                # critical, not error: SimLogger.error raises (the
+                # abort path); here we must keep control to emit the
+                # structured report and choose the exit code.
+                for _, msg in result.health.diagnostics():
+                    logger.critical(0, "shadow-tpu", msg)
+                report = {"failure": failure,
+                          "attempts": result.attempts}
+                logger.flush()
+                print(json.dumps(report))
+                return 3
+            sim, stats = result.sim, result.stats
         elif b.cfg.pcap:
             from shadow_tpu.utils import checkpoint as ckpt
 
@@ -342,6 +401,22 @@ def main(argv=None) -> int:
                     b.cfg.end_time, "shadow-tpu",
                     f"path {a}->{c}: {int(mat[a, c])} packets")
 
+        # health-latch enforcement (faults/health.py): the sticky
+        # overflow counters stop being silent integers — every run
+        # ends with an explicit verdict, and a fatal latch means a
+        # non-zero exit with a structured failure report instead of
+        # corrupted-but-plausible results.
+        from shadow_tpu.faults import health as health_mod
+
+        run_health = health_mod.gather(sim)
+        # critical, not error: SimLogger.error raises, and the fatal
+        # path below must still print the structured report + exit 3.
+        for sev, msg in run_health.diagnostics():
+            if sev == "fatal":
+                logger.critical(b.cfg.end_time, "shadow-tpu", msg)
+            else:
+                logger.warning(b.cfg.end_time, "shadow-tpu", msg)
+
         ev = int(stats.events_processed)
         sim_s = b.cfg.end_time / 1e9
         report = {
@@ -361,6 +436,13 @@ def main(argv=None) -> int:
             "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
             + int(sim.net.rq_overflow),
         }
+        if run_health.fatal:
+            report["failure"] = run_health.failure_report()
+            logger.critical(b.cfg.end_time, "shadow-tpu",
+                            "simulation FAILED " + json.dumps(report))
+            logger.flush()
+            print(json.dumps(report))
+            return 3
         logger.message(b.cfg.end_time, "shadow-tpu", "simulation complete "
                        + json.dumps(report))
         logger.flush()

@@ -538,5 +538,19 @@ def load(config: ShadowConfig, *, seed: int = 1,
         if extra:
             vprocs.extend(extra)
             bundle.extra_vprocs = []
+
+    if config.faults:
+        # Resolve names -> indices against the placed bundle and
+        # install the compiled plan + wakeup events. Must happen after
+        # plugin configure (which may replace bundle.sim wholesale).
+        from shadow_tpu import faults as faults_mod
+
+        if vprocs:
+            raise ValueError(
+                "fault plans require the on-device window loop; "
+                ".py-plugin virtual processes are host-driven and "
+                "cannot honor the schedule deterministically")
+        records = faults_mod.records_from_config(config, bundle)
+        faults_mod.install(bundle, records)
     return LoadedSim(bundle=bundle, handlers=tuple(handlers),
                      config=config, vprocs=tuple(vprocs))

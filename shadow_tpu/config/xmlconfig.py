@@ -60,6 +60,29 @@ class HostElem:
 
 
 @dataclass
+class FaultSpec:
+    """One <fault> element — an entry in the run's deterministic fault
+    schedule (shadow-tpu extension; the reference only has static
+    per-path reliability). `a`/`b` are host *names* (resolved to host
+    or attachment-vertex indices by faults.plan.records_from_config
+    once placement is known) or raw indices. `value` is a loss
+    probability (kind="loss") or seconds of added latency
+    (kind="latency").
+
+      <fault time="1.5" kind="linkdown" a="client" b="server"/>
+      <fault time="2.0" kind="loss"     a="client" b="server" value="0.05"/>
+      <fault time="3.0" kind="crash"    a="relay"/>
+      <fault time="4.0" kind="restart"  a="relay"/>
+    """
+
+    time_ns: int
+    kind: str
+    a: str
+    b: Optional[str] = None
+    value: Optional[float] = None
+
+
+@dataclass
 class ShadowConfig:
     stoptime: int                  # ns
     bootstraptime: int             # ns
@@ -67,6 +90,7 @@ class ShadowConfig:
     topology_path: Optional[str]
     plugins: dict[str, PluginSpec]
     hosts: list[HostElem]
+    faults: list[FaultSpec] = field(default_factory=list)
 
     def expanded_hosts(self):
         """Yield (name, HostElem) with quantity stamped out the way the
@@ -109,6 +133,7 @@ def parse_config(text: str) -> ShadowConfig:
     topology_path = None
     plugins: dict[str, PluginSpec] = {}
     hosts: list[HostElem] = []
+    faults: list[FaultSpec] = []
 
     for child in root:
         if child.tag == "kill":
@@ -157,6 +182,18 @@ def parse_config(text: str) -> ShadowConfig:
                         arguments=shlex.split(sub.get("arguments", "")),
                     ))
             hosts.append(he)
+        elif child.tag == "fault":
+            t = _seconds_attr(child, "time", default=None)
+            if t is None:
+                raise ValueError("<fault> requires time")
+            kind = child.get("kind")
+            a = child.get("a")
+            if kind is None or a is None:
+                raise ValueError("<fault> requires kind and a")
+            v = child.get("value")
+            faults.append(FaultSpec(
+                time_ns=t, kind=kind, a=a, b=child.get("b"),
+                value=None if v is None else float(v)))
         # unknown elements are ignored (forward compatible)
 
     if stoptime is None:
@@ -170,6 +207,7 @@ def parse_config(text: str) -> ShadowConfig:
         topology_path=topology_path,
         plugins=plugins,
         hosts=hosts,
+        faults=sorted(faults, key=lambda f: f.time_ns),
     )
 
 

@@ -120,7 +120,7 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
 def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 emit_capacity: int = 4, lane_id=None,
                 route_fn=_default_route, min_fn=_identity,
-                bulk_fn=None):
+                bulk_fn=None, fault_fn=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -129,7 +129,15 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     When `bulk_fn` is set (net.bulk.make_bulk_fn), eligible hosts'
     whole windows are consumed in one vectorized pass first; the
     fixpoint below then only iterates for leftover hosts (zero
-    iterations in the steady state of bulk-friendly workloads)."""
+    iterations in the steady state of bulk-friendly workloads).
+
+    `fault_fn` (faults.apply.make_fault_fn) runs first, at the window
+    boundary: it rewrites the latency/reliability tables and applies
+    crash resets as a pure function of wend, so every event inside the
+    window sees the post-fault network. None (the default) leaves the
+    body untouched."""
+    if fault_fn is not None:
+        sim = fault_fn(sim, wend)
     if bulk_fn is not None:
         sim, n_bulk = bulk_fn(sim, wend)
         stats = stats.replace(
@@ -154,6 +162,7 @@ def run(
     route_fn=_default_route,
     min_fn=_identity,
     bulk_fn=None,
+    fault_fn=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -184,7 +193,7 @@ def run(
         wend = jnp.minimum(wstart + min_jump, end_time + 1)
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
-            route_fn, min_fn, bulk_fn,
+            route_fn, min_fn, bulk_fn, fault_fn,
         )
         return sim, stats, next_min
 

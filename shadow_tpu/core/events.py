@@ -78,6 +78,9 @@ class EventKind:
                         # micro-step packetizes; the chain unwinds in
                         # the window fixpoint (ref: _tcp_flush's while
                         # loop, tcp.c:1121-...)
+    FAULT_WAKEUP = 13   # pending no-op seeded at each fault-plan time
+                        # so a window boundary lands at (or before) the
+                        # fault even in sparse workloads (faults/apply)
     USER = 16
 
 

@@ -1,0 +1,31 @@
+"""Deterministic fault injection + run health + supervised recovery.
+
+- plan: record types, validation, JSON/config parsing, compilation
+  (numpy-only — safe for offline tools).
+- apply: compiles a plan into the window-boundary fault_fn the engine
+  runs (stateless table replay; crash resets).
+- health: RunHealth latches folded from the engine's sticky counters.
+- supervisor: checkpointed retry loop the CLI's --supervise uses.
+"""
+
+from shadow_tpu.faults.plan import (  # noqa: F401
+    FaultKind,
+    FaultPlan,
+    FaultRecord,
+    compile_plan,
+    records_from_config,
+    records_from_json,
+    validate_records,
+)
+from shadow_tpu.faults.apply import (  # noqa: F401
+    fault_fn_for,
+    install,
+    make_fault_fn,
+    seed_wakeups,
+)
+from shadow_tpu.faults.health import RunHealth, gather  # noqa: F401
+from shadow_tpu.faults.supervisor import (  # noqa: F401
+    LatchTrip,
+    SupervisorResult,
+    run_supervised,
+)

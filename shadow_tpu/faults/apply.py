@@ -1,0 +1,296 @@
+"""Window-boundary fault application — the device half of faults/.
+
+Design rule: every fault effect is a *pure function of (compiled plan
+constants, wend)*. Each window boundary replays all records with
+`t_ns < wend` over the pristine boot tables; host liveness is the
+count of crash records minus restart records so far. No cursor, no
+sticky fault state in Sim — which is exactly what makes the three
+determinism contracts free:
+
+- checkpoint/resume: nothing to save. The restored sim's (possibly
+  fault-mutated) tables are overwritten from base on the very next
+  boundary, so a resume inside a fault window is bit-identical.
+- sharding: the plan and base tables are replicated constants and the
+  wend sequence is identical on every shard, so every chip computes
+  the same replicated tables without any collective.
+- no plan -> no cost: make_fault_fn returns None and the engine's
+  window body is unchanged.
+
+Replay is O(records) scatter work per *window boundary* (not per
+packet, not per micro-step); plans are human-written schedules of a
+handful to a few hundred records, so this is noise next to the window
+body itself.
+
+Exactness: effects materialize when a window boundary passes the
+record time. seed_wakeups pins a pending event at every record time,
+so the conservative advance rule (next window starts at the min
+pending event time) guarantees a boundary lands at or before each
+fault — a fault is never skipped by a sparse-workload window jump,
+and in dense workloads it quantizes to at most one window early
+(documented in docs/6-robustness.md).
+
+Crash semantics: while a host's crash count exceeds its restart
+count, every boundary (idempotently) flushes its event row — sparing
+PROC_START and FAULT_WAKEUP so the seeded restart survives — and
+restores its per-host netstack/app/TCP rows to their boot values
+(fresh process image, boot-time binds recreated exactly as app setup
+made them). RNG state and observability counters are deliberately
+*not* rolled back: a restarted host continues its random stream and
+keeps its lifetime drop/byte counts, like a rebooted machine behind
+the same NIC counters.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EventKind
+from shadow_tpu.net.state import NetState, REPLICATED_FIELDS
+from shadow_tpu.faults.plan import (
+    FaultKind,
+    FaultPlan,
+    HOST_KINDS,
+    PPM,
+    compile_plan,
+    validate_records,
+)
+
+I32 = jnp.int32
+F32 = jnp.float32
+
+# NetState per-host fields that survive a crash. Everything else with
+# a leading host dim is restored to its boot value while the host is
+# down (static config fields are equal to boot, so restoring them is
+# the identity).
+_CRASH_KEEP = frozenset(REPLICATED_FIELDS) | {
+    "lane_id", "rng_keys", "rng_ctr", "rq_overflow", "last_drop_status",
+}
+
+
+def _crash_keep(name: str) -> bool:
+    return (name in _CRASH_KEEP or name.startswith("ctr_")
+            or name.startswith("cap_"))
+
+
+def _down_mask(leaf, down):
+    """Broadcast down [H] bool against a [H, ...] leaf."""
+    return down.reshape(down.shape + (1,) * (leaf.ndim - 1))
+
+
+def _boot_rows(boot_leaf, lane_id):
+    """Local boot rows of a replicated [GH, ...] boot capture (gather
+    through lane_id so the same constant serves serial and shard_map
+    bodies alike — identity gather when unsharded)."""
+    return jnp.asarray(boot_leaf)[lane_id]
+
+
+def make_fault_fn(plan: FaultPlan, boot_sim):
+    """Compile `plan` against the *boot* sim (the bundle's pristine
+    state — never a restored checkpoint, whose tables may already be
+    fault-mutated) into `fault_fn(sim, wend) -> sim`, applied by
+    core.engine.step_window before each window. Returns None for an
+    empty plan so the engine body is untouched."""
+    if plan is None or plan.n == 0:
+        return None
+
+    base_lat = np.asarray(boot_sim.net.latency_ns)
+    base_rel = np.asarray(boot_sim.net.reliability)
+    GH = int(boot_sim.net.host_ip.shape[0])
+    V = base_rel.shape[0]
+    if plan.num_vertices and plan.num_vertices != V:
+        raise ValueError(f"plan compiled for {plan.num_vertices} vertices, "
+                         f"topology has {V}")
+
+    k_np = plan.kind
+    rel_kinds = np.isin(k_np, (FaultKind.LINK_DOWN, FaultKind.LINK_UP,
+                               FaultKind.LOSS, FaultKind.PARTITION,
+                               FaultKind.HEAL))
+    lat_kinds = k_np == FaultKind.LATENCY
+    has_crash = bool(np.isin(k_np, HOST_KINDS).any())
+
+    t_c = jnp.asarray(plan.t_ns)
+    k_c = jnp.asarray(plan.kind)
+    a_c = jnp.asarray(plan.a)
+    b_c = jnp.asarray(plan.b)
+    v_c = jnp.asarray(plan.value)
+    lat0 = jnp.asarray(base_lat)
+    rel0 = jnp.asarray(base_rel)
+    ri = jnp.arange(V, dtype=I32)[:, None]
+    ci = jnp.arange(V, dtype=I32)[None, :]
+
+    # Boot captures for the crash reset — replicated constants whose
+    # local rows are gathered through lane_id inside the (possibly
+    # shard_map'd) body.
+    if has_crash:
+        boot_net = {
+            f.name: jnp.asarray(getattr(boot_sim.net, f.name))
+            for f in dataclasses.fields(NetState) if not _crash_keep(f.name)
+        }
+        boot_app = jax.tree.map(jnp.asarray, boot_sim.app)
+        boot_tcp = jax.tree.map(jnp.asarray, boot_sim.tcp)
+        crash_idx_base = jnp.where(k_c == FaultKind.CRASH, a_c, GH)
+        restart_idx_base = jnp.where(k_c == FaultKind.RESTART, a_c, GH)
+
+    def _replay_tables(wend):
+        """Sequential replay (later records win; ties in plan order)."""
+
+        def body(i, tables):
+            lat, rel = tables
+            act = t_c[i] < wend
+            k, a, b, v = k_c[i], a_c[i], b_c[i], v_c[i]
+            # b is -1 for single-endpoint kinds, so on_ab is all-false
+            # for them (ri/ci are >= 0) and the update is a no-op.
+            on_ab = ((ri == a) & (ci == b)) | ((ri == b) & (ci == a))
+            on_cross = (ri == a) | (ci == a)
+            is_vertex = (k == FaultKind.PARTITION) | (k == FaultKind.HEAL)
+            touch = act & (k != FaultKind.LATENCY) & jnp.where(
+                is_vertex, on_cross, on_ab)
+            new_rel = jnp.select(
+                [(k == FaultKind.LINK_DOWN) | (k == FaultKind.PARTITION),
+                 (k == FaultKind.LINK_UP) | (k == FaultKind.HEAL),
+                 k == FaultKind.LOSS],
+                [jnp.zeros_like(rel), rel0,
+                 jnp.full_like(rel, 1.0 - v.astype(F32) / PPM)],
+                rel)
+            rel = jnp.where(touch, new_rel, rel)
+            lat = jnp.where(act & (k == FaultKind.LATENCY) & on_ab,
+                            lat0 + v, lat)
+            return lat, rel
+
+        lat, rel = jax.lax.fori_loop(0, plan.n, body, (lat0, rel0))
+        return lat, rel
+
+    def _down_vector(wend):
+        """down[h] = more crashes than restarts with t < wend."""
+        act = t_c < wend
+        crashes = jnp.zeros((GH + 1,), I32).at[
+            jnp.where(act, crash_idx_base, GH)].add(1)[:GH]
+        restarts = jnp.zeros((GH + 1,), I32).at[
+            jnp.where(act, restart_idx_base, GH)].add(1)[:GH]
+        return crashes > restarts
+
+    def _crash_reset(sim, down):
+        lane = sim.net.lane_id
+        q = sim.events
+        spare = ((q.kind == EventKind.PROC_START)
+                 | (q.kind == EventKind.FAULT_WAKEUP))
+        keep = ~down[:, None] | spare
+        q = q.replace(
+            time=jnp.where(keep, q.time, simtime.INVALID),
+            kind=jnp.where(keep, q.kind, 0),
+            src=jnp.where(keep, q.src, 0),
+            seq=jnp.where(keep, q.seq, 0),
+            words=jnp.where(keep[:, :, None], q.words, 0),
+        )
+        net_upd = {}
+        for name, boot in boot_net.items():
+            cur = getattr(sim.net, name)
+            fresh = _boot_rows(boot, lane)
+            net_upd[name] = jnp.where(_down_mask(cur, down), fresh, cur)
+
+        def _reset_tree(cur_tree, boot_tree):
+            if cur_tree is None:
+                return None
+            def leaf(cur, boot):
+                if cur.ndim == 0 or boot.shape[0] != GH:
+                    return cur
+                fresh = _boot_rows(boot, lane)
+                return jnp.where(_down_mask(cur, down), fresh, cur)
+            return jax.tree.map(leaf, cur_tree, boot_tree)
+
+        return sim.replace(
+            events=q,
+            net=sim.net.replace(**net_upd),
+            app=_reset_tree(sim.app, boot_app),
+            tcp=_reset_tree(sim.tcp, boot_tcp),
+        )
+
+    def fault_fn(sim, wend):
+        if rel_kinds.any() or lat_kinds.any():
+            lat, rel = _replay_tables(wend)
+            net = sim.net
+            if lat_kinds.any():
+                net = net.replace(latency_ns=lat)
+            if rel_kinds.any():
+                net = net.replace(reliability=rel)
+            sim = sim.replace(net=net)
+        if has_crash:
+            down_g = _down_vector(wend)
+            down_l = down_g[sim.net.lane_id]
+            sim = jax.lax.cond(jnp.any(down_g),
+                               lambda s: _crash_reset(s, down_l),
+                               lambda s: s, sim)
+        return sim
+
+    return fault_fn
+
+
+def seed_wakeups(sim, records, vertex_of_host):
+    """Push one pending event per fault record so a window boundary
+    lands at (or before) every fault time. CRASH/link/partition kinds
+    seed an inert FAULT_WAKEUP; RESTART seeds a real PROC_START at the
+    restarted host so its app re-runs its start handler (fresh boot
+    image courtesy of the crash reset). Link-level records wake the
+    first host attached to vertex `a` (any host pins the global window
+    sequence; host 0 if the vertex is unattached)."""
+    from shadow_tpu.core.events import emit_words, push_rows
+
+    vertex_of_host = np.asarray(vertex_of_host)
+    H = int(vertex_of_host.shape[0])
+    for r in records:
+        if r.kind == FaultKind.RESTART:
+            host, kind = int(r.a), EventKind.PROC_START
+        elif r.kind == FaultKind.CRASH:
+            host, kind = int(r.a), EventKind.FAULT_WAKEUP
+        else:
+            att = np.flatnonzero(vertex_of_host == r.a)
+            host = int(att[0]) if att.size else 0
+            kind = EventKind.FAULT_WAKEUP
+        mask = np.zeros(H, bool)
+        mask[host] = True
+        m = jnp.asarray(mask)
+        q = push_rows(
+            sim.events,
+            m,
+            jnp.full((H,), r.t_ns, simtime.DTYPE),
+            jnp.full((H,), kind, I32),
+            jnp.arange(H, dtype=I32),
+            sim.events.next_seq,
+            emit_words(0, num_hosts=H),
+        )
+        q = q.replace(next_seq=q.next_seq + m.astype(I32))
+        sim = sim.replace(events=q)
+    return sim
+
+
+def install(bundle, records):
+    """Attach a fault schedule to a built SimBundle: validate +
+    compile the plan, seed the wakeup events into bundle.sim, and
+    stash the plan on the bundle for fault_fn_for / runners. Call
+    before the first window runs (loader does this at load time)."""
+    records = list(records)
+    GH = int(bundle.sim.net.host_ip.shape[0])
+    V = int(np.asarray(bundle.sim.net.reliability).shape[0])
+    plan = compile_plan(records, num_hosts=GH, num_vertices=V)
+    errors, _ = validate_records(records, num_hosts=GH, num_vertices=V,
+                                 min_jump_ns=bundle.min_jump)
+    if errors:  # compile_plan already raised; belt and braces
+        raise ValueError("\n".join(errors))
+    bundle.sim = seed_wakeups(bundle.sim, records,
+                              bundle.sim.net.vertex_of_host)
+    bundle.fault_plan = plan
+    return plan
+
+
+def fault_fn_for(bundle):
+    """fault_fn for a bundle previously passed through install(), or
+    None when it carries no plan. Must be given the *boot* bundle —
+    base tables are captured from bundle.sim before any window ran."""
+    if getattr(bundle, "fault_plan", None) is None:
+        return None
+    return make_fault_fn(bundle.fault_plan, bundle.sim)

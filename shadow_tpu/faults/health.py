@@ -1,0 +1,140 @@
+"""Run-health latches — fold the engine's sticky failure counters
+into one struct with a verdict, instead of leaving them as silent
+integers in the final report.
+
+Three of the latches already exist in device state (they are sticky
+by construction — counters only ever increase):
+
+- EventQueue.overflow: a host row was full when push_rows needed a
+  slot. The dropped event is *gone*; everything after it is suspect.
+- Outbox.overflow: same, for the cross-host staging buffer.
+- NetState.rq_overflow: upstream router ring wrapped.
+
+Two more are computed host-side by the supervisor loop from window
+telemetry it already has:
+
+- stall: K consecutive windows advanced with zero events processed —
+  the advance rule should make this impossible (windows start at the
+  min pending event time), so it indicates a wedged clock.
+- time_regression: a window's next start preceded the current window
+  *start* (< wstart, not < wend: runahead overrides legally schedule
+  into the current window).
+
+Severity: the five above are fatal — state is corrupt or the clock is
+broken; rerun with bigger capacities (the diagnostics name the knob).
+Outbox.narrow_miss is a *warning*: the narrow exchange tier fell back
+to full width, which is a perf regression, never corruption.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class RunHealth:
+    """Host-side snapshot of the latches after (part of) a run."""
+
+    events_overflow: int = 0
+    outbox_overflow: int = 0
+    rq_overflow: int = 0
+    narrow_miss: int = 0
+    stalled_windows: int = 0      # longest zero-event streak observed
+    stall_limit: int = 0          # K that makes the streak fatal (0 = off)
+    time_regression: bool = False
+    # context for diagnostics
+    window_start: Optional[int] = None   # wstart when gathered
+    suspect_hosts: tuple = ()            # rows at capacity (global ids)
+
+    @property
+    def fatal(self) -> bool:
+        return bool(
+            self.events_overflow or self.outbox_overflow
+            or self.rq_overflow or self.time_regression
+            or (self.stall_limit and self.stalled_windows >= self.stall_limit))
+
+    def diagnostics(self) -> list:
+        """Human-readable findings: (severity, message) pairs, fatal
+        first. Empty when the run is clean."""
+        out = []
+        where = (f" at window t={self.window_start}"
+                 if self.window_start is not None else "")
+        hosts = (f" (suspect host rows at capacity: "
+                 f"{list(self.suspect_hosts)})" if self.suspect_hosts else "")
+        if self.events_overflow:
+            out.append(("fatal",
+                        f"event queue overflow x{self.events_overflow}"
+                        f"{where}{hosts}: events were dropped — results "
+                        f"are invalid; rerun with a larger "
+                        f"--event-capacity"))
+        if self.outbox_overflow:
+            out.append(("fatal",
+                        f"outbox overflow x{self.outbox_overflow}{where}: "
+                        f"cross-host sends were dropped; rerun with a "
+                        f"larger emit/exchange capacity"))
+        if self.rq_overflow:
+            out.append(("fatal",
+                        f"router ring overflow x{self.rq_overflow}{where}: "
+                        f"upstream packets were dropped un-modelled; grow "
+                        f"the router ring (config router_ring)"))
+        if self.time_regression:
+            out.append(("fatal",
+                        f"simulated time regressed{where}: a window "
+                        f"started before its predecessor — engine "
+                        f"invariant broken, results invalid"))
+        if self.stall_limit and self.stalled_windows >= self.stall_limit:
+            out.append(("fatal",
+                        f"engine stalled: {self.stalled_windows} "
+                        f"consecutive windows processed zero events"
+                        f"{where}"))
+        if self.narrow_miss:
+            out.append(("warning",
+                        f"narrow exchange tier missed {self.narrow_miss} "
+                        f"window(s) (full-width fallback): perf only, "
+                        f"results remain exact — raise the narrow width "
+                        f"if this persists"))
+        return out
+
+    def failure_report(self) -> dict:
+        """Structured failure payload for the CLI's final JSON."""
+        return {
+            "fatal": self.fatal,
+            "events_overflow": self.events_overflow,
+            "outbox_overflow": self.outbox_overflow,
+            "rq_overflow": self.rq_overflow,
+            "narrow_miss": self.narrow_miss,
+            "stalled_windows": self.stalled_windows,
+            "stall_limit": self.stall_limit,
+            "time_regression": self.time_regression,
+            "window_start": self.window_start,
+            "suspect_hosts": [int(h) for h in self.suspect_hosts],
+            "diagnostics": [m for _, m in self.diagnostics()],
+        }
+
+
+def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
+           time_regression=False, max_suspects=8) -> RunHealth:
+    """Pull the device latches into a RunHealth. Cheap (a handful of
+    scalars plus one fill_count) — fine to call once per checkpoint
+    interval and after every run."""
+    suspects = ()
+    ev = int(np.asarray(sim.events.overflow))
+    if ev:
+        fill = np.asarray(sim.events.fill_count())
+        full = np.flatnonzero(fill >= sim.events.capacity)
+        lane = np.asarray(sim.net.lane_id)
+        suspects = tuple(int(lane[h]) for h in full[:max_suspects])
+    return RunHealth(
+        events_overflow=ev,
+        outbox_overflow=int(np.asarray(sim.outbox.overflow)),
+        rq_overflow=int(np.asarray(sim.net.rq_overflow)),
+        narrow_miss=int(np.asarray(sim.outbox.narrow_miss)),
+        stalled_windows=int(stalled_windows),
+        stall_limit=int(stall_limit),
+        time_regression=bool(time_regression),
+        window_start=None if window_start is None else int(window_start),
+        suspect_hosts=suspects,
+    )

@@ -1,0 +1,160 @@
+"""Run supervisor: window loop + health latches + checkpoint-backed
+recovery.
+
+The CLI's `--supervise` mode runs the simulation through here instead
+of the one-shot jitted runner. Every round the supervisor inspects
+the sticky latches (faults/health.py) plus its own stall /
+time-regression telemetry; every N *windows* it snapshots the sim
+(utils/checkpoint.py — atomic + checksummed, so a trip mid-save can
+never leave a poisoned resume point). When a fatal latch trips it
+restores the last good snapshot, backs off exponentially, and retries
+up to max_retries before giving up with a structured failure report.
+
+Retrying after a *deterministic* trip only helps when the operator's
+knobs differ between attempts (the retry hook bumps nothing itself —
+determinism is the whole point), but crashes of the host process,
+preemptions, and transient device loss are exactly what the
+checkpoint chain is for; the bounded retry covers those while the
+structured report covers the deterministic case.
+
+Checkpoint cadence is counted in windows, not sim-ns: window length
+tracks min_jump, so N windows is a stable amount of device work
+regardless of the topology's latency floor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time as _time
+from typing import Optional
+
+import numpy as np
+
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import health as health_mod
+from shadow_tpu.utils import checkpoint as ckpt
+
+
+class LatchTrip(RuntimeError):
+    """A fatal health latch fired mid-run."""
+
+    def __init__(self, health: health_mod.RunHealth):
+        self.health = health
+        msgs = "; ".join(m for s, m in health.diagnostics() if s == "fatal")
+        super().__init__(msgs or "health latch tripped")
+
+
+@dataclasses.dataclass
+class SupervisorResult:
+    ok: bool
+    sim: object
+    stats: object                      # EngineStats totals (last attempt)
+    health: health_mod.RunHealth       # final latch snapshot
+    attempts: int = 1
+    resumed_from: Optional[str] = None  # snapshot path of the last resume
+    checkpoints: tuple = ()            # (path, time_ns) saved, all attempts
+
+    def failure_report(self) -> dict:
+        rep = self.health.failure_report()
+        rep["attempts"] = self.attempts
+        rep["resumed_from"] = self.resumed_from
+        return rep
+
+
+def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
+                   end_time=None, checkpoint_path,
+                   checkpoint_every_windows: int = 64,
+                   max_retries: int = 2, backoff_s: float = 0.25,
+                   stall_windows: int = 512,
+                   log=None, on_window=None,
+                   sleep=_time.sleep) -> SupervisorResult:
+    """Run bundle to end_time under supervision. Serial runner only
+    (the host must regain control at every window barrier); the CLI
+    routes --supervise to it. `log` is a callable taking one message
+    string; `sleep` is injectable for tests."""
+
+    def say(msg):
+        if log is not None:
+            log(msg)
+
+    total_saved = []
+    attempt = 0
+    resume_sim = None
+    resume_time = 0
+    resumed_from = None
+
+    while True:
+        attempt += 1
+        # Per-attempt telemetry the on_round closure mutates.
+        tele = {"zero_streak": 0, "worst_streak": 0, "regressed": False,
+                "wstart": None, "since_ckpt": 0}
+
+        def on_round(sim, wstats, wstart, wend, next_min):
+            tele["wstart"] = wstart
+            if int(np.asarray(wstats.events_processed)) == 0:
+                tele["zero_streak"] += 1
+                tele["worst_streak"] = max(tele["worst_streak"],
+                                           tele["zero_streak"])
+            else:
+                tele["zero_streak"] = 0
+            # Runahead may legally schedule inside the current window
+            # (next_min < wend); only a start-regression is corrupt.
+            if next_min < wstart:
+                tele["regressed"] = True
+            h = _gather(sim)
+            if h.fatal:
+                raise LatchTrip(h)
+            tele["since_ckpt"] += 1
+            if (tele["since_ckpt"] >= checkpoint_every_windows
+                    and next_min < simtime.INVALID):
+                # Healthy at this barrier: snapshot resumes at next_min.
+                p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
+                              time_ns=next_min)
+                total_saved.append((p, next_min))
+                tele["since_ckpt"] = 0
+            if on_window is not None:
+                on_window(sim, wend)
+
+        def _gather(sim):
+            return health_mod.gather(
+                sim,
+                window_start=tele["wstart"],
+                stalled_windows=tele["worst_streak"],
+                stall_limit=stall_windows,
+                time_regression=tele["regressed"],
+            )
+
+        try:
+            sim, stats, _ = ckpt.run_windows(
+                bundle, app_handlers,
+                end_time=end_time,
+                start_time=resume_time,
+                sim=resume_sim,
+                fault_fn=fault_fn,
+                on_round=on_round,
+            )
+            h = _gather(sim)
+            if h.fatal:
+                raise LatchTrip(h)
+            return SupervisorResult(
+                ok=True, sim=sim, stats=stats, health=h,
+                attempts=attempt, resumed_from=resumed_from,
+                checkpoints=tuple(total_saved))
+        except LatchTrip as trip:
+            say(f"supervisor: latch trip on attempt {attempt}: {trip}")
+            if attempt > max_retries:
+                return SupervisorResult(
+                    ok=False, sim=None, stats=None, health=trip.health,
+                    attempts=attempt, resumed_from=resumed_from,
+                    checkpoints=tuple(total_saved))
+            if total_saved:
+                path, t = total_saved[-1]
+                say(f"supervisor: resuming from {path} (t={t}) after "
+                    f"backoff")
+                resume_sim, resume_time, _ = ckpt.load(path, bundle.sim)
+                resumed_from = path
+            else:
+                say("supervisor: no snapshot yet, restarting from boot")
+                resume_sim, resume_time = None, 0
+                resumed_from = None
+            sleep(backoff_s * (2 ** (attempt - 1)))

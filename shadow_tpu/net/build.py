@@ -79,6 +79,10 @@ class SimBundle:
     # (config/loader.py): turns on the bulk window pass wherever the
     # bundle is run (CLI serial, sharded, bench).
     app_bulk: Any = None
+    # Optional faults.plan.FaultPlan attached by faults.install():
+    # runners derive the window-boundary fault_fn from it (and the
+    # boot sim) via faults.fault_fn_for(bundle).
+    fault_plan: Any = None
 
     def ip_of(self, name: str) -> int:
         return self.dns.resolve_name(name).ip
@@ -181,11 +185,26 @@ def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk,
     return None
 
 
+def _resolve_fault_fn(bundle: SimBundle, fault_fn):
+    """Every runner flavor applies a bundle's installed fault plan by
+    default — a config-driven schedule must hold wherever the bundle
+    runs (serial, chunked, sharded, bench). An explicit fault_fn
+    overrides."""
+    if fault_fn is not None:
+        return fault_fn
+    if getattr(bundle, "fault_plan", None) is not None:
+        from shadow_tpu.faults.apply import fault_fn_for
+
+        return fault_fn_for(bundle)
+    return None
+
+
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
                 app_tcp_bulk=None,
                 route_impl: str | None = None,
-                tcp_bulk_lossless: bool = False):
+                tcp_bulk_lossless: bool = False,
+                fault_fn=None):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
     seconds per call at this op count; a reused jitted callable pays
@@ -213,6 +232,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     end = end_time if end_time is not None else bundle.cfg.end_time
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
                                tcp_bulk_lossless)
+    fault_fn = _resolve_fault_fn(bundle, fault_fn)
     route_fn = _default_route
     if route_impl is not None:
         from shadow_tpu.core.events import route_outbox
@@ -228,6 +248,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             lane_id=sim.net.lane_id,
             route_fn=route_fn,
             bulk_fn=bulk_fn,
+            fault_fn=fault_fn,
         )
 
     return jax.jit(_go)
@@ -236,7 +257,8 @@ def make_runner(bundle: SimBundle, app_handlers=(),
 def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                         end_time: int | None = None, app_bulk=None,
                         app_tcp_bulk=None, chunk_windows: int = 256,
-                        tcp_bulk_lossless: bool = False):
+                        tcp_bulk_lossless: bool = False,
+                        fault_fn=None):
     """make_runner variant that executes `chunk_windows` windows per
     device call with a host-side outer loop — window-for-window the
     SAME sequence engine.run's single while_loop produces (advance
@@ -267,6 +289,7 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
     min_jump = max(int(bundle.min_jump), 1)
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
                                tcp_bulk_lossless)
+    fault_fn = _resolve_fault_fn(bundle, fault_fn)
 
     @jax.jit
     def k_windows(sim, stats, wstart):
@@ -279,7 +302,8 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                 return step_window(
                     sim, stats, step, wend,
                     emit_capacity=bundle.cfg.emit_capacity,
-                    lane_id=sim.net.lane_id, bulk_fn=bulk_fn)
+                    lane_id=sim.net.lane_id, bulk_fn=bulk_fn,
+                    fault_fn=fault_fn)
 
             return jax.lax.cond(wstart <= end, run_one,
                                 lambda ops: ops, (sim, stats, wstart))

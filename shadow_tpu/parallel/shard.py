@@ -33,6 +33,16 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from jax.tree_util import tree_map_with_path
 
+try:                                  # jax >= 0.6 top-level name
+    from jax import shard_map as _shard_map
+except ImportError:                   # 0.4.x: experimental home, and the
+    # replication-check kwarg is still called check_rep there
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _shard_map(f, *, mesh, in_specs, out_specs, check_vma=True):
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, check_rep=check_vma)
+
 from shadow_tpu.core import simtime
 from shadow_tpu.core.engine import EngineStats, run as engine_run
 from shadow_tpu.core.events import (
@@ -264,7 +274,7 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                     end_time: int, min_jump: int, emit_capacity: int,
                     lane_id_fn=None, exchange_capacity: int | None = None,
                     narrow: int | None = None,
-                    bulk_fn=None):
+                    bulk_fn=None, fault_fn=None):
     """Shared factory: a jitted sim -> (sim, stats) running the full
     engine loop under shard_map (used by sharded_engine_run and
     make_sharded_runner — keep their semantics identical)."""
@@ -284,6 +294,12 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                                        exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
             bulk_fn=bulk_fn,
+            # fault_fn closes over replicated plan constants and
+            # derives everything from wend, which the pmin barrier
+            # keeps identical on every shard — so each chip rewrites
+            # the replicated tables to the same values with no extra
+            # collective (faults/apply.py).
+            fault_fn=fault_fn,
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 
@@ -292,7 +308,7 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
     # pvary annotations throughout; replication of the declared-P()
     # outputs is guaranteed by _replicate_scalars psumming every
     # scalar leaf (and verified by the bit-identity tests).
-    shmapped = jax.shard_map(
+    shmapped = _shard_map(
         _body, mesh=mesh, in_specs=(specs,), out_specs=(specs, stats_specs),
         check_vma=False,
     )
@@ -319,6 +335,7 @@ def sharded_engine_run(
     exchange_capacity: int | None = None,
     narrow: int | None = None,
     bulk_fn=None,
+    fault_fn=None,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
     *global* state (as built for single-shard); sharding/replication
@@ -330,12 +347,12 @@ def sharded_engine_run(
         mesh, axis, sim, step_fn, end_time=end_time, min_jump=min_jump,
         emit_capacity=emit_capacity, lane_id_fn=lane_id_fn,
         exchange_capacity=exchange_capacity, narrow=narrow,
-        bulk_fn=bulk_fn)(sim)
+        bulk_fn=bulk_fn, fault_fn=fault_fn)(sim)
 
 
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
                         exchange_capacity: int | None = None,
-                        narrow: int | None = None):
+                        narrow: int | None = None, fault_fn=None):
     """A jitted (sim, wend) -> (sim, stats, next_min) running ONE
     window round under shard_map — the building block for host-driven
     window loops (ProcessRuntime, checkpoint.run_windows) on a mesh.
@@ -355,11 +372,12 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             route_fn=_sharded_route_fn(axis, num_shards, lane,
                                        exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
+            fault_fn=fault_fn,
         )
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
         return out_sim, stats, next_min
 
-    shmapped = jax.shard_map(
+    shmapped = _shard_map(
         _body, mesh=mesh, in_specs=(specs, P()),
         out_specs=(specs, stats_specs, P()), check_vma=False,
     )
@@ -370,7 +388,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
                         app_handlers=(), end_time: int | None = None,
                         exchange_capacity: int | None = None,
                         app_bulk=None, app_tcp_bulk=None,
-                        tcp_bulk_lossless: bool = False):
+                        tcp_bulk_lossless: bool = False,
+                        fault_fn=None):
     """Multi-chip variant of shadow_tpu.net.build.make_runner: a
     REUSABLE jitted sim -> (sim, stats) callable running the whole
     window loop under shard_map (benchmarks must reuse one callable —
@@ -393,13 +412,16 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
 
         bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
                                    lossless=tcp_bulk_lossless)
+    from shadow_tpu.net.build import _resolve_fault_fn
+
+    fault_fn = _resolve_fault_fn(bundle, fault_fn)
     return _make_whole_run(
         mesh, axis, bundle.sim, step,
         end_time=end_time if end_time is not None else bundle.cfg.end_time,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
-        bulk_fn=bulk_fn)
+        bulk_fn=bulk_fn, fault_fn=fault_fn)
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),

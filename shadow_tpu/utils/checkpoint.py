@@ -7,12 +7,25 @@ next window start and the counter-based RNG (core/rng.py) needs no
 stream state beyond what the arrays already hold).
 
 Determinism contract: run(0 -> T) == run(0 -> C) + save + load +
-run(C -> T), bit for bit — proven by tests/test_checkpoint.py.
+run(C -> T), bit for bit — proven by tests/test_checkpoint.py. The
+contract holds with a fault plan installed too: fault effects are a
+pure function of (plan, window end), never of saved state
+(faults/apply.py).
+
+Torn-snapshot safety (the supervisor in faults/supervisor.py resumes
+from these after trips, possibly after the process itself died
+mid-save): save() writes to a temp file in the target directory and
+os.replace()s it into place — readers see the old snapshot or the new
+one, never a partial write — and every leaf carries a CRC32 that
+load() verifies before resuming.
 """
 
 from __future__ import annotations
 
 import json
+import os
+import tempfile
+import zlib
 
 import jax
 import numpy as np
@@ -33,20 +46,46 @@ def _leaf_dict(sim) -> dict:
     return out
 
 
+def _crc(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def save(path: str, sim, *, time_ns: int, extra: dict | None = None):
     """Snapshot a Sim pytree at a window boundary. `time_ns` is the
-    next window start (resume point)."""
+    next window start (resume point). Atomic: the snapshot appears at
+    `path` complete or not at all."""
     leaves = _leaf_dict(sim)
     meta = {"time_ns": int(time_ns), "extra": extra or {},
-            "layout": LAYOUT_VERSION, "keys": sorted(leaves)}
-    np.savez_compressed(path, __meta__=json.dumps(meta),
-                        **{k: v for k, v in leaves.items()})
+            "layout": LAYOUT_VERSION, "keys": sorted(leaves),
+            "crc32": {k: _crc(v) for k, v in leaves.items()}}
+    # np.savez appends ".npz" to *paths* but not to file objects, and
+    # the atomic write goes through a file object — normalize here so
+    # both spellings land at the same place.
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=".ckpt.", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, __meta__=json.dumps(meta),
+                                **{k: v for k, v in leaves.items()})
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)  # same directory -> atomic rename
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
 
 
 def load(path: str, template_sim):
     """Rebuild a Sim from a snapshot. `template_sim` supplies the
     pytree structure (build the bundle with the SAME config first);
-    every array is checked against the template's shape and dtype."""
+    every array is checked against the template's shape and dtype,
+    and against the stored CRC32 when the snapshot carries one."""
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
         layout = meta.get("layout", 1)
@@ -55,6 +94,7 @@ def load(path: str, template_sim):
                 f"snapshot uses packet-word layout v{layout}, this "
                 f"build reads v{LAYOUT_VERSION} — resuming would "
                 f"reinterpret header words; re-run from config")
+        crcs = meta.get("crc32", {})  # absent in older snapshots
         flat, treedef = jax.tree_util.tree_flatten_with_path(template_sim)
         leaves = []
         for pth, tleaf in flat:
@@ -69,6 +109,10 @@ def load(path: str, template_sim):
                     f"snapshot leaf {key} is {arr.shape}/{arr.dtype}, "
                     f"template expects {t.shape}/{t.dtype} "
                     f"(config mismatch)")
+            if key in crcs and _crc(arr) != crcs[key]:
+                raise ValueError(
+                    f"snapshot leaf {key} fails its CRC32 — snapshot "
+                    f"is corrupt, refuse to resume")
             leaves.append(jax.numpy.asarray(arr))
         treedef = jax.tree_util.tree_structure(template_sim)
         sim = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -79,14 +123,18 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 start_time: int = 0, sim=None,
                 checkpoint_every_ns: int | None = None,
                 checkpoint_path: str | None = None,
-                on_window=None):
+                on_window=None, on_round=None, fault_fn=None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
     master.c:450-480; one jitted step_window per round so the host
     regains control at every barrier). Returns (sim, stats,
     checkpoints) where checkpoints lists the saved (path, time_ns).
     `on_window(sim, wend)` runs after every round — pcap drains,
-    heartbeats, progress hooks.
+    heartbeats, progress hooks. `on_round(sim, wstats, wstart, wend,
+    next_min)` additionally sees the per-round stats and times — the
+    supervisor (faults/supervisor.py) hangs its health latches and
+    window-counted checkpoints off it; it may raise to abort the loop.
+    `fault_fn` (faults.apply) is threaded into step_window.
     """
     import jax.numpy as jnp
 
@@ -99,13 +147,18 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     end = end_time if end_time is not None else cfg.end_time
     min_jump = max(int(bundle.min_jump), 1)
     sim = sim if sim is not None else bundle.sim
+    if fault_fn is None:
+        from shadow_tpu.net.build import _resolve_fault_fn
+
+        fault_fn = _resolve_fault_fn(bundle, None)
 
     @jax.jit
     def one_window(sim, wend):
         stats = EngineStats.create()
         return step_window(sim, stats, step, wend,
                            emit_capacity=cfg.emit_capacity,
-                           lane_id=sim.net.lane_id)
+                           lane_id=sim.net.lane_id,
+                           fault_fn=fault_fn)
 
     total = EngineStats.create()
     saved = []
@@ -115,8 +168,7 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     while wstart <= end:
         if (next_ckpt is not None and wstart >= next_ckpt
                 and checkpoint_path is not None):
-            p = f"{checkpoint_path}.{wstart}.npz"
-            save(p, sim, time_ns=wstart)
+            p = save(f"{checkpoint_path}.{wstart}.npz", sim, time_ns=wstart)
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
         wend = min(wstart + min_jump, end + 1)
@@ -126,9 +178,11 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             micro_steps=total.micro_steps + stats.micro_steps,
             windows=total.windows + 1,
         )
+        nm = int(next_min)
         if on_window is not None:
             on_window(sim, wend)
-        nm = int(next_min)
+        if on_round is not None:
+            on_round(sim, stats, wstart, wend, nm)
         if nm >= simtime.INVALID:
             break
         wstart = nm

@@ -9,9 +9,17 @@ bench.py.
 """
 
 import importlib.util
+import os
 import pathlib
 
-import jax
+# jax_num_cpu_devices only exists on newer jax; on older builds the
+# XLA flag is the only pre-backend-init knob for virtual CPU devices.
+# Must be set before the backend initializes (it is lazy, so doing it
+# at conftest import time is early enough even if jax was imported).
+os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                           + " --xla_force_host_platform_device_count=8")
+
+import jax  # noqa: E402
 
 TOOLS = pathlib.Path(__file__).resolve().parent.parent / "tools"
 
@@ -25,7 +33,10 @@ def load_tool(name):
     return mod
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    pass  # older jax: the XLA_FLAGS fallback above covers it
 # The full device program is large (the whole netstack + TCP state
 # machine inlined into one while-loop body); persist compiled binaries
 # so the multi-minute XLA compile is paid once per (shape, code)

@@ -94,3 +94,116 @@ def test_load_rejects_config_mismatch(tmp_path):
     other = _build(H=16, load=2, sim_s=1)   # different shapes
     with pytest.raises(ValueError, match="config mismatch"):
         checkpoint.load(p, other.sim)
+
+
+def test_save_is_atomic_and_checksummed(tmp_path):
+    b = _build(H=8, load=2, sim_s=1)
+    # both spellings land at the same .npz (np.savez path/fileobj quirk)
+    p = checkpoint.save(str(tmp_path / "snap"), b.sim, time_ns=7)
+    assert p.endswith(".npz")
+    assert (tmp_path / "snap.npz").exists()
+    # no temp litter after a successful atomic rename
+    assert not list(tmp_path.glob(".ckpt.*"))
+    sim, t, _ = checkpoint.load(p, b.sim)
+    assert t == 7
+
+    # a bit-flipped leaf must fail its CRC, not resume into garbage
+    import json as _json
+
+    with np.load(p, allow_pickle=False) as z:
+        data = {k: z[k] for k in z.files}
+        meta = _json.loads(str(z["__meta__"]))
+    key = next(k for k in data if k != "__meta__"
+               and data[k].size and data[k].dtype != np.bool_)
+    data[key] = data[key].copy()
+    data[key].reshape(-1)[0] += 1
+    corrupt = tmp_path / "corrupt.npz"
+    np.savez(corrupt, __meta__=_json.dumps(meta),
+             **{k: v for k, v in data.items() if k != "__meta__"})
+    with pytest.raises(ValueError, match="CRC32"):
+        checkpoint.load(str(corrupt), b.sim)
+
+
+@pytest.mark.faults
+def test_checkpoint_inside_fault_window_bit_identical(tmp_path):
+    """The stateless-fault contract: a snapshot taken INSIDE a fault
+    window (link down at 0.3 s, snapshot ~0.4 s, link up at 0.6 s)
+    resumes bit-identically — the restored tables are recomputed from
+    (plan, wend) at the next boundary, nothing fault-ish is saved."""
+    from shadow_tpu import faults
+
+    SEC = simtime.ONE_SECOND
+    plan = [
+        faults.FaultRecord(t_ns=int(0.3 * SEC),
+                           kind=faults.FaultKind.LINK_DOWN, a=0, b=0),
+        faults.FaultRecord(t_ns=int(0.6 * SEC),
+                           kind=faults.FaultKind.LINK_UP, a=0, b=0),
+    ]
+
+    b1 = _build(H=8, load=2, sim_s=1)
+    faults.install(b1, plan)
+    sim_a, _, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+    # the outage actually bit: remote phold messages were dropped
+    assert int(np.asarray(sim_a.net.ctr_drop_reliability).sum()) > 0
+
+    b2 = _build(H=8, load=2, sim_s=1)
+    faults.install(b2, plan)
+    ck = str(tmp_path / "snap")
+    # snapshot at every boundary up to mid-outage; the seeded wakeup
+    # guarantees a boundary lands exactly at the 0.3 s fault time
+    _, _, saved = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,),
+        end_time=int(0.45 * SEC), checkpoint_every_ns=50_000_000,
+        checkpoint_path=ck)
+    assert saved, "no snapshot inside the fault window"
+    path, t_ck = saved[-1]
+    assert int(0.3 * SEC) <= t_ck < int(0.6 * SEC)
+
+    b3 = _build(H=8, load=2, sim_s=1)
+    faults.install(b3, plan)   # same plan; bundle.sim stays the boot image
+    sim_r, t_resume, _ = checkpoint.load(path, b3.sim)
+    sim_b, _, _ = checkpoint.run_windows(
+        b3, app_handlers=(phold.handler,), sim=sim_r,
+        start_time=t_resume)
+    _assert_sims_equal(sim_a, sim_b)
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_tcp_retransmit_recovers_link_outage():
+    """A TCP bulk transfer rides out a mid-transfer link outage: data
+    segments die on the down link (0-length ACKs are exempt from the
+    reliability draw), RTO backoff keeps retrying, and after the link
+    heals retransmissions deliver every byte."""
+    from shadow_tpu import faults
+    from shadow_tpu.apps import relay
+    from shadow_tpu.net.build import make_runner
+
+    SEC = simtime.ONE_SECOND
+    H, total = 4, 30_000
+    cap = 64
+    cfg = NetConfig(num_hosts=H, seed=3, end_time=12 * SEC,
+                    sockets_per_host=4, event_capacity=cap,
+                    outbox_capacity=cap, router_ring=cap)
+    hosts = [HostSpec(name=f"n{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = relay.setup(b.sim, circuits=[[0, 1], [2, 3]],
+                        total_bytes=total)
+    faults.install(b, [
+        faults.FaultRecord(t_ns=int(1.3 * SEC),
+                           kind=faults.FaultKind.LINK_DOWN, a=0, b=0),
+        faults.FaultRecord(t_ns=int(1.6 * SEC),
+                           kind=faults.FaultKind.LINK_UP, a=0, b=0),
+    ])
+    sim, _ = make_runner(b, app_handlers=(relay.handler,))(b.sim)
+
+    assert int(sim.events.overflow) == 0
+    # the outage dropped data mid-transfer ...
+    assert int(np.asarray(sim.net.ctr_drop_reliability).sum()) > 0
+    # ... retransmission engaged ...
+    assert int(np.asarray(sim.tcp.retx_segs).sum()) > 0
+    assert int(np.asarray(sim.net.ctr_tx_retx_bytes).sum()) > 0
+    # ... and recovered every byte end to end
+    servers = np.asarray(sim.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim.app.rcvd)[servers] == total).all()

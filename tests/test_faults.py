@@ -1,0 +1,343 @@
+"""Fault-injection subsystem (shadow_tpu/faults/): plan validation,
+config parsing, window-boundary application, crash/restart semantics,
+shard-count independence under a fault plan, health latches, and the
+supervisor's trip/resume/report loop.
+
+Determinism contract under test: fault effects are a pure function of
+(compiled plan, window end) — never of run history — so the same plan
+produces bit-identical runs across reruns, checkpoint splits
+(tests/test_checkpoint.py), and shard counts.
+"""
+
+import contextlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+from shadow_tpu import faults
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.faults.plan import FaultKind, FaultRecord
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+
+SEC = simtime.ONE_SECOND
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def _build(H=8, load=2, sim_s=1, seed=7, event_capacity=None):
+    cap = event_capacity or max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False, end_time=sim_s * SEC, seed=seed,
+                    event_capacity=cap, outbox_capacity=max(32, 4 * load),
+                    router_ring=max(32, 4 * load), in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+# Mirrors the shapes warmed by the checkpoint tests so the jitted
+# fault window compiles once per suite run.
+PLAN = [
+    FaultRecord(t_ns=int(0.3 * SEC), kind=FaultKind.LOSS, a=0, b=0,
+                value=200_000),
+    FaultRecord(t_ns=int(0.4 * SEC), kind=FaultKind.CRASH, a=3),
+    FaultRecord(t_ns=int(0.5 * SEC), kind=FaultKind.LINK_UP, a=0, b=0),
+    FaultRecord(t_ns=int(0.6 * SEC), kind=FaultKind.RESTART, a=3),
+    FaultRecord(t_ns=int(0.7 * SEC), kind=FaultKind.LATENCY, a=0, b=0,
+                value=5_000_000),
+]
+
+
+# ---------------------------------------------------------------- plan
+
+
+def test_validate_catches_schedule_errors():
+    bad = [
+        FaultRecord(t_ns=2 * SEC, kind=FaultKind.RESTART, a=3),
+        FaultRecord(t_ns=1 * SEC, kind=FaultKind.LOSS, a=0, b=1,
+                    value=1_500_000),
+        FaultRecord(t_ns=3 * SEC, kind=FaultKind.LINK_DOWN, a=0),
+        FaultRecord(t_ns=4 * SEC, kind=FaultKind.LATENCY, a=0, b=0,
+                    value=-5),
+        FaultRecord(t_ns=5 * SEC, kind=FaultKind.CRASH, a=99),
+    ]
+    errors, _ = faults.validate_records(bad, num_hosts=8, num_vertices=2)
+    text = "\n".join(errors)
+    assert "without a preceding crash" in text
+    assert "not sorted" in text
+    assert "ppm" in text
+    assert "both endpoints" in text or "requires b" in text
+    assert "negative" in text.lower()
+    assert "99" in text
+    with pytest.raises(ValueError):
+        faults.compile_plan(bad, num_hosts=8, num_vertices=2)
+
+
+def test_validate_accepts_clean_plan_and_warns_on_quantization():
+    errors, warnings = faults.validate_records(
+        PLAN, num_hosts=8, num_vertices=1, min_jump_ns=50_000_001)
+    assert errors == []
+    assert warnings  # 0.3 s does not align to a 50.000001 ms window
+
+
+def test_records_from_json_units():
+    recs = faults.records_from_json({"faults": [
+        {"time_s": 1.5, "kind": "link-down", "a": 0, "b": 1},
+        {"t_ns": 2_000_000_000, "kind": "loss", "a": 0, "b": 1,
+         "value": 0.25},
+        {"time_s": 3.0, "kind": "latency", "a": 1, "b": 0, "value": 0.01},
+    ]})
+    assert recs[0].t_ns == 1_500_000_000
+    assert recs[0].kind == FaultKind.LINK_DOWN
+    assert recs[1].value == 250_000           # probability -> ppm
+    assert recs[2].value == 10_000_000        # seconds -> ns
+
+
+def test_xml_fault_elements_parse_sorted():
+    from shadow_tpu.config.xmlconfig import parse_config
+
+    cfg = parse_config("""<shadow>
+      <topology><![CDATA[%s]]></topology>
+      <kill time="3"/>
+      <fault time="2" kind="linkup" a="peer" b="peer2"/>
+      <fault time="1" kind="linkdown" a="peer" b="peer2"/>
+      <fault time="1.5" kind="crash" a="peer3"/>
+      <node id="peer" quantity="4">
+        <application plugin="x" starttime="0" arguments=""/>
+      </node>
+      <plugin id="x" path="shadow-plugin-test-phold"/>
+    </shadow>""" % GRAPH)
+    assert [f.time_ns for f in cfg.faults] == [
+        1_000_000_000, 1_500_000_000, 2_000_000_000]
+    assert cfg.faults[0].kind == "linkdown"
+    assert cfg.faults[2].a == "peer"
+    assert cfg.faults[1].value is None
+
+
+def test_lint_tool_json_and_xml(tmp_path):
+    import importlib.util
+    import pathlib
+
+    root = pathlib.Path(__file__).resolve().parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "faultplan_lint", root / "tools" / "faultplan_lint.py")
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    good = json.dumps({"faults": [
+        {"time_s": 1.0, "kind": "loss", "a": 0, "b": 0, "value": 0.05},
+        {"time_s": 2.0, "kind": "linkup", "a": 0, "b": 0},
+    ]})
+    errors, _ = lint.lint_text(good, vertices=1)
+    assert errors == []
+
+    bad = json.dumps({"faults": [
+        {"time_s": 1.0, "kind": "restart", "a": 2}]})
+    errors, _ = lint.lint_text(bad, hosts=4)
+    assert any("without a preceding crash" in e for e in errors)
+
+    xml = """<shadow>
+      <topology><![CDATA[%s]]></topology>
+      <kill time="3"/>
+      <fault time="1" kind="crash" a="nosuchhost"/>
+      <fault time="2" kind="restart" a="peer2"/>
+      <node id="peer" quantity="4">
+        <application plugin="x" starttime="0" arguments=""/>
+      </node>
+      <plugin id="x" path="shadow-plugin-test-phold"/>
+    </shadow>""" % GRAPH
+    errors, _ = lint.lint_text(xml)
+    assert any("names no configured host" in e for e in errors)
+    # peer2 restarts without a crash (the crash names a different host)
+    assert any("without a preceding crash" in e for e in errors)
+
+    # the shipped example plan must stay lint-clean
+    example = root / "examples" / "faultplan_degraded.json"
+    errors, _ = lint.lint_text(example.read_text(), vertices=1)
+    assert errors == []
+
+    # CLI wrapper: exit 0 / exit 1
+    p = tmp_path / "bad.json"
+    p.write_text(bad)
+    assert lint.main([str(p), "--hosts", "4", "-q"]) == 1
+    assert lint.main([str(root / "examples" / "faultplan_degraded.json"),
+                      "--vertices", "1", "-q"]) == 0
+
+
+# -------------------------------------------------------------- health
+
+
+def test_health_latches_and_report():
+    h = faults.RunHealth(events_overflow=2, outbox_overflow=0,
+                         rq_overflow=0, narrow_miss=3, stalled_windows=0,
+                         stall_limit=512, time_regression=False,
+                         window_start=123, suspect_hosts=(1, 4))
+    assert h.fatal
+    sev = {m: s for s, m in h.diagnostics()}
+    assert any("event-capacity" in m for m in sev)
+    assert any(s == "warning" for s in sev.values())  # narrow_miss
+    rep = h.failure_report()
+    assert rep["events_overflow"] == 2
+    assert rep["suspect_hosts"] == [1, 4]
+    assert any("event queue overflow" in d for d in rep["diagnostics"])
+
+    ok = faults.RunHealth(events_overflow=0, outbox_overflow=0,
+                          rq_overflow=0, narrow_miss=0, stalled_windows=0,
+                          stall_limit=512, time_regression=False)
+    assert not ok.fatal and ok.diagnostics() == []
+
+
+# ----------------------------------------------- device-side semantics
+
+
+@pytest.mark.faults
+def test_crash_restart_fresh_boot_image():
+    """Crash flushes host 3 and restores its boot image; the seeded
+    RESTART re-runs PROC_START so the host re-injects and keeps
+    participating. The faulted run must differ from the fault-free
+    run (the plan actually did something) yet stay deterministic."""
+    from shadow_tpu.utils import checkpoint
+
+    b = _build()
+    faults.install(b, PLAN)
+    sim, stats, _ = checkpoint.run_windows(b, app_handlers=(phold.handler,))
+    assert int(sim.events.overflow) == 0
+    assert int(np.asarray(sim.net.rq_overflow).max()) == 0
+    # restart re-ran the start handler: the boot-image remaining was
+    # re-drained to zero and host 3 kept receiving after the restart
+    assert int(np.asarray(sim.app.remaining)[3]) == 0
+    assert int(np.asarray(sim.app.rcvd)[3]) > 0
+    # loss flap dropped circulating messages
+    assert int(np.asarray(sim.net.ctr_drop_reliability).sum()) > 0
+
+    plain = _build()
+    sim_p, _, _ = checkpoint.run_windows(plain,
+                                         app_handlers=(phold.handler,))
+    assert (int(np.asarray(sim_p.app.rcvd).sum())
+            != int(np.asarray(sim.app.rcvd).sum()))
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_fault_plan_shard_count_independent():
+    """The same fault plan on 1 device and on an 8-device mesh must
+    produce bit-identical final state — the plan is a replicated
+    constant and wend is pmin-identical on every shard."""
+    import jax
+    from jax.sharding import Mesh
+
+    from shadow_tpu.parallel.shard import run_sharded
+
+    b1 = _build(H=16, load=4)
+    faults.install(b1, PLAN)
+    sim_a, _ = make_runner(b1, app_handlers=(phold.handler,))(b1.sim)
+
+    b2 = _build(H=16, load=4)
+    faults.install(b2, PLAN)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sim_b, _ = run_sharded(b2, mesh, "hosts", app_handlers=(phold.handler,))
+
+    # exchange-tier telemetry is shard-layout-dependent by nature
+    # (per-shard staging watermarks); simulation state must match.
+    TELEMETRY = {".outbox.max_occupied", ".outbox.narrow_hit",
+                 ".outbox.narrow_miss"}
+    fa = jax.tree_util.tree_flatten_with_path(sim_a)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sim_b)[0]
+    for (pa, la), (_, lb) in zip(fa, fb):
+        key = jax.tree_util.keystr(pa)
+        if key in TELEMETRY:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"{key} diverged at 8 shards")
+
+
+# ----------------------------------------------------------- supervisor
+
+
+@pytest.mark.faults
+def test_supervisor_clean_run_saves_checkpoints(tmp_path):
+    b = _build()
+    faults.install(b, PLAN)
+    res = faults.run_supervised(
+        b, app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck"),
+        checkpoint_every_windows=4, sleep=lambda s: None)
+    assert res.ok and res.attempts == 1
+    assert res.checkpoints, "no snapshots written on the clean path"
+    assert not res.health.fatal
+    # snapshots are loadable (atomic + CRC-verified)
+    from shadow_tpu.utils import checkpoint
+
+    path, t = res.checkpoints[0]
+    _, t_loaded, _ = checkpoint.load(path, _build().sim)
+    assert t_loaded == t
+
+
+@pytest.mark.faults
+def test_supervisor_trips_retries_and_reports():
+    """A poisoned latch (event-queue overflow) must trip every
+    attempt; the supervisor retries max_retries times from the last
+    good state, then gives up with a structured report."""
+    b = _build()
+    b.sim = b.sim.replace(events=b.sim.events.replace(
+        overflow=b.sim.events.overflow + 1))
+    slept = []
+    res = faults.run_supervised(
+        b, app_handlers=(phold.handler,),
+        checkpoint_path="/tmp/never-used",
+        max_retries=2, backoff_s=0.5, sleep=slept.append)
+    assert not res.ok
+    assert res.attempts == 3                  # initial + 2 retries
+    assert slept == [0.5, 1.0]                # exponential backoff
+    assert res.health.events_overflow >= 1
+    rep = res.failure_report()
+    assert rep["attempts"] == 3
+    assert any("event queue overflow" in d for d in rep["diagnostics"])
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_cli_supervise_end_to_end(tmp_path):
+    """--supervise through cli.main: config-driven fault plan, clean
+    exit 0, health report in the JSON summary, checkpoints on disk."""
+    from shadow_tpu.cli import main as cli_main
+
+    conf = tmp_path / "phold.xml"
+    conf.write_text("""<shadow>
+      <topology><![CDATA[%s]]></topology>
+      <kill time="2"/>
+      <plugin id="testphold" path="shadow-plugin-test-phold"/>
+      <fault time="0.8" kind="loss" a="peer" b="peer2" value="0.1"/>
+      <fault time="1.2" kind="linkup" a="peer" b="peer2"/>
+      <node id="peer" quantity="8">
+        <application plugin="testphold" starttime="0"
+          arguments="load=4 quantity=8"/>
+      </node>
+    </shadow>""" % GRAPH)
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([str(conf), "--supervise", "--seed", "5",
+                       "--platform", "cpu",
+                       "--checkpoint-every-windows", "8",
+                       "-d", str(tmp_path / "data")])
+    assert rc == 0
+    report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert report["overflow"] == 0
+    assert "failure" not in report
+    snaps = list((tmp_path / "data").glob("checkpoint*.npz"))
+    assert snaps, "supervise mode wrote no checkpoints"
