@@ -22,12 +22,20 @@ rate measures raw engine throughput. Env knobs:
                                   on a degraded network (injected
                                   loss / flaps / latency spikes; see
                                   examples/faultplan_degraded.json)
+  BENCH_TELEMETRY=0               disable the window telemetry ring
+                                  for the phold runs (default on; the
+                                  ring rides the timed program, so
+                                  on-vs-off is the honest overhead
+                                  comparison — acceptance: <2%)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
 a CPU-fallback number can never masquerade as a TPU one.
 vs_baseline compares against BASELINE.json's published events_per_sec
-at the same scale; 0.0 until measured.
+at the same scale; 0.0 until measured. With telemetry on, the line
+also carries per-window stats from the ring (events_per_window
+percentiles, wallclock_per_window_ms) and the run manifest
+(telemetry/export.py run_manifest: config hash, seed, final counters).
 """
 
 from __future__ import annotations
@@ -156,12 +164,13 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     Queue capacity starts tight (3*load) and doubles on overflow —
     events are counted when dropped, never silently lost, so a clean
     overflow==0 run at a tight capacity is sound AND fast."""
-    state = {"n": 0, "cap": None, "fn": None, "sims": None}
+    state = {"n": 0, "cap": None, "fn": None, "sims": None,
+             "bundle": None}
+    telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
 
     def build_at(cap):
         b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
                          fault_records)
-        fn = _make_phold_fn(b, shards)
         # pre-build distinct-seed inputs so the timed call measures
         # only the device program, not host-side setup (each carries
         # its own seeded fault wakeups)
@@ -169,9 +178,18 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                                        graph, replica_size,
                                        fault_records).sim
                           for i in (1, 2)]
+        if telem_on:
+            # ring attached to the TIMED inputs, on purpose: the
+            # overhead claim (<2% vs BENCH_TELEMETRY=0) is only honest
+            # if the measured program carries the ring writes
+            from shadow_tpu import telemetry
+
+            sims = [telemetry.attach(s) for s in sims]
+            b.sim = sims[0]
+        fn = _make_phold_fn(b, shards)
         for s in sims:
             jax.block_until_ready(s.net.rng_keys)
-        state.update(cap=cap, fn=fn, sims=sims)
+        state.update(cap=cap, fn=fn, sims=sims, bundle=b)
 
     build_at(max(16, 3 * load))
 
@@ -189,9 +207,14 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                 go.escalated = True
                 continue
             assert int(jax.device_get(sim.app.rcvd.sum())) > 0
+            go.last_sim = sim
+            go.last_stats = stats
             return int(stats.events_processed)
 
     go.escalated = False
+    go.last_sim = None
+    go.last_stats = None
+    go.state = state
     return go
 
 
@@ -417,6 +440,32 @@ def main(argv=None) -> None:
     if _SHARDS > 1:
         out["shards"] = _SHARDS
         out["total_events_per_sec"] = round(total_rate, 1)
+    if getattr(runner, "last_sim", None) is not None and (
+            getattr(runner.last_sim, "telem", None) is not None):
+        # per-window stats from the device telemetry ring of the TIMED
+        # run, plus the run manifest (telemetry/export.py)
+        from shadow_tpu import telemetry
+
+        h = telemetry.Harvester()
+        h.drain(runner.last_sim)
+        tel = h.summary()
+        if "events_per_window" in tel:
+            out["events_per_window"] = {
+                k: round(v, 2)
+                for k, v in tel["events_per_window"].items()}
+        windows = int(runner.last_stats.windows)
+        if windows:
+            # wall clock is host-side and covers the whole program, so
+            # only the mean is derivable (the ring's sim-time records
+            # carry no wall timestamps — the device cannot read a
+            # clock); percentiles here would be fabricated
+            out["wallclock_per_window_ms"] = round(
+                wall * 1000.0 / windows, 4)
+        b = runner.state["bundle"]
+        out["manifest"] = telemetry.run_manifest(
+            cfg=b.cfg, seed=b.cfg.seed, shards=max(_SHARDS, 1),
+            sim=runner.last_sim, stats=runner.last_stats,
+            harvester=h, wall_seconds=wall)
     print(json.dumps(out))
 
 

@@ -17,6 +17,7 @@ working):
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
 import sys
 import time
@@ -116,6 +117,19 @@ def make_parser() -> argparse.ArgumentParser:
                         "loop; --no-track-paths overrides a config "
                         "that enables it")
     p.add_argument("--event-capacity", type=int, default=None)
+    # --- window telemetry (shadow_tpu/telemetry) ---------------------
+    p.add_argument("--trace-out", default=None,
+                   help="write a Chrome-trace/Perfetto JSON of "
+                        "per-window telemetry records (sim-time track) "
+                        "plus wall-clock phase spans; enables the "
+                        "device-resident telemetry ring")
+    p.add_argument("--metrics-out", default=None,
+                   help="write final counters as Prometheus text "
+                        "exposition; enables the telemetry ring")
+    p.add_argument("--telemetry-capacity", type=int, default=None,
+                   help="telemetry ring capacity in window records "
+                        "(default 4096); overruns are latched as a "
+                        "health warning, never silently")
     # --- run supervisor (faults/supervisor.py) -----------------------
     p.add_argument("--supervise", action="store_true",
                    help="host-driven window loop with health latches, "
@@ -243,6 +257,33 @@ def main(argv=None) -> int:
                         "sim_seconds": round(int(wend) / 1e9, 3),
                         "wall_seconds": round(time.time() - t0, 3)}))
 
+        # window telemetry (shadow_tpu/telemetry): attach the on-device
+        # ring BEFORE any run path branches so checkpoint templates,
+        # the supervisor's resume template, and the compiled programs
+        # all see the same pytree. A None ring costs literally zero
+        # compiled ops (make_telem_fn is a trace-time no-op), so runs
+        # without these flags are untouched.
+        telem_on = bool(args.trace_out or args.metrics_out
+                        or args.telemetry_capacity)
+        harvester = None
+        timers = None
+        if telem_on and loaded.vprocs:
+            logger.warning(0, "shadow-tpu",
+                           "window telemetry is unavailable with .py "
+                           "plugins (ProcessRuntime drives its own "
+                           "window loop); --trace-out/--metrics-out "
+                           "ignored")
+            telem_on = False
+        if telem_on:
+            from shadow_tpu import telemetry
+
+            b.sim = telemetry.attach(
+                b.sim,
+                capacity=args.telemetry_capacity
+                or telemetry.DEFAULT_CAPACITY)
+            harvester = telemetry.Harvester()
+            timers = telemetry.PhaseTimers()
+
         cap = None
         if b.cfg.pcap:
             # pcap capture needs a host-driven window loop to drain
@@ -317,15 +358,17 @@ def main(argv=None) -> int:
                     _cap.drain(s)
                 progress_hook(s, wend)
 
-            result = run_supervised(
-                b, app_handlers=loaded.handlers,
-                checkpoint_path=ckpt_prefix,
-                checkpoint_every_windows=args.checkpoint_every_windows,
-                max_retries=args.max_retries,
-                backoff_s=args.retry_backoff,
-                stall_windows=args.stall_windows,
-                log=lambda m: logger.message(0, "shadow-tpu", m),
-                on_window=sup_hook)
+            with (timers.phase("supervised-run") if timers is not None
+                  else contextlib.nullcontext()):
+                result = run_supervised(
+                    b, app_handlers=loaded.handlers,
+                    checkpoint_path=ckpt_prefix,
+                    checkpoint_every_windows=args.checkpoint_every_windows,
+                    max_retries=args.max_retries,
+                    backoff_s=args.retry_backoff,
+                    stall_windows=args.stall_windows,
+                    log=lambda m: logger.message(0, "shadow-tpu", m),
+                    on_window=sup_hook, harvester=harvester)
             if not result.ok:
                 failure = result.failure_report()
                 # critical, not error: SimLogger.error raises (the
@@ -335,6 +378,38 @@ def main(argv=None) -> int:
                     logger.critical(0, "shadow-tpu", msg)
                 report = {"failure": failure,
                           "attempts": result.attempts}
+                # the trip carries the sim, so the shutdown
+                # diagnostics the success path prints still run:
+                # object accounting (ref: slave.c:237-241) and the
+                # run manifest — a failed run is exactly when you
+                # want them
+                if result.sim is not None:
+                    from shadow_tpu.utils import objcount
+
+                    oc = objcount.gather(result.sim)
+                    logger.message(0, "shadow-tpu", oc.format())
+                    logger.message(0, "shadow-tpu", oc.format_diff())
+                    if telem_on:
+                        from shadow_tpu import telemetry
+
+                        harvester.drain(result.sim)
+                        man = telemetry.run_manifest(
+                            cfg=b.cfg, seed=args.seed, shards=1,
+                            sim=result.sim, health=result.health,
+                            fault_plan=b.fault_plan,
+                            harvester=harvester, timers=timers)
+                        os.makedirs(args.data_directory, exist_ok=True)
+                        telemetry.write_manifest(
+                            os.path.join(args.data_directory,
+                                         "run_manifest.json"), man)
+                        if args.trace_out:
+                            telemetry.write_trace(
+                                args.trace_out, harvester.records,
+                                timers, 1)
+                        if args.metrics_out:
+                            telemetry.write_metrics(args.metrics_out,
+                                                    man)
+                        report["manifest"] = man
                 logger.flush()
                 print(json.dumps(report))
                 return 3
@@ -344,20 +419,47 @@ def main(argv=None) -> int:
 
             def pcap_hook(s, wend):
                 cap.drain(s)
+                if harvester is not None:
+                    # the host already regains control every window
+                    # here; draining per window keeps ring loss at zero
+                    harvester.drain(s)
                 progress_hook(s, wend)
 
-            sim, stats, _ = ckpt.run_windows(
-                b, app_handlers=loaded.handlers, on_window=pcap_hook)
+            with (timers.phase("window-loop") if timers is not None
+                  else contextlib.nullcontext()):
+                sim, stats, _ = ckpt.run_windows(
+                    b, app_handlers=loaded.handlers, on_window=pcap_hook)
         elif mesh is not None:
             from shadow_tpu.parallel.shard import run_sharded
 
-            sim, stats = run_sharded(b, mesh, app_handlers=loaded.handlers,
-                                     app_bulk=b.app_bulk)
+            if timers is not None:
+                with timers.phase("device-execute"):
+                    sim, stats = run_sharded(
+                        b, mesh, app_handlers=loaded.handlers,
+                        app_bulk=b.app_bulk)
+                    jax.block_until_ready(sim)
+            else:
+                sim, stats = run_sharded(
+                    b, mesh, app_handlers=loaded.handlers,
+                    app_bulk=b.app_bulk)
         else:
-            from shadow_tpu.net.build import run
+            if timers is not None:
+                # split trace+compile from device execution so the
+                # wall-time trace track shows where a cold start went
+                from shadow_tpu.net.build import make_runner
 
-            sim, stats = run(b, app_handlers=loaded.handlers,
-                             app_bulk=b.app_bulk)
+                runner = make_runner(b, app_handlers=loaded.handlers,
+                                     app_bulk=b.app_bulk)
+                with timers.phase("trace-compile"):
+                    compiled = runner.lower(b.sim).compile()
+                with timers.phase("device-execute"):
+                    sim, stats = compiled(b.sim)
+                    jax.block_until_ready(sim)
+            else:
+                from shadow_tpu.net.build import run
+
+                sim, stats = run(b, app_handlers=loaded.handlers,
+                                 app_bulk=b.app_bulk)
         if cap is not None:
             cap.drain(sim)
             cap.close()
@@ -408,7 +510,13 @@ def main(argv=None) -> int:
         # corrupted-but-plausible results.
         from shadow_tpu.faults import health as health_mod
 
-        run_health = health_mod.gather(sim)
+        if harvester is not None:
+            with timers.phase("harvest"):
+                harvester.drain(sim)
+        run_health = health_mod.gather(
+            sim,
+            telemetry_lost=(harvester.records_lost
+                            if harvester is not None else 0))
         # critical, not error: SimLogger.error raises, and the fatal
         # path below must still print the structured report + exit 3.
         for sev, msg in run_health.diagnostics():
@@ -436,6 +544,32 @@ def main(argv=None) -> int:
             "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
             + int(sim.net.rq_overflow),
         }
+        if telem_on:
+            from shadow_tpu import telemetry
+
+            nshards = mesh.shape["hosts"] if mesh is not None else 1
+            with timers.phase("export"):
+                man = telemetry.run_manifest(
+                    cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
+                    stats=stats, health=run_health,
+                    fault_plan=b.fault_plan, harvester=harvester,
+                    timers=timers, wall_seconds=wall)
+                os.makedirs(args.data_directory, exist_ok=True)
+                mpath = telemetry.write_manifest(
+                    os.path.join(args.data_directory,
+                                 "run_manifest.json"), man)
+                logger.message(b.cfg.end_time, "shadow-tpu",
+                               f"run manifest -> {mpath}")
+                if args.trace_out:
+                    telemetry.write_trace(args.trace_out,
+                                          harvester.records, timers,
+                                          nshards)
+                    logger.message(b.cfg.end_time, "shadow-tpu",
+                                   f"trace -> {args.trace_out} (load in "
+                                   f"chrome://tracing or ui.perfetto.dev)")
+                if args.metrics_out:
+                    telemetry.write_metrics(args.metrics_out, man)
+            report["telemetry"] = man["telemetry"]
         if run_health.fatal:
             report["failure"] = run_health.failure_report()
             logger.critical(b.cfg.end_time, "shadow-tpu",

@@ -120,7 +120,7 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
 def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 emit_capacity: int = 4, lane_id=None,
                 route_fn=_default_route, min_fn=_identity,
-                bulk_fn=None, fault_fn=None):
+                bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -135,7 +135,17 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     boundary: it rewrites the latency/reliability tables and applies
     crash resets as a pure function of wend, so every event inside the
     window sees the post-fault network. None (the default) leaves the
-    body untouched."""
+    body untouched.
+
+    `telem_fn` (telemetry.ring.make_telem_fn) records one per-window
+    telemetry record after the drain and BEFORE route_fn — the outbox
+    must still hold the window's staged sends (route clears it), and
+    queue occupancy is measured at its end-of-drain low-water point.
+    `wstart` (the window's start time) is only consumed by telemetry;
+    None records a zero-length window."""
+    if telem_fn is not None:
+        ev0 = stats.events_processed
+        ms0 = stats.micro_steps
     if fault_fn is not None:
         sim = fault_fn(sim, wend)
     if bulk_fn is not None:
@@ -144,6 +154,10 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
             events_processed=stats.events_processed + n_bulk)
     sim, stats = window_fixpoint(sim, stats, step_fn, wend, emit_capacity,
                                  lane_id)
+    if telem_fn is not None:
+        sim = telem_fn(sim, wend if wstart is None else wstart, wend,
+                       stats.events_processed - ev0,
+                       stats.micro_steps - ms0)
     sim = route_fn(sim)
     stats = stats.replace(windows=stats.windows + 1)
     next_min = min_fn(jnp.min(sim.events.min_time()))
@@ -163,6 +177,7 @@ def run(
     min_fn=_identity,
     bulk_fn=None,
     fault_fn=None,
+    telem_fn=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -193,7 +208,7 @@ def run(
         wend = jnp.minimum(wstart + min_jump, end_time + 1)
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
-            route_fn, min_fn, bulk_fn, fault_fn,
+            route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,
         )
         return sim, stats, next_min
 

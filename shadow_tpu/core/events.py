@@ -132,6 +132,14 @@ class EventQueue:
         """[H] number of occupied slots per host row."""
         return jnp.sum(self.valid(), axis=1, dtype=I32)
 
+    def occupancy(self) -> tuple:
+        """(min, max, sum) of per-host occupied slots — the telemetry
+        ring's queue-occupancy probe (shard-local values; the telem
+        hook reduces them across shards at the window barrier)."""
+        fill = self.fill_count()
+        return (jnp.min(fill), jnp.max(fill),
+                jnp.sum(fill, dtype=simtime.DTYPE))
+
     def min_time(self) -> jax.Array:
         """[H] earliest pending event time per host (INVALID if none).
         The per-shard reduction of this is the conservative barrier's
@@ -269,6 +277,13 @@ class Outbox:
     @property
     def capacity(self) -> int:
         return self.dst.shape[1]
+
+    def occupied(self) -> jax.Array:
+        """[H, M] bool: slots holding a staged entry (dst >= 0).
+        `count` alone cannot answer this — the TCP bulk pass stages at
+        sparse columns, so consumers (route, telemetry) must test the
+        dst plane."""
+        return self.dst >= 0
 
     @staticmethod
     def create(num_hosts: int, capacity: int,

@@ -45,6 +45,9 @@ class RunHealth:
     stalled_windows: int = 0      # longest zero-event streak observed
     stall_limit: int = 0          # K that makes the streak fatal (0 = off)
     time_regression: bool = False
+    # window telemetry records overwritten before the host drained them
+    # (telemetry/harvest.py) — observability loss only, results exact
+    telemetry_lost: int = 0
     # context for diagnostics
     window_start: Optional[int] = None   # wstart when gathered
     suspect_hosts: tuple = ()            # rows at capacity (global ids)
@@ -96,6 +99,13 @@ class RunHealth:
                         f"window(s) (full-width fallback): perf only, "
                         f"results remain exact — raise the narrow width "
                         f"if this persists"))
+        if self.telemetry_lost:
+            out.append(("warning",
+                        f"telemetry ring overran: {self.telemetry_lost} "
+                        f"window record(s) lost before the host drained "
+                        f"them — results remain exact, the trace has "
+                        f"gaps; raise --telemetry-capacity or drain "
+                        f"more often"))
         return out
 
     def failure_report(self) -> dict:
@@ -109,6 +119,7 @@ class RunHealth:
             "stalled_windows": self.stalled_windows,
             "stall_limit": self.stall_limit,
             "time_regression": self.time_regression,
+            "telemetry_lost": self.telemetry_lost,
             "window_start": self.window_start,
             "suspect_hosts": [int(h) for h in self.suspect_hosts],
             "diagnostics": [m for _, m in self.diagnostics()],
@@ -116,7 +127,8 @@ class RunHealth:
 
 
 def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
-           time_regression=False, max_suspects=8) -> RunHealth:
+           time_regression=False, telemetry_lost=0,
+           max_suspects=8) -> RunHealth:
     """Pull the device latches into a RunHealth. Cheap (a handful of
     scalars plus one fill_count) — fine to call once per checkpoint
     interval and after every run."""
@@ -135,6 +147,7 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         stalled_windows=int(stalled_windows),
         stall_limit=int(stall_limit),
         time_regression=bool(time_regression),
+        telemetry_lost=int(telemetry_lost),
         window_start=None if window_start is None else int(window_start),
         suspect_hosts=suspects,
     )

@@ -36,10 +36,13 @@ from shadow_tpu.utils import checkpoint as ckpt
 
 
 class LatchTrip(RuntimeError):
-    """A fatal health latch fired mid-run."""
+    """A fatal health latch fired mid-run. Carries the sim state at the
+    trip so the failure path can still dump diagnostics (object counts,
+    final counters for the run manifest)."""
 
-    def __init__(self, health: health_mod.RunHealth):
+    def __init__(self, health: health_mod.RunHealth, sim=None):
         self.health = health
+        self.sim = sim
         msgs = "; ".join(m for s, m in health.diagnostics() if s == "fatal")
         super().__init__(msgs or "health latch tripped")
 
@@ -66,12 +69,16 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    checkpoint_every_windows: int = 64,
                    max_retries: int = 2, backoff_s: float = 0.25,
                    stall_windows: int = 512,
-                   log=None, on_window=None,
+                   log=None, on_window=None, harvester=None,
                    sleep=_time.sleep) -> SupervisorResult:
     """Run bundle to end_time under supervision. Serial runner only
     (the host must regain control at every window barrier); the CLI
     routes --supervise to it. `log` is a callable taking one message
-    string; `sleep` is injectable for tests."""
+    string; `sleep` is injectable for tests. `harvester`
+    (telemetry.Harvester) is drained every round — "between supervisor
+    checkpoints" — and its loss count rides the health snapshot as a
+    warning; its rewind handling keeps resumed attempts from
+    double-counting replayed windows."""
 
     def say(msg):
         if log is not None:
@@ -101,9 +108,11 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             # (next_min < wend); only a start-regression is corrupt.
             if next_min < wstart:
                 tele["regressed"] = True
+            if harvester is not None:
+                harvester.drain(sim)
             h = _gather(sim)
             if h.fatal:
-                raise LatchTrip(h)
+                raise LatchTrip(h, sim)
             tele["since_ckpt"] += 1
             if (tele["since_ckpt"] >= checkpoint_every_windows
                     and next_min < simtime.INVALID):
@@ -122,6 +131,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 stalled_windows=tele["worst_streak"],
                 stall_limit=stall_windows,
                 time_regression=tele["regressed"],
+                telemetry_lost=(harvester.records_lost
+                                if harvester is not None else 0),
             )
 
         try:
@@ -133,9 +144,11 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 fault_fn=fault_fn,
                 on_round=on_round,
             )
+            if harvester is not None:
+                harvester.drain(sim)
             h = _gather(sim)
             if h.fatal:
-                raise LatchTrip(h)
+                raise LatchTrip(h, sim)
             return SupervisorResult(
                 ok=True, sim=sim, stats=stats, health=h,
                 attempts=attempt, resumed_from=resumed_from,
@@ -143,8 +156,10 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
         except LatchTrip as trip:
             say(f"supervisor: latch trip on attempt {attempt}: {trip}")
             if attempt > max_retries:
+                # carry the tripped sim so the caller can still report
+                # (object counts, manifest counters) from it
                 return SupervisorResult(
-                    ok=False, sim=None, stats=None, health=trip.health,
+                    ok=False, sim=trip.sim, stats=None, health=trip.health,
                     attempts=attempt, resumed_from=resumed_from,
                     checkpoints=tuple(total_saved))
             if total_saved:

@@ -241,6 +241,11 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             q, out = route_outbox(sim.events, sim.outbox, impl=route_impl)
             return sim.replace(events=q, outbox=out)
 
+    # trace-time no-op unless telemetry.attach()ed to the input sim
+    from shadow_tpu.telemetry.ring import make_telem_fn
+
+    telem_fn = make_telem_fn()
+
     def _go(sim):
         return engine_run(
             sim, step, end_time=end, min_jump=bundle.min_jump,
@@ -249,6 +254,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             route_fn=route_fn,
             bulk_fn=bulk_fn,
             fault_fn=fault_fn,
+            telem_fn=telem_fn,
         )
 
     return jax.jit(_go)
@@ -290,6 +296,9 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
                                tcp_bulk_lossless)
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
+    from shadow_tpu.telemetry.ring import make_telem_fn
+
+    telem_fn = make_telem_fn()
 
     @jax.jit
     def k_windows(sim, stats, wstart):
@@ -303,7 +312,8 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                     sim, stats, step, wend,
                     emit_capacity=bundle.cfg.emit_capacity,
                     lane_id=sim.net.lane_id, bulk_fn=bulk_fn,
-                    fault_fn=fault_fn)
+                    fault_fn=fault_fn, telem_fn=telem_fn,
+                    wstart=wstart)
 
             return jax.lax.cond(wstart <= end, run_one,
                                 lambda ops: ops, (sim, stats, wstart))

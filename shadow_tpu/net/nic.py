@@ -582,7 +582,14 @@ def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
             vsrc, vdst].add(known.astype(I64), mode="drop"))
 
     # tracker byte split (ref: tracker.c:51-99): data vs retransmit,
-    # classified by the packet's own audit trail
+    # classified by the packet's own audit trail. These cumulative
+    # counters are the single source for every observability consumer:
+    # the tracker heartbeat deltas them per interval, the telemetry
+    # ring deltas drop_total per window (telemetry/ring.py), and the
+    # run manifest reports the final totals — so a new counter only
+    # needs to be bumped here (and mirrored in the tcp_bulk drain lane
+    # for fields the bulk pass also advances, e.g. ctr_tx_retx_bytes)
+    # to reach all three.
     is_retx = (words[:, pf.W_STATUS] & pf.PDS_SND_TCP_RETRANSMITTED) != 0
     net = net.replace(
         last_drop_status=jnp.where(

@@ -383,6 +383,20 @@ class Sim:
     net: NetState
     app: Any = None
     tcp: Any = None  # TcpState when cfg.tcp (net/tcp.py), else None
+    # TelemetryRing (telemetry/ring.py) when window telemetry is on.
+    # None contributes no pytree leaves, so checkpoints and compiled
+    # programs built without telemetry are byte-identical to pre-telem
+    # builds; telemetry.attach() is the explicit opt-in.
+    telem: Any = None
+
+
+def drop_total(net: NetState) -> jax.Array:
+    """[H] i64 total packets dropped per host, all drop classes. The
+    single definition of "a drop" shared by the tracker heartbeat, the
+    telemetry ring's per-window delta, and the manifest's final
+    counters — so all three agree by construction."""
+    return (net.ctr_drop_reliability + net.ctr_drop_codel
+            + net.ctr_drop_nosocket + net.ctr_drop_bufferfull)
 
 
 def ip_of_hosts(cfg: NetConfig, net: "NetState", idx) -> jax.Array:

@@ -55,6 +55,7 @@ from shadow_tpu.core.events import (
     segment_ranks,
 )
 from shadow_tpu.net.state import NetState, REPLICATED_FIELDS
+from shadow_tpu.telemetry.ring import make_telem_fn
 
 I32 = jnp.int32
 
@@ -68,6 +69,13 @@ def sim_specs(sim, axis: str):
 
     def spec(path, leaf):
         names = [k.name for k in path if hasattr(k, "name")]
+        # The telemetry ring is replicated state: its [W] planes are
+        # ring slots, not host rows, and every value stored is already
+        # globally reduced at the window barrier (telemetry/ring.py).
+        # This check must come first — the 1-D planes would otherwise
+        # fall through to P(axis).
+        if names and names[0] == "telem":
+            return P()
         # Replicated lookup tables are identified by NetState field
         # name, scoped to the NetState subtree ("net" in a Sim, or a
         # bare NetState) so an app field that happens to share a name
@@ -220,6 +228,10 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     narrow_pinned = (lax.pmax(ob.narrow_hit, axis),
                      lax.pmax(ob.narrow_miss, axis),
                      lax.pmax(ob.max_occupied, axis))
+    # The telemetry ring is pinned the same way: its scalars (count,
+    # prev_*) and planes already hold globally-reduced values — the
+    # delta-psum below would multiply them by the shard count.
+    telem = getattr(sim, "telem", None)
     sim = jax.tree.map(
         lambda leaf, init: init + lax.psum(leaf - init, axis)
         if jnp.ndim(leaf) == 0 else leaf,
@@ -228,6 +240,8 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     sim = sim.replace(outbox=sim.outbox.replace(
         narrow_hit=narrow_pinned[0], narrow_miss=narrow_pinned[1],
         max_occupied=narrow_pinned[2]))
+    if telem is not None:
+        sim = sim.replace(telem=telem)
     stats = EngineStats(
         events_processed=lax.psum(stats.events_processed, axis),
         micro_steps=lax.psum(stats.micro_steps, axis),
@@ -300,6 +314,8 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             # the replicated tables to the same values with no extra
             # collective (faults/apply.py).
             fault_fn=fault_fn,
+            # trace-time no-op when sim.telem is None (telemetry off)
+            telem_fn=make_telem_fn(axis),
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 

@@ -1,0 +1,25 @@
+"""Window telemetry: device-resident per-window ring + host exports.
+
+See ring.py (the on-device ring and the engine hook), harvest.py (the
+between-calls drain + wall-clock phase timers), export.py (Chrome
+trace / Prometheus text / run manifest)."""
+
+from shadow_tpu.telemetry.ring import (  # noqa: F401
+    DEFAULT_CAPACITY,
+    TelemetryRing,
+    attach,
+    make_telem_fn,
+)
+from shadow_tpu.telemetry.harvest import (  # noqa: F401
+    Harvester,
+    PhaseTimers,
+    WindowRecord,
+)
+from shadow_tpu.telemetry.export import (  # noqa: F401
+    chrome_trace,
+    prometheus_text,
+    run_manifest,
+    write_manifest,
+    write_metrics,
+    write_trace,
+)

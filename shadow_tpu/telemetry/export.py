@@ -1,0 +1,212 @@
+"""Telemetry export: Chrome-trace JSON, Prometheus text, run manifest.
+
+Three host-side views over the harvested ring + phase timers:
+
+- chrome_trace(): the Trace Event Format JSON that chrome://tracing
+  and Perfetto load. One "sim-time" process track of per-window
+  complete ("X") events whose ts/dur are *simulated* microseconds,
+  plus one wall-time track per shard carrying the phase-timer spans
+  (trace/compile vs device execute vs harvest/export overhead).
+- prometheus_text(): the text exposition format, final counter values
+  as gauges/counters — scrape-file style for dashboards.
+- run_manifest(): the run's identity + outcome in one JSON object:
+  config hash, seed, shard count, fault-plan digest, final counters,
+  health verdict, telemetry summary. bench.py embeds it in its JSON
+  line and the CLI writes it next to the trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+
+def _us(ns: int) -> float:
+    return ns / 1000.0
+
+
+def chrome_trace(records, timers=None, num_shards: int = 1) -> dict:
+    """Build a Trace Event Format object (dict; json.dump it).
+
+    Sim-time track: pid 0, one "X" event per window record, ts/dur in
+    simulated µs (the format's native unit), counters in args.
+    Wall-time tracks: pid 1, tid = shard id, phase spans in wall µs
+    from the timer origin. Both Chrome and Perfetto accept mixed
+    timelines as separate process groups."""
+    events = []
+    events.append({"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+                   "args": {"name": "sim-time (simulated µs)"}})
+    events.append({"ph": "M", "name": "thread_name", "pid": 0, "tid": 0,
+                   "args": {"name": "windows"}})
+    for r in records:
+        events.append({
+            "ph": "X", "pid": 0, "tid": 0,
+            "name": f"window {r.index}",
+            "ts": _us(r.wstart),
+            # zero-duration complete events render invisibly; clamp at
+            # 1 ns worth of µs so degenerate windows stay clickable
+            "dur": max(_us(r.wend - r.wstart), 0.001),
+            "args": {
+                "events": r.events, "micro_steps": r.micro_steps,
+                "routed_local": r.routed_local,
+                "routed_cross": r.routed_cross,
+                "drops": r.drops, "retx": r.retx,
+                "queue_occupancy": {
+                    "min": r.qocc_min, "max": r.qocc_max,
+                    "sum": r.qocc_sum},
+            },
+        })
+    if timers is not None:
+        events.append({"ph": "M", "name": "process_name", "pid": 1,
+                       "tid": 0, "args": {"name": "wall-time (µs)"}})
+        for s in range(max(num_shards, 1)):
+            events.append({"ph": "M", "name": "thread_name", "pid": 1,
+                           "tid": s, "args": {"name": f"shard {s}"}})
+        for p in timers.phases:
+            shards = ([p.shard] if p.shard is not None
+                      else range(max(num_shards, 1)))
+            for s in shards:
+                events.append({
+                    "ph": "X", "pid": 1, "tid": s, "name": p.name,
+                    "ts": p.start_s * 1e6, "dur": p.dur_s * 1e6,
+                    "args": {},
+                })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def prometheus_text(counters: dict, prefix: str = "shadow_tpu") -> str:
+    """Flatten a {name: number} dict into Prometheus text exposition
+    lines. Nested dicts become labeled samples
+    (name{key="sub"} value)."""
+    lines = []
+    for name, val in sorted(counters.items()):
+        metric = f"{prefix}_{name}"
+        if isinstance(val, dict):
+            lines.append(f"# TYPE {metric} gauge")
+            for k, v in sorted(val.items()):
+                lines.append(f'{metric}{{key="{k}"}} {_num(v)}')
+        else:
+            lines.append(f"# TYPE {metric} gauge")
+            lines.append(f"{metric} {_num(val)}")
+    return "\n".join(lines) + "\n"
+
+
+def _num(v) -> str:
+    if isinstance(v, bool):
+        return "1" if v else "0"
+    if isinstance(v, float):
+        return repr(v)
+    return str(int(v))
+
+
+def config_hash(cfg) -> str:
+    """sha256 of the canonicalized NetConfig — two runs with the same
+    hash ran the same simulation parameters."""
+    d = dataclasses.asdict(cfg)
+    blob = json.dumps(d, sort_keys=True, default=str)
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def fault_plan_digest(plan) -> str | None:
+    """sha256 over the compiled plan's record columns (None = no plan
+    installed)."""
+    if plan is None:
+        return None
+    cols = [plan.t_ns, plan.kind, plan.a, plan.b, plan.value]
+    blob = json.dumps([[int(x) for x in c] for c in cols])
+    return hashlib.sha256(blob.encode()).hexdigest()
+
+
+def final_counters(sim, stats=None) -> dict:
+    """Final device counter totals for the manifest / metrics file."""
+    import numpy as np
+
+    from shadow_tpu.net.state import drop_total
+
+    net = sim.net
+    out = {
+        "drops_total": int(np.asarray(drop_total(net)).sum()),
+        "tx_packets_total": int(np.asarray(net.ctr_tx_packets).sum()),
+        "rx_packets_total": int(np.asarray(net.ctr_rx_packets).sum()),
+        "tx_bytes_total": int(np.asarray(net.ctr_tx_bytes).sum()),
+        "rx_bytes_total": int(np.asarray(net.ctr_rx_bytes).sum()),
+        "retx_bytes_total": int(np.asarray(net.ctr_tx_retx_bytes).sum()),
+        "events_overflow": int(np.asarray(sim.events.overflow)),
+        "outbox_overflow": int(np.asarray(sim.outbox.overflow)),
+        "rq_overflow": int(np.asarray(net.rq_overflow)),
+    }
+    if getattr(sim, "tcp", None) is not None:
+        out["retx_segments_total"] = int(
+            np.asarray(sim.tcp.retx_segs).sum())
+    if stats is not None:
+        out["events_processed"] = int(stats.events_processed)
+        out["micro_steps"] = int(stats.micro_steps)
+        out["windows"] = int(stats.windows)
+    return out
+
+
+def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
+                 health=None, fault_plan=None, harvester=None,
+                 timers=None, wall_seconds: float | None = None) -> dict:
+    """The run's identity + outcome (see module docstring)."""
+    man = {
+        "config_hash": config_hash(cfg),
+        "seed": int(seed),
+        "shards": int(shards),
+        "num_hosts": int(cfg.num_hosts),
+        "end_time_ns": int(cfg.end_time),
+        "fault_plan_digest": fault_plan_digest(fault_plan),
+        "counters": final_counters(sim, stats),
+    }
+    if wall_seconds is not None:
+        man["wall_seconds"] = round(float(wall_seconds), 3)
+    if health is not None:
+        man["health"] = health.failure_report()
+        man["health"]["verdict"] = "fatal" if health.fatal else (
+            "warnings" if health.diagnostics() else "clean")
+    tel = {"windows_recorded": 0, "records_lost": 0}
+    if harvester is not None:
+        tel = harvester.summary()
+    man["telemetry"] = tel
+    if timers is not None:
+        man["wall_phases_s"] = {
+            k: round(v, 6) for k, v in timers.totals().items()}
+    return man
+
+
+def metrics_from_manifest(man: dict) -> dict:
+    """Flatten the manifest into the {name: number-or-dict} shape
+    prometheus_text() takes."""
+    out = dict(man["counters"])
+    out["seed"] = man["seed"]
+    out["shards"] = man["shards"]
+    out["num_hosts"] = man["num_hosts"]
+    tel = man.get("telemetry", {})
+    out["telemetry_windows_recorded"] = tel.get("windows_recorded", 0)
+    out["telemetry_records_lost"] = tel.get("records_lost", 0)
+    if "events_per_window" in tel:
+        out["events_per_window"] = tel["events_per_window"]
+    if "health" in man:
+        out["health_fatal"] = bool(man["health"]["fatal"])
+    if "wall_phases_s" in man:
+        out["wall_phase_seconds"] = man["wall_phases_s"]
+    return out
+
+
+def write_trace(path: str, records, timers=None, num_shards: int = 1):
+    with open(path, "w") as f:
+        json.dump(chrome_trace(records, timers, num_shards), f)
+    return path
+
+
+def write_metrics(path: str, manifest: dict):
+    with open(path, "w") as f:
+        f.write(prometheus_text(metrics_from_manifest(manifest)))
+    return path
+
+
+def write_manifest(path: str, manifest: dict):
+    with open(path, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    return path

@@ -1,0 +1,140 @@
+"""Host-side telemetry: ring drains and wall-clock phase timers.
+
+The Harvester pulls the device ring (telemetry/ring.py) into plain
+Python records between device calls — after a whole-run program, or
+per window from a host-driven loop's on_window hook (the supervisor /
+pcap paths), i.e. "between supervisor checkpoints". Like the pcap
+drain (utils/pcap.py), it detects overruns from the monotonic write
+counter: count advancing more than `capacity` since the last drain
+means records were overwritten before the host saw them; the total is
+latched in `records_lost` and surfaced as a health warning
+(faults/health.py), never silently.
+
+PhaseTimers records named wall-clock spans (trace/compile, device
+execute, harvest, export) on the host timeline; export.chrome_trace
+draws them as per-shard wall-time tracks alongside the ring's
+sim-time track.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from shadow_tpu.telemetry.ring import PLANES
+
+
+@dataclass
+class WindowRecord:
+    """One harvested per-window record (host-side ints)."""
+
+    index: int        # monotonic window number (ring count at write)
+    wstart: int
+    wend: int
+    events: int
+    micro_steps: int
+    routed_local: int
+    routed_cross: int
+    drops: int
+    retx: int
+    qocc_min: int
+    qocc_max: int
+    qocc_sum: int
+
+
+@dataclass
+class Harvester:
+    """Incremental ring drain with overrun accounting."""
+
+    seen: int = 0                 # ring count at the last drain
+    records: list = field(default_factory=list)
+    records_lost: int = 0
+
+    def drain(self, sim) -> int:
+        """Pull records written since the last drain. Returns how many
+        were taken. Tolerates a count REWIND (the supervisor resumed
+        from an older checkpoint): already-harvested records past the
+        restored count are discarded so replayed windows are not
+        double-counted."""
+        ring = getattr(sim, "telem", None)
+        if ring is None:
+            return 0
+        c = int(np.asarray(ring.count))
+        if c < self.seen:
+            self.records = [r for r in self.records if r.index < c]
+            self.seen = c
+        new = c - self.seen
+        if new <= 0:
+            return 0
+        W = ring.capacity
+        lost = max(0, new - W)
+        self.records_lost += lost
+        take = min(new, W)
+        idx = np.arange(c - take, c)
+        slots = idx % W
+        planes = {name: np.asarray(getattr(ring, name))[slots]
+                  for name, _ in PLANES}
+        for k in range(take):
+            self.records.append(WindowRecord(
+                index=int(idx[k]),
+                **{name: int(planes[name][k]) for name, _ in PLANES}))
+        self.seen = c
+        return take
+
+    def summary(self) -> dict:
+        """Aggregates for the run manifest / bench line."""
+        evs = np.array([r.events for r in self.records], np.int64)
+        out = {
+            "windows_recorded": len(self.records),
+            "records_lost": self.records_lost,
+        }
+        if len(evs):
+            out["events_per_window"] = {
+                "p50": float(np.percentile(evs, 50)),
+                "p90": float(np.percentile(evs, 90)),
+                "p99": float(np.percentile(evs, 99)),
+                "mean": float(evs.mean()),
+            }
+            out["micro_steps_per_window_max"] = int(
+                max(r.micro_steps for r in self.records))
+            out["qocc_max"] = int(max(r.qocc_max for r in self.records))
+        return out
+
+
+@dataclass
+class Phase:
+    name: str
+    start_s: float     # offset from the timer origin
+    dur_s: float
+    shard: int | None  # None = applies to every shard
+
+
+class PhaseTimers:
+    """Named wall-clock spans on one origin, for the wall-time trace
+    tracks. `shard=None` spans are drawn on every shard's track (the
+    single-controller JAX host drives all shards through one
+    timeline)."""
+
+    def __init__(self):
+        self.t0 = time.perf_counter()
+        self.phases: list[Phase] = []
+
+    @contextmanager
+    def phase(self, name: str, shard: int | None = None):
+        s = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.phases.append(Phase(
+                name=name, start_s=s - self.t0,
+                dur_s=time.perf_counter() - s, shard=shard))
+
+    def totals(self) -> dict:
+        """phase name -> total seconds (merged over repeats)."""
+        out: dict = {}
+        for p in self.phases:
+            out[p.name] = out.get(p.name, 0.0) + p.dur_s
+        return out

@@ -152,13 +152,18 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
 
         fault_fn = _resolve_fault_fn(bundle, None)
 
+    from shadow_tpu.telemetry.ring import make_telem_fn
+
+    telem_fn = make_telem_fn()  # trace-time no-op when sim.telem is None
+
     @jax.jit
-    def one_window(sim, wend):
+    def one_window(sim, wstart, wend):
         stats = EngineStats.create()
         return step_window(sim, stats, step, wend,
                            emit_capacity=cfg.emit_capacity,
                            lane_id=sim.net.lane_id,
-                           fault_fn=fault_fn)
+                           fault_fn=fault_fn,
+                           telem_fn=telem_fn, wstart=wstart)
 
     total = EngineStats.create()
     saved = []
@@ -172,7 +177,7 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
         wend = min(wstart + min_jump, end + 1)
-        sim, stats, next_min = one_window(sim, wend)
+        sim, stats, next_min = one_window(sim, wstart, wend)
         total = EngineStats(
             events_processed=total.events_processed + stats.events_processed,
             micro_steps=total.micro_steps + stats.micro_steps,

@@ -39,11 +39,13 @@ class _Snap:
 
 
 def _snapshot(sim) -> _Snap:
+    from shadow_tpu.net.state import drop_total
+
     net = sim.net
-    drops = (np.asarray(net.ctr_drop_reliability)
-             + np.asarray(net.ctr_drop_codel)
-             + np.asarray(net.ctr_drop_nosocket)
-             + np.asarray(net.ctr_drop_bufferfull))
+    # the same all-classes drop definition the telemetry ring and the
+    # run manifest use (net.state.drop_total) — heartbeats, per-window
+    # records and final counters agree by construction
+    drops = np.asarray(drop_total(net))
     return _Snap(
         rx_bytes=np.asarray(net.ctr_rx_bytes).copy(),
         tx_bytes=np.asarray(net.ctr_tx_bytes).copy(),

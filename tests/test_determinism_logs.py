@@ -22,6 +22,45 @@ def _run_cli_capture():
     return out.getvalue()
 
 
+def test_tracker_heartbeat_shard_invariant():
+    """The heartbeat lines are formatted from per-host counter deltas;
+    since sharding is bit-identical in state (test_parallel), the
+    USER-VISIBLE heartbeat must be byte-identical between a 1-shard
+    and an 8-shard run of the same seed — no canonicalization pass."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import run
+    from shadow_tpu.parallel import run_sharded
+    from shadow_tpu.utils.shadowlog import LogLevel, SimLogger
+    from shadow_tpu.utils.tracker import Tracker
+    from test_parallel import _build, pingpong
+
+    def heartbeat_bytes(sim, host_names):
+        out = io.StringIO()
+        logger = SimLogger(LogLevel.MESSAGE, stream=out, buffered=False)
+        tr = Tracker(logger, host_names, interval_s=5)
+        tr.heartbeat(jax.device_get(sim), 5 * simtime.ONE_SECOND)
+        return out.getvalue()
+
+    b1 = _build()
+    sim1, _ = run(b1, app_handlers=(pingpong.handler,))
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    b8 = _build()
+    sim8, _ = run_sharded(b8, mesh, "hosts",
+                          app_handlers=(pingpong.handler,))
+
+    a = heartbeat_bytes(sim1, b1.host_names)
+    b = heartbeat_bytes(sim8, b8.host_names)
+    assert a == b
+    assert "[shadow-heartbeat] [node]" in a
+    assert "[shadow-heartbeat] [socket]" in a
+    # all buffers drained post-run, so only the ram header remains
+    assert "[shadow-heartbeat] [ram-header]" in a
+
+
 def test_two_runs_byte_identical_after_strip():
     st = load_tool("strip_log_for_compare")
     a = _run_cli_capture()

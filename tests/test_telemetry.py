@@ -1,0 +1,283 @@
+"""Device-resident window telemetry ring (telemetry/): the ring's
+records must agree with the engine's own counters, be bit-identical
+across shard counts (the observability analog of test_parallel's
+state determinism), survive checkpoint/resume, and degrade loudly —
+never silently — when the ring overruns. Export round-trips are
+linted with the same validator the CI gate uses (tools/
+telemetry_lint.py), so the trace the tests bless is the trace
+Perfetto accepts."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import load_tool
+from jax.sharding import Mesh
+
+from shadow_tpu import telemetry
+from shadow_tpu.apps import phold, pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import health as health_mod
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel import run_sharded
+from shadow_tpu.telemetry import ring as ring_mod
+from shadow_tpu.utils import checkpoint
+
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 8
+PORT = 7000
+
+# every field of a WindowRecord except the routing split, which is
+# mesh-dependent (its SUM is shard-invariant, checked separately)
+INVARIANT_FIELDS = ("index", "wstart", "wend", "events", "micro_steps",
+                    "drops", "retx", "qocc_min", "qocc_max", "qocc_sum")
+
+
+def _build(seed=1):
+    cfg = NetConfig(num_hosts=H, end_time=5 * simtime.ONE_SECOND, seed=seed)
+    hosts = [HostSpec(name=f"client{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H // 2)]
+    hosts += [HostSpec(name=f"server{i}") for i in range(H // 2)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    client = jnp.asarray(np.arange(H) < H // 2)
+    server = jnp.asarray(np.arange(H) >= H // 2)
+    server_ip = np.zeros(H, np.int64)
+    for i in range(H // 2):
+        server_ip[i] = b.ip_of(f"server{i}")
+    b.sim = pingpong.setup(b.sim, client_mask=client, server_mask=server,
+                           server_ip=jnp.asarray(server_ip),
+                           server_port=PORT, count=5, size=128)
+    return b
+
+
+@pytest.fixture(scope="module")
+def serial():
+    """Whole-device-program run with a ring attached, plus its
+    harvest."""
+    b = _build()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    sim, stats = run(b, app_handlers=(pingpong.handler,))
+    sim, stats = jax.device_get((sim, stats))
+    h = telemetry.Harvester()
+    h.drain(sim)
+    return b, sim, stats, h
+
+
+def test_ring_records_match_engine_stats(serial):
+    _, sim, stats, h = serial
+    recs = h.records
+    assert len(recs) == int(stats.windows)
+    assert h.records_lost == 0
+    # the per-window event counts are a partition of the engine total
+    assert sum(r.events for r in recs) == int(stats.events_processed)
+    assert max(r.micro_steps for r in recs) <= int(stats.micro_steps)
+    # window bounds advance monotonically and never overlap
+    for a, b_ in zip(recs, recs[1:]):
+        assert a.wend <= b_.wstart
+        assert b_.index == a.index + 1
+    for r in recs:
+        assert r.wstart < r.wend
+        assert r.qocc_min <= r.qocc_max
+        # on one shard every routed packet is local
+        assert r.routed_cross == 0
+
+
+def test_records_bit_identical_across_shard_counts(serial):
+    _, _, stats1, h1 = serial
+    b = _build()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sim2, stats2 = run_sharded(b, mesh, "hosts",
+                               app_handlers=(pingpong.handler,))
+    h2 = telemetry.Harvester()
+    h2.drain(jax.device_get(sim2))
+    assert len(h1.records) == len(h2.records) == int(stats2.windows)
+    for r1, r2 in zip(h1.records, h2.records):
+        for f in INVARIANT_FIELDS:
+            assert getattr(r1, f) == getattr(r2, f), \
+                f"window {r1.index}: {f} differs across shard counts"
+        # the local/cross split depends on the mesh; the total doesn't
+        assert (r1.routed_local + r1.routed_cross
+                == r2.routed_local + r2.routed_cross), r1.index
+    # 8 hosts on 8 shards: every pingpong packet crosses a shard
+    assert sum(r.routed_cross for r in h2.records) > 0
+
+
+def test_export_roundtrip_passes_lint(serial, tmp_path):
+    b, sim, stats, h = serial
+    timers = telemetry.PhaseTimers()
+    with timers.phase("device-execute"):
+        pass
+    trace = telemetry.chrome_trace(h.records, timers=timers, num_shards=1)
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, timers=timers,
+                                 wall_seconds=1.0)
+    lint = load_tool("telemetry_lint")
+    errs, _ = lint.lint_trace_obj(trace)
+    assert errs == []
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert warns == []   # no overrun -> nothing to warn about
+    assert man["counters"]["windows"] == len(h.records)
+    assert man["telemetry"]["windows_recorded"] == len(h.records)
+    assert man["health"]["verdict"] == "clean"
+    # the files the CLI writes lint clean through the CLI entrypoint
+    tp, mp = str(tmp_path / "t.json"), str(tmp_path / "m.json")
+    telemetry.write_trace(tp, h.records, timers, 1)
+    telemetry.write_manifest(mp, man)
+    assert lint.main(["--trace", tp, "--manifest", mp, "-q"]) == 0
+    # and trace_view renders a summary from them without a manifest
+    tv = load_tool("trace_view")
+    out = tv.summarize(trace, man)
+    assert f"{len(h.records)} windows" in out
+    assert "events/window p50=" in out
+    # prometheus text: every manifest counter appears once
+    prom = telemetry.prometheus_text(man["counters"])
+    assert "shadow_tpu_windows" in prom
+
+
+def test_telemetry_off_runs_unchanged(serial):
+    """A run without a ring is bit-identical in simulation state to
+    the run with one — recording is observation, not perturbation."""
+    _, sim_t, stats_t, _ = serial
+    b = _build()
+    assert b.sim.telem is None
+    sim0, stats0 = jax.device_get(run(b, app_handlers=(pingpong.handler,)))
+    assert int(stats0.events_processed) == int(stats_t.events_processed)
+    assert int(stats0.windows) == int(stats_t.windows)
+    np.testing.assert_array_equal(np.asarray(sim0.net.ctr_rx_bytes),
+                                  np.asarray(sim_t.net.ctr_rx_bytes))
+    np.testing.assert_array_equal(np.asarray(sim0.net.rng_ctr),
+                                  np.asarray(sim_t.net.rng_ctr))
+    np.testing.assert_array_equal(np.asarray(sim0.app.rtt_sum),
+                                  np.asarray(sim_t.app.rtt_sum))
+
+
+def test_attach_is_idempotent_and_validates():
+    b = _build()
+    s1 = telemetry.attach(b.sim, capacity=32)
+    assert s1.telem.capacity == 32
+    s2 = telemetry.attach(s1, capacity=64)   # already attached: no-op
+    assert s2 is s1
+    with pytest.raises(ValueError):
+        ring_mod.TelemetryRing.create(0)
+
+
+def test_overflow_latches_as_health_warning(serial):
+    """Writing past capacity between drains must surface as
+    records_lost -> health warning -> manifest lint warning, and must
+    never corrupt the surviving (newest) records or flip fatal."""
+    _, sim, stats, _ = serial
+    ring = ring_mod.TelemetryRing.create(4)
+    for i in range(10):
+        ring = ring_mod._record(ring, {
+            "wstart": i * 100, "wend": i * 100 + 100, "events": i,
+            "micro_steps": 1, "routed_local": 0, "routed_cross": 0,
+            "drops": 0, "retx": 0, "qocc_min": 0, "qocc_max": 1,
+            "qocc_sum": 1})
+    h = telemetry.Harvester()
+    taken = h.drain(sim.replace(telem=ring))
+    assert taken == 4                       # only the ring's worth
+    assert h.records_lost == 6              # 10 written - 4 kept
+    assert [r.index for r in h.records] == [6, 7, 8, 9]
+    assert [r.events for r in h.records] == [6, 7, 8, 9]
+    rh = health_mod.gather(sim, telemetry_lost=h.records_lost)
+    assert not rh.fatal                     # observability loss only
+    sev = dict((m, s) for s, m in rh.diagnostics())
+    overran = [m for m in sev if "telemetry ring overran" in m]
+    assert overran and sev[overran[0]] == "warning"
+    # the manifest carries the latch, so lint warns instead of erroring
+    man = telemetry.run_manifest(cfg=_build().cfg, seed=1, shards=1,
+                                 sim=sim, stats=stats, health=rh,
+                                 harvester=h)
+    lint = load_tool("telemetry_lint")
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert any("lost to ring overrun" in w for w in warns)
+    # ...but a manifest that DROPS the health latch is an error
+    man_bad = dict(man, health={"diagnostics": [], "telemetry_lost": 0})
+    errs, _ = lint.lint_manifest_obj(man_bad)
+    assert any("does not surface" in e for e in errs)
+
+
+def test_harvester_rewind_discards_replayed_windows(serial):
+    """Supervisor resume rewinds the ring count; already-harvested
+    records past the restored count must be dropped so replayed
+    windows are not double-counted."""
+    _, sim, _, _ = serial
+    ring = ring_mod.TelemetryRing.create(8)
+    for i in range(6):
+        ring = ring_mod._record(ring, {"wstart": i, "wend": i + 1,
+                                       "events": i})
+    h = telemetry.Harvester()
+    h.drain(sim.replace(telem=ring))
+    assert [r.index for r in h.records] == [0, 1, 2, 3, 4, 5]
+    # "restore" a checkpoint taken at count=3, then replay two windows
+    rewound = ring.replace(count=jnp.asarray(3, jnp.int64))
+    for i in range(3, 5):
+        rewound = ring_mod._record(rewound, {"wstart": i, "wend": i + 1,
+                                             "events": i})
+    h.drain(sim.replace(telem=rewound))
+    assert [r.index for r in h.records] == [0, 1, 2, 3, 4]
+    assert h.records_lost == 0
+
+
+def _phold_bundle(seed=7):
+    H16, load = 16, 4
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H16, tcp=False,
+                    end_time=2 * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H16)]
+    b = build(cfg, ONE_VERTEX.replace("10240", "102400"), hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    b.sim = telemetry.attach(b.sim, capacity=64)
+    return b
+
+
+@pytest.mark.slow
+def test_checkpoint_resume_preserves_ring(tmp_path):
+    """The ring rides the checkpoint pytree: a split run's final ring
+    is bit-identical to the straight run's (and so is its harvest)."""
+    sim_a, stats_a, _ = checkpoint.run_windows(
+        _phold_bundle(), app_handlers=(phold.handler,))
+
+    b2 = _phold_bundle()
+    ck = str(tmp_path / "snap")
+    _, _, saved = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), end_time=simtime.ONE_SECOND,
+        checkpoint_every_ns=simtime.ONE_SECOND, checkpoint_path=ck)
+    assert saved
+    path, t_ck = saved[-1]
+    b3 = _phold_bundle()
+    sim_r, t_resume, _ = checkpoint.load(path, b3.sim)
+    assert int(np.asarray(sim_r.telem.count)) > 0   # ring was saved
+    sim_b, stats_b, _ = checkpoint.run_windows(
+        b3, app_handlers=(phold.handler,), sim=sim_r,
+        start_time=t_resume)
+
+    # stats_b counts only post-resume windows; the ring is cumulative
+    # state, so its count must be the straight run's full total
+    assert int(np.asarray(sim_b.telem.count)) \
+        == int(np.asarray(sim_a.telem.count)) == int(stats_a.windows)
+    ha, hb = telemetry.Harvester(), telemetry.Harvester()
+    ha.drain(jax.device_get(sim_a))
+    hb.drain(jax.device_get(sim_b))
+    assert ha.records == hb.records
+    for name, _ in ring_mod.PLANES:
+        np.testing.assert_array_equal(
+            np.asarray(getattr(sim_a.telem, name)),
+            np.asarray(getattr(sim_b.telem, name)), err_msg=name)

@@ -196,3 +196,112 @@ def test_plot_shadow_multi_experiment(tmp_path):
     # x3 (6) + retransmitted segments x3 + RAM x3 + 3 CDFs +
     # progress + rate bars = 44+
     assert int(m.group(1)) >= 40, int(m.group(1))
+
+
+# ---- telemetry_lint (tools/telemetry_lint.py) -----------------------
+
+GOOD_TRACE = {
+    "traceEvents": [
+        {"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
+         "args": {"name": "sim-time"}},
+        {"ph": "X", "pid": 0, "tid": 0, "name": "window 0",
+         "ts": 0.0, "dur": 50000.0,
+         "args": {"events": 4, "micro_steps": 2, "routed_local": 4,
+                  "routed_cross": 0, "drops": 0, "retx": 0,
+                  "queue_occupancy": {"min": 0, "max": 2, "sum": 3}}},
+        {"ph": "X", "pid": 0, "tid": 0, "name": "window 1",
+         "ts": 50000.0, "dur": 50000.0,
+         "args": {"events": 2, "micro_steps": 1, "routed_local": 2,
+                  "routed_cross": 0, "drops": 0, "retx": 0,
+                  "queue_occupancy": {"min": 0, "max": 1, "sum": 1}}},
+    ],
+    "displayTimeUnit": "ms",
+}
+
+GOOD_MANIFEST = {
+    "config_hash": "ab" * 32, "seed": 1, "shards": 1,
+    "counters": {"windows": 2, "events_processed": 6},
+    "telemetry": {"windows_recorded": 2, "records_lost": 0},
+    "health": {"verdict": "clean", "diagnostics": [],
+               "telemetry_lost": 0},
+}
+
+
+def _copy(obj):
+    import copy
+
+    return copy.deepcopy(obj)
+
+
+def test_telemetry_lint_accepts_good_outputs():
+    tl = _load("telemetry_lint")
+    assert tl.lint_trace_obj(GOOD_TRACE) == ([], [])
+    assert tl.lint_manifest_obj(GOOD_MANIFEST) == ([], [])
+
+
+def test_telemetry_lint_rejects_schema_violations():
+    tl = _load("telemetry_lint")
+    # bare array: Perfetto needs the object format to be emitted here
+    errs, _ = tl.lint_trace_obj([])
+    assert errs
+    # every event needs a phase
+    t = _copy(GOOD_TRACE)
+    del t["traceEvents"][1]["ph"]
+    errs, _ = tl.lint_trace_obj(t)
+    assert any('"ph"' in e for e in errs)
+    # zero-duration complete events render invisibly
+    t = _copy(GOOD_TRACE)
+    t["traceEvents"][1]["dur"] = 0
+    errs, _ = tl.lint_trace_obj(t)
+    assert any("dur" in e for e in errs)
+    # negative counters can't come out of a correct exporter
+    t = _copy(GOOD_TRACE)
+    t["traceEvents"][1]["args"]["events"] = -1
+    errs, _ = tl.lint_trace_obj(t)
+    assert any("args.events" in e for e in errs)
+    # impossible occupancy bounds
+    t = _copy(GOOD_TRACE)
+    t["traceEvents"][1]["args"]["queue_occupancy"] = {"min": 5, "max": 1}
+    errs, _ = tl.lint_trace_obj(t)
+    assert any("min > max" in e for e in errs)
+
+
+def test_telemetry_lint_overlap_is_warning_not_error():
+    tl = _load("telemetry_lint")
+    t = _copy(GOOD_TRACE)
+    t["traceEvents"][2]["ts"] = 10000.0   # starts inside window 0
+    errs, warns = tl.lint_trace_obj(t)
+    assert errs == []
+    assert any("before the previous window ended" in w for w in warns)
+
+
+def test_telemetry_lint_unsurfaced_ring_loss_is_error():
+    tl = _load("telemetry_lint")
+    m = _copy(GOOD_MANIFEST)
+    m["telemetry"]["records_lost"] = 3
+    m["counters"]["windows"] = 5      # 2 recorded + 3 lost
+    errs, _ = tl.lint_manifest_obj(m)
+    assert any("does not surface" in e for e in errs)
+    # latched in health -> warning, not error
+    m["health"]["telemetry_lost"] = 3
+    errs, warns = tl.lint_manifest_obj(m)
+    assert errs == []
+    assert any("ring overrun" in w for w in warns)
+    # more windows accounted for than the engine ran
+    m2 = _copy(GOOD_MANIFEST)
+    m2["telemetry"]["windows_recorded"] = 9
+    errs, _ = tl.lint_manifest_obj(m2)
+    assert any("engine ran only" in e for e in errs)
+
+
+def test_telemetry_lint_cli_exit_codes(tmp_path):
+    import json
+
+    tl = _load("telemetry_lint")
+    good = tmp_path / "good.json"
+    good.write_text(json.dumps(GOOD_TRACE))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"traceEvents": [{"pid": 0}]}))
+    assert tl.main(["--trace", str(good), "-q"]) == 0
+    assert tl.main(["--trace", str(bad), "-q"]) == 1
+    assert tl.main(["--trace", str(tmp_path / "missing.json"), "-q"]) == 1

@@ -6,6 +6,12 @@ heartbeat lines and sim-vs-wall progress ticks, emit
 stats.shadow.json.
 
 Usage: parse_shadow.py shadow.log [-o stats.shadow.json]
+       [-m run_manifest.json]
+
+-m merges the run manifest the CLI writes next to its trace
+(telemetry/export.py run_manifest) into the stats under "manifest",
+so plot_shadow.py can add the windows/sec and events/window pages
+without re-reading the log.
 """
 
 from __future__ import annotations
@@ -108,13 +114,23 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("log")
     ap.add_argument("-o", "--output", default="stats.shadow.json")
+    ap.add_argument("-m", "--manifest", default=None,
+                    help="run_manifest.json to merge (written by the "
+                         "CLI's telemetry exporter into "
+                         "<data-directory>/)")
     args = ap.parse_args(argv)
     with _open(args.log) as f:
         stats = parse(f)
+    extra = ""
+    if args.manifest:
+        with open(args.manifest) as f:
+            stats["manifest"] = json.load(f)
+        extra = (f", manifest with "
+                 f"{len(stats['manifest'].get('counters', {}))} counters")
     with open(args.output, "w") as f:
         json.dump(stats, f, indent=1)
     print(f"wrote {args.output}: {len(stats['nodes'])} nodes, "
-          f"{len(stats['ticks'])} ticks")
+          f"{len(stats['ticks'])} ticks{extra}")
     return 0
 
 

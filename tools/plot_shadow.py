@@ -260,6 +260,63 @@ def main(argv=None) -> int:
         pdf.savefig(fig)
         plt.close(fig)
 
+        # -- manifest pages (parse_shadow.py -m run_manifest.json) -----
+        # engine-rate views from the telemetry run manifest: windows
+        # per wall-second and events per window
+        def manifest_bar(title, ylabel, value_fn):
+            labels, vals = [], []
+            for label, stats in experiments:
+                man = stats.get("manifest")
+                if not man:
+                    continue
+                v = value_fn(man)
+                if v is not None:
+                    labels.append(label)
+                    vals.append(v)
+            if not labels:
+                return
+            fig, ax = _new_page(plt, title)
+            ax.bar(labels, vals, alpha=0.7)
+            ax.set_ylabel(ylabel)
+            pdf.savefig(fig)
+            plt.close(fig)
+
+        def _windows_per_sec(man):
+            w = man.get("counters", {}).get("windows")
+            wall = man.get("wall_seconds")
+            return w / wall if w and wall else None
+
+        def _events_per_window(man):
+            epw = man.get("telemetry", {}).get("events_per_window")
+            if epw:
+                return epw.get("mean")
+            c = man.get("counters", {})
+            if c.get("windows"):
+                return c.get("events_processed", 0) / c["windows"]
+            return None
+
+        manifest_bar("windows per wall-second", "windows/s",
+                     _windows_per_sec)
+        manifest_bar("events per window (mean)", "events/window",
+                     _events_per_window)
+
+        # events-per-window percentile spread across experiments
+        fig, ax = _new_page(plt, "events per window (percentiles)")
+        any_pct = False
+        for label, stats in experiments:
+            epw = (stats.get("manifest") or {}).get(
+                "telemetry", {}).get("events_per_window")
+            if epw:
+                ks = [k for k in ("p50", "p90", "p99") if k in epw]
+                ax.plot(ks, [epw[k] for k in ks], marker="o",
+                        label=label)
+                any_pct = True
+        if any_pct:
+            ax.set_ylabel("events/window")
+            ax.legend(fontsize=8)
+            pdf.savefig(fig)
+        plt.close(fig)
+
     print(f"wrote {out}")
     return 0
 

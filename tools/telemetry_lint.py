@@ -1,0 +1,196 @@
+#!/usr/bin/env python3
+"""Offline telemetry-output validator — CI gate for the trace /
+manifest files the CLI and bench emit, so a malformed export is caught
+by the test suite instead of by a blank Perfetto tab.
+
+Checks:
+
+- Trace JSON (--trace): Chrome Trace Event Format schema — top-level
+  {"traceEvents": [...]}; every event carries "ph"; "X" (complete)
+  events carry numeric ts/dur with dur > 0 and int pid/tid; "M"
+  (metadata) events carry the known metadata names; window events'
+  args hold the per-window counters with sane values (events >= 0,
+  qocc_min <= qocc_max); sim-time windows are sorted by ts and
+  non-overlapping (warns otherwise — a ring overrun leaves gaps,
+  which are legal).
+- Manifest JSON (--manifest): required identity keys present
+  (config_hash, seed, shards, counters); the telemetry block's
+  records_lost is SURFACED — a nonzero loss count without a matching
+  health warning in the manifest is an error (silent observability
+  loss is exactly what the latch design forbids).
+
+Usage: telemetry_lint.py [--trace trace.json]
+                         [--manifest run_manifest.json]
+Exit 0 = clean (warnings allowed), 1 = errors.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# metadata record names Chrome/Perfetto understand (trace event format
+# spec §Metadata Events) — anything else is silently ignored by the
+# viewers, which usually means a typo here
+KNOWN_METADATA = {
+    "process_name", "process_labels", "process_sort_index",
+    "thread_name", "thread_sort_index",
+}
+WINDOW_ARGS = ("events", "micro_steps", "routed_local", "routed_cross",
+               "drops", "retx")
+
+
+def lint_trace_obj(obj) -> tuple[list, list]:
+    """(errors, warnings) for a parsed Chrome-trace object."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(obj, dict) or "traceEvents" not in obj:
+        return (['top level must be an object with "traceEvents" '
+                 '(the JSON Object Format; Perfetto rejects bare '
+                 'arrays with displayTimeUnit)'], [])
+    evs = obj["traceEvents"]
+    if not isinstance(evs, list):
+        return (['"traceEvents" must be an array'], [])
+    windows = []
+    for i, e in enumerate(evs):
+        where = f"traceEvents[{i}]"
+        if not isinstance(e, dict) or "ph" not in e:
+            errors.append(f'{where}: every event needs a "ph" phase')
+            continue
+        ph = e["ph"]
+        if ph == "M":
+            if e.get("name") not in KNOWN_METADATA:
+                warnings.append(
+                    f'{where}: metadata name {e.get("name")!r} is not '
+                    f'one the viewers understand ({sorted(KNOWN_METADATA)})')
+            continue
+        if ph != "X":
+            warnings.append(f'{where}: unexpected phase {ph!r} (the '
+                            f'exporter only emits "X" and "M")')
+            continue
+        for k in ("ts", "dur"):
+            if not isinstance(e.get(k), (int, float)):
+                errors.append(f'{where}: "X" event needs numeric {k}')
+        for k in ("pid", "tid"):
+            if not isinstance(e.get(k), int):
+                errors.append(f'{where}: "X" event needs integer {k}')
+        if isinstance(e.get("dur"), (int, float)) and e["dur"] <= 0:
+            errors.append(f'{where}: dur must be > 0 (zero-duration '
+                          f'complete events render invisibly)')
+        if e.get("pid") == 0 and isinstance(e.get("args"), dict):
+            a = e["args"]
+            for k in WINDOW_ARGS:
+                if k in a and (not isinstance(a[k], int) or a[k] < 0):
+                    errors.append(f"{where}: args.{k} must be a "
+                                  f"non-negative integer")
+            q = a.get("queue_occupancy")
+            if isinstance(q, dict) and (
+                    q.get("min", 0) > q.get("max", 0)):
+                errors.append(f"{where}: queue_occupancy min > max")
+            if isinstance(e.get("ts"), (int, float)):
+                windows.append((e["ts"], e.get("dur", 0), i))
+    # window ordering: the harvester emits records in ring order, so
+    # an unsorted sim-time track means export corruption; gaps are
+    # legal (ring overrun drops whole records, latched elsewhere)
+    last_end = None
+    for ts, dur, i in windows:
+        if last_end is not None and ts < last_end:
+            warnings.append(
+                f"traceEvents[{i}]: sim-time window at ts={ts} starts "
+                f"before the previous window ended ({last_end}) — "
+                f"overlapping windows (supervisor replay after a "
+                f"resume can legally do this; otherwise suspect)")
+        last_end = ts + dur
+    if not windows:
+        warnings.append("no sim-time window events (pid 0) — empty "
+                        "run or telemetry was off")
+    return errors, warnings
+
+
+def lint_manifest_obj(man) -> tuple[list, list]:
+    """(errors, warnings) for a parsed run_manifest.json."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(man, dict):
+        return (["manifest must be a JSON object"], [])
+    for k in ("config_hash", "seed", "shards", "counters"):
+        if k not in man:
+            errors.append(f'manifest missing "{k}"')
+    tel = man.get("telemetry")
+    if not isinstance(tel, dict):
+        errors.append('manifest missing the "telemetry" block')
+        return errors, warnings
+    lost = tel.get("records_lost", 0)
+    if lost:
+        # the loss MUST be surfaced: either the health block carries
+        # the latch or a diagnostic names it — never a silent integer
+        health = man.get("health", {})
+        latched = health.get("telemetry_lost", 0) == lost or any(
+            "telemetry ring overran" in d
+            for d in health.get("diagnostics", []))
+        if not latched:
+            errors.append(
+                f"telemetry.records_lost={lost} but the health block "
+                f"does not surface it — ring overruns must be latched "
+                f"(faults/health.py), never silent")
+        else:
+            warnings.append(
+                f"{lost} telemetry record(s) lost to ring overrun "
+                f"(latched in health; trace has gaps)")
+    rec = tel.get("windows_recorded", 0)
+    cw = man.get("counters", {}).get("windows")
+    if cw is not None and rec + lost > cw:
+        errors.append(
+            f"telemetry accounts for {rec}+{lost} windows but the "
+            f"engine ran only {cw}")
+    return errors, warnings
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="validate telemetry exports (Chrome-trace JSON "
+                    "and/or run manifest)")
+    ap.add_argument("--trace", default=None, help="trace JSON path")
+    ap.add_argument("--manifest", default=None,
+                    help="run_manifest.json path")
+    ap.add_argument("-q", "--quiet", action="store_true",
+                    help="suppress warnings, print errors only")
+    args = ap.parse_args(argv)
+    if not args.trace and not args.manifest:
+        ap.error("give --trace and/or --manifest")
+
+    errors: list = []
+    warnings: list = []
+    for path, lint in ((args.trace, lint_trace_obj),
+                       (args.manifest, lint_manifest_obj)):
+        if not path:
+            continue
+        try:
+            with open(path) as f:
+                obj = json.load(f)
+        except (OSError, ValueError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        e2, w2 = lint(obj)
+        errors += [f"{path}: {m}" for m in e2]
+        warnings += [f"{path}: {m}" for m in w2]
+
+    for e in errors:
+        print(f"ERROR: {e}", file=sys.stderr)
+    if not args.quiet:
+        for w in warnings:
+            print(f"WARNING: {w}", file=sys.stderr)
+    if errors:
+        print(f"{len(errors)} error(s), {len(warnings)} warning(s)",
+              file=sys.stderr)
+        return 1
+    print(f"OK ({len(warnings)} warning(s))", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

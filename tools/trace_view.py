@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Terminal summary of a telemetry trace / run manifest — the quick
+look before (or instead of) loading the JSON into Perfetto.
+
+Prints, from the trace's sim-time track: window count, sim-time span,
+events/window and micro-steps/window percentiles, total routed
+local/cross split, drops, retransmits, and a coarse events-per-window
+sparkline; from the wall-time tracks: total seconds per phase
+(trace/compile vs device execute vs harvest/export). With a manifest,
+adds the run identity line (config hash, seed, shards, health
+verdict).
+
+Usage: trace_view.py trace.json [--manifest run_manifest.json]
+       [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+SPARK = "▁▂▃▄▅▆▇█"
+
+
+def _pct(vals, q):
+    if not vals:
+        return 0.0
+    vs = sorted(vals)
+    i = min(len(vs) - 1, max(0, round(q / 100 * (len(vs) - 1))))
+    return vs[i]
+
+
+def sparkline(vals, width: int = 60) -> str:
+    if not vals:
+        return ""
+    # bucket to `width` columns, max per bucket (spikes must survive)
+    n = len(vals)
+    cols = []
+    for c in range(min(width, n)):
+        lo = c * n // min(width, n)
+        hi = max(lo + 1, (c + 1) * n // min(width, n))
+        cols.append(max(vals[lo:hi]))
+    top = max(cols) or 1
+    return "".join(SPARK[min(len(SPARK) - 1,
+                             int(v / top * (len(SPARK) - 1)))]
+                   for v in cols)
+
+
+def summarize(trace: dict, manifest: dict | None = None,
+              top: int = 5) -> str:
+    lines = []
+    evs = trace.get("traceEvents", [])
+    wins = [e for e in evs if e.get("ph") == "X" and e.get("pid") == 0]
+    phases = [e for e in evs if e.get("ph") == "X" and e.get("pid") == 1]
+    if manifest:
+        h = manifest.get("health", {})
+        lines.append(
+            f"run {manifest.get('config_hash', '?')[:12]} seed="
+            f"{manifest.get('seed')} shards={manifest.get('shards')} "
+            f"hosts={manifest.get('num_hosts')} "
+            f"verdict={h.get('verdict', 'n/a')}")
+    if wins:
+        t0 = min(e["ts"] for e in wins)
+        t1 = max(e["ts"] + e.get("dur", 0) for e in wins)
+        ev = [e.get("args", {}).get("events", 0) for e in wins]
+        ms = [e.get("args", {}).get("micro_steps", 0) for e in wins]
+        lines.append(
+            f"{len(wins)} windows over {(t1 - t0) / 1e6:.3f} sim-s "
+            f"({t0 / 1e6:.3f} .. {t1 / 1e6:.3f})")
+        lines.append(
+            f"events/window p50={_pct(ev, 50)} p90={_pct(ev, 90)} "
+            f"p99={_pct(ev, 99)} max={max(ev)}  "
+            f"micro-steps/window max={max(ms)}")
+        args_sum = {}
+        for k in ("routed_local", "routed_cross", "drops", "retx"):
+            args_sum[k] = sum(e.get("args", {}).get(k, 0) for e in wins)
+        lines.append(
+            f"routed local={args_sum['routed_local']} "
+            f"cross={args_sum['routed_cross']} "
+            f"drops={args_sum['drops']} retx={args_sum['retx']}")
+        lines.append("events/window " + sparkline(ev))
+        busiest = sorted(wins, key=lambda e: -e.get("args", {})
+                         .get("events", 0))[:top]
+        for e in busiest:
+            a = e.get("args", {})
+            lines.append(
+                f"  busiest: {e.get('name', '?')} ts={e['ts']:.0f}µs "
+                f"events={a.get('events')} "
+                f"micro_steps={a.get('micro_steps')} "
+                f"qocc_max={a.get('queue_occupancy', {}).get('max')}")
+    else:
+        lines.append("no sim-time window events in trace")
+    if phases:
+        totals: dict = {}
+        for e in phases:
+            # shard=None spans are duplicated per shard tid; count a
+            # span once per name+ts so the total is wall time, not
+            # wall time x shards
+            key = (e.get("name"), e.get("ts"))
+            totals.setdefault(key, e.get("dur", 0))
+        by_name: dict = {}
+        for (name, _), dur in totals.items():
+            by_name[name] = by_name.get(name, 0.0) + dur
+        lines.append("wall phases: " + "  ".join(
+            f"{k}={v / 1e6:.3f}s" for k, v in sorted(by_name.items())))
+    if manifest:
+        tel = manifest.get("telemetry", {})
+        if tel.get("records_lost"):
+            lines.append(f"WARNING: {tel['records_lost']} window "
+                         f"record(s) lost to ring overrun — trace has "
+                         f"gaps")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="summarize a shadow-tpu telemetry trace")
+    ap.add_argument("trace", help="Chrome-trace JSON (--trace-out)")
+    ap.add_argument("--manifest", default=None,
+                    help="run_manifest.json for the identity line")
+    ap.add_argument("--top", type=int, default=5,
+                    help="busiest windows to list")
+    args = ap.parse_args(argv)
+    with open(args.trace) as f:
+        trace = json.load(f)
+    manifest = None
+    if args.manifest:
+        with open(args.manifest) as f:
+            manifest = json.load(f)
+    print(summarize(trace, manifest, top=args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
