@@ -27,6 +27,17 @@ rate measures raw engine throughput. Env knobs:
                                   ring rides the timed program, so
                                   on-vs-off is the honest overhead
                                   comparison — acceptance: <2%)
+  BENCH_ACTIVE=N                  sparse PHOLD shape: only the first N
+                                  hosts inject load (phold.setup
+                                  active_hosts) — the census/compaction
+                                  benchmark geometry. Disables the bulk
+                                  pass (bulk consumes whole windows
+                                  before the fixpoint, which would
+                                  starve the fast path being measured).
+  BENCH_SPARSE_LANES=S            compact-lane budget (cfg.sparse_lanes;
+                                  unset = engine default 256, 0 =
+                                  fast path off — the A/B lever for
+                                  the sparse-window speedup claim)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
@@ -97,7 +108,9 @@ def ref_topology_text() -> str:
 
 def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
                  cap: int | None = None, graph: str | None = None,
-                 replica_size: int | None = None, fault_records=None):
+                 replica_size: int | None = None, fault_records=None,
+                 active_hosts: int | None = None,
+                 sparse_lanes: int | None = None):
     from shadow_tpu.apps import phold
     from shadow_tpu.core import simtime
     from shadow_tpu.net.build import HostSpec, build
@@ -115,10 +128,12 @@ def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
     cfg = NetConfig(num_hosts=H, tcp=False,
                     end_time=sim_s * simtime.ONE_SECOND, seed=seed,
                     event_capacity=cap, outbox_capacity=cap,
-                    router_ring=cap, in_ring=max(16, 2 * load))
+                    router_ring=cap, in_ring=max(16, 2 * load),
+                    sparse_lanes=sparse_lanes)
     hosts = [HostSpec(name=f"peer{i}", proc_start_time=0) for i in range(H)]
     b = build(cfg, graph or ONE_VERTEX, hosts)
-    b.sim = phold.setup(b.sim, load=load, replica_size=replica_size)
+    b.sim = phold.setup(b.sim, load=load, replica_size=replica_size,
+                        active_hosts=active_hosts)
     if fault_records:
         # degraded-network scenario: the plan rides the bundle, so the
         # same runner factories apply it on 1 shard and N shards alike
@@ -143,17 +158,19 @@ def make_shard_aware_runner(b, shards: int, **kw):
     return make_runner(b, **kw)
 
 
-def _make_phold_fn(b, shards: int):
+def _make_phold_fn(b, shards: int, use_bulk: bool = True):
     from shadow_tpu.apps import phold
 
-    return make_shard_aware_runner(b, shards,
-                                   app_handlers=(phold.handler,),
-                                   app_bulk=phold.BULK)
+    return make_shard_aware_runner(
+        b, shards, app_handlers=(phold.handler,),
+        app_bulk=phold.BULK if use_bulk else None)
 
 
 def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   graph: str | None = None,
-                  replica_size: int | None = None, fault_records=None):
+                  replica_size: int | None = None, fault_records=None,
+                  active_hosts: int | None = None,
+                  sparse_lanes: int | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -170,13 +187,14 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
 
     def build_at(cap):
         b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
-                         fault_records)
+                         fault_records, active_hosts, sparse_lanes)
         # pre-build distinct-seed inputs so the timed call measures
         # only the device program, not host-side setup (each carries
         # its own seeded fault wakeups)
         sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap,
                                        graph, replica_size,
-                                       fault_records).sim
+                                       fault_records, active_hosts,
+                                       sparse_lanes).sim
                           for i in (1, 2)]
         if telem_on:
             # ring attached to the TIMED inputs, on purpose: the
@@ -186,7 +204,10 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
 
             sims = [telemetry.attach(s) for s in sims]
             b.sim = sims[0]
-        fn = _make_phold_fn(b, shards)
+        # sparse shape: bulk would consume whole windows before the
+        # fixpoint ever ran, starving the compaction fast path the
+        # shape exists to exercise
+        fn = _make_phold_fn(b, shards, use_bulk=active_hosts is None)
         for s in sims:
             jax.block_until_ready(s.net.rng_keys)
         state.update(cap=cap, fn=fn, sims=sims, bundle=b)
@@ -257,6 +278,23 @@ def enable_compile_cache() -> None:
     from shadow_tpu.utils.compcache import enable_compile_cache as go
 
     go()
+
+
+def _cache_files() -> set | None:
+    """Recursive file-set snapshot of the persistent compile cache
+    (None = cache disabled or the directory does not exist yet). The
+    fresh-vs-cached call is a before/after diff: new files appeared
+    during the warm call means XLA actually compiled and wrote an
+    executable; an unchanged set means the call was served from the
+    cache (load+execute only)."""
+    d = jax.config.jax_compilation_cache_dir
+    if not d or not os.path.isdir(d):
+        return None
+    out = set()
+    for root, _, files in os.walk(d):
+        for f in files:
+            out.add(os.path.join(root, f))
+    return out
 
 
 def _probe_backend(tries: int = 3, timeout_s: int = 0) -> int:
@@ -370,14 +408,24 @@ def main(argv=None) -> None:
     # events/s per chip, the honest per-chip throughput for the
     # seed-ensemble use case.
     replicas = int(os.environ.get("BENCH_REPLICAS", "1"))
+    active = os.environ.get("BENCH_ACTIVE")
+    active = int(active) if active else None
+    sparse = os.environ.get("BENCH_SPARSE_LANES")
+    sparse = int(sparse) if sparse is not None else None
     if workload == "phold":
+        if active is not None and replicas > 1:
+            raise SystemExit("BENCH_ACTIVE and BENCH_REPLICAS are "
+                             "mutually exclusive PHOLD shapes")
         runner = _phold_runner(H * replicas, load, sim_s, shards=_SHARDS,
                                graph=graph,
                                replica_size=H if replicas > 1 else None,
-                               fault_records=fault_records)
+                               fault_records=fault_records,
+                               active_hosts=active, sparse_lanes=sparse)
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
         if replicas > 1:
             name += f"_x{replicas}replicas"
+        if active is not None:
+            name += f"_active{active}"
     else:
         if fault_records:
             raise SystemExit(
@@ -396,7 +444,18 @@ def main(argv=None) -> None:
     if _SHARDS > 1:
         name += f"_{_SHARDS}shards"
 
-    runner()                      # compile + warm (may escalate capacity)
+    # compile + warm (may escalate capacity). Timed + cache-diffed:
+    # compile_s is the wall cost of the first device call, and the
+    # cache file-set diff says whether it truly compiled (fresh) or
+    # was served from the persistent cache (VERDICT open item 6 —
+    # compile accounting must ride the bench line, not folklore).
+    cache_before = _cache_files()
+    t0 = time.perf_counter()
+    runner()
+    compile_s = time.perf_counter() - t0
+    cache_after = _cache_files()
+    compile_fresh = (cache_before is None
+                     or bool((cache_after or set()) - cache_before))
     while True:
         t0 = time.perf_counter()
         events = runner()         # timed (compile cached)
@@ -436,6 +495,8 @@ def main(argv=None) -> None:
         "unit": "events/s",
         "vs_baseline": round(vs, 3),
         "backend": jax.default_backend(),
+        "compile_s": round(compile_s, 3),
+        "compile_cache": "fresh" if compile_fresh else "cached",
     }
     if _SHARDS > 1:
         out["shards"] = _SHARDS
@@ -465,7 +526,8 @@ def main(argv=None) -> None:
         out["manifest"] = telemetry.run_manifest(
             cfg=b.cfg, seed=b.cfg.seed, shards=max(_SHARDS, 1),
             sim=runner.last_sim, stats=runner.last_stats,
-            harvester=h, wall_seconds=wall)
+            harvester=h, wall_seconds=wall,
+            compile_s=compile_s, compile_fresh=compile_fresh)
     print(json.dumps(out))
 
 

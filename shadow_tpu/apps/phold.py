@@ -63,26 +63,46 @@ def _replica_peer(app, net, u):
 
 
 def setup(sim, *, load: int, port: int = 9000,
-          replica_size: int | None = None):
+          replica_size: int | None = None,
+          active_hosts: int | None = None):
     """All hosts run PHOLD: bind a UDP socket, seed `load` messages.
     `replica_size` partitions the hosts into independent replicas of
-    that many hosts each (peer draws stay in-replica)."""
+    that many hosts each (peer draws stay in-replica). `active_hosts`
+    is the sparse-workload shape: only the first N hosts inject load
+    and peers draw from that prefix, so the other H-N rows stay idle
+    forever — the census/compaction benchmark geometry (a handful of
+    live lanes in a sea of allocated capacity). Mutually exclusive
+    with replica_size."""
     H = sim.net.host_ip.shape[0]
     if H < 2:
         raise ValueError("PHOLD needs at least 2 hosts")
+    if active_hosts is not None and replica_size is not None:
+        raise ValueError("active_hosts and replica_size are mutually "
+                         "exclusive PHOLD shapes")
     rs = H if replica_size is None else replica_size
     if rs < 2 or H % rs != 0:
         raise ValueError(f"replica_size={rs} must divide H={H}, be >= 2")
+    active = H if active_hosts is None else active_hosts
+    if active < 2 or active > H:
+        raise ValueError(f"active_hosts={active} must be in [2, H={H}]")
     every = jnp.ones((H,), bool)
     net, sock = sk_create(sim.net, every, SocketType.UDP)
     net, _ = sk_bind(net, every, sock, 0, port)
     lane = jnp.arange(H, dtype=I32)
+    if active_hosts is None:
+        peer_base = (lane // rs) * rs
+        peer_span = jnp.full((H,), rs, I32)
+        remaining = jnp.full((H,), load, I32)
+    else:
+        peer_base = jnp.zeros((H,), I32)
+        peer_span = jnp.full((H,), active, I32)
+        remaining = jnp.where(lane < active, load, 0).astype(I32)
     app = PholdApp(
         sock=sock,
-        peer_base=(lane // rs) * rs,
-        peer_span=jnp.full((H,), rs, I32),
+        peer_base=peer_base,
+        peer_span=peer_span,
         port=jnp.full((H,), port, I32),
-        remaining=jnp.full((H,), load, I32),
+        remaining=remaining,
         sent=jnp.zeros((H,), I64),
         rcvd=jnp.zeros((H,), I64),
     )

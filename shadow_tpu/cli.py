@@ -113,9 +113,10 @@ def make_parser() -> argparse.ArgumentParser:
                    default=None,
                    help="count packets per (src,dst) topology vertex "
                         "pair, logged at shutdown (ref: topology.c "
-                        "per-path counters); forces the serial window "
-                        "loop; --no-track-paths overrides a config "
-                        "that enables it")
+                        "per-path counters); works serial and sharded "
+                        "(per-shard partials psum at the barrier); "
+                        "--no-track-paths overrides a config that "
+                        "enables it")
     p.add_argument("--event-capacity", type=int, default=None)
     # --- window telemetry (shadow_tpu/telemetry) ---------------------
     p.add_argument("--trace-out", default=None,
@@ -292,10 +293,12 @@ def main(argv=None) -> int:
 
             cap = CaptureSession(b, args.data_directory)
         mesh = None
-        if args.workers > 1 and (b.cfg.pcap or b.cfg.track_paths):
-            which = "logpcap" if b.cfg.pcap else "--track-paths"
+        # track_paths no longer forces serial: shard-local [V,V]
+        # partials are psummed at the window barrier
+        # (parallel/shard.py _replicate_scalars)
+        if args.workers > 1 and b.cfg.pcap:
             logger.warning(0, "shadow-tpu",
-                           f"{which} forces the serial window loop; "
+                           f"logpcap forces the serial window loop; "
                            f"--workers {args.workers} ignored")
         elif args.workers > 1:
             from jax.sharding import Mesh

@@ -1,0 +1,70 @@
+"""Active-lane compaction (sparse-window layer 1).
+
+Gather the host rows that hold any event before the window end into a
+compact [S]-lane view of the whole Sim, run the window fixpoint at
+width S, and scatter the results back. The row-selection rules are the
+SAME as the sharding specs (parallel/shard.py sim_specs): a leaf whose
+leading dimension is the host dimension is gathered; replicated lookup
+tables (NetState.REPLICATED_FIELDS), the telemetry ring, and scalars
+pass through whole. That identity of rules is what makes compaction
+sound — every handler already has to treat its row index as a local
+lane (identity comes from net.lane_id and replicated tables), because
+sharding imposes exactly the same contract.
+
+Bit-identity: the gathered indices are DISTINCT real rows (argsort of
+the activity mask, actives first in ascending row order), so per-row
+pop order, per-source sequence numbering, and the scatter-back are
+exact. Padding lanes are inactive rows whose queues hold nothing
+before wend — they pop nothing, and every handler is a masked batch
+update for which an all-false mask is the identity.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+I32 = jnp.int32
+
+
+def _replicated(path, sim) -> bool:
+    # Lazy import: core must not depend on net at module load.
+    from shadow_tpu.net.state import REPLICATED_FIELDS, NetState
+
+    names = [k.name for k in path if hasattr(k, "name")]
+    if names and names[0] == "telem":
+        return True
+    if names and names[-1] in REPLICATED_FIELDS and (
+        names[-2] == "net" if len(names) > 1
+        else isinstance(sim, NetState)
+    ):
+        return True
+    return False
+
+
+def gather_lanes(sim, idx: jax.Array):
+    """Compact view of `sim` holding rows `idx` ([S] i32, distinct)."""
+    def g(path, leaf):
+        if _replicated(path, sim) or jnp.ndim(leaf) == 0:
+            return leaf
+        return leaf[idx]
+
+    return jax.tree_util.tree_map_with_path(g, sim)
+
+
+def scatter_lanes(full, compact, idx: jax.Array):
+    """Write a compact Sim's rows back into the full-width `full`.
+    Replicated/scalar leaves take the compact branch's value (they are
+    whole-sim state the fixpoint may have updated, e.g. counters)."""
+    def s(path, fleaf, cleaf):
+        if _replicated(path, full) or jnp.ndim(fleaf) == 0:
+            return cleaf
+        return fleaf.at[idx].set(cleaf)
+
+    return jax.tree_util.tree_map_with_path(s, full, compact)
+
+
+def active_indices(active: jax.Array, s: int) -> jax.Array:
+    """First `s` row indices with actives packed first ([S] i32,
+    distinct, ascending within each group — stable partition)."""
+    return jnp.argsort(~active, stable=True)[:s].astype(I32)

@@ -18,6 +18,7 @@ heads (jax.lax.pmin across shards in shadow_tpu.parallel).
 
 from __future__ import annotations
 
+import inspect
 from typing import Callable, Protocol
 
 import jax
@@ -25,18 +26,45 @@ import jax.numpy as jnp
 from flax import struct
 
 from shadow_tpu.core import simtime
+from shadow_tpu.core.compact import (
+    active_indices,
+    gather_lanes,
+    scatter_lanes,
+)
 from shadow_tpu.core.events import (
     EmitBuffer,
     EventQueue,
     Outbox,
     Popped,
     apply_emissions,
+    emit_kind_bits,
+    kind_census,
     pop_earliest,
     route_outbox,
 )
 
 I32 = jnp.int32
 I64 = jnp.int64
+
+# Default active-lane budget S for the sparse-window fast path: when
+# the global census of rows holding any event < wend fits, the window
+# fixpoint runs over a compacted [S]-lane view of the Sim instead of
+# all H rows (core/compact.py). 256 holds the config-#2-shaped sparse
+# TCP workloads (~28 active of 10,240) with a wide margin while staying
+# a single nice tile. NetConfig.sparse_lanes overrides; 0 disables.
+DEFAULT_SPARSE_LANES = 256
+
+
+def resolve_sparse_lanes(cfg) -> int:
+    """Effective S for a config: cfg.sparse_lanes (None -> the
+    default), forced to 0 (off) when it cannot narrow anything."""
+    v = getattr(cfg, "sparse_lanes", None)
+    if v is None:
+        v = DEFAULT_SPARSE_LANES
+    v = int(v)
+    if v <= 0 or v >= int(cfg.num_hosts):
+        return 0
+    return v
 
 # step_fn(sim, popped, emitbuf) -> (sim, emitbuf): apply every handler
 # for one micro-step's popped events ([H] lanes, masked by popped.valid).
@@ -53,11 +81,18 @@ class EngineStats:
     events_processed: jax.Array  # [] i64
     micro_steps: jax.Array       # [] i64
     windows: jax.Array           # [] i64
+    # Sparse-window fast path: windows drained at compact [S] width vs
+    # windows that ran the full-width body (census exceeded S, or the
+    # window held no live lane at all). hit + miss == windows whenever
+    # the fast path is enabled; both stay 0 when it is off.
+    fastpath_hit: jax.Array      # [] i64
+    fastpath_miss: jax.Array     # [] i64
 
     @staticmethod
     def create() -> "EngineStats":
         z = jnp.zeros((), I64)
-        return EngineStats(events_processed=z, micro_steps=z, windows=z)
+        return EngineStats(events_processed=z, micro_steps=z, windows=z,
+                           fastpath_hit=z, fastpath_miss=z)
 
 
 # route_fn(sim) -> sim: deliver the outbox into destination queues.
@@ -76,33 +111,57 @@ def _identity(x):
     return x
 
 
+def _takes_census(step_fn) -> bool:
+    """Does step_fn accept the per-window kind census? Hand-written
+    3-arg step functions (tests, tools) keep working unchanged."""
+    try:
+        return "census" in inspect.signature(step_fn).parameters
+    except (TypeError, ValueError):
+        return False
+
+
 def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
                     emit_capacity: int = 4, lane_id=None):
     """Drain every event earlier than wend (local events only — handlers
     may keep emitting same-host events inside the window, e.g. loopback
     +1ns deliveries, ref: network_interface.c:546-554; iterate to
     fixpoint like the reference's pop-until-NULL worker loop). Purely
-    shard-local: no collectives, so shards iterate independently."""
-    H = sim.events.num_hosts
+    shard-local: no collectives, so shards iterate independently.
+
+    When step_fn accepts a `census` kwarg (net.step.make_step_fn), the
+    loop carries the window's kind bitmask (events.kind_census): seeded
+    from the queue at entry, OR-extended with each micro-step's
+    emissions, so handler families whose kinds never occur this window
+    are skipped for the whole window instead of re-testing the popped
+    vector each micro-step."""
     wend = jnp.asarray(wend, simtime.DTYPE)
+    # Zero emission template hoisted out of the loop body: one constant
+    # per trace instead of a fresh EmitBuffer.create materialized every
+    # micro-step.
+    buf0 = EmitBuffer.create(sim.events.num_hosts, emit_capacity,
+                             nwords=sim.events.words.shape[-1])
+    with_census = _takes_census(step_fn)
 
     def cond(carry):
-        sim, stats = carry
-        return jnp.any(sim.events.min_time() < wend)
+        return jnp.any(carry[0].events.min_time() < wend)
 
     def body(carry):
-        sim, stats = carry
+        if with_census:
+            sim, stats, census = carry
+        else:
+            sim, stats = carry
         q, popped = pop_earliest(sim.events, wend)
         sim = sim.replace(events=q)
-        buf = EmitBuffer.create(H, emit_capacity,
-                                nwords=sim.events.words.shape[-1])
         # events_processed counts EXECUTED events: pops the CPU
         # admission gate re-queues (step._cpu_gate) are excluded via
         # the blocked-counter delta, so a repeatedly deferred event
         # still counts exactly once
         blocked0 = (jnp.sum(sim.net.ctr_cpu_blocked)
                     if hasattr(sim, "net") else jnp.zeros((), I64))
-        sim, buf = step_fn(sim, popped, buf)
+        if with_census:
+            sim, buf = step_fn(sim, popped, buf0, census=census)
+        else:
+            sim, buf = step_fn(sim, popped, buf0)
         blocked1 = (jnp.sum(sim.net.ctr_cpu_blocked)
                     if hasattr(sim, "net") else jnp.zeros((), I64))
         q, out = apply_emissions(sim.events, sim.outbox, buf, lane_id)
@@ -112,15 +171,23 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
             + jnp.sum(popped.valid, dtype=I64) - (blocked1 - blocked0),
             micro_steps=stats.micro_steps + 1,
         )
+        if with_census:
+            return sim, stats, census | emit_kind_bits(buf)
         return sim, stats
 
-    return jax.lax.while_loop(cond, body, (sim, stats))
+    if with_census:
+        out = jax.lax.while_loop(
+            cond, body, (sim, stats, kind_census(sim.events, wend)))
+    else:
+        out = jax.lax.while_loop(cond, body, (sim, stats))
+    return out[0], out[1]
 
 
 def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 emit_capacity: int = 4, lane_id=None,
                 route_fn=_default_route, min_fn=_identity,
-                bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None):
+                bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None,
+                sparse_lanes: int = 0, census_fn=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -142,7 +209,16 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     must still hold the window's staged sends (route clears it), and
     queue occupancy is measured at its end-of-drain low-water point.
     `wstart` (the window's start time) is only consumed by telemetry;
-    None records a zero-length window."""
+    None records a zero-length window.
+
+    `sparse_lanes` > 0 arms the sparse-window fast path: when the
+    GLOBAL count of rows holding any event < wend (census_fn reduces
+    the shard-local count; lax.psum under shard_map, so every shard
+    takes the same branch) fits the budget S and is nonzero, the
+    fixpoint runs over a compacted [S]-lane Sim (core/compact.py) and
+    scatters back — bit-identical by construction. fault_fn, bulk_fn,
+    telemetry and route all run at full width on both branches, so
+    fault/checkpoint boundaries are unchanged."""
     if telem_fn is not None:
         ev0 = stats.events_processed
         ms0 = stats.micro_steps
@@ -152,12 +228,59 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         sim, n_bulk = bulk_fn(sim, wend)
         stats = stats.replace(
             events_processed=stats.events_processed + n_bulk)
-    sim, stats = window_fixpoint(sim, stats, step_fn, wend, emit_capacity,
-                                 lane_id)
+
+    S = int(sparse_lanes) if sparse_lanes else 0
+    n_active = None
+    if S > 0 or telem_fn is not None:
+        active = sim.events.min_time() < jnp.asarray(wend, simtime.DTYPE)
+        n_active = jnp.sum(active, dtype=I32)  # shard-LOCAL lane count
+    fastpath = jnp.zeros((), jnp.bool_)
+    if S > 0:
+        n_global = (census_fn or _identity)(n_active)
+        # Require at least one live lane: an all-quiet window's
+        # full-width fixpoint terminates immediately, so compaction
+        # would pay gather+scatter for nothing (bulk-pass workloads
+        # consume whole windows before the fixpoint every round).
+        hit = (n_global > 0) & (n_global <= S)
+
+        def _full_body(op):
+            fsim, fstats = op
+            return window_fixpoint(
+                fsim, fstats, step_fn, wend, emit_capacity, lane_id)
+
+        if S < sim.events.num_hosts:
+            def _compact_body(op):
+                fsim, fstats = op
+                idx = active_indices(active, S)
+                lane_c = (idx if lane_id is None
+                          else jnp.asarray(lane_id, I32)[idx])
+                csim = gather_lanes(fsim, idx)
+                csim, fstats = window_fixpoint(
+                    csim, fstats, step_fn, wend, emit_capacity, lane_c)
+                return scatter_lanes(fsim, csim, idx), fstats
+
+            sim, stats = jax.lax.cond(hit, _compact_body, _full_body,
+                                      (sim, stats))
+        else:
+            # This (shard-local) width is already <= S: there is
+            # nothing to narrow, so run full width unconditionally —
+            # but keep the GLOBAL hit/miss accounting below, so the
+            # decision record is shard-count-invariant (a 64-host
+            # serial run compacts to S=16 while its 8-shard twin runs
+            # 8-wide shards as-is; both must count the same hits).
+            sim, stats = _full_body((sim, stats))
+        stats = stats.replace(
+            fastpath_hit=stats.fastpath_hit + hit.astype(I64),
+            fastpath_miss=stats.fastpath_miss + (~hit).astype(I64))
+        fastpath = hit
+    else:
+        sim, stats = window_fixpoint(sim, stats, step_fn, wend,
+                                     emit_capacity, lane_id)
     if telem_fn is not None:
         sim = telem_fn(sim, wend if wstart is None else wstart, wend,
                        stats.events_processed - ev0,
-                       stats.micro_steps - ms0)
+                       stats.micro_steps - ms0,
+                       n_active, fastpath)
     sim = route_fn(sim)
     stats = stats.replace(windows=stats.windows + 1)
     next_min = min_fn(jnp.min(sim.events.min_time()))
@@ -178,6 +301,8 @@ def run(
     bulk_fn=None,
     fault_fn=None,
     telem_fn=None,
+    sparse_lanes: int = 0,
+    census_fn=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -209,6 +334,7 @@ def run(
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
             route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,
+            sparse_lanes, census_fn,
         )
         return sim, stats, next_min
 

@@ -269,6 +269,11 @@ class Outbox:
     narrow_hit: jax.Array   # [] i32 windows on the narrow branch
     narrow_miss: jax.Array  # [] i32 windows forced to full width
     max_occupied: jax.Array  # [] i32 max occupancy the gate measured
+    # sparse-window layer 3: windows whose outbox staged nothing, so
+    # route_outbox skipped the insert pipeline entirely (and, sharded,
+    # the all-to-all's cheap branch). Running total, like the narrow
+    # counters.
+    route_elided: jax.Array  # [] i32 windows with an empty exchange
 
     @property
     def num_hosts(self) -> int:
@@ -300,6 +305,7 @@ class Outbox:
             narrow_hit=jnp.zeros((), I32),
             narrow_miss=jnp.zeros((), I32),
             max_occupied=jnp.zeros((), I32),
+            route_elided=jnp.zeros((), I32),
         )
 
 
@@ -700,17 +706,35 @@ def route_outbox(q: EventQueue, out: Outbox, impl: str | None = None,
             jnp.where(out.dst >= 0, jnp.arange(M, dtype=I32)[None, :] + 1,
                       0))
         hit = occupied_width <= width
+        empty = occupied_width == 0
         out = out.replace(
             narrow_hit=out.narrow_hit + hit.astype(I32),
             narrow_miss=out.narrow_miss + (~hit).astype(I32),
-            max_occupied=jnp.maximum(out.max_occupied, occupied_width))
+            max_occupied=jnp.maximum(out.max_occupied, occupied_width),
+            route_elided=out.route_elided + empty.astype(I32))
+        # Empty-exchange elision (sparse-window layer 3): an occupied
+        # width of zero means no row staged anything, so the insert
+        # pipeline is a structural no-op — skip it. occupied_width
+        # counts bad-dst entries too, so empty also implies no
+        # overflow accounting is owed.
         q = jax.lax.cond(
-            hit,
-            lambda qq: _route_width(qq, out, width, impl),
-            lambda qq: _route_width(qq, out, M, impl),
+            empty,
+            lambda qq: qq,
+            lambda qq: jax.lax.cond(
+                hit,
+                lambda q2: _route_width(q2, out, width, impl),
+                lambda q2: _route_width(q2, out, M, impl),
+                qq),
             q)
     else:
-        q = _route_width(q, out, M, impl)
+        empty = ~jnp.any(out.dst >= 0)
+        out = out.replace(
+            route_elided=out.route_elided + empty.astype(I32))
+        q = jax.lax.cond(
+            empty,
+            lambda qq: qq,
+            lambda qq: _route_width(qq, out, M, impl),
+            q)
     return q, clear_outbox(out)
 
 
@@ -822,3 +846,45 @@ def apply_emissions(
     q = q.replace(next_seq=q.next_seq + nvalid,
                   overflow=q.overflow + buf.overflow)
     return q, out
+
+
+# --- Window kind census (sparse-window layer 2) -------------------------
+#
+# One u32 bitmask per window: bit k set when any event of kind k could
+# be popped before wend. Kinds >= 31 share bit 31, so the mask can only
+# OVER-approximate — sound, because every handler is a masked batch
+# update and an all-false mask is the identity (net/step.py documents
+# the invariant). The census seeds from the queue at window entry and
+# is OR-extended with each micro-step's emissions, so kinds that only
+# appear mid-window (e.g. TCP_FLUSH staged by the receive path) are
+# re-admitted before their events can be popped.
+
+def _kind_bit(kind: jax.Array) -> jax.Array:
+    """One-hot u32 bit per kind; kinds >= 31 collapse onto bit 31."""
+    return jnp.uint32(1) << jnp.clip(kind, 0, 31).astype(jnp.uint32)
+
+
+def _or_reduce(bits: jax.Array) -> jax.Array:
+    return jax.lax.reduce(bits, jnp.uint32(0),
+                          lambda a, b: jax.lax.bitwise_or(a, b),
+                          tuple(range(bits.ndim)))
+
+
+def kind_census(q: EventQueue, wend) -> jax.Array:
+    """[] u32 bitmask of event kinds present in `q` before `wend`."""
+    m = q.time < jnp.asarray(wend, simtime.DTYPE)
+    return _or_reduce(jnp.where(m, _kind_bit(q.kind), jnp.uint32(0)))
+
+
+def emit_kind_bits(buf: EmitBuffer) -> jax.Array:
+    """[] u32 bitmask of event kinds staged in an EmitBuffer."""
+    m = buf.dst >= 0
+    return _or_reduce(jnp.where(m, _kind_bit(buf.kind), jnp.uint32(0)))
+
+
+def census_mask(kinds) -> int:
+    """Static u32 mask for a handler family's kind tuple (host side)."""
+    m = 0
+    for k in kinds:
+        m |= 1 << min(int(k), 31)
+    return m

@@ -246,6 +246,8 @@ def make_runner(bundle: SimBundle, app_handlers=(),
 
     telem_fn = make_telem_fn()
 
+    from shadow_tpu.core.engine import resolve_sparse_lanes
+
     def _go(sim):
         return engine_run(
             sim, step, end_time=end, min_jump=bundle.min_jump,
@@ -255,6 +257,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             bulk_fn=bulk_fn,
             fault_fn=fault_fn,
             telem_fn=telem_fn,
+            sparse_lanes=resolve_sparse_lanes(bundle.cfg),
         )
 
     return jax.jit(_go)
@@ -308,12 +311,15 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
             def run_one(ops):
                 sim, stats, wstart = ops
                 wend = jnp.minimum(wstart + min_jump, end + 1)
+                from shadow_tpu.core.engine import resolve_sparse_lanes
+
                 return step_window(
                     sim, stats, step, wend,
                     emit_capacity=bundle.cfg.emit_capacity,
                     lane_id=sim.net.lane_id, bulk_fn=bulk_fn,
                     fault_fn=fault_fn, telem_fn=telem_fn,
-                    wstart=wstart)
+                    wstart=wstart,
+                    sparse_lanes=resolve_sparse_lanes(bundle.cfg))
 
             return jax.lax.cond(wstart <= end, run_one,
                                 lambda ops: ops, (sim, stats, wstart))

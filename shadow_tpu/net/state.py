@@ -135,9 +135,18 @@ class NetConfig:
     # packetCount, logged at cache clear): a [V,V] matrix counting
     # remote send attempts per (src vertex, dst vertex). Off by
     # default: the hot-path scatter-add costs real time on TPU, and
-    # the reference pays ~nothing for its CPU counter. Serial-runner
-    # observability — sharded runs keep the [1,1] zero matrix.
+    # the reference pays ~nothing for its CPU counter. Sharded runs
+    # accumulate shard-local partials into the replicated matrix and
+    # psum the deltas at each window barrier (parallel/shard.py
+    # _replicate_scalars), so the harvested matrix equals the serial
+    # one bit-for-bit.
     track_paths: bool = False
+    # Active-lane budget S for the sparse-window fast path
+    # (core/engine.py): windows whose global census of rows holding
+    # any event < wend fits S run the fixpoint over a compacted
+    # [S]-lane Sim. None = engine default (DEFAULT_SPARSE_LANES);
+    # 0 disables; values >= num_hosts are treated as disabled.
+    sparse_lanes: int | None = None
     bootstrap_end: int = 0       # "unlimited bandwidth" period end
                                  # (ref: master.c:261-268)
     end_time: int = simtime.ONE_SECOND
@@ -205,8 +214,9 @@ class NetConfig:
 REPLICATED_FIELDS = frozenset({
     "host_ip", "ip_sorted", "host_of_ip_sorted", "vertex_of_host",
     "latency_ns", "reliability", "bw_up_kibps", "bw_down_kibps",
-    # serial-runner observability matrix ([1,1] zeros when sharded —
-    # cfg.track_paths is a serial-only feature)
+    # observability matrix: each shard scatter-adds into its replica;
+    # the window barrier psums the deltas back to a global matrix
+    # (parallel/shard.py _replicate_scalars)
     "ctr_path_packets",
 })
 

@@ -52,6 +52,26 @@ def _kind_pred(popped, kinds):
     return jnp.any(m)
 
 
+def _family_pred(census, popped, kinds):
+    """Handler-family gate. With a window kind census (sparse-window
+    layer 2) the scalar bit test short-circuits the whole family for
+    every micro-step of a window whose census lacks the kinds — the
+    per-micro-step popped-vector test only refines it within windows
+    where the family is live. The census may over-approximate (bit 31
+    is shared by kinds >= 31; emissions widen it), which is safe:
+    handlers are masked batch updates, so a gate that opens onto an
+    all-false mask is the identity."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core.events import census_mask
+
+    p = _kind_pred(popped, kinds)
+    if census is None:
+        return p
+    hot = (census & jnp.uint32(census_mask(kinds))) != 0
+    return hot & p
+
+
 def _cpu_gate(cfg: NetConfig, sim, popped, buf):
     """Virtual-CPU admission check (ref: event_execute, event.c:71-89
     + cpu.c:56-110): a host whose accumulated processing delay exceeds
@@ -113,13 +133,13 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
         (h, k) for h, k in _PRE_APP if h not in _TCP_HANDLERS)
     cpu_on = cfg.cpu_threshold_ns >= 0
 
-    def step(sim, popped, buf):
+    def step(sim, popped, buf, census=None):
         if cpu_on:
             sim, popped, buf = _cpu_gate(cfg, sim, popped, buf)
         sim, buf = _handle_proc_stop(cfg, sim, popped, buf)
         for h, kinds in pre:
             sim, buf = jax.lax.cond(
-                _kind_pred(popped, kinds),
+                _family_pred(census, popped, kinds),
                 lambda op, h=h: h(cfg, op[0], popped, op[1]),
                 lambda op: op,
                 (sim, buf))
@@ -127,8 +147,20 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
         # dead); the netstack handlers above still ran for it
         app_popped = popped._replace(
             valid=popped.valid & ~sim.net.proc_stopped)
-        for h in app_handlers:
-            sim, buf = h(cfg, sim, app_popped, buf)
+        if app_handlers:
+            # app handlers are masked batch updates under the same
+            # contract as the netstack (all-false == identity), so a
+            # micro-step where every popped lane was CPU-deferred or
+            # belongs to a stopped host skips the app subgraph whole
+            def _apps(op):
+                s, b = op
+                for h in app_handlers:
+                    s, b = h(cfg, s, app_popped, b)
+                return s, b
+
+            sim, buf = jax.lax.cond(
+                jnp.any(app_popped.valid), _apps, lambda op: op,
+                (sim, buf))
         # the send drain also serves lanes whose nic_send_now bit was
         # set by handlers above, not just popped NIC_SEND events
         send_pred = _kind_pred(popped, (EventKind.NIC_SEND,)) \

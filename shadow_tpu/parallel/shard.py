@@ -44,7 +44,11 @@ except ImportError:                   # 0.4.x: experimental home, and the
                               out_specs=out_specs, check_rep=check_vma)
 
 from shadow_tpu.core import simtime
-from shadow_tpu.core.engine import EngineStats, run as engine_run
+from shadow_tpu.core.engine import (
+    EngineStats,
+    resolve_sparse_lanes,
+    run as engine_run,
+)
 from shadow_tpu.core.events import (
     EventQueue,
     Outbox,
@@ -190,23 +194,40 @@ def route_outbox_sharded(
 
     C_n = (max(M, n // (4 * num_shards)) if narrow is None
            else narrow)
+    # +1 so rank == C_n-1 fits; a globally empty exchange gives
+    # gmax == 0 — the common case in sparse windows, where the whole
+    # all-to-all + insert pipeline is elided (layer 3). The pmax'd
+    # predicate is identical on every shard, so skipping the
+    # collective is coherent (the narrow-tier precedent).
+    gmax = lax.pmax(jnp.max(jnp.where(ok, rank, -1)) + 1, axis)
+    empty = gmax == 0
+
+    def elide(qq):
+        # bad-dst entries are excluded from `ok` (they never enter the
+        # exchange) but still owe their loud overflow accounting
+        return qq.replace(overflow=qq.overflow + jnp.sum(bad, dtype=I32))
+
     if C_n and C_n < C_full:
-        # +1 so rank == C_n-1 fits; empty windows give gmax == 0
-        gmax = lax.pmax(
-            jnp.max(jnp.where(ok, rank, -1)) + 1, axis)
         hit = gmax <= C_n
         out = out.replace(
             narrow_hit=out.narrow_hit + hit.astype(I32),
             narrow_miss=out.narrow_miss + (~hit).astype(I32),
             max_occupied=jnp.maximum(out.max_occupied,
-                                     gmax.astype(I32)))
+                                     gmax.astype(I32)),
+            route_elided=out.route_elided + empty.astype(I32))
         q = lax.cond(
-            hit,
-            lambda qq: exchange(qq, C_n),
-            lambda qq: exchange(qq, C_full),
+            empty,
+            elide,
+            lambda qq: lax.cond(
+                hit,
+                lambda q2: exchange(q2, C_n),
+                lambda q2: exchange(q2, C_full),
+                qq),
             q)
     else:
-        q = exchange(q, C_full)
+        out = out.replace(
+            route_elided=out.route_elided + empty.astype(I32))
+        q = lax.cond(empty, elide, lambda qq: exchange(qq, C_full), q)
     return q, clear_outbox(out)
 
 
@@ -225,13 +246,27 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     # identical on every shard, and a sum of per-shard maxima would be
     # meaningless for max_occupied — pin all three, overwrite after.
     ob = sim.outbox
+    # route_elided rides along: the elision branch is decided by a
+    # pmax'd census, so the count is already identical on every shard.
     narrow_pinned = (lax.pmax(ob.narrow_hit, axis),
                      lax.pmax(ob.narrow_miss, axis),
-                     lax.pmax(ob.max_occupied, axis))
+                     lax.pmax(ob.max_occupied, axis),
+                     lax.pmax(ob.route_elided, axis))
     # The telemetry ring is pinned the same way: its scalars (count,
     # prev_*) and planes already hold globally-reduced values — the
     # delta-psum below would multiply them by the shard count.
     telem = getattr(sim, "telem", None)
+    # The per-path matrix is declared replicated (REPLICATED_FIELDS)
+    # but each shard scatter-adds only its own hosts' sends into its
+    # replica — psum the [V,V] delta so the reassembled matrix equals
+    # the serial one. Skipped when track_paths is off (the [1,1] zero
+    # matrix needs no collective).
+    net = getattr(sim, "net", None)
+    path_pinned = None
+    if net is not None and net.ctr_path_packets.shape != (1, 1):
+        init_paths = initial_sim.net.ctr_path_packets
+        path_pinned = init_paths + lax.psum(
+            net.ctr_path_packets - init_paths, axis)
     sim = jax.tree.map(
         lambda leaf, init: init + lax.psum(leaf - init, axis)
         if jnp.ndim(leaf) == 0 else leaf,
@@ -239,13 +274,20 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     )
     sim = sim.replace(outbox=sim.outbox.replace(
         narrow_hit=narrow_pinned[0], narrow_miss=narrow_pinned[1],
-        max_occupied=narrow_pinned[2]))
+        max_occupied=narrow_pinned[2], route_elided=narrow_pinned[3]))
     if telem is not None:
         sim = sim.replace(telem=telem)
+    if path_pinned is not None:
+        sim = sim.replace(net=sim.net.replace(
+            ctr_path_packets=path_pinned))
     stats = EngineStats(
         events_processed=lax.psum(stats.events_processed, axis),
         micro_steps=lax.psum(stats.micro_steps, axis),
         windows=lax.pmax(stats.windows, axis),
+        # the fastpath branch is globally decided (census_fn psum), so
+        # every shard counted the same hits/misses — pin, don't sum
+        fastpath_hit=lax.pmax(stats.fastpath_hit, axis),
+        fastpath_miss=lax.pmax(stats.fastpath_miss, axis),
     )
     return sim, stats
 
@@ -258,17 +300,10 @@ def _harness_specs(mesh: Mesh, axis: str, sim):
     H = sim.events.num_hosts
     if H % num_shards != 0:
         raise ValueError(f"num_hosts={H} not divisible by {num_shards} shards")
-    net = getattr(sim, "net", None)
-    if net is not None and net.ctr_path_packets.shape != (1, 1):
-        # each shard would scatter-add only its own hosts into its
-        # local replica of the declared-replicated matrix — silently
-        # wrong counts; the CLI serializes track_paths runs instead
-        raise ValueError(
-            "cfg.track_paths is serial-only: per-path packet counters "
-            "do not aggregate across shards (run without a mesh)")
     specs = sim_specs(sim, axis)
     stats_specs = EngineStats(
-        events_processed=P(), micro_steps=P(), windows=P()
+        events_processed=P(), micro_steps=P(), windows=P(),
+        fastpath_hit=P(), fastpath_miss=P(),
     )
     return num_shards, specs, stats_specs
 
@@ -288,7 +323,7 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                     end_time: int, min_jump: int, emit_capacity: int,
                     lane_id_fn=None, exchange_capacity: int | None = None,
                     narrow: int | None = None,
-                    bulk_fn=None, fault_fn=None):
+                    bulk_fn=None, fault_fn=None, sparse_lanes: int = 0):
     """Shared factory: a jitted sim -> (sim, stats) running the full
     engine loop under shard_map (used by sharded_engine_run and
     make_sharded_runner — keep their semantics identical)."""
@@ -316,6 +351,10 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             fault_fn=fault_fn,
             # trace-time no-op when sim.telem is None (telemetry off)
             telem_fn=make_telem_fn(axis),
+            sparse_lanes=sparse_lanes,
+            # the active-lane census is a GLOBAL count so every shard
+            # takes the same compact/full branch
+            census_fn=lambda x: lax.psum(x, axis),
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 
@@ -352,6 +391,7 @@ def sharded_engine_run(
     narrow: int | None = None,
     bulk_fn=None,
     fault_fn=None,
+    sparse_lanes: int = 0,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
     *global* state (as built for single-shard); sharding/replication
@@ -363,7 +403,8 @@ def sharded_engine_run(
         mesh, axis, sim, step_fn, end_time=end_time, min_jump=min_jump,
         emit_capacity=emit_capacity, lane_id_fn=lane_id_fn,
         exchange_capacity=exchange_capacity, narrow=narrow,
-        bulk_fn=bulk_fn, fault_fn=fault_fn)(sim)
+        bulk_fn=bulk_fn, fault_fn=fault_fn,
+        sparse_lanes=sparse_lanes)(sim)
 
 
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
@@ -389,6 +430,8 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
                                        exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
             fault_fn=fault_fn,
+            sparse_lanes=resolve_sparse_lanes(cfg),
+            census_fn=lambda x: lax.psum(x, axis),
         )
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
         return out_sim, stats, next_min
@@ -437,7 +480,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
-        bulk_fn=bulk_fn, fault_fn=fault_fn)
+        bulk_fn=bulk_fn, fault_fn=fault_fn,
+        sparse_lanes=resolve_sparse_lanes(bundle.cfg))
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),

@@ -1878,11 +1878,13 @@ class ProcessRuntime:
             self._tcp_st_cache = None
             if on_window is not None:
                 on_window(self.sim, wend)
-            total = EngineStats(
+            total = total.replace(
                 events_processed=total.events_processed
                 + stats.events_processed,
                 micro_steps=total.micro_steps + stats.micro_steps,
                 windows=total.windows + 1,
+                fastpath_hit=total.fastpath_hit + stats.fastpath_hit,
+                fastpath_miss=total.fastpath_miss + stats.fastpath_miss,
             )
             now = int(wend)
         # collect payload-pool entries whose packets died on device

@@ -55,6 +55,8 @@ def chrome_trace(records, timers=None, num_shards: int = 1) -> dict:
                 "queue_occupancy": {
                     "min": r.qocc_min, "max": r.qocc_max,
                     "sum": r.qocc_sum},
+                "active_lanes": r.active_lanes,
+                "fastpath": r.fastpath,
             },
         })
     if timers is not None:
@@ -135,6 +137,7 @@ def final_counters(sim, stats=None) -> dict:
         "events_overflow": int(np.asarray(sim.events.overflow)),
         "outbox_overflow": int(np.asarray(sim.outbox.overflow)),
         "rq_overflow": int(np.asarray(net.rq_overflow)),
+        "route_elided": int(np.asarray(sim.outbox.route_elided)),
     }
     if getattr(sim, "tcp", None) is not None:
         out["retx_segments_total"] = int(
@@ -143,13 +146,20 @@ def final_counters(sim, stats=None) -> dict:
         out["events_processed"] = int(stats.events_processed)
         out["micro_steps"] = int(stats.micro_steps)
         out["windows"] = int(stats.windows)
+        out["fastpath_hit"] = int(stats.fastpath_hit)
+        out["fastpath_miss"] = int(stats.fastpath_miss)
     return out
 
 
 def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  health=None, fault_plan=None, harvester=None,
-                 timers=None, wall_seconds: float | None = None) -> dict:
-    """The run's identity + outcome (see module docstring)."""
+                 timers=None, wall_seconds: float | None = None,
+                 compile_s: float | None = None,
+                 compile_fresh: bool | None = None) -> dict:
+    """The run's identity + outcome (see module docstring).
+    `compile_s` is the wall time of the first (compiling) device call;
+    `compile_fresh` says whether it actually compiled (True) or was
+    served from the persistent compilation cache (False)."""
     man = {
         "config_hash": config_hash(cfg),
         "seed": int(seed),
@@ -161,6 +171,10 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
     }
     if wall_seconds is not None:
         man["wall_seconds"] = round(float(wall_seconds), 3)
+    if compile_s is not None:
+        man["compile_s"] = round(float(compile_s), 3)
+    if compile_fresh is not None:
+        man["compile_fresh"] = bool(compile_fresh)
     if health is not None:
         man["health"] = health.failure_report()
         man["health"]["verdict"] = "fatal" if health.fatal else (
@@ -189,6 +203,10 @@ def metrics_from_manifest(man: dict) -> dict:
         out["events_per_window"] = tel["events_per_window"]
     if "health" in man:
         out["health_fatal"] = bool(man["health"]["fatal"])
+    if "compile_s" in man:
+        out["compile_seconds"] = man["compile_s"]
+        if "compile_fresh" in man:
+            out["compile_fresh"] = bool(man["compile_fresh"])
     if "wall_phases_s" in man:
         out["wall_phase_seconds"] = man["wall_phases_s"]
     return out

@@ -43,6 +43,8 @@ class WindowRecord:
     qocc_min: int
     qocc_max: int
     qocc_sum: int
+    active_lanes: int  # host rows live at window start (global)
+    fastpath: int      # 1 = drained on the compact [S]-lane branch
 
 
 @dataclass
@@ -101,6 +103,10 @@ class Harvester:
             out["micro_steps_per_window_max"] = int(
                 max(r.micro_steps for r in self.records))
             out["qocc_max"] = int(max(r.qocc_max for r in self.records))
+            out["fastpath_windows"] = int(
+                sum(r.fastpath for r in self.records))
+            out["active_lanes_max"] = int(
+                max(r.active_lanes for r in self.records))
         return out
 
 

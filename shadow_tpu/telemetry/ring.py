@@ -23,6 +23,12 @@ Record fields (one [W] plane each):
 - retx               TCP segments retransmitted this window
 - qocc_min/max/sum   event-queue occupancy across hosts at the end of
                      the window drain (pre-route)
+- active_lanes       host rows holding any event < wend when the
+                     window fixpoint started (global psum; the
+                     sparse-window census input, core/engine.py)
+- fastpath           1 when the window drained on the compact [S]-lane
+                     fast path, 0 when it ran full width (replicated:
+                     the census branch is globally decided)
 
 Shard invariance: every field is reduced at the window barrier with
 the collective that makes it *identical on every shard and equal to
@@ -65,6 +71,8 @@ PLANES = (
     ("qocc_min", I32),
     ("qocc_max", I32),
     ("qocc_sum", I64),
+    ("active_lanes", I64),
+    ("fastpath", I32),
 )
 
 DEFAULT_CAPACITY = 4096
@@ -86,6 +94,8 @@ class TelemetryRing:
     qocc_min: jax.Array      # [W] i32
     qocc_max: jax.Array      # [W] i32
     qocc_sum: jax.Array      # [W] i64
+    active_lanes: jax.Array  # [W] i64
+    fastpath: jax.Array      # [W] i32
     # monotonic windows-recorded counter; slot = count % W. The host
     # detects overruns from count jumps (never a device-side latch:
     # the whole-run device program cannot see host drains).
@@ -164,7 +174,12 @@ def make_telem_fn(axis: str | None = None):
         def pmin(x):
             return lax.pmin(x, axis)
 
-    def telem_fn(sim, wstart, wend, ev_delta, ms_delta):
+    def telem_fn(sim, wstart, wend, ev_delta, ms_delta,
+                 active_lanes=None, fastpath=None):
+        """active_lanes is the SHARD-LOCAL live-lane count (psummed
+        into the record below so it rides the existing collective);
+        fastpath is the replicated census-branch indicator. Both
+        default to zero for callers predating the sparse fast path."""
         ring = getattr(sim, "telem", None)
         if ring is None:
             return sim
@@ -190,9 +205,11 @@ def make_telem_fn(axis: str | None = None):
         # shard-local end-of-drain occupancy; reduced below
         qmin_l, qmax_l, qsum_l = sim.events.occupancy()
 
+        active_l = (jnp.zeros((), I64) if active_lanes is None
+                    else jnp.asarray(active_lanes).astype(I64))
         sums = psum(jnp.stack([
             ev_delta.astype(I64), n_local, n_cross, drops_cum, retx_cum,
-            qsum_l,
+            qsum_l, active_l,
         ]))
         maxes = pmax(jnp.stack([
             ms_delta.astype(I64), qmax_l.astype(I64),
@@ -211,6 +228,9 @@ def make_telem_fn(axis: str | None = None):
             qocc_sum=sums[5],
             qocc_min=qmin,
             qocc_max=maxes[1],
+            active_lanes=sums[6],
+            fastpath=(jnp.zeros((), I32) if fastpath is None
+                      else jnp.asarray(fastpath).astype(I32)),
         ))
         ring = ring.replace(prev_drops=sums[3], prev_retx=sums[4])
         return sim.replace(telem=ring)

@@ -34,8 +34,13 @@ import numpy as np
 # changing shape/dtype (e.g. the packetfmt word reindex): shape checks
 # alone cannot catch a reinterpretation, so load() refuses snapshots
 # from a different layout generation instead of resuming into garbage.
-LAYOUT_VERSION = 2  # v2: protocol-independent packet words 0..5,
+LAYOUT_VERSION = 3  # v2: protocol-independent packet words 0..5,
                     # TCP header words 6..16 (packetfmt.py)
+                    # v3: Outbox grew the route_elided counter leaf —
+                    # the pytree structure changed, so v2 snapshots
+                    # cannot be resumed (load()'s per-leaf key check
+                    # would also catch it, but with a config-mismatch
+                    # message; the layout gate names the real cause)
 
 
 def _leaf_dict(sim) -> dict:
@@ -156,6 +161,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
 
     telem_fn = make_telem_fn()  # trace-time no-op when sim.telem is None
 
+    from shadow_tpu.core.engine import resolve_sparse_lanes
+
     @jax.jit
     def one_window(sim, wstart, wend):
         stats = EngineStats.create()
@@ -163,7 +170,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                            emit_capacity=cfg.emit_capacity,
                            lane_id=sim.net.lane_id,
                            fault_fn=fault_fn,
-                           telem_fn=telem_fn, wstart=wstart)
+                           telem_fn=telem_fn, wstart=wstart,
+                           sparse_lanes=resolve_sparse_lanes(cfg))
 
     total = EngineStats.create()
     saved = []
@@ -178,10 +186,12 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             next_ckpt += checkpoint_every_ns
         wend = min(wstart + min_jump, end + 1)
         sim, stats, next_min = one_window(sim, wstart, wend)
-        total = EngineStats(
+        total = total.replace(
             events_processed=total.events_processed + stats.events_processed,
             micro_steps=total.micro_steps + stats.micro_steps,
             windows=total.windows + 1,
+            fastpath_hit=total.fastpath_hit + stats.fastpath_hit,
+            fastpath_miss=total.fastpath_miss + stats.fastpath_miss,
         )
         nm = int(next_min)
         if on_window is not None:

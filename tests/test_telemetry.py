@@ -38,9 +38,12 @@ H = 8
 PORT = 7000
 
 # every field of a WindowRecord except the routing split, which is
-# mesh-dependent (its SUM is shard-invariant, checked separately)
+# mesh-dependent (its SUM is shard-invariant, checked separately).
+# active_lanes is a global psum and fastpath a globally-decided branch
+# bit, so both ARE shard-invariant and belong here.
 INVARIANT_FIELDS = ("index", "wstart", "wend", "events", "micro_steps",
-                    "drops", "retx", "qocc_min", "qocc_max", "qocc_sum")
+                    "drops", "retx", "qocc_min", "qocc_max", "qocc_sum",
+                    "active_lanes", "fastpath")
 
 
 def _build(seed=1):

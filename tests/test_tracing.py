@@ -76,14 +76,26 @@ def test_events_exec_matches_engine_total_serial_and_bulk():
                                   np.asarray(sim2.net.ctr_events_exec))
 
 
-def test_track_paths_rejected_on_mesh():
+def test_path_counters_shard_invariant():
+    """The [V,V] path matrix is replicated with per-shard partial
+    sums psum'd at every window barrier, so any shard count must
+    produce the serial matrix exactly (the guard that used to reject
+    track_paths on a mesh is gone)."""
     import jax
-    import pytest
     from jax.sharding import Mesh
 
     from shadow_tpu.parallel.shard import run_sharded
 
-    b = _build(8, 2, track_paths=True)
-    mesh = Mesh(np.array(jax.devices()[:2]), ("hosts",))
-    with pytest.raises(ValueError, match="serial-only"):
-        run_sharded(b, mesh, app_handlers=(phold.handler,))
+    b1 = _build(8, 2, track_paths=True)
+    sim1, st1 = make_runner(b1, app_handlers=(phold.handler,))(b1.sim)
+    mat1 = np.asarray(sim1.net.ctr_path_packets)
+    assert mat1.sum() > 0
+
+    for nshards in (2, 8):
+        b2 = _build(8, 2, track_paths=True)
+        mesh = Mesh(np.array(jax.devices()[:nshards]), ("hosts",))
+        sim2, st2 = run_sharded(b2, mesh, app_handlers=(phold.handler,))
+        np.testing.assert_array_equal(
+            mat1, np.asarray(sim2.net.ctr_path_packets),
+            err_msg=f"path matrix diverged at {nshards} shards")
+        assert int(st1.events_processed) == int(st2.events_processed)

@@ -41,7 +41,7 @@ KNOWN_METADATA = {
     "thread_name", "thread_sort_index",
 }
 WINDOW_ARGS = ("events", "micro_steps", "routed_local", "routed_cross",
-               "drops", "retx")
+               "drops", "retx", "active_lanes", "fastpath")
 
 
 def lint_trace_obj(obj) -> tuple[list, list]:
@@ -147,6 +147,31 @@ def lint_manifest_obj(man) -> tuple[list, list]:
         errors.append(
             f"telemetry accounts for {rec}+{lost} windows but the "
             f"engine ran only {cw}")
+    # compile accounting (VERDICT open item 6, first step): a bench /
+    # CLI manifest that carries compile_s must make it a sane number,
+    # and the fresh-vs-cache flag a bool
+    cs = man.get("compile_s")
+    if cs is not None and (not isinstance(cs, (int, float))
+                           or isinstance(cs, bool) or cs < 0):
+        errors.append(f"compile_s must be a non-negative number, "
+                      f"got {cs!r}")
+    cf = man.get("compile_fresh")
+    if cf is not None and not isinstance(cf, bool):
+        errors.append(f"compile_fresh must be a bool, got {cf!r}")
+    # sparse fast-path counters: non-negative, and hit+miss can never
+    # exceed the windows the engine ran
+    ctr = man.get("counters", {})
+    fp = [ctr.get(k) for k in ("fastpath_hit", "fastpath_miss")]
+    for k, v in zip(("fastpath_hit", "fastpath_miss"), fp):
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errors.append(f"counters.{k} must be a non-negative "
+                          f"integer, got {v!r}")
+    if (cw is not None and all(isinstance(v, int) for v in fp)
+            and fp[0] + fp[1] > cw):
+        errors.append(
+            f"fastpath_hit+miss = {fp[0]}+{fp[1]} exceeds the "
+            f"{cw} windows the engine ran")
     return errors, warnings
 
 
