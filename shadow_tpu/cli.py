@@ -132,6 +132,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "(default 4096); overruns are latched as a "
                         "health warning, never silently")
     # --- run supervisor (faults/supervisor.py) -----------------------
+    p.add_argument("--host-kernel", choices=("run", "diff"), default=None,
+                   help="execute the config's .py-plugin processes on "
+                        "the REAL host kernel (hostrun backend): 'run' "
+                        "executes there only; 'diff' runs both backends "
+                        "and diffs normalized syscall traces, writing a "
+                        "conformance block into run_manifest.json "
+                        "(exit 4 on divergence; docs/7-conformance.md)")
+    p.add_argument("--host-time-scale", type=float, default=0.05,
+                   help="host-kernel backend: simulated seconds -> real "
+                        "seconds for sleeps/timers (default 0.05)")
     p.add_argument("--supervise", action="store_true",
                    help="host-driven window loop with health latches, "
                         "periodic checkpoints, and checkpoint-backed "
@@ -179,6 +189,70 @@ def overrides_from_args(args) -> dict:
         "track_paths": args.track_paths,
     }
     return {k: v for k, v in overrides.items() if v is not None}
+
+
+def _host_kernel_mode(args, b, loaded, logger) -> int:
+    """--host-kernel: execute the config's virtual processes on the
+    real OS (hostrun backend). 'diff' additionally runs the simulation
+    and compares normalized syscall traces — the dual-mode conformance
+    check (docs/7-conformance.md). Exit codes: 0 agree/ran, 2 sandbox
+    has no bindable localhost ports, 4 divergence."""
+    import os
+
+    from shadow_tpu import hostrun
+    from shadow_tpu.hostrun.trace import TraceRecorder
+
+    try:
+        hostrun.PortAllocator.preflight()
+    except hostrun.PortsUnavailable as e:
+        print(f"error: host-kernel backend unavailable: {e}",
+              file=sys.stderr)
+        return 2
+
+    ip_names = {int(b.ip_of(n)): n for n in b.host_names}
+    host_rec = TraceRecorder(ip_names=ip_names)
+    ex = hostrun.HostKernelExecutor(
+        b, time_scale=args.host_time_scale, trace=host_rec)
+    for hi, fn, st, sp in loaded.vprocs:
+        ex.spawn(hi, fn, start_time=st, stop_time=sp)
+    t0 = time.time()
+    ex.run()
+    wall = time.time() - t0
+    logger.message(0, "shadow-tpu",
+                   f"host-kernel run complete: {len(ex.procs)} "
+                   f"process(es), {wall:.2f}s wall")
+    if args.host_kernel == "run":
+        print(json.dumps({"mode": "host-kernel-run",
+                          "processes": len(ex.procs),
+                          "wall_seconds": round(wall, 3)}))
+        return 0
+
+    # diff: the same generators through the simulation, then compare
+    from shadow_tpu import telemetry
+    from shadow_tpu.process.vproc import ProcessRuntime
+
+    sim_rec = TraceRecorder(ip_names=ip_names)
+    rt = ProcessRuntime(b, app_handlers=loaded.handlers)
+    rt.trace = sim_rec
+    for hi, fn, st, sp in loaded.vprocs:
+        rt.spawn(hi, fn, start_time=st, stop_time=sp)
+    sim, stats = rt.run()
+    res = hostrun.diff_traces(sim_rec.normalized(), host_rec.normalized())
+    print(hostrun.render(res))
+    name = os.path.basename(args.config) if args.config else "config"
+    conf = {"workloads": {name: "agree" if res.agree else "diverge"},
+            "agree": int(res.agree), "diverge": int(not res.agree),
+            "total": 1}
+    man = telemetry.run_manifest(
+        cfg=b.cfg, seed=args.seed, shards=1, sim=sim, stats=stats,
+        fault_plan=b.fault_plan, conformance=conf)
+    os.makedirs(args.data_directory, exist_ok=True)
+    mpath = telemetry.write_manifest(
+        os.path.join(args.data_directory, "run_manifest.json"), man)
+    logger.message(0, "shadow-tpu", f"run manifest -> {mpath}")
+    print(json.dumps({"mode": "host-kernel-diff", "agree": res.agree,
+                      "manifest": mpath}))
+    return 0 if res.agree else 4
 
 
 def main(argv=None) -> int:
@@ -320,6 +394,15 @@ def main(argv=None) -> int:
                     f"count); using {w}")
             if w > 1:
                 mesh = Mesh(np.array(jax.devices()[:w]), ("hosts",))
+        if args.host_kernel:
+            if not loaded.vprocs:
+                print("error: --host-kernel needs a config with .py "
+                      "plugins (virtual processes)", file=sys.stderr)
+                logger.flush()
+                return 1
+            code = _host_kernel_mode(args, b, loaded, logger)
+            logger.flush()
+            return code
         if loaded.vprocs:
             # .py plugins: coroutine processes over the simulated
             # syscall surface — the config-reachable form of the

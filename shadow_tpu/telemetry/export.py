@@ -155,7 +155,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  health=None, fault_plan=None, harvester=None,
                  timers=None, wall_seconds: float | None = None,
                  compile_s: float | None = None,
-                 compile_fresh: bool | None = None) -> dict:
+                 compile_fresh: bool | None = None,
+                 conformance: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -186,6 +187,10 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
     if timers is not None:
         man["wall_phases_s"] = {
             k: round(v, 6) for k, v in timers.totals().items()}
+    if conformance is not None:
+        # dual-mode verdicts (hostrun/runner.py:conformance_block):
+        # which workloads ran both backends, and whether they agreed
+        man["conformance"] = conformance
     return man
 
 
@@ -209,6 +214,9 @@ def metrics_from_manifest(man: dict) -> dict:
             out["compile_fresh"] = bool(man["compile_fresh"])
     if "wall_phases_s" in man:
         out["wall_phase_seconds"] = man["wall_phases_s"]
+    if "conformance" in man:
+        out["conformance_agree"] = man["conformance"].get("agree", 0)
+        out["conformance_diverge"] = man["conformance"].get("diverge", 0)
     return out
 
 

@@ -305,3 +305,68 @@ def test_telemetry_lint_cli_exit_codes(tmp_path):
     assert tl.main(["--trace", str(good), "-q"]) == 0
     assert tl.main(["--trace", str(bad), "-q"]) == 1
     assert tl.main(["--trace", str(tmp_path / "missing.json"), "-q"]) == 1
+
+
+# ---- dual-mode conformance (tools/dualmode_diff.py) -----------------
+
+def _trace_doc(procs):
+    return {"meta": {}, "procs": procs}
+
+
+def test_dualmode_diff_compare_exit_codes(tmp_path):
+    import json
+
+    dd = _load("dualmode_diff")
+    agree = _trace_doc({"h0:p1": [["getpid", [], 1], ["_exit", [], None]]})
+    diverge = _trace_doc({"h0:p1": [["getpid", [], 2], ["_exit", [], None]]})
+    a = tmp_path / "a.json"
+    b = tmp_path / "b.json"
+    c = tmp_path / "c.json"
+    a.write_text(json.dumps(agree))
+    b.write_text(json.dumps(agree))
+    c.write_text(json.dumps(diverge))
+    assert dd.main(["--sim", str(a), "--host", str(b)]) == dd.EXIT_OK
+    # divergence MUST exit non-zero (the CI contract)
+    assert dd.main(["--sim", str(a), "--host", str(c)]) == dd.EXIT_DIVERGED
+    # usage errors are distinguishable from divergence
+    assert dd.main(["--sim", str(a)]) == dd.EXIT_USAGE
+    assert dd.main(["--sim", str(a),
+                    "--host", str(tmp_path / "nope.json")]) == dd.EXIT_USAGE
+    rpt = tmp_path / "report.json"
+    assert dd.main(["--sim", str(a), "--host", str(c),
+                    "--json", str(rpt)]) == dd.EXIT_DIVERGED
+    doc = json.loads(rpt.read_text())
+    assert doc["agree"] is False and doc["mode"] == "compare"
+
+
+def test_dualmode_diff_catalog_surface():
+    dd = _load("dualmode_diff")
+    assert dd.main(["--list"]) == dd.EXIT_OK
+    assert dd.main(["--workload", "not-a-workload"]) == dd.EXIT_USAGE
+
+
+def test_telemetry_lint_conformance_block():
+    tl = _load("telemetry_lint")
+    m = _copy(GOOD_MANIFEST)
+    m["conformance"] = {"workloads": {"bind": "agree", "epoll": "agree"},
+                        "agree": 2, "diverge": 0, "total": 2}
+    assert tl.lint_manifest_obj(m) == ([], [])
+    # a divergence is surfaced as a warning, never silent
+    m["conformance"]["workloads"]["epoll"] = "diverge"
+    m["conformance"] = dict(m["conformance"], agree=1, diverge=1)
+    errs, warns = tl.lint_manifest_obj(m)
+    assert errs == []
+    assert any("diverged" in w and "epoll" in w for w in warns)
+    # incoherent counts and missing keys are errors
+    m["conformance"]["total"] = 5
+    errs, _ = tl.lint_manifest_obj(m)
+    assert any("incoherent" in e for e in errs)
+    m2 = _copy(GOOD_MANIFEST)
+    m2["conformance"] = {"workloads": {}, "agree": -1, "diverge": 0,
+                         "total": 0}
+    errs, _ = tl.lint_manifest_obj(m2)
+    assert any("non-negative" in e for e in errs)
+    m3 = _copy(GOOD_MANIFEST)
+    m3["conformance"] = {"agree": 0}
+    errs, _ = tl.lint_manifest_obj(m3)
+    assert any('missing "workloads"' in e for e in errs)

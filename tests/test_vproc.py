@@ -343,3 +343,83 @@ def test_gethostbyname():
     assert results["resolved"] == b.ip_of("server")
     assert results["missing"] == -1
     assert results["got"] == 64
+
+
+def test_condition_variables_rpth_semantics():
+    """pthread cond vars over the vproc surface (ref: the rpth
+    pthread.c cond implementation the reference interposes): wait
+    releases the mutex and blocks; signal wakes exactly the OLDEST
+    waiter; broadcast wakes all; the woken thread re-acquires the
+    mutex before returning; waiting without holding the mutex is
+    EPERM (-1)."""
+    b = _bundle()
+    order = []
+
+    def main(host):
+        mid = yield vproc.mutex_init()
+        cid = yield vproc.cond_init()
+
+        # EPERM: cond_wait without holding the mutex
+        r = yield vproc.cond_wait(cid, mid)
+        assert r == -1
+
+        def waiter(tag):
+            def run(_h):
+                yield vproc.mutex_lock(mid)
+                r = yield vproc.cond_wait(cid, mid)
+                assert r == 0
+                order.append(tag)       # holds the mutex again here
+                yield vproc.mutex_unlock(mid)
+            return run
+
+        t1 = yield vproc.thread_create(waiter("w1"))
+        t2 = yield vproc.thread_create(waiter("w2"))
+        t3 = yield vproc.thread_create(waiter("w3"))
+        yield vproc.sleep(simtime.ONE_SECOND)   # let all three park
+
+        yield vproc.mutex_lock(mid)
+        yield vproc.cond_signal(cid)            # wakes w1 only
+        yield vproc.mutex_unlock(mid)
+        yield vproc.sleep(simtime.ONE_SECOND)
+        assert order == ["w1"], order
+
+        yield vproc.mutex_lock(mid)
+        yield vproc.cond_broadcast(cid)         # wakes w2 and w3
+        yield vproc.mutex_unlock(mid)
+        yield vproc.thread_join(t1)
+        yield vproc.thread_join(t2)
+        yield vproc.thread_join(t3)
+        assert sorted(order) == ["w1", "w2", "w3"], order
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, main)
+    rt.run()
+    assert all(p.done for p in rt.procs)
+
+
+def test_fork_exec_system_return_enosys():
+    """fork/exec/system are deliberate ENOSYS stubs (ref:
+    process.h:103-437's process_undefined family): the call returns
+    -1 and errno reads ENOSYS, instead of the old hard raise — so
+    reference plugins that probe-and-fallback keep running."""
+    b = _bundle()
+    seen = {}
+
+    def main(host):
+        seen["fork"] = yield vproc.fork()
+        seen["fork_errno"] = yield vproc.get_errno()
+        seen["exec"] = yield vproc.execv("/bin/true", ("true",))
+        seen["system"] = yield vproc.system("echo hi")
+        seen["errno"] = yield vproc.get_errno()
+        # errno is per-process state: a successful call leaves it
+        pid = yield vproc.getpid()
+        assert pid > 0
+
+    rt = ProcessRuntime(b)
+    rt.spawn(0, main)
+    rt.run()
+    assert seen["fork"] == -1
+    assert seen["fork_errno"] == vproc.ENOSYS
+    assert seen["exec"] == -1
+    assert seen["system"] == -1
+    assert seen["errno"] == vproc.ENOSYS

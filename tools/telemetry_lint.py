@@ -172,6 +172,40 @@ def lint_manifest_obj(man) -> tuple[list, list]:
         errors.append(
             f"fastpath_hit+miss = {fp[0]}+{fp[1]} exceeds the "
             f"{cw} windows the engine ran")
+    # dual-mode conformance block (optional): counts must be coherent
+    # non-negative ints summing to the per-workload verdicts, and a
+    # divergence is always SURFACED as a warning
+    conf = man.get("conformance")
+    if conf is not None:
+        if not isinstance(conf, dict):
+            errors.append("conformance must be an object")
+        else:
+            for k in ("workloads", "agree", "diverge", "total"):
+                if k not in conf:
+                    errors.append(f'conformance missing "{k}"')
+            for k in ("agree", "diverge", "total"):
+                v = conf.get(k)
+                if k in conf and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 0):
+                    errors.append(f"conformance.{k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            wl = conf.get("workloads")
+            if isinstance(wl, dict) and all(
+                    isinstance(conf.get(k), int)
+                    for k in ("agree", "diverge", "total")):
+                if conf["agree"] + conf["diverge"] != conf["total"] \
+                        or conf["total"] != len(wl):
+                    errors.append(
+                        f"conformance counts incoherent: agree="
+                        f"{conf['agree']} + diverge={conf['diverge']} "
+                        f"vs total={conf['total']} over "
+                        f"{len(wl)} workload verdict(s)")
+            if isinstance(conf.get("diverge"), int) and conf["diverge"]:
+                bad = sorted(k for k, v in (wl or {}).items()
+                             if v != "agree")
+                warnings.append(
+                    f"conformance: {conf['diverge']} workload(s) "
+                    f"diverged between backends: {bad}")
     return errors, warnings
 
 
