@@ -118,6 +118,8 @@ def make_parser() -> argparse.ArgumentParser:
                         "--no-track-paths overrides a config that "
                         "enables it")
     p.add_argument("--event-capacity", type=int, default=None)
+    p.add_argument("--outbox-capacity", type=int, default=None)
+    p.add_argument("--router-ring", type=int, default=None)
     # --- window telemetry (shadow_tpu/telemetry) ---------------------
     p.add_argument("--trace-out", default=None,
                    help="write a Chrome-trace/Perfetto JSON of "
@@ -161,6 +163,24 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-windows", type=int, default=512,
                    help="consecutive zero-event windows before the "
                         "stall latch trips")
+    p.add_argument("--auto-grow", action="store_true",
+                   help="supervisor escalation: a fatal capacity "
+                        "overflow (event queue / outbox / router ring) "
+                        "doubles the tripped knob, rebuilds at the "
+                        "grown shapes, and transplants the last clean "
+                        "checkpoint instead of consuming a retry "
+                        "(faults/escalate.py)")
+    p.add_argument("--max-grow", type=int, default=8,
+                   help="escalation budget: total capacity doublings "
+                        "allowed across the run (chain-wide)")
+    p.add_argument("--resume", default=None, metavar="PATH",
+                   help="continue a previous run from its checkpoint: "
+                        "a snapshot file, a checkpoint path prefix, or "
+                        "a data directory (newest snapshot wins). "
+                        "Implies --supervise; capacities recorded in "
+                        "the snapshot metadata are applied "
+                        "automatically, and a different --workers "
+                        "count is fine (snapshots are global-layout)")
     p.add_argument("--version", action="version",
                    version="shadow-tpu 0.1 (capability target: shadow 1.x)")
     return p
@@ -186,9 +206,25 @@ def overrides_from_args(args) -> dict:
         "runahead": args.runahead,
         "sockets_per_host": args.sockets_per_host,
         "event_capacity": args.event_capacity,
+        "outbox_capacity": args.outbox_capacity,
+        "router_ring": args.router_ring,
         "track_paths": args.track_paths,
     }
     return {k: v for k, v in overrides.items() if v is not None}
+
+
+def _resolve_resume(path: str) -> str | None:
+    """--resume accepts a snapshot file, a checkpoint prefix, or a
+    data directory; returns the newest matching snapshot path."""
+    import os
+
+    from shadow_tpu.utils import checkpoint as ckpt
+
+    if os.path.isdir(path):
+        return ckpt.latest_checkpoint(os.path.join(path, "checkpoint"))
+    if os.path.isfile(path):
+        return path
+    return ckpt.latest_checkpoint(path)
 
 
 def _host_kernel_mode(args, b, loaded, logger) -> int:
@@ -300,14 +336,44 @@ def main(argv=None) -> int:
     # each round, slave.c:446-450)
     try:
         cfg = parse_config(text)
+        # --resume: find the snapshot BEFORE building, because its
+        # recorded capacities must size the build (a post-escalation
+        # snapshot is larger than the config says; a mismatch is
+        # diagnosed by name either way, never resumed into garbage)
+        resume_ckpt = None
+        resume_meta = None
+        overrides = overrides_from_args(args)
+        if args.resume:
+            resume_ckpt = _resolve_resume(args.resume)
+            if resume_ckpt is None:
+                print(f"error: no checkpoint found at {args.resume}",
+                      file=sys.stderr)
+                return 1
+            args.supervise = True
+            from shadow_tpu.utils import checkpoint as ckpt_mod
+
+            resume_meta = ckpt_mod.peek_meta(resume_ckpt)
+            for k, v in (resume_meta.get("capacities") or {}).items():
+                if k in ("event_capacity", "outbox_capacity",
+                         "router_ring"):
+                    overrides[k] = max(int(overrides.get(k) or 0), int(v))
         # relative <topology path> / <plugin path="*.py"> entries are
         # relative to the CONFIG FILE, not the cwd (the reference
         # resolves the same way) — load() handles both via base_dir
         loaded = load(cfg, seed=args.seed,
-                      overrides=overrides_from_args(args),
+                      overrides=overrides,
                       base_dir=os.path.dirname(os.path.abspath(args.config))
                       if args.config else None)
         b = loaded.bundle
+        if resume_meta is not None and resume_meta.get("config_digest"):
+            from shadow_tpu.telemetry.export import config_hash
+
+            if resume_meta["config_digest"] != config_hash(b.cfg):
+                logger.warning(
+                    0, "shadow-tpu",
+                    "resume snapshot was taken under a different "
+                    "config digest — continuing, but the runs are "
+                    "not the same simulation")
         logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
                        f"min window {b.min_jump} ns, "
                        f"end {b.cfg.end_time} ns")
@@ -367,6 +433,7 @@ def main(argv=None) -> int:
 
             cap = CaptureSession(b, args.data_directory)
         mesh = None
+        sup_result = None  # set by the --supervise branch
         # track_paths no longer forces serial: shard-local [V,V]
         # partials are psummed at the window barrier
         # (parallel/shard.py _replicate_scalars)
@@ -428,12 +495,12 @@ def main(argv=None) -> int:
 
             sim, stats = rt.run(on_window=vproc_hook)
         elif args.supervise:
-            from shadow_tpu.faults.supervisor import run_supervised
+            import signal
 
-            if mesh is not None:
-                logger.warning(0, "shadow-tpu",
-                               "--supervise uses the serial host-driven "
-                               "window loop; --workers ignored")
+            from shadow_tpu.faults.escalate import EscalationPolicy
+            from shadow_tpu.faults.supervisor import run_supervised
+            from shadow_tpu.telemetry.export import config_hash
+
             ckpt_prefix = args.checkpoint_path or os.path.join(
                 args.data_directory, "checkpoint")
             os.makedirs(os.path.dirname(os.path.abspath(ckpt_prefix)),
@@ -444,17 +511,94 @@ def main(argv=None) -> int:
                     _cap.drain(s)
                 progress_hook(s, wend)
 
-            with (timers.phase("supervised-run") if timers is not None
-                  else contextlib.nullcontext()):
-                result = run_supervised(
-                    b, app_handlers=loaded.handlers,
-                    checkpoint_path=ckpt_prefix,
-                    checkpoint_every_windows=args.checkpoint_every_windows,
-                    max_retries=args.max_retries,
-                    backoff_s=args.retry_backoff,
-                    stall_windows=args.stall_windows,
-                    log=lambda m: logger.message(0, "shadow-tpu", m),
-                    on_window=sup_hook, harvester=harvester)
+            # preemption safety: the first SIGTERM/SIGINT asks the
+            # supervisor for a final atomic snapshot at the next window
+            # barrier (exit 5); the handler restores the previous
+            # disposition immediately, so a second signal kills a hung
+            # run the ordinary way
+            stop_flag = {"v": False}
+            prev_handlers = {}
+
+            def _on_signal(signum, frame):
+                stop_flag["v"] = True
+                signal.signal(signum, prev_handlers[signum])
+
+            for _sg in (signal.SIGTERM, signal.SIGINT):
+                try:
+                    prev_handlers[_sg] = signal.signal(_sg, _on_signal)
+                except ValueError:
+                    pass  # not the main thread (embedded use)
+
+            nshards = mesh.shape["hosts"] if mesh is not None else 1
+            try:
+                with (timers.phase("supervised-run") if timers is not None
+                      else contextlib.nullcontext()):
+                    result = run_supervised(
+                        b, app_handlers=loaded.handlers,
+                        checkpoint_path=ckpt_prefix,
+                        checkpoint_every_windows=(
+                            args.checkpoint_every_windows),
+                        max_retries=args.max_retries,
+                        backoff_s=args.retry_backoff,
+                        stall_windows=args.stall_windows,
+                        escalation=(EscalationPolicy(
+                            max_grow=args.max_grow)
+                            if args.auto_grow else None),
+                        stop=lambda: stop_flag["v"],
+                        resume_from=resume_ckpt,
+                        mesh=mesh,
+                        config_digest=config_hash(b.cfg),
+                        log=lambda m: logger.message(0, "shadow-tpu", m),
+                        on_window=sup_hook, harvester=harvester)
+            finally:
+                for _sg, _h in prev_handlers.items():
+                    with contextlib.suppress(ValueError, TypeError):
+                        signal.signal(_sg, _h)
+            sup_result = result
+
+            def _sup_manifest(sim_, health_, stats_=None):
+                from shadow_tpu import telemetry
+
+                harvester.drain(sim_)
+                man = telemetry.run_manifest(
+                    cfg=b.cfg, seed=args.seed, shards=nshards,
+                    sim=sim_, stats=stats_, health=health_,
+                    fault_plan=b.fault_plan,
+                    harvester=harvester, timers=timers,
+                    run_id=result.run_id, resume_of=result.resume_of,
+                    escalations=result.escalations,
+                    preempted=result.preempted or None)
+                os.makedirs(args.data_directory, exist_ok=True)
+                telemetry.write_manifest(
+                    os.path.join(args.data_directory,
+                                 "run_manifest.json"), man)
+                if args.trace_out:
+                    telemetry.write_trace(args.trace_out,
+                                          harvester.records, timers,
+                                          nshards)
+                if args.metrics_out:
+                    telemetry.write_metrics(args.metrics_out, man)
+                return man
+
+            if result.preempted:
+                # interrupted, not failed: the final snapshot is on
+                # disk and `--resume <data-directory>` continues the
+                # run (distinct exit code so wrappers can requeue)
+                report = {
+                    "preempted": True,
+                    "checkpoint": result.final_checkpoint,
+                    "run_id": result.run_id,
+                    "escalations": len(result.escalations),
+                    "resume": f"--resume {args.data_directory}",
+                }
+                if telem_on and result.sim is not None:
+                    report["manifest"] = _sup_manifest(
+                        result.sim, None, result.stats)
+                logger.message(0, "shadow-tpu", "run preempted "
+                               + json.dumps(report))
+                logger.flush()
+                print(json.dumps(report))
+                return 5
             if not result.ok:
                 failure = result.failure_report()
                 # critical, not error: SimLogger.error raises (the
@@ -476,26 +620,8 @@ def main(argv=None) -> int:
                     logger.message(0, "shadow-tpu", oc.format())
                     logger.message(0, "shadow-tpu", oc.format_diff())
                     if telem_on:
-                        from shadow_tpu import telemetry
-
-                        harvester.drain(result.sim)
-                        man = telemetry.run_manifest(
-                            cfg=b.cfg, seed=args.seed, shards=1,
-                            sim=result.sim, health=result.health,
-                            fault_plan=b.fault_plan,
-                            harvester=harvester, timers=timers)
-                        os.makedirs(args.data_directory, exist_ok=True)
-                        telemetry.write_manifest(
-                            os.path.join(args.data_directory,
-                                         "run_manifest.json"), man)
-                        if args.trace_out:
-                            telemetry.write_trace(
-                                args.trace_out, harvester.records,
-                                timers, 1)
-                        if args.metrics_out:
-                            telemetry.write_metrics(args.metrics_out,
-                                                    man)
-                        report["manifest"] = man
+                        report["manifest"] = _sup_manifest(
+                            result.sim, result.health)
                 logger.flush()
                 print(json.dumps(report))
                 return 3
@@ -630,6 +756,12 @@ def main(argv=None) -> int:
             "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
             + int(sim.net.rq_overflow),
         }
+        if sup_result is not None:
+            if sup_result.escalations:
+                report["escalations"] = [
+                    e.as_dict() for e in sup_result.escalations]
+            if sup_result.resume_of:
+                report["resume_of"] = sup_result.resume_of
         if telem_on:
             from shadow_tpu import telemetry
 
@@ -639,7 +771,11 @@ def main(argv=None) -> int:
                     cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
                     stats=stats, health=run_health,
                     fault_plan=b.fault_plan, harvester=harvester,
-                    timers=timers, wall_seconds=wall)
+                    timers=timers, wall_seconds=wall,
+                    **({} if sup_result is None else {
+                        "run_id": sup_result.run_id,
+                        "resume_of": sup_result.resume_of,
+                        "escalations": sup_result.escalations}))
                 os.makedirs(args.data_directory, exist_ok=True)
                 mpath = telemetry.write_manifest(
                     os.path.join(args.data_directory,

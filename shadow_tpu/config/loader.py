@@ -384,6 +384,11 @@ def load(config: ShadowConfig, *, seed: int = 1,
     reference's Options-beats-XML precedence is inverted for host
     element attributes, matching master.c:355-364)."""
     overrides = overrides or {}
+    # captured before hint-merging mutates the dict: the rebuild
+    # closure below replays the CALLER's overrides, then layers the
+    # escalation's capacity bumps on top (so they beat plugin hints
+    # the same way CLI flags do)
+    caller_overrides = dict(overrides)
 
     def _resolve(path: str) -> str:
         # a relative <topology path> / <plugin path> is relative to
@@ -552,5 +557,18 @@ def load(config: ShadowConfig, *, seed: int = 1,
                 "cannot honor the schedule deterministically")
         records = faults_mod.records_from_config(config, bundle)
         faults_mod.install(bundle, records)
+
+    def _rebuild(new_overrides: dict) -> SimBundle:
+        # Full reload — topology placement, app setup, fault install —
+        # at the merged capacities. Everything but the overridden
+        # shapes is a pure function of (config, seed), so the rebuilt
+        # boot state matches the original wherever shapes agree; the
+        # escalation transplanter relies on that.
+        merged = dict(caller_overrides)
+        merged.update(new_overrides)
+        return load(config, seed=seed, overrides=merged,
+                    base_dir=base_dir).bundle
+
+    bundle.rebuild = _rebuild
     return LoadedSim(bundle=bundle, handlers=tuple(handlers),
                      config=config, vprocs=tuple(vprocs))

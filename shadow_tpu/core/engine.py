@@ -94,6 +94,39 @@ class EngineStats:
         return EngineStats(events_processed=z, micro_steps=z, windows=z,
                            fastpath_hit=z, fastpath_miss=z)
 
+    # Host-side accumulation across attempts/rebuilds. The supervisor
+    # carries totals over an escalation boundary, where the pre-trip
+    # counters live in a *different* jitted program than the post-heal
+    # ones — accumulate as plain ints, never mix traced arrays from
+    # two builds.
+    def add(self, other: "EngineStats") -> "EngineStats":
+        return EngineStats(
+            events_processed=self.events_processed + other.events_processed,
+            micro_steps=self.micro_steps + other.micro_steps,
+            windows=self.windows + other.windows,
+            fastpath_hit=self.fastpath_hit + other.fastpath_hit,
+            fastpath_miss=self.fastpath_miss + other.fastpath_miss,
+        )
+
+    def as_dict(self) -> dict:
+        return {
+            "events_processed": int(self.events_processed),
+            "micro_steps": int(self.micro_steps),
+            "windows": int(self.windows),
+            "fastpath_hit": int(self.fastpath_hit),
+            "fastpath_miss": int(self.fastpath_miss),
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "EngineStats":
+        def v(k):
+            return jnp.asarray(int(d.get(k, 0)), I64)
+        return EngineStats(events_processed=v("events_processed"),
+                           micro_steps=v("micro_steps"),
+                           windows=v("windows"),
+                           fastpath_hit=v("fastpath_hit"),
+                           fastpath_miss=v("fastpath_miss"))
+
 
 # route_fn(sim) -> sim: deliver the outbox into destination queues.
 # The default is the single-shard events.route_outbox; the multi-chip

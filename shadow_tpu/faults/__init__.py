@@ -5,7 +5,12 @@
 - apply: compiles a plan into the window-boundary fault_fn the engine
   runs (stateless table replay; crash resets).
 - health: RunHealth latches folded from the engine's sticky counters.
-- supervisor: checkpointed retry loop the CLI's --supervise uses.
+- supervisor: checkpointed retry loop the CLI's --supervise uses,
+  with capacity escalation and preemption-safe resume chains.
+- escalate: latch -> capacity-knob mapping, grow policy, and the
+  checkpoint-into-grown-shapes transplanter.
+- conserve: per-window conservation-invariant checker (the chaos
+  soak harness's oracle).
 """
 
 from shadow_tpu.faults.plan import (  # noqa: F401
@@ -26,6 +31,14 @@ from shadow_tpu.faults.apply import (  # noqa: F401
 from shadow_tpu.faults.health import RunHealth, gather  # noqa: F401
 from shadow_tpu.faults.supervisor import (  # noqa: F401
     LatchTrip,
+    Preempted,
     SupervisorResult,
     run_supervised,
 )
+from shadow_tpu.faults.escalate import (  # noqa: F401
+    Escalation,
+    EscalationPolicy,
+    GrowBudgetExceeded,
+    transplant,
+)
+from shadow_tpu.faults import conserve  # noqa: F401

@@ -1,0 +1,125 @@
+"""Per-window conservation invariants — the chaos harness's oracle.
+
+Every event that ever receives a (source, seq) identity bumps exactly
+one `next_seq` counter (boot seeding in net/build.py, fault wakeups in
+faults/apply.py, window emissions in core/events.py apply_emissions),
+and every identified event is, at any window barrier, in exactly one
+place: already processed, still queued, staged in the outbox, or
+loudly dropped. That gives the ledger
+
+    sum(next_seq) == events_processed + sum(fill_count)
+                     + sum(outbox.count) [ + drops ]
+
+EXACT when the overflow latches are zero — which is every healed run,
+since any nonzero overflow is a fatal latch the supervisor escalates
+on. With nonzero overflow the right side brackets the left instead
+(EmitBuffer drops never received a seq, so `q.overflow` mixes
+seq-carrying and seq-less drops): the checker degrades to a bounds
+check rather than lying about exactness.
+
+CRASH faults flush a host's event row non-conservatively by design
+(the reference drops a dead host's events too), so chaos plans that
+want the exact ledger exclude crash/restart kinds.
+
+The clock half: window starts must be strictly increasing and each
+round's next_min may never precede its window start (runahead legally
+schedules *inside* the current window — `next_min < wend` is fine;
+`next_min < wstart` is corruption, the same rule the supervisor
+latches as time_regression).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowSample:
+    """The ledger at one window barrier (host-side ints — samples
+    survive process kills and program rebuilds by construction)."""
+
+    wstart: int
+    wend: int
+    next_min: int
+    pushed: int       # sum(events.next_seq): identities ever assigned
+    processed: int    # cumulative events_processed (incl. resume base)
+    queued: int       # sum(events.fill_count())
+    outboxed: int     # sum(outbox.count) (0 after route clears it)
+    drops: int        # events.overflow + outbox.overflow (rq spill
+                      # drops packets, not identified events)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def sample(sim, *, wstart: int, wend: int, next_min: int,
+           processed_total: int) -> WindowSample:
+    """Read the ledger off the device at a window barrier.
+    `processed_total` is the harness's cumulative processed count —
+    cumulative across resumes/escalations, which per-attempt engine
+    stats are not."""
+    q = sim.events
+    return WindowSample(
+        wstart=int(wstart), wend=int(wend), next_min=int(next_min),
+        pushed=int(np.sum(np.asarray(q.next_seq, dtype=np.int64))),
+        processed=int(processed_total),
+        queued=int(np.sum(np.asarray(q.fill_count()))),
+        outboxed=int(np.sum(np.asarray(sim.outbox.count))),
+        drops=int(q.overflow) + int(sim.outbox.overflow),
+    )
+
+
+def check(samples) -> list[str]:
+    """Validate a run's sample sequence; returns human-readable
+    violation strings (empty == conserved). Deliberately side-effect
+    free and picky — tests corrupt counters to prove it catches."""
+    errors: list[str] = []
+    prev = None
+    for i, s in enumerate(samples):
+        where = f"window[{i}] (wstart={s.wstart})"
+        if s.wend <= s.wstart:
+            errors.append(f"{where}: wend={s.wend} <= wstart")
+        if s.next_min < s.wstart:
+            errors.append(f"{where}: clock regressed — next_min="
+                          f"{s.next_min} < wstart={s.wstart}")
+        if prev is not None and s.wstart <= prev.wstart:
+            errors.append(
+                f"{where}: window starts not strictly increasing "
+                f"(previous wstart={prev.wstart})")
+        if prev is not None and s.pushed < prev.pushed:
+            errors.append(
+                f"{where}: pushed count went backwards "
+                f"({prev.pushed} -> {s.pushed}) — next_seq is "
+                f"monotone by construction")
+        if prev is not None and s.processed < prev.processed:
+            errors.append(
+                f"{where}: processed count went backwards "
+                f"({prev.processed} -> {s.processed})")
+        accounted = s.processed + s.queued + s.outboxed
+        if s.drops == 0:
+            if s.pushed != accounted:
+                errors.append(
+                    f"{where}: conservation violated — pushed="
+                    f"{s.pushed} != processed={s.processed} + queued="
+                    f"{s.queued} + outboxed={s.outboxed}")
+        else:
+            # drops mix seq-carrying and seq-less losses: bounds only
+            if not (accounted <= s.pushed <= accounted + s.drops):
+                errors.append(
+                    f"{where}: pushed={s.pushed} outside "
+                    f"[{accounted}, {accounted + s.drops}] "
+                    f"(drops={s.drops})")
+        prev = s
+    return errors
+
+
+def stitch(before: list, after: list, resume_time: int) -> list:
+    """Splice sample sequences across a kill/heal boundary: the resumed
+    attempt replays from its checkpoint, so `before` samples at or
+    past the resume point are superseded by the replay (bit-identical
+    by the checkpoint contract — but the replayed copies carry the
+    post-resume cumulative counters, so keep exactly one copy)."""
+    kept = [s for s in before if s.wstart < resume_time]
+    return kept + list(after)

@@ -1,0 +1,220 @@
+"""Capacity escalation: turn a fatal overflow latch into a bigger run.
+
+The reference never overflows — its heaps grow (shadow's C event queue
+is a dynamic splay tree); our static device shapes trade that away for
+compiled-program speed, so an undersized capacity is a *fatal* latch
+(faults/health.py). This module closes the loop the way an elastic
+trainer regrows its mesh: map the tripped latch to the capacity knob
+that sizes it, double the knob (bounded by a grow budget), rebuild the
+bundle at the new shapes, and TRANSPLANT the last clean pre-trip
+checkpoint into the grown arrays.
+
+Why transplanting is exact and not best-effort: the supervisor gathers
+health BEFORE saving a snapshot, so every snapshot on disk predates
+the first dropped event — its contents are a prefix the larger
+capacity would have produced bit-for-bit (capacity only changes
+behavior at the first drop). Padding that prefix with empty slots on
+the grown axis therefore reproduces, byte for byte on every logical
+slot, the state of a from-scratch run at the grown capacity — modulo
+one *layout* (not content) freedom: the router ring's modular head
+addressing, which transplant() canonicalizes to head 0.
+
+Empty-slot encodings (must match core/events.py create() and
+net/state.py make_net_state): `.time` planes are simtime.INVALID,
+`.dst` planes are -1, everything else zero-fills.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+import numpy as np
+
+from shadow_tpu.core import simtime
+
+# fatal overflow latch (faults/health.py RunHealth field) -> the
+# NetConfig capacity knob that sizes the overflowed array. The knob
+# names are loader override keys, so a rebuild is just
+# bundle.rebuild({knob: new}).
+LATCH_KNOBS = {
+    "events_overflow": "event_capacity",
+    "outbox_overflow": "outbox_capacity",
+    "rq_overflow": "router_ring",
+}
+
+
+class GrowBudgetExceeded(RuntimeError):
+    """The escalation policy ran out of doublings — the run falls back
+    to the plain retry path (and then to the structured failure
+    report naming the knob)."""
+
+
+@dataclasses.dataclass(frozen=True)
+class Escalation:
+    """One healed capacity trip, recorded in checkpoint extras and the
+    run manifest (`escalations` block)."""
+
+    time_ns: int   # window start the heal resumed from
+    latch: str     # RunHealth field that tripped
+    knob: str      # NetConfig knob grown
+    old: int
+    new: int
+
+    def as_dict(self) -> dict:
+        return {"time_ns": self.time_ns, "latch": self.latch,
+                "knob": self.knob, "from": self.old, "to": self.new}
+
+    @staticmethod
+    def from_dict(d: dict) -> "Escalation":
+        return Escalation(time_ns=int(d["time_ns"]), latch=d["latch"],
+                          knob=d["knob"], old=int(d["from"]),
+                          new=int(d["to"]))
+
+
+@dataclasses.dataclass(frozen=True)
+class EscalationPolicy:
+    """Geometric regrowth: each trip doubles the tripped knob(s).
+    `max_grow` bounds the total number of doublings across the whole
+    run (including a resumed chain's earlier heals) — HBM is finite
+    and a workload that keeps outrunning doubling capacity needs an
+    operator, not another doubling."""
+
+    factor: int = 2
+    max_grow: int = 8
+
+
+def overflowed_latches(health) -> list[str]:
+    """Which capacity latches tripped, in LATCH_KNOBS order (stable:
+    escalation records and grown knobs are deterministic)."""
+    return [k for k in LATCH_KNOBS if int(getattr(health, k)) > 0]
+
+
+def plan_growth(health, capacities: dict, policy: EscalationPolicy,
+                grows_used: int, *, time_ns: int,
+                ) -> tuple[dict, list[Escalation]]:
+    """Map tripped latches to capacity overrides. `capacities` is the
+    current build's knob values (utils.checkpoint.capacities_of_sim).
+    Raises GrowBudgetExceeded when the doublings would exceed
+    policy.max_grow, and ValueError when no *capacity* latch tripped
+    (stall/regression trips are not healable by growing anything)."""
+    latches = overflowed_latches(health)
+    if not latches:
+        raise ValueError("no capacity latch tripped — escalation "
+                         "cannot heal this failure")
+    if grows_used + len(latches) > policy.max_grow:
+        raise GrowBudgetExceeded(
+            f"healing {latches} needs {len(latches)} more doubling(s) "
+            f"but {grows_used}/{policy.max_grow} of the grow budget "
+            f"is spent (--max-grow)")
+    overrides: dict = {}
+    events: list[Escalation] = []
+    for latch in latches:
+        knob = LATCH_KNOBS[latch]
+        old = int(capacities[knob])
+        new = old * policy.factor
+        overrides[knob] = new
+        events.append(Escalation(time_ns=int(time_ns), latch=latch,
+                                 knob=knob, old=old, new=new))
+    return overrides, events
+
+
+def _fill_for(key: str):
+    """Empty-slot encoding for a padded region of leaf `key`."""
+    if key.endswith(".time"):
+        return simtime.INVALID
+    if key.endswith(".dst"):
+        return -1
+    return 0
+
+
+def _rotate_router_ring(leaves: dict) -> dict:
+    """Canonicalize the router ring to head 0 before tail-padding.
+
+    rq slots address as (head + i) % R; growing R re-maps every
+    wrapped slot, so naive tail-padding would interleave live and
+    empty entries. Rotating each row so logical slot 0 sits at
+    physical 0 (and zeroing rq_head) preserves the ring's *content*
+    exactly while making tail-padding correct. rq_count is modular-
+    address independent and stays put."""
+    keys = {k: k for k in leaves}
+    src_k = next((k for k in keys if k.endswith(".rq_src")), None)
+    head_k = next((k for k in keys if k.endswith(".rq_head")), None)
+    if src_k is None or head_k is None:
+        return leaves
+    head = leaves[head_k]
+    if not np.any(head):
+        return leaves  # already canonical
+    R = leaves[src_k].shape[1]
+    idx = (head[:, None] + np.arange(R)[None, :]) % R  # [H, R]
+    out = dict(leaves)
+    for k in keys:
+        if k.endswith((".rq_src", ".rq_enq_ts", ".rq_words")):
+            arr = leaves[k]
+            out[k] = np.take_along_axis(
+                arr, idx.reshape(idx.shape + (1,) * (arr.ndim - 2)),
+                axis=1)
+    out[head_k] = np.zeros_like(head)
+    return out
+
+
+def transplant(leaves: dict, meta: dict, template_sim):
+    """Embed a snapshot's leaves into a (possibly larger) template.
+
+    For every template leaf: identical shape -> the checkpoint bytes,
+    verbatim; a grown trailing region -> checkpoint contents at the
+    leading corner over an empty-slot canvas. Anything else — shrunk
+    axis, dtype change, rank change, missing leaf — refuses loudly,
+    naming the exact leaf. Returns (sim, time_ns, extra) exactly like
+    checkpoint.load()."""
+    import jax
+
+    caps = meta.get("capacities") or {}
+    flat, _ = jax.tree_util.tree_flatten_with_path(template_sim)
+    tmap = {jax.tree_util.keystr(p): np.asarray(l) for p, l in flat}
+
+    # the host axis never grows: events re-key by host index, so a
+    # different H is a different simulation, not a bigger one
+    th = next((l.shape[0] for k, l in tmap.items()
+               if k.endswith(".rq_head")), None)
+    if caps.get("num_hosts") is not None and th is not None \
+            and caps["num_hosts"] != th:
+        raise ValueError(
+            f"snapshot has num_hosts={caps['num_hosts']}, template "
+            f"has {th} — the host axis cannot be transplanted")
+
+    ring_grew = (caps.get("router_ring") is not None and th is not None
+                 and any(k.endswith(".rq_src")
+                         and l.shape[1] > caps["router_ring"]
+                         for k, l in tmap.items()))
+    if ring_grew:
+        leaves = _rotate_router_ring(leaves)
+
+    out = []
+    for pth, tleaf in flat:
+        key = jax.tree_util.keystr(pth)
+        if key not in leaves:
+            raise ValueError(f"snapshot missing leaf {key} "
+                             f"(config mismatch?)")
+        arr = np.asarray(leaves[key])
+        t = np.asarray(tleaf)
+        if arr.dtype != t.dtype or arr.ndim != t.ndim:
+            raise ValueError(
+                f"cannot transplant leaf {key}: snapshot is "
+                f"{arr.shape}/{arr.dtype}, template is "
+                f"{t.shape}/{t.dtype}")
+        if arr.shape == t.shape:
+            out.append(jnp.asarray(arr))
+            continue
+        if any(a > b for a, b in zip(arr.shape, t.shape)):
+            raise ValueError(
+                f"cannot transplant leaf {key}: snapshot axis "
+                f"{arr.shape} exceeds template {t.shape} — capacities "
+                f"only grow (resuming into a shrunken config loses "
+                f"state)")
+        canvas = np.full(t.shape, _fill_for(key), dtype=t.dtype)
+        canvas[tuple(slice(0, s) for s in arr.shape)] = arr
+        out.append(jnp.asarray(canvas))
+    treedef = jax.tree_util.tree_structure(template_sim)
+    sim = jax.tree_util.tree_unflatten(treedef, out)
+    return sim, meta["time_ns"], meta.get("extra", {})

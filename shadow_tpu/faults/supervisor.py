@@ -1,36 +1,55 @@
 """Run supervisor: window loop + health latches + checkpoint-backed
-recovery.
+recovery + capacity escalation + preemption-safe resume chains.
 
 The CLI's `--supervise` mode runs the simulation through here instead
 of the one-shot jitted runner. Every round the supervisor inspects
 the sticky latches (faults/health.py) plus its own stall /
 time-regression telemetry; every N *windows* it snapshots the sim
 (utils/checkpoint.py — atomic + checksummed, so a trip mid-save can
-never leave a poisoned resume point). When a fatal latch trips it
-restores the last good snapshot, backs off exponentially, and retries
-up to max_retries before giving up with a structured failure report.
+never leave a poisoned resume point). Recovery has three distinct
+paths, accounted separately:
 
-Retrying after a *deterministic* trip only helps when the operator's
-knobs differ between attempts (the retry hook bumps nothing itself —
-determinism is the whole point), but crashes of the host process,
-preemptions, and transient device loss are exactly what the
-checkpoint chain is for; the bounded retry covers those while the
-structured report covers the deterministic case.
+- **escalation** (`escalation=EscalationPolicy(...)`): a fatal
+  *capacity* latch (event queue / outbox / router ring overflow) is
+  healed, not retried — the tripped knob doubles, the bundle rebuilds
+  at the grown shapes (bundle.rebuild, installed by config/loader),
+  and the last clean pre-trip snapshot transplants into the padded
+  arrays (faults/escalate.py). Escalation restarts do NOT consume the
+  retry budget and do not back off: the restart is a fix, not a
+  gamble.
+- **retry**: everything else (stall, regression, exhausted grow
+  budget, no rebuild hook) restores the last good snapshot, backs off
+  exponentially, and retries up to max_retries before giving up with
+  a structured failure report. Retrying a *deterministic* trip
+  reproduces it — the budget exists for host-process crashes and
+  transient device loss.
+- **preemption** (`stop=callable`): when the flag reads true at a
+  round barrier the supervisor takes one final atomic checkpoint and
+  raises out with `preempted=True` — the CLI maps it to its own exit
+  code and a manifest carrying the `resume_of` chain id, and
+  `--resume` continues the chain later, under any shard count
+  (snapshots hold global-layout arrays).
 
 Checkpoint cadence is counted in windows, not sim-ns: window length
 tracks min_jump, so N windows is a stable amount of device work
-regardless of the topology's latency floor.
+regardless of the topology's latency floor. Engine-stat totals ride
+every snapshot's `extra` (escalation-aware carryover: the pre-trip
+counters live in a different compiled program than the post-heal
+ones), so a resumed chain reports cumulative work, not the last
+attempt's slice.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import time as _time
+import uuid
 from typing import Optional
 
 import numpy as np
 
 from shadow_tpu.core import simtime
+from shadow_tpu.faults import escalate as escalate_mod
 from shadow_tpu.faults import health as health_mod
 from shadow_tpu.utils import checkpoint as ckpt
 
@@ -47,21 +66,59 @@ class LatchTrip(RuntimeError):
         super().__init__(msgs or "health latch tripped")
 
 
+class Preempted(RuntimeError):
+    """The stop flag was set at a round barrier; a final checkpoint
+    was taken before raising."""
+
+    def __init__(self, path: str, time_ns: int, sim=None):
+        self.path = path
+        self.time_ns = time_ns
+        self.sim = sim
+        super().__init__(f"preempted at t={time_ns}, checkpoint {path}")
+
+
 @dataclasses.dataclass
 class SupervisorResult:
     ok: bool
     sim: object
-    stats: object                      # EngineStats totals (last attempt)
+    stats: object                      # EngineStats, cumulative chain
     health: health_mod.RunHealth       # final latch snapshot
     attempts: int = 1
     resumed_from: Optional[str] = None  # snapshot path of the last resume
     checkpoints: tuple = ()            # (path, time_ns) saved, all attempts
+    # accounting split (the --retries budget must not be consumed by
+    # successful self-healing):
+    retries_used: int = 0              # failure retries, <= max_retries
+    escalation_restarts: int = 0       # heals; unbounded by max_retries
+    escalations: tuple = ()            # Escalation records, chain-wide
+    preempted: bool = False
+    final_checkpoint: Optional[str] = None  # preemption's last snapshot
+    run_id: Optional[str] = None
+    resume_of: Optional[str] = None    # run_id of the chain predecessor
 
     def failure_report(self) -> dict:
-        rep = self.health.failure_report()
+        rep = self.health.failure_report() if self.health is not None \
+            else {"verdict": "preempted", "fatal": []}
         rep["attempts"] = self.attempts
         rep["resumed_from"] = self.resumed_from
+        rep["retries_used"] = self.retries_used
+        rep["escalation_restarts"] = self.escalation_restarts
+        if self.escalations:
+            rep["escalations"] = [e.as_dict() for e in self.escalations]
+        if self.preempted:
+            rep["verdict"] = "preempted"
+            rep["final_checkpoint"] = self.final_checkpoint
         return rep
+
+
+def _stats_get(wstats) -> dict:
+    """Per-round EngineStats as host ints (one device_get)."""
+    import jax
+
+    s = jax.device_get(wstats)
+    return {k: int(getattr(s, k)) for k in
+            ("events_processed", "micro_steps", "windows",
+             "fastpath_hit", "fastpath_miss")}
 
 
 def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
@@ -69,36 +126,86 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    checkpoint_every_windows: int = 64,
                    max_retries: int = 2, backoff_s: float = 0.25,
                    stall_windows: int = 512,
-                   log=None, on_window=None, harvester=None,
-                   sleep=_time.sleep) -> SupervisorResult:
-    """Run bundle to end_time under supervision. Serial runner only
-    (the host must regain control at every window barrier); the CLI
-    routes --supervise to it. `log` is a callable taking one message
-    string; `sleep` is injectable for tests. `harvester`
-    (telemetry.Harvester) is drained every round — "between supervisor
-    checkpoints" — and its loss count rides the health snapshot as a
-    warning; its rewind handling keeps resumed attempts from
-    double-counting replayed windows."""
+                   log=None, on_window=None, on_round=None,
+                   harvester=None, sleep=_time.sleep,
+                   escalation: escalate_mod.EscalationPolicy | None = None,
+                   rebuild=None, stop=None, resume_from=None,
+                   run_id: str | None = None,
+                   mesh=None, mesh_axis: str = "hosts",
+                   exchange_capacity: int | None = None,
+                   config_digest: str | None = None,
+                   ) -> SupervisorResult:
+    """Run bundle to end_time under supervision (host-driven window
+    loop; serial by default, shard_map'd over `mesh` when given — the
+    host regains control at every window barrier either way).
+
+    `escalation` turns capacity trips into heals (see module doc);
+    `rebuild(overrides) -> SimBundle` defaults to bundle.rebuild (set
+    by config/loader.load). When escalation rebuilds, an explicitly
+    passed `fault_fn` is dropped and re-resolved from the rebuilt
+    bundle's installed plan — a closure over the old shapes would
+    poison the new program. `stop()` is polled at every round barrier
+    (preemption flag, set from a signal handler); `resume_from` is a
+    snapshot path to continue a previous run's chain (grown-capacity
+    snapshots transplant automatically). `on_round(sim, wstats,
+    wstart, wend, next_min)` runs after the health check each round —
+    the chaos harness samples its conservation ledger there. `log` is
+    a callable taking one message string; `sleep` is injectable for
+    tests."""
 
     def say(msg):
         if log is not None:
             log(msg)
 
+    rebuild_fn = rebuild if rebuild is not None \
+        else getattr(bundle, "rebuild", None)
+    run_id = run_id or uuid.uuid4().hex[:12]
+    shards = mesh.shape[mesh_axis] if mesh is not None else 1
+
     total_saved = []
     attempt = 0
+    retries_used = 0
+    escalation_restarts = 0
+    escalations: list = []
+    grows_used = 0
     resume_sim = None
     resume_time = 0
     resumed_from = None
+    resume_of = None
+    base_stats = {}                    # chain totals at the resume point
+
+    if resume_from is not None:
+        leaves, meta = ckpt.load_leaves(resume_from)
+        resume_sim, resume_time, extra = escalate_mod.transplant(
+            leaves, meta, bundle.sim)
+        base_stats = dict(extra.get("stats", {}))
+        resume_of = extra.get("run_id")
+        escalations = [escalate_mod.Escalation.from_dict(d)
+                       for d in extra.get("escalations", [])]
+        grows_used = len(escalations)
+        resumed_from = resume_from
+        say(f"supervisor: resuming chain {resume_of or '?'} from "
+            f"{resume_from} (t={resume_time})")
+
+    def _ckpt_extra(acc: dict) -> dict:
+        stats = {k: base_stats.get(k, 0) + acc.get(k, 0)
+                 for k in ("events_processed", "micro_steps", "windows",
+                           "fastpath_hit", "fastpath_miss")}
+        return {"stats": stats, "run_id": run_id,
+                "escalations": [e.as_dict() for e in escalations]}
 
     while True:
         attempt += 1
-        # Per-attempt telemetry the on_round closure mutates.
+        # Per-attempt telemetry the round closure mutates.
         tele = {"zero_streak": 0, "worst_streak": 0, "regressed": False,
-                "wstart": None, "since_ckpt": 0}
+                "wstart": None, "since_ckpt": 0, "acc": {}}
 
-        def on_round(sim, wstats, wstart, wend, next_min):
+        def _on_round(sim, wstats, wstart, wend, next_min):
             tele["wstart"] = wstart
-            if int(np.asarray(wstats.events_processed)) == 0:
+            ws = _stats_get(wstats)
+            for k, v in ws.items():
+                tele["acc"][k] = tele["acc"].get(k, 0) + v
+            if ws["events_processed"] == 0:
                 tele["zero_streak"] += 1
                 tele["worst_streak"] = max(tele["worst_streak"],
                                            tele["zero_streak"])
@@ -112,17 +219,37 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 harvester.drain(sim)
             h = _gather(sim)
             if h.fatal:
+                # before the user hooks on purpose: a tripped round's
+                # state is corrupt and will be replayed after the heal
+                # — observers should never see it as a completed round
                 raise LatchTrip(h, sim)
+            # Health precedes every save: snapshots are always clean,
+            # which is what makes escalation transplants exact.
             tele["since_ckpt"] += 1
             if (tele["since_ckpt"] >= checkpoint_every_windows
                     and next_min < simtime.INVALID):
                 # Healthy at this barrier: snapshot resumes at next_min.
                 p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
-                              time_ns=next_min)
+                              time_ns=next_min, shards=shards,
+                              config_digest=config_digest,
+                              extra=_ckpt_extra(tele["acc"]))
                 total_saved.append((p, next_min))
                 tele["since_ckpt"] = 0
+            if on_round is not None:
+                on_round(sim, wstats, wstart, wend, next_min)
             if on_window is not None:
                 on_window(sim, wend)
+            # Preemption polls LAST: the round is complete and every
+            # observer has seen it — the final snapshot's resume point
+            # starts the next round, so a hook that never saw this one
+            # would double- or under-count across the kill boundary.
+            if stop is not None and stop() and next_min < simtime.INVALID:
+                p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
+                              time_ns=next_min, shards=shards,
+                              config_digest=config_digest,
+                              extra=_ckpt_extra(tele["acc"]))
+                total_saved.append((p, next_min))
+                raise Preempted(p, next_min, sim)
 
         def _gather(sim):
             return health_mod.gather(
@@ -135,6 +262,18 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                                 if harvester is not None else 0),
             )
 
+        def _result(ok, sim, h, **kw):
+            return SupervisorResult(
+                ok=ok, sim=sim, health=h, attempts=attempt,
+                resumed_from=resumed_from,
+                checkpoints=tuple(total_saved),
+                retries_used=retries_used,
+                escalation_restarts=escalation_restarts,
+                escalations=tuple(escalations),
+                run_id=run_id, resume_of=resume_of, **kw)
+
+        from shadow_tpu.core.engine import EngineStats
+
         try:
             sim, stats, _ = ckpt.run_windows(
                 bundle, app_handlers,
@@ -142,34 +281,90 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 start_time=resume_time,
                 sim=resume_sim,
                 fault_fn=fault_fn,
-                on_round=on_round,
+                on_round=_on_round,
+                stats0=(EngineStats.from_dict(base_stats)
+                        if base_stats else None),
+                mesh=mesh, mesh_axis=mesh_axis,
+                exchange_capacity=exchange_capacity,
             )
             if harvester is not None:
                 harvester.drain(sim)
             h = _gather(sim)
             if h.fatal:
                 raise LatchTrip(h, sim)
-            return SupervisorResult(
-                ok=True, sim=sim, stats=stats, health=h,
-                attempts=attempt, resumed_from=resumed_from,
-                checkpoints=tuple(total_saved))
+            return _result(True, sim, h, stats=stats)
+        except Preempted as p:
+            say(f"supervisor: {p}")
+            # the preempting round passed its health check before the
+            # final save — report that healthy snapshot, not a guess
+            return _result(
+                False, p.sim, _gather(p.sim),
+                stats=EngineStats.from_dict(
+                    _ckpt_extra(tele["acc"])["stats"]),
+                preempted=True, final_checkpoint=p.path)
         except LatchTrip as trip:
             say(f"supervisor: latch trip on attempt {attempt}: {trip}")
-            if attempt > max_retries:
+            healed = False
+            if escalation is not None and rebuild_fn is not None:
+                try:
+                    caps = ckpt.capacities_of_sim(bundle.sim)
+                    t0 = total_saved[-1][1] if total_saved else 0
+                    grow, events = escalate_mod.plan_growth(
+                        trip.health, caps, escalation, grows_used,
+                        time_ns=t0)
+                    healed = True
+                except (ValueError, escalate_mod.GrowBudgetExceeded) as e:
+                    say(f"supervisor: escalation unavailable: {e}")
+            if healed:
+                for ev in events:
+                    say(f"supervisor: escalating {ev.knob} "
+                        f"{ev.old} -> {ev.new} ({ev.latch})")
+                    if harvester is not None:
+                        harvester.mark_escalation(ev)
+                old_telem = getattr(bundle.sim, "telem", None)
+                bundle = rebuild_fn(grow)
+                if old_telem is not None:
+                    from shadow_tpu.telemetry.ring import attach
+
+                    bundle.sim = attach(bundle.sim,
+                                        capacity=old_telem.capacity)
+                # a caller-supplied fault_fn closes over the OLD
+                # shapes; drop it — run_windows re-resolves from the
+                # rebuilt bundle's installed plan
+                fault_fn = None
+                escalations.extend(events)
+                grows_used += len(events)
+                escalation_restarts += 1
+                if total_saved:
+                    path, t = total_saved[-1]
+                    say(f"supervisor: transplanting {path} (t={t}) "
+                        f"into grown shapes")
+                    leaves, meta = ckpt.load_leaves(path)
+                    resume_sim, resume_time, extra = \
+                        escalate_mod.transplant(leaves, meta, bundle.sim)
+                    base_stats = dict(extra.get("stats", {}))
+                    resumed_from = path
+                else:
+                    say("supervisor: no snapshot yet, rebooting at "
+                        "grown capacity")
+                    resume_sim, resume_time = None, 0
+                    base_stats = {}
+                continue  # a heal consumes no retry and sleeps never
+            if retries_used >= max_retries:
                 # carry the tripped sim so the caller can still report
                 # (object counts, manifest counters) from it
-                return SupervisorResult(
-                    ok=False, sim=trip.sim, stats=None, health=trip.health,
-                    attempts=attempt, resumed_from=resumed_from,
-                    checkpoints=tuple(total_saved))
+                return _result(False, trip.sim, trip.health, stats=None)
+            retries_used += 1
             if total_saved:
                 path, t = total_saved[-1]
                 say(f"supervisor: resuming from {path} (t={t}) after "
                     f"backoff")
-                resume_sim, resume_time, _ = ckpt.load(path, bundle.sim)
+                resume_sim, resume_time, extra = ckpt.load(path, bundle.sim)
+                base_stats = dict(extra.get("stats", {}))
                 resumed_from = path
             else:
                 say("supervisor: no snapshot yet, restarting from boot")
                 resume_sim, resume_time = None, 0
                 resumed_from = None
-            sleep(backoff_s * (2 ** (attempt - 1)))
+                base_stats = {}
+            sleep(backoff_s * (2 ** (retries_used - 1)))

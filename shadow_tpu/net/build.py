@@ -83,6 +83,13 @@ class SimBundle:
     # runners derive the window-boundary fault_fn from it (and the
     # boot sim) via faults.fault_fn_for(bundle).
     fault_plan: Any = None
+    # Optional rebuild(overrides: dict) -> SimBundle installed by
+    # config/loader.py: re-run the whole load (topology, app setup,
+    # fault install) with capacity overrides merged in. This is the
+    # escalation path's lever (faults/escalate.py) — a grown capacity
+    # needs a fresh Sim AND fresh step/fault closures, because every
+    # compiled function shape-specializes on the boot arrays.
+    rebuild: Any = None
 
     def ip_of(self, name: str) -> int:
         return self.dns.resolve_name(name).ip

@@ -410,17 +410,20 @@ def sharded_engine_run(
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
                         exchange_capacity: int | None = None,
                         narrow: int | None = None, fault_fn=None):
-    """A jitted (sim, wend) -> (sim, stats, next_min) running ONE
-    window round under shard_map — the building block for host-driven
-    window loops (ProcessRuntime, checkpoint.run_windows) on a mesh.
-    next_min is replicated by the pmin barrier; `sim` may be passed
-    unsharded on first call (jit reshards per sim_specs)."""
+    """A jitted (sim, wstart, wend) -> (sim, stats, next_min) running
+    ONE window round under shard_map — the building block for
+    host-driven window loops (ProcessRuntime, checkpoint.run_windows)
+    on a mesh. next_min is replicated by the pmin barrier; `sim` may be
+    passed unsharded on first call (jit reshards per sim_specs). The
+    telemetry hook is threaded with the mesh axis so ring aggregates
+    are globally reduced — a trace-time no-op when sim.telem is None,
+    exactly like the whole-run harness."""
     from shadow_tpu.core.engine import step_window
 
     num_shards, specs, stats_specs = _harness_specs(mesh, axis,
                                                     sim_template)
 
-    def _body(local_sim, wend):
+    def _body(local_sim, wstart, wend):
         lane = local_sim.net.lane_id
         stats = EngineStats.create()
         out_sim, stats, next_min = step_window(
@@ -430,6 +433,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
                                        exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
             fault_fn=fault_fn,
+            telem_fn=make_telem_fn(axis), wstart=wstart,
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
         )
@@ -437,7 +441,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
         return out_sim, stats, next_min
 
     shmapped = _shard_map(
-        _body, mesh=mesh, in_specs=(specs, P()),
+        _body, mesh=mesh, in_specs=(specs, P(), P()),
         out_specs=(specs, stats_specs, P()), check_vma=False,
     )
     return jax.jit(shmapped)

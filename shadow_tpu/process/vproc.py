@@ -846,9 +846,8 @@ class ProcessRuntime:
         if mesh is not None:
             from shadow_tpu.parallel.shard import make_sharded_window
 
-            win = make_sharded_window(mesh, axis, bundle.sim, self.cfg,
-                                      self._step)
-            self._jit_window = lambda sim, wstart, wend: win(sim, wend)
+            self._jit_window = make_sharded_window(
+                mesh, axis, bundle.sim, self.cfg, self._step)
         else:
             self._jit_window = jax.jit(self._window)
         # host-side snapshots of sk_flags / tcp.st, fetched at most

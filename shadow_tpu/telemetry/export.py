@@ -156,11 +156,18 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  timers=None, wall_seconds: float | None = None,
                  compile_s: float | None = None,
                  compile_fresh: bool | None = None,
-                 conformance: dict | None = None) -> dict:
+                 conformance: dict | None = None,
+                 run_id: str | None = None,
+                 resume_of: str | None = None,
+                 escalations=None,
+                 preempted: bool | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
-    served from the persistent compilation cache (False)."""
+    served from the persistent compilation cache (False). `run_id` /
+    `resume_of` chain preemption-split runs (--resume); `escalations`
+    lists the supervisor's healed capacity trips (Escalation records
+    or their dicts)."""
     man = {
         "config_hash": config_hash(cfg),
         "seed": int(seed),
@@ -191,6 +198,16 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # dual-mode verdicts (hostrun/runner.py:conformance_block):
         # which workloads ran both backends, and whether they agreed
         man["conformance"] = conformance
+    if run_id is not None:
+        man["run_id"] = run_id
+    if resume_of is not None:
+        man["resume_of"] = resume_of
+    if escalations:
+        man["escalations"] = [
+            e if isinstance(e, dict) else e.as_dict()
+            for e in escalations]
+    if preempted is not None:
+        man["preempted"] = bool(preempted)
     return man
 
 
@@ -217,6 +234,15 @@ def metrics_from_manifest(man: dict) -> dict:
     if "conformance" in man:
         out["conformance_agree"] = man["conformance"].get("agree", 0)
         out["conformance_diverge"] = man["conformance"].get("diverge", 0)
+    if "escalations" in man:
+        esc = man["escalations"]
+        out["escalations_total"] = len(esc)
+        # final capacity per grown knob — the dashboard's "what is
+        # this run actually sized at now" gauge
+        out["escalated_capacity"] = {
+            e["knob"]: e["to"] for e in esc if "knob" in e}
+    if "preempted" in man:
+        out["preempted"] = bool(man["preempted"])
     return out
 
 

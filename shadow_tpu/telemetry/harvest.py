@@ -54,6 +54,16 @@ class Harvester:
     seen: int = 0                 # ring count at the last drain
     records: list = field(default_factory=list)
     records_lost: int = 0
+    # escalation marks (faults/escalate.py Escalation dicts) the
+    # supervisor notes on heal: the ring itself survives a transplant
+    # byte-for-byte, but the heal is a host-side act the device never
+    # sees — record it here so the manifest's telemetry aggregates
+    # carry it next to the windows it interrupted
+    escalation_marks: list = field(default_factory=list)
+
+    def mark_escalation(self, esc) -> None:
+        self.escalation_marks.append(
+            esc if isinstance(esc, dict) else esc.as_dict())
 
     def drain(self, sim) -> int:
         """Pull records written since the last drain. Returns how many
@@ -107,6 +117,8 @@ class Harvester:
                 sum(r.fastpath for r in self.records))
             out["active_lanes_max"] = int(
                 max(r.active_lanes for r in self.records))
+        if self.escalation_marks:
+            out["escalations"] = len(self.escalation_marks)
         return out
 
 

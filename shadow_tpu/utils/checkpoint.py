@@ -55,14 +55,37 @@ def _crc(arr: np.ndarray) -> int:
     return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
 
 
-def save(path: str, sim, *, time_ns: int, extra: dict | None = None):
+def capacities_of_sim(sim) -> dict:
+    """The static-shape knobs a snapshot depends on, read from the
+    arrays themselves (the Sim does not carry its NetConfig). These
+    ride __meta__ so a resume into a differently-sized build is
+    diagnosed by *name* — and so the escalation transplanter
+    (faults/escalate.py) knows which axis grew."""
+    return {
+        "num_hosts": int(sim.events.num_hosts),
+        "event_capacity": int(sim.events.capacity),
+        "outbox_capacity": int(sim.outbox.dst.shape[1]),
+        "router_ring": int(sim.net.rq_src.shape[1]),
+    }
+
+
+def save(path: str, sim, *, time_ns: int, extra: dict | None = None,
+         shards: int = 1, config_digest: str | None = None):
     """Snapshot a Sim pytree at a window boundary. `time_ns` is the
     next window start (resume point). Atomic: the snapshot appears at
-    `path` complete or not at all."""
+    `path` complete or not at all. `shards` records the mesh width the
+    run used and `config_digest` the config hash — both are diagnostic
+    metadata only (state arrays are always saved in global layout, so
+    a snapshot resumes under ANY shard count; a digest mismatch is a
+    warning, not a refusal)."""
     leaves = _leaf_dict(sim)
     meta = {"time_ns": int(time_ns), "extra": extra or {},
             "layout": LAYOUT_VERSION, "keys": sorted(leaves),
-            "crc32": {k: _crc(v) for k, v in leaves.items()}}
+            "crc32": {k: _crc(v) for k, v in leaves.items()},
+            "capacities": capacities_of_sim(sim),
+            "shards": int(shards),
+            "config_digest": config_digest,
+            "jax_version": jax.__version__}
     # np.savez appends ".npz" to *paths* but not to file objects, and
     # the atomic write goes through a file object — normalize here so
     # both spellings land at the same place.
@@ -86,41 +109,124 @@ def save(path: str, sim, *, time_ns: int, extra: dict | None = None):
     return path
 
 
-def load(path: str, template_sim):
-    """Rebuild a Sim from a snapshot. `template_sim` supplies the
-    pytree structure (build the bundle with the SAME config first);
-    every array is checked against the template's shape and dtype,
-    and against the stored CRC32 when the snapshot carries one."""
+def _check_layout(meta: dict):
+    layout = meta.get("layout", 1)
+    if layout != LAYOUT_VERSION:
+        raise ValueError(
+            f"snapshot uses packet-word layout v{layout}, this "
+            f"build reads v{LAYOUT_VERSION} — resuming would "
+            f"reinterpret header words; re-run from config")
+
+
+def peek_meta(path: str) -> dict:
+    """Read a snapshot's __meta__ without touching the state arrays —
+    cheap enough for the CLI's --resume to pick capacity overrides and
+    for faultplan_lint's cross-check. Raises on a layout-generation
+    mismatch (shape metadata from another layout is meaningless)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
     with np.load(path, allow_pickle=False) as z:
         meta = json.loads(str(z["__meta__"]))
-        layout = meta.get("layout", 1)
-        if layout != LAYOUT_VERSION:
-            raise ValueError(
-                f"snapshot uses packet-word layout v{layout}, this "
-                f"build reads v{LAYOUT_VERSION} — resuming would "
-                f"reinterpret header words; re-run from config")
+    _check_layout(meta)
+    return meta
+
+
+def latest_checkpoint(prefix: str) -> str | None:
+    """Newest snapshot (by recorded resume time) among files written
+    as f"{prefix}.{time_ns}.npz" — the spelling both run_windows and
+    the supervisor use. Returns None when no snapshot matches; skips
+    files whose time suffix does not parse (never another run's)."""
+    import glob
+
+    best, best_t = None, -1
+    for p in glob.glob(f"{prefix}.*.npz"):
+        stem = p[len(prefix) + 1:-len(".npz")]
+        try:
+            t = int(stem)
+        except ValueError:
+            continue
+        if t > best_t:
+            best, best_t = p, t
+    return best
+
+
+def load_leaves(path: str) -> tuple[dict, dict]:
+    """CRC- and layout-verified raw leaves: {keystr: np.ndarray} plus
+    the __meta__ dict. load() builds a same-shape Sim from these; the
+    escalation transplanter (faults/escalate.py) pads them into a
+    grown template instead. A CRC failure names the exact leaf."""
+    with np.load(path, allow_pickle=False) as z:
+        meta = json.loads(str(z["__meta__"]))
+        _check_layout(meta)
         crcs = meta.get("crc32", {})  # absent in older snapshots
-        flat, treedef = jax.tree_util.tree_flatten_with_path(template_sim)
-        leaves = []
-        for pth, tleaf in flat:
-            key = jax.tree_util.keystr(pth)
-            if key not in z:
-                raise ValueError(f"snapshot missing leaf {key} "
-                                 f"(config mismatch?)")
+        leaves = {}
+        for key in z.files:
+            if key == "__meta__":
+                continue
             arr = z[key]
-            t = np.asarray(tleaf)
-            if arr.shape != t.shape or arr.dtype != t.dtype:
-                raise ValueError(
-                    f"snapshot leaf {key} is {arr.shape}/{arr.dtype}, "
-                    f"template expects {t.shape}/{t.dtype} "
-                    f"(config mismatch)")
             if key in crcs and _crc(arr) != crcs[key]:
                 raise ValueError(
                     f"snapshot leaf {key} fails its CRC32 — snapshot "
                     f"is corrupt, refuse to resume")
-            leaves.append(jax.numpy.asarray(arr))
-        treedef = jax.tree_util.tree_structure(template_sim)
-        sim = jax.tree_util.tree_unflatten(treedef, leaves)
+            leaves[key] = arr
+    return leaves, meta
+
+
+# leaf-key prefixes -> the capacity knob that sizes them, for shape
+# mismatch diagnostics (the knob names match NetConfig fields and the
+# loader's override keys, so the message is directly actionable)
+_KNOB_OF_CAPACITY = {
+    "event_capacity": "event_capacity",
+    "outbox_capacity": "outbox_capacity",
+    "router_ring": "router_ring",
+    "num_hosts": "host count",
+}
+
+
+def _shape_mismatch_msg(key, arr, t, meta) -> str:
+    msg = (f"snapshot leaf {key} is {arr.shape}/{arr.dtype}, "
+           f"template expects {t.shape}/{t.dtype} (config mismatch)")
+    caps = meta.get("capacities")
+    if caps:
+        # name the knob(s) whose recorded value explains the leaf —
+        # "config mismatch" alone sends the operator diffing configs;
+        # "snapshot was taken at event_capacity=512, this build has
+        # 128" sends them straight to the flag
+        diffs = [f"snapshot {k}={v}" for k, v in sorted(caps.items())
+                 if isinstance(v, int) and (v in arr.shape)
+                 and (v not in t.shape)]
+        if diffs:
+            msg += ("; " + ", ".join(diffs)
+                    + " — rebuild with matching capacities or resume "
+                      "with --auto-grow")
+    return msg
+
+
+def load(path: str, template_sim):
+    """Rebuild a Sim from a snapshot. `template_sim` supplies the
+    pytree structure (build the bundle with the SAME config first);
+    every array is checked against the template's shape and dtype,
+    and against the stored CRC32 when the snapshot carries one. Every
+    refusal names the exact leaf (and, for shape mismatches, the
+    capacity knob recorded at save time) instead of a generic
+    config-mismatch shrug."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    stored, meta = load_leaves(path)
+    flat, _ = jax.tree_util.tree_flatten_with_path(template_sim)
+    leaves = []
+    for pth, tleaf in flat:
+        key = jax.tree_util.keystr(pth)
+        if key not in stored:
+            raise ValueError(f"snapshot missing leaf {key} "
+                             f"(config mismatch?)")
+        arr = stored[key]
+        t = np.asarray(tleaf)
+        if arr.shape != t.shape or arr.dtype != t.dtype:
+            raise ValueError(_shape_mismatch_msg(key, arr, t, meta))
+        leaves.append(jax.numpy.asarray(arr))
+    treedef = jax.tree_util.tree_structure(template_sim)
+    sim = jax.tree_util.tree_unflatten(treedef, leaves)
     return sim, meta["time_ns"], meta["extra"]
 
 
@@ -128,7 +234,9 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 start_time: int = 0, sim=None,
                 checkpoint_every_ns: int | None = None,
                 checkpoint_path: str | None = None,
-                on_window=None, on_round=None, fault_fn=None):
+                on_window=None, on_round=None, fault_fn=None,
+                stats0=None, mesh=None, mesh_axis: str = "hosts",
+                exchange_capacity: int | None = None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
     master.c:450-480; one jitted step_window per round so the host
@@ -140,6 +248,14 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     supervisor (faults/supervisor.py) hangs its health latches and
     window-counted checkpoints off it; it may raise to abort the loop.
     `fault_fn` (faults.apply) is threaded into step_window.
+
+    `stats0` seeds the running totals (resume chains and escalation
+    restarts carry processed-event counts across program rebuilds).
+    `mesh` switches the per-round window to the shard_map harness
+    (parallel.shard.make_sharded_window) over `mesh_axis` — same
+    advance rule, same host-side loop, so supervision and checkpoints
+    work identically multi-chip; state stays in global layout at the
+    host boundary, so snapshots remain shard-count portable.
     """
     import jax.numpy as jnp
 
@@ -157,23 +273,32 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
 
         fault_fn = _resolve_fault_fn(bundle, None)
 
-    from shadow_tpu.telemetry.ring import make_telem_fn
+    shards = 1
+    if mesh is not None:
+        from shadow_tpu.parallel.shard import make_sharded_window
 
-    telem_fn = make_telem_fn()  # trace-time no-op when sim.telem is None
+        shards = mesh.shape[mesh_axis]
+        one_window = make_sharded_window(
+            mesh, mesh_axis, bundle.sim, cfg, step,
+            exchange_capacity=exchange_capacity, fault_fn=fault_fn)
+    else:
+        from shadow_tpu.telemetry.ring import make_telem_fn
 
-    from shadow_tpu.core.engine import resolve_sparse_lanes
+        telem_fn = make_telem_fn()  # trace-time no-op, telem is None
 
-    @jax.jit
-    def one_window(sim, wstart, wend):
-        stats = EngineStats.create()
-        return step_window(sim, stats, step, wend,
-                           emit_capacity=cfg.emit_capacity,
-                           lane_id=sim.net.lane_id,
-                           fault_fn=fault_fn,
-                           telem_fn=telem_fn, wstart=wstart,
-                           sparse_lanes=resolve_sparse_lanes(cfg))
+        from shadow_tpu.core.engine import resolve_sparse_lanes
 
-    total = EngineStats.create()
+        @jax.jit
+        def one_window(sim, wstart, wend):
+            stats = EngineStats.create()
+            return step_window(sim, stats, step, wend,
+                               emit_capacity=cfg.emit_capacity,
+                               lane_id=sim.net.lane_id,
+                               fault_fn=fault_fn,
+                               telem_fn=telem_fn, wstart=wstart,
+                               sparse_lanes=resolve_sparse_lanes(cfg))
+
+    total = stats0 if stats0 is not None else EngineStats.create()
     saved = []
     next_ckpt = (start_time + checkpoint_every_ns
                  if checkpoint_every_ns else None)
@@ -181,7 +306,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     while wstart <= end:
         if (next_ckpt is not None and wstart >= next_ckpt
                 and checkpoint_path is not None):
-            p = save(f"{checkpoint_path}.{wstart}.npz", sim, time_ns=wstart)
+            p = save(f"{checkpoint_path}.{wstart}.npz", sim,
+                     time_ns=wstart, shards=shards)
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
         wend = min(wstart + min_jump, end + 1)

@@ -24,11 +24,12 @@ GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
 </graphml>"""
 
 
-def _build(H=16, load=4, sim_s=2, seed=7):
+def _build(H=16, load=4, sim_s=2, seed=7, event_capacity=None):
     cap = max(32, 4 * load)
     cfg = NetConfig(num_hosts=H, tcp=False,
                     end_time=sim_s * simtime.ONE_SECOND, seed=seed,
-                    event_capacity=cap, outbox_capacity=cap,
+                    event_capacity=event_capacity or cap,
+                    outbox_capacity=cap,
                     router_ring=cap, in_ring=max(8, 2 * load))
     hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
     b = build(cfg, GRAPH, hosts)
@@ -122,6 +123,112 @@ def test_save_is_atomic_and_checksummed(tmp_path):
              **{k: v for k, v in data.items() if k != "__meta__"})
     with pytest.raises(ValueError, match="CRC32"):
         checkpoint.load(str(corrupt), b.sim)
+
+
+def test_meta_records_capacities_shards_digest(tmp_path):
+    """__meta__ carries the static-shape knobs, the mesh width, and
+    the config digest — what --resume, faultplan_lint --checkpoint,
+    and the escalation transplanter key off (ISSUE PR 5 satellite)."""
+    b = _build(H=8, load=2, sim_s=1)
+    p = checkpoint.save(str(tmp_path / "s"), b.sim, time_ns=5,
+                        shards=4, config_digest="d" * 64)
+    meta = checkpoint.peek_meta(p)
+    assert meta["capacities"] == checkpoint.capacities_of_sim(b.sim)
+    assert meta["capacities"]["num_hosts"] == 8
+    assert meta["shards"] == 4
+    assert meta["config_digest"] == "d" * 64
+    assert meta["layout"] == checkpoint.LAYOUT_VERSION
+    assert meta["jax_version"]
+
+
+def test_load_mismatch_names_the_capacity_knob(tmp_path):
+    """A shape refusal must name the knob recorded at save time and
+    point at --auto-grow — not shrug 'config mismatch'."""
+    small = _build(H=8, load=2, sim_s=1, event_capacity=32)
+    big = _build(H=8, load=2, sim_s=1, event_capacity=64)
+    p = checkpoint.save(str(tmp_path / "s"), small.sim, time_ns=0)
+    with pytest.raises(ValueError) as ei:
+        checkpoint.load(p, big.sim)
+    msg = str(ei.value)
+    assert "snapshot event_capacity=32" in msg
+    assert "--auto-grow" in msg
+    assert "snapshot leaf" in msg   # the exact leaf is still named
+
+
+def test_latest_checkpoint_picks_newest_by_time(tmp_path):
+    b = _build(H=8, load=2, sim_s=1)
+    pre = str(tmp_path / "ck")
+    checkpoint.save(f"{pre}.100", b.sim, time_ns=100)
+    checkpoint.save(f"{pre}.250", b.sim, time_ns=250)
+    (tmp_path / "ck.junk.npz").write_bytes(b"not a snapshot")
+    best = checkpoint.latest_checkpoint(pre)
+    assert best.endswith("ck.250.npz")
+    assert checkpoint.peek_meta(best)["time_ns"] == 250
+    assert checkpoint.latest_checkpoint(str(tmp_path / "none")) is None
+
+
+def test_cross_shard_resume_portability(tmp_path):
+    """Snapshots are global-layout: save under an 8-device mesh and
+    resume serially — and the reverse — both bit-identical to the
+    straight serial run (ISSUE PR 5 satellite). Exchange-tier staging
+    watermarks are shard-layout-dependent by nature (same carve-out as
+    test_faults.py's shard-independence test) and are excluded."""
+    import jax
+    from jax.sharding import Mesh
+
+    TELEMETRY = {".outbox.max_occupied", ".outbox.narrow_hit",
+                 ".outbox.narrow_miss"}
+
+    def _eq(sa, sb):
+        fa = jax.tree_util.tree_flatten_with_path(sa)[0]
+        fb = jax.tree_util.tree_flatten_with_path(sb)[0]
+        for (pa, la), (_, lb) in zip(fa, fb):
+            key = jax.tree_util.keystr(pa)
+            if key in TELEMETRY:
+                continue
+            np.testing.assert_array_equal(
+                np.asarray(la), np.asarray(lb),
+                err_msg=f"{key} diverged")
+
+    H, load, sim_s = 8, 2, 1
+    SEC = simtime.ONE_SECOND
+    sim_ref, _, _ = checkpoint.run_windows(
+        _build(H=H, load=load, sim_s=sim_s),
+        app_handlers=(phold.handler,))
+
+    # sharded save -> serial resume
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    _, _, saved = checkpoint.run_windows(
+        _build(H=H, load=load, sim_s=sim_s),
+        app_handlers=(phold.handler,), end_time=SEC // 2,
+        checkpoint_every_ns=SEC // 4,
+        checkpoint_path=str(tmp_path / "m8"), mesh=mesh8)
+    assert saved
+    path, t_ck = saved[-1]
+    assert checkpoint.peek_meta(path)["shards"] == 8
+    b = _build(H=H, load=load, sim_s=sim_s)
+    sim_r, t0, _ = checkpoint.load(path, b.sim)
+    assert t0 == t_ck
+    sim_serial, _, _ = checkpoint.run_windows(
+        b, app_handlers=(phold.handler,), sim=sim_r, start_time=t0)
+    _eq(sim_ref, sim_serial)
+
+    # serial save -> sharded resume (different width than the save)
+    _, _, saved2 = checkpoint.run_windows(
+        _build(H=H, load=load, sim_s=sim_s),
+        app_handlers=(phold.handler,), end_time=SEC // 2,
+        checkpoint_every_ns=SEC // 4,
+        checkpoint_path=str(tmp_path / "s1"))
+    assert saved2
+    path2, _ = saved2[-1]
+    assert checkpoint.peek_meta(path2)["shards"] == 1
+    b2 = _build(H=H, load=load, sim_s=sim_s)
+    sim_r2, t2, _ = checkpoint.load(path2, b2.sim)
+    mesh4 = Mesh(np.array(jax.devices()[:4]), ("hosts",))
+    sim_sharded, _, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), sim=sim_r2, start_time=t2,
+        mesh=mesh4)
+    _eq(sim_ref, sim_sharded)
 
 
 @pytest.mark.faults

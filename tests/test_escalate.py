@@ -1,0 +1,420 @@
+"""Self-healing runs (ISSUE PR 5 tentpole): capacity escalation turns
+a fatal overflow latch into a grown rebuild + checkpoint transplant;
+preemption turns SIGTERM into a final snapshot a later --resume
+continues bit-identically. The acceptance bars live here:
+
+- a run sized to overflow completes under escalation, and its final
+  state is bit-identical to a from-scratch run at the grown capacity
+  (the transplant contract from faults/escalate.py);
+- escalation restarts do NOT consume the retry budget (the supervisor
+  accounting bugfix);
+- a preempted chain resumed from its final snapshot ends bit-identical
+  to the uninterrupted run — including when the resume happens under a
+  different shard count;
+- the conservation checker (faults/conserve.py) actually catches
+  corruption — a ledger that cannot fail is not an oracle;
+- the fixed-seed chaos smoke (tools/chaos_soak.py run_trial) holds all
+  of the above at once under randomized faults + kills.
+"""
+
+import types
+
+import numpy as np
+import pytest
+
+from conftest import load_tool
+
+from shadow_tpu import faults
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import conserve, escalate
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.utils import checkpoint
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H, LOAD = 8, 2
+
+
+def _build(caps, sim_s=1, seed=7):
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=caps["event_capacity"],
+                    outbox_capacity=caps["outbox_capacity"],
+                    router_ring=caps["router_ring"],
+                    in_ring=max(8, 2 * LOAD))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=LOAD)
+    return b
+
+
+def _roomy():
+    c = max(32, 4 * LOAD)
+    return {"event_capacity": c, "outbox_capacity": c, "router_ring": c}
+
+
+# exchange-tier staging watermarks are shard-layout-dependent by
+# nature (same carve-out as test_faults.py shard-independence test);
+# simulation state proper must always match bit for bit
+_SHARD_TELEMETRY = {".outbox.max_occupied", ".outbox.narrow_hit",
+                    ".outbox.narrow_miss"}
+
+
+def _assert_sims_equal(sa, sb, ignore=frozenset()):
+    import jax
+
+    fa = jax.tree_util.tree_flatten_with_path(sa)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sb)[0]
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        key = jax.tree_util.keystr(pa)
+        if key in ignore:
+            continue
+        np.testing.assert_array_equal(
+            np.asarray(la), np.asarray(lb),
+            err_msg=f"{key} diverged")
+
+
+# ---- plan_growth: latch -> knob mapping and the grow budget ---------
+
+def _health(**latches):
+    base = {"events_overflow": 0, "outbox_overflow": 0, "rq_overflow": 0}
+    base.update(latches)
+    return types.SimpleNamespace(**base)
+
+
+def test_plan_growth_doubles_tripped_knob():
+    caps = {"event_capacity": 32, "outbox_capacity": 64, "router_ring": 16}
+    policy = escalate.EscalationPolicy(max_grow=8)
+    grow, events = escalate.plan_growth(
+        _health(events_overflow=5), caps, policy, 0, time_ns=123)
+    assert grow == {"event_capacity": 64}
+    (ev,) = events
+    assert (ev.latch, ev.knob, ev.old, ev.new) == (
+        "events_overflow", "event_capacity", 32, 64)
+    assert ev.time_ns == 123
+    # round-trips through the manifest encoding
+    assert escalate.Escalation.from_dict(ev.as_dict()) == ev
+
+
+def test_plan_growth_handles_multiple_latches_and_budget():
+    caps = {"event_capacity": 8, "outbox_capacity": 8, "router_ring": 8}
+    policy = escalate.EscalationPolicy(max_grow=3)
+    grow, events = escalate.plan_growth(
+        _health(events_overflow=1, rq_overflow=2), caps, policy, 0,
+        time_ns=0)
+    assert grow == {"event_capacity": 16, "router_ring": 16}
+    assert len(events) == 2
+    # 2/3 of the budget spent: one more double fits, two do not
+    with pytest.raises(escalate.GrowBudgetExceeded):
+        escalate.plan_growth(
+            _health(events_overflow=1, rq_overflow=1), caps, policy, 2,
+            time_ns=0)
+    # a non-capacity trip (stall, regression) is not healable
+    with pytest.raises(ValueError, match="no capacity latch"):
+        escalate.plan_growth(_health(), caps, policy, 0, time_ns=0)
+
+
+# ---- transplant: pad-with-empty on the grown axis -------------------
+
+def test_transplant_pads_grown_event_axis(tmp_path):
+    small = _build(dict(_roomy(), event_capacity=32))
+    # run a few windows so the snapshot holds live state, not boot zeros
+    sim, _, _ = checkpoint.run_windows(
+        small, app_handlers=(phold.handler,),
+        end_time=simtime.ONE_SECOND // 10)
+    p = checkpoint.save(str(tmp_path / "s"), sim, time_ns=77)
+    leaves, meta = checkpoint.load_leaves(p)
+
+    big = _build(dict(_roomy(), event_capacity=64))
+    out, t, _ = escalate.transplant(leaves, meta, big.sim)
+    assert t == 77
+
+    import jax
+
+    flat = {jax.tree_util.keystr(k): np.asarray(v) for k, v in
+            jax.tree_util.tree_flatten_with_path(out)[0]}
+    for key, arr in flat.items():
+        src = np.asarray(leaves[key])
+        if src.shape == arr.shape:
+            np.testing.assert_array_equal(arr, src, err_msg=key)
+            continue
+        # grown axis: checkpoint bytes at the leading corner ...
+        np.testing.assert_array_equal(
+            arr[tuple(slice(0, s) for s in src.shape)], src,
+            err_msg=f"{key} prefix")
+        # ... empty-slot encoding in the pad
+        pad = arr[:, src.shape[1]:]
+        fill = (simtime.INVALID if key.endswith(".time")
+                else -1 if key.endswith(".dst") else 0)
+        assert (pad == fill).all(), f"{key} pad is not empty-slot"
+
+
+def test_transplant_refuses_shrink_and_host_change(tmp_path):
+    big = _build(dict(_roomy(), event_capacity=64))
+    p = checkpoint.save(str(tmp_path / "s"), big.sim, time_ns=0)
+    leaves, meta = checkpoint.load_leaves(p)
+    small = _build(dict(_roomy(), event_capacity=32))
+    with pytest.raises(ValueError, match="capacities only grow"):
+        escalate.transplant(leaves, meta, small.sim)
+    meta2 = dict(meta, capacities=dict(meta["capacities"], num_hosts=4))
+    with pytest.raises(ValueError, match="host axis"):
+        escalate.transplant(leaves, meta2, big.sim)
+
+
+def test_router_ring_rotation_canonicalizes_head():
+    """rq slots address as (head + i) % R; the rotation must preserve
+    logical content while moving slot 0 to physical 0 (so tail-padding
+    a grown ring cannot interleave live and empty entries)."""
+    R = 4
+    src = np.array([[10, 11, 12, 13], [20, 21, 22, 23]])
+    ts = src * 100
+    words = np.stack([src, src + 1], axis=-1)       # extra trailing dim
+    head = np.array([1, 3])
+    leaves = {"net.rq_src": src, "net.rq_enq_ts": ts,
+              "net.rq_words": words, "net.rq_head": head,
+              "net.rq_count": np.array([2, 2])}
+    out = escalate._rotate_router_ring(leaves)
+    assert (out["net.rq_head"] == 0).all()
+    for h in range(2):
+        logical = [(head[h] + i) % R for i in range(R)]
+        np.testing.assert_array_equal(out["net.rq_src"][h],
+                                      src[h, logical])
+        np.testing.assert_array_equal(out["net.rq_enq_ts"][h],
+                                      ts[h, logical])
+        np.testing.assert_array_equal(out["net.rq_words"][h],
+                                      words[h, logical])
+    # counts are address-independent and stay put
+    np.testing.assert_array_equal(out["net.rq_count"],
+                                  leaves["net.rq_count"])
+    # already-canonical rings are returned untouched
+    leaves["net.rq_head"] = np.zeros(2, dtype=int)
+    assert escalate._rotate_router_ring(leaves) is leaves
+
+
+# ---- escalation end to end: heal, accounting, bit-identity ----------
+
+def test_escalation_heals_overflow_without_consuming_retries(tmp_path):
+    """A run sized to overflow completes under --auto-grow, the final
+    state matches the from-scratch run at the grown capacity, and the
+    heal consumed zero of the retry budget (the accounting bugfix:
+    max_retries=0 would fail instantly if a heal counted as a retry)."""
+    caps = dict(_roomy(), event_capacity=1)   # guaranteed trip
+
+    def make():
+        return _build(caps)
+
+    def rebuild(overrides):
+        caps.update(overrides)
+        return make()
+
+    res = faults.run_supervised(
+        make(), app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck"),
+        checkpoint_every_windows=4, max_retries=0,
+        sleep=lambda s: None,
+        escalation=faults.EscalationPolicy(max_grow=8),
+        rebuild=rebuild)
+
+    assert res.ok
+    assert res.retries_used == 0
+    assert res.escalation_restarts >= 1
+    assert res.escalations
+    assert all(e.knob == "event_capacity" and e.new == 2 * e.old
+               for e in res.escalations)
+    grown = caps["event_capacity"]
+    assert grown == res.escalations[-1].new > 1
+    assert int(res.sim.events.overflow) == 0
+
+    # bit-identical to never having been undersized at all
+    ref = _build(dict(caps))
+    sim_ref, _, _ = checkpoint.run_windows(
+        ref, app_handlers=(phold.handler,))
+    _assert_sims_equal(res.sim, sim_ref)
+
+    # the failure-report split surfaces both counters
+    rep = res.failure_report()
+    assert rep["retries_used"] == 0
+    assert rep["escalation_restarts"] == res.escalation_restarts
+    assert rep["escalations"]
+
+
+def test_grow_budget_exhaustion_falls_back_to_retry_path(tmp_path):
+    """max_grow=0 makes the trip unhealable; with max_retries=0 the
+    supervisor must give up with a structured report (naming the
+    latch), not loop — and must not count phantom retries."""
+    caps = dict(_roomy(), event_capacity=1)
+    res = faults.run_supervised(
+        _build(caps), app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck"),
+        checkpoint_every_windows=4, max_retries=0,
+        sleep=lambda s: None,
+        escalation=faults.EscalationPolicy(max_grow=0),
+        rebuild=lambda o: _build(caps))
+    assert not res.ok
+    assert res.escalation_restarts == 0
+    assert res.retries_used == 0
+    rep = res.failure_report()
+    assert rep["fatal"] is True
+    assert rep["events_overflow"] > 0
+    assert any("overflow" in d for d in rep["diagnostics"])
+
+
+# ---- preemption: final snapshot + resume chains ---------------------
+
+def test_preemption_resume_bit_identical_across_shards(tmp_path):
+    """Stop mid-run (the SIGTERM path minus the signal), resume from
+    the final snapshot, and the chain's end state is bit-identical to
+    the uninterrupted run — serially AND under a 4-device mesh (the
+    snapshot is global-layout, so the shard count is free to change
+    across the kill boundary)."""
+    import jax
+    from jax.sharding import Mesh
+
+    caps = _roomy()
+    base = _build(caps)
+    sim_ref, stats_ref, _ = checkpoint.run_windows(
+        base, app_handlers=(phold.handler,))
+
+    rounds = {"n": 0}
+
+    def on_round(sim, wstats, wstart, wend, next_min):
+        rounds["n"] += 1
+
+    res1 = faults.run_supervised(
+        _build(caps), app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck"),
+        checkpoint_every_windows=4, max_retries=0,
+        sleep=lambda s: None, on_round=on_round,
+        stop=lambda: rounds["n"] >= 3)
+    assert res1.preempted and not res1.ok
+    assert res1.final_checkpoint
+    assert res1.run_id
+    rep = res1.failure_report()
+    assert rep["verdict"] == "preempted"
+    assert rep["final_checkpoint"] == res1.final_checkpoint
+
+    # resume serially
+    res2 = faults.run_supervised(
+        _build(caps), app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck2"),
+        checkpoint_every_windows=64, max_retries=0,
+        sleep=lambda s: None, resume_from=res1.final_checkpoint)
+    assert res2.ok
+    assert res2.resume_of == res1.run_id      # the manifest chain id
+    _assert_sims_equal(res2.sim, sim_ref)
+    # engine totals carried across the kill boundary, not restarted
+    assert int(res2.stats.events_processed) \
+        == int(stats_ref.events_processed)
+
+    # resume the same snapshot under a different shard count
+    mesh = Mesh(np.array(jax.devices()[:4]), ("hosts",))
+    res3 = faults.run_supervised(
+        _build(caps), app_handlers=(phold.handler,),
+        checkpoint_path=str(tmp_path / "ck3"),
+        checkpoint_every_windows=64, max_retries=0,
+        sleep=lambda s: None, resume_from=res1.final_checkpoint,
+        mesh=mesh)
+    assert res3.ok
+    _assert_sims_equal(res3.sim, sim_ref, ignore=_SHARD_TELEMETRY)
+
+
+# ---- the conservation checker must itself be falsifiable ------------
+
+def _samples():
+    mk = conserve.WindowSample
+    return [
+        mk(wstart=0, wend=10, next_min=5, pushed=8, processed=4,
+           queued=4, outboxed=0, drops=0),
+        mk(wstart=5, wend=15, next_min=12, pushed=12, processed=8,
+           queued=3, outboxed=1, drops=0),
+        mk(wstart=12, wend=22, next_min=20, pushed=14, processed=11,
+           queued=3, outboxed=0, drops=0),
+    ]
+
+
+def test_conserve_check_accepts_lawful_sequence():
+    assert conserve.check(_samples()) == []
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    (lambda s: s.__class__(**{**s.as_dict(), "processed":
+                              s.processed - 1}), "conservation violated"),
+    (lambda s: s.__class__(**{**s.as_dict(), "pushed":
+                              s.pushed + 3}), "conservation violated"),
+    (lambda s: s.__class__(**{**s.as_dict(), "next_min":
+                              s.wstart - 1}), "clock regressed"),
+    (lambda s: s.__class__(**{**s.as_dict(), "wstart": 0, "wend": 10}),
+     "not strictly increasing"),
+])
+def test_conserve_check_catches_corruption(mutate, needle):
+    """Deliberately corrupt one counter of one barrier; the checker
+    must name the violation (an oracle that cannot fail proves
+    nothing)."""
+    samples = _samples()
+    samples[2] = mutate(samples[2])
+    errors = conserve.check(samples)
+    assert any(needle in e for e in errors), errors
+
+
+def test_conserve_drops_degrade_to_bounds():
+    s = _samples()[0]
+    # with drops, pushed may exceed the accounted sum by up to drops
+    lax = s.__class__(**{**s.as_dict(), "pushed": s.pushed + 2,
+                         "drops": 2})
+    assert conserve.check([lax]) == []
+    over = s.__class__(**{**s.as_dict(), "pushed": s.pushed + 3,
+                          "drops": 2})
+    assert any("outside" in e for e in conserve.check([over]))
+
+
+def test_conserve_stitch_supersedes_replayed_windows():
+    before = _samples()
+    after = [conserve.WindowSample(
+        wstart=5, wend=15, next_min=12, pushed=12, processed=8,
+        queued=3, outboxed=1, drops=0)]
+    spliced = conserve.stitch(before, after, resume_time=5)
+    assert [s.wstart for s in spliced] == [0, 5]
+
+
+# ---- fixed-seed chaos smoke (tier-1) and the long soak (slow) -------
+
+def test_chaos_smoke_fixed_seed(tmp_path):
+    """2 kills + escalation under a seeded random fault plan, with the
+    conservation ledger checked at every barrier and the healed chain
+    diffed bit-for-bit against the uninterrupted run at the final
+    capacities (tools/chaos_soak.py run_trial)."""
+    cs = load_tool("chaos_soak")
+    # seed chosen so both kills land inside the run AND the undersized
+    # queue trips at least one escalation (the two healing paths cross)
+    rep = cs.run_trial(2, kills=2, verify=True,
+                       workdir=str(tmp_path))
+    assert rep["conservation_errors"] == []
+    assert rep["ok"], rep
+    assert rep["kills"] == 2
+    assert rep["segments"] == 3           # 2 kills -> 3 chain segments
+    assert rep["escalation_restarts"] >= 1
+    assert rep["retries_used"] == 0       # heals consumed no retries
+    assert rep["verified_bit_identical"] is True
+    assert rep["resume_of"]               # the chain linked its runs
+
+
+@pytest.mark.slow
+def test_chaos_soak_many_seeds(tmp_path):
+    cs = load_tool("chaos_soak")
+    for seed in range(20, 25):
+        d = tmp_path / str(seed)
+        d.mkdir()
+        rep = cs.run_trial(seed, kills=2, verify=True, workdir=str(d))
+        assert rep["ok"], rep

@@ -341,3 +341,116 @@ def test_cli_supervise_end_to_end(tmp_path):
     assert "failure" not in report
     snaps = list((tmp_path / "data").glob("checkpoint*.npz"))
     assert snaps, "supervise mode wrote no checkpoints"
+
+
+def _phold_conf(tmp_path, *, sim_s=1, quantity=8, load=4):
+    conf = tmp_path / "phold.xml"
+    conf.write_text("""<shadow>
+      <topology><![CDATA[%s]]></topology>
+      <kill time="%d"/>
+      <plugin id="testphold" path="shadow-plugin-test-phold"/>
+      <node id="peer" quantity="%d">
+        <application plugin="testphold" starttime="0"
+          arguments="load=%d quantity=%d"/>
+      </node>
+    </shadow>""" % (GRAPH, sim_s, quantity, load, quantity))
+    return conf
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_cli_auto_grow_heals_undersized_run(tmp_path):
+    """Acceptance (ISSUE PR 5): a PHOLD run sized to overflow completes
+    under --supervise --auto-grow, the report and manifest record the
+    escalation, and telemetry_lint accepts the healed manifest."""
+    from conftest import load_tool
+
+    from shadow_tpu.cli import main as cli_main
+
+    conf = _phold_conf(tmp_path)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([str(conf), "--supervise", "--auto-grow",
+                       "--seed", "5", "--platform", "cpu",
+                       "--event-capacity", "4",
+                       "--checkpoint-every-windows", "4",
+                       "--telemetry-capacity", "256",
+                       "-d", str(tmp_path / "data")])
+    assert rc == 0
+    report = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert report["overflow"] == 0
+    assert report.get("escalations"), "undersized run never escalated"
+    assert all(e["knob"] == "event_capacity" and e["to"] == 2 * e["from"]
+               for e in report["escalations"])
+
+    man = json.loads(
+        (tmp_path / "data" / "run_manifest.json").read_text())
+    assert man["escalations"] == report["escalations"]
+    assert man["run_id"]
+    tl = load_tool("telemetry_lint")
+    errs, warns = tl.lint_manifest_obj(man)
+    assert errs == [], errs
+    assert any("escalation" in w for w in warns)
+
+
+@pytest.mark.faults
+@pytest.mark.slow
+def test_cli_sigterm_preempt_then_resume(tmp_path, monkeypatch):
+    """Acceptance (ISSUE PR 5): SIGTERM mid-run exits 5 with a final
+    snapshot on disk; `--resume <data-dir>` continues the chain to the
+    uninterrupted run's totals and links the manifests via resume_of.
+    raise_signal at a round barrier drives the CLI's real handler
+    deterministically (no timing races)."""
+    import signal
+
+    from shadow_tpu.cli import main as cli_main
+    from shadow_tpu.faults import supervisor as sup_mod
+
+    conf = _phold_conf(tmp_path)
+    common = ["--supervise", "--seed", "5", "--platform", "cpu",
+              "--checkpoint-every-windows", "4"]
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([str(conf), *common, "-d", str(tmp_path / "base")])
+    assert rc == 0
+    base = json.loads(buf.getvalue().strip().splitlines()[-1])
+
+    real = sup_mod.run_supervised
+
+    def preempting(*a, **kw):
+        rounds = {"n": 0}
+        user = kw.get("on_round")
+
+        def on_round(sim, ws, wstart, wend, next_min):
+            if user is not None:
+                user(sim, ws, wstart, wend, next_min)
+            rounds["n"] += 1
+            if rounds["n"] == 3:
+                signal.raise_signal(signal.SIGTERM)
+        kw["on_round"] = on_round
+        return real(*a, **kw)
+
+    monkeypatch.setattr(sup_mod, "run_supervised", preempting)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([str(conf), *common, "-d", str(tmp_path / "data")])
+    assert rc == 5
+    pre = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert pre["preempted"] is True
+    assert pre["checkpoint"] and pre["run_id"]
+    monkeypatch.setattr(sup_mod, "run_supervised", real)
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = cli_main([str(conf), *common,
+                       "--resume", str(tmp_path / "data"),
+                       "-d", str(tmp_path / "data2")])
+    assert rc == 0
+    rep = json.loads(buf.getvalue().strip().splitlines()[-1])
+    assert rep["resume_of"] == pre["run_id"]
+    # chain totals equal the uninterrupted run's (bit-identity of the
+    # final state itself is proven in tests/test_escalate.py)
+    assert rep["events"] == base["events"]
+    assert rep["app_rcvd"] == base["app_rcvd"]
+    assert rep["overflow"] == 0

@@ -370,3 +370,122 @@ def test_telemetry_lint_conformance_block():
     m3["conformance"] = {"agree": 0}
     errs, _ = tl.lint_manifest_obj(m3)
     assert any('missing "workloads"' in e for e in errs)
+
+
+def test_telemetry_lint_escalation_and_resume_blocks():
+    """The supervisor-v2 manifest fields (ISSUE PR 5 satellite):
+    run_id/resume_of chain identity, escalations[] records, and the
+    preempted flag all validate — and incoherent ones are errors."""
+    tl = _load("telemetry_lint")
+    m = _copy(GOOD_MANIFEST)
+    m["run_id"] = "abc123def456"
+    m["resume_of"] = "000111222333"
+    m["preempted"] = False
+    m["escalations"] = [
+        {"time_ns": 0, "latch": "events_overflow",
+         "knob": "event_capacity", "from": 32, "to": 64},
+        {"time_ns": 5, "latch": "events_overflow",
+         "knob": "event_capacity", "from": 64, "to": 128},
+    ]
+    errs, warns = tl.lint_manifest_obj(m)
+    assert errs == []
+    assert any("escalation(s) healed" in w for w in warns)
+
+    # a chained run must identify itself
+    m2 = _copy(GOOD_MANIFEST)
+    m2["resume_of"] = "000111222333"
+    errs, _ = tl.lint_manifest_obj(m2)
+    assert any("resume_of" in e and "run_id" in e for e in errs)
+    m2["run_id"] = ""          # empty id is as bad as a missing one
+    errs, _ = tl.lint_manifest_obj(m2)
+    assert any("non-empty string" in e for e in errs)
+
+    # unknown knobs and non-growing records are exporter bugs
+    m3 = _copy(m)
+    m3["escalations"][0]["knob"] = "emit_capacity"
+    errs, _ = tl.lint_manifest_obj(m3)
+    assert any("unknown grow knob" in e for e in errs)
+    m4 = _copy(m)
+    m4["escalations"][1]["to"] = 64
+    errs, _ = tl.lint_manifest_obj(m4)
+    assert any("capacities only grow" in e for e in errs)
+
+    # a "healed" run whose latch counter is still nonzero lied
+    m5 = _copy(m)
+    m5["counters"]["events_overflow"] = 3
+    m5["health"]["verdict"] = "clean"
+    errs, _ = tl.lint_manifest_obj(m5)
+    assert any("latch at zero" in e for e in errs)
+
+    # empty escalations array: omit the key instead
+    m6 = _copy(GOOD_MANIFEST)
+    m6["escalations"] = []
+    errs, _ = tl.lint_manifest_obj(m6)
+    assert any("non-empty array" in e for e in errs)
+
+    m7 = _copy(GOOD_MANIFEST)
+    m7["preempted"] = "yes"
+    errs, _ = tl.lint_manifest_obj(m7)
+    assert any("preempted must be a bool" in e for e in errs)
+
+
+# ---- faultplan_lint --checkpoint cross-check ------------------------
+
+def _snapshot_meta(**caps):
+    base = {"num_hosts": 8, "event_capacity": 64,
+            "outbox_capacity": 32, "router_ring": 32}
+    base.update(caps)
+    return {"time_ns": 100, "extra": {}, "layout": None,
+            "capacities": base, "shards": 4}
+
+
+def test_faultplan_lint_against_checkpoint_meta():
+    fl = _load("faultplan_lint")
+    meta = _snapshot_meta()
+    # shrinking any capacity below the snapshot's is a lint error
+    errs, warns, hosts = fl.lint_against_checkpoint(
+        meta, event_capacity=32)
+    assert any("capacities only grow" in e for e in errs)
+    # growing is allowed, flagged as a transplant
+    errs, warns, hosts = fl.lint_against_checkpoint(
+        meta, event_capacity=128)
+    assert errs == []
+    assert any("transplant" in w for w in warns)
+    # the snapshot's host count feeds the plan's range checks
+    assert hosts == 8
+    # changing the host axis can never transplant
+    errs, _, _ = fl.lint_against_checkpoint(meta, hosts=16)
+    assert any("host axis" in e for e in errs)
+    # matching intent is clean (shard note is informational only)
+    errs, warns, _ = fl.lint_against_checkpoint(
+        meta, hosts=8, event_capacity=64)
+    assert errs == []
+    assert any("any --workers count" in w for w in warns)
+
+
+def test_faultplan_lint_checkpoint_cli(tmp_path):
+    """End to end through main(): a resume into a shrunken config
+    fails at lint time; the same plan with a grown target passes."""
+    import json
+
+    import numpy as np
+
+    from shadow_tpu.utils.checkpoint import LAYOUT_VERSION
+
+    fl = _load("faultplan_lint")
+    plan = tmp_path / "plan.json"
+    plan.write_text(json.dumps({"faults": [
+        {"time_s": 1.0, "kind": "loss", "a": 0, "b": 0, "value": 0.05},
+    ]}))
+    meta = _snapshot_meta()
+    meta["layout"] = LAYOUT_VERSION
+    snap = tmp_path / "snap.npz"
+    np.savez(snap, __meta__=json.dumps(meta))
+
+    assert fl.main([str(plan), "--checkpoint", str(snap),
+                    "--event-capacity", "32", "-q"]) == 1
+    assert fl.main([str(plan), "--checkpoint", str(snap),
+                    "--event-capacity", "128", "-q"]) == 0
+    # an unreadable snapshot is an error, not a crash
+    assert fl.main([str(plan), "--checkpoint",
+                    str(tmp_path / "missing.npz"), "-q"]) == 1

@@ -1,0 +1,297 @@
+#!/usr/bin/env python3
+"""Randomized chaos soak for the self-healing supervisor.
+
+Each trial assembles a hostile-but-lawful run from a seeded RNG:
+
+- a random fault plan over loss / latency / linkdown / linkup (crash
+  and restart are excluded on purpose — a crash flushes a host's event
+  row non-conservatively, which would void the exact ledger the soak
+  asserts; see faults/conserve.py),
+- a deliberately undersized event queue, so the overflow latch trips
+  and the supervisor must escalate (grow + rebuild + transplant)
+  rather than retry,
+- a random number of simulated preemption kills: the stop flag fires
+  at a random round barrier, the supervisor takes its final snapshot
+  and raises Preempted, and the trial resumes the chain from that
+  snapshot — exactly the SIGTERM/--resume path minus the signal.
+
+The oracle is the per-window conservation ledger (faults/conserve.py):
+at every round barrier of every attempt of every segment,
+pushed == processed + queued + outboxed (exact, since healed runs
+carry zero overflow), and window starts / counters stay monotone.
+Samples from windows that a resume replays are superseded by the
+replay (the checkpoint contract makes them bit-identical), mirroring
+conserve.stitch.
+
+With --verify each trial also re-runs the whole simulation
+uninterrupted at the final (post-escalation) capacities and demands
+the final device state be bit-identical to the healed chain's — the
+acceptance check for "escalation reproduces the from-scratch run at
+grown capacity".
+
+Usage:
+  chaos_soak.py --trials 20 --seed 1 [--kills 2] [--verify]
+One JSON line per trial on stdout; exit 1 if any trial fails.
+tests/test_escalate.py imports run_trial() for the fixed-seed tier-1
+smoke; the multi-trial soak is the `slow`-marked variant.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
+def random_records(rng: np.random.Generator, *, sim_s: int):
+    """A lawful random plan over the conservation-safe kinds. Link
+    flaps are generated as down/up pairs so the topology never stays
+    dark to the end (an all-dark run finishes early and legally, but
+    soaks nothing)."""
+    from shadow_tpu.core import simtime
+    from shadow_tpu.faults.plan import (FaultKind, FaultRecord,
+                                        validate_records)
+
+    SEC = simtime.ONE_SECOND
+    end = sim_s * SEC
+    recs = []
+    for _ in range(int(rng.integers(2, 6))):
+        t = int(rng.integers(SEC // 10, end - SEC // 10))
+        roll = rng.random()
+        if roll < 0.45:
+            recs.append(FaultRecord(
+                t_ns=t, kind=FaultKind.LOSS, a=0, b=0,
+                value=int(rng.integers(50_000, 300_000))))
+        elif roll < 0.8:
+            recs.append(FaultRecord(
+                t_ns=t, kind=FaultKind.LATENCY, a=0, b=0,
+                value=int(rng.integers(100_000, 5_000_000))))
+        else:
+            up = min(t + int(rng.integers(50, 200)) * 1_000_000, end - 1)
+            recs.append(FaultRecord(t_ns=t, kind=FaultKind.LINK_DOWN,
+                                    a=0, b=0))
+            recs.append(FaultRecord(t_ns=up, kind=FaultKind.LINK_UP,
+                                    a=0, b=0))
+    recs.sort(key=lambda r: r.t_ns)
+    errors, _ = validate_records(recs, num_vertices=1)
+    assert not errors, errors  # generator bug, not a sim bug
+    return recs
+
+
+def _build(hosts, load, sim_s, seed, caps):
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+
+    cfg = NetConfig(num_hosts=hosts, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=caps["event_capacity"],
+                    outbox_capacity=caps["outbox_capacity"],
+                    router_ring=caps["router_ring"],
+                    in_ring=max(8, 2 * load))
+    specs = [HostSpec(name=f"p{i}", proc_start_time=0)
+             for i in range(hosts)]
+    b = build(cfg, GRAPH, specs)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def run_trial(seed: int, *, hosts: int = 8, load: int = 2,
+              sim_s: int = 1, kills: int = 2,
+              undersize: bool = True, max_grow: int = 8,
+              checkpoint_every: int = 4, workdir: str | None = None,
+              verify: bool = False, log=None) -> dict:
+    """One healed run: random plan + undersized capacity + `kills`
+    random preemption kills, conservation-checked at every barrier.
+    Returns a JSON-able report; report["ok"] is the verdict."""
+    from shadow_tpu import faults
+    from shadow_tpu.apps import phold
+    from shadow_tpu.faults import conserve
+
+    rng = np.random.default_rng(seed)
+    records = random_records(rng, sim_s=sim_s)
+    roomy = max(32, 4 * load)
+    caps = {"event_capacity": (int(rng.integers(1, load + 1))
+                               if undersize else roomy),
+            "outbox_capacity": roomy,
+            "router_ring": roomy}
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_soak.")
+
+    def make_bundle():
+        b = _build(hosts, load, sim_s, seed, caps)
+        faults.install(b, records)
+        return b
+
+    def rebuild(overrides):
+        caps.update(overrides)  # next segment boots at grown shapes too
+        return make_bundle()
+
+    # The conservation ledger, sampled at every round barrier. A
+    # resume replays from its snapshot, so a non-advancing wstart
+    # supersedes the stale tail (conserve.stitch semantics, applied
+    # online); cumulative processed restarts from the last kept
+    # barrier — the snapshot the replay resumed from.
+    samples: list = []
+
+    def on_round(sim, wstats, wstart, wend, next_min):
+        while samples and samples[-1].wstart >= wstart:
+            samples.pop()
+        base = samples[-1].processed if samples else 0
+        delta = int(np.asarray(wstats.events_processed))
+        samples.append(conserve.sample(
+            sim, wstart=wstart, wend=wend, next_min=next_min,
+            processed_total=base + delta))
+        ctl["rounds"] += 1
+
+    ctl = {"rounds": 0, "kill_at": None}
+
+    def stop():
+        return (ctl["kill_at"] is not None
+                and ctl["rounds"] >= ctl["kill_at"])
+
+    kills_left = kills
+    segments = 0
+    escalation_restarts = 0
+    retries_used = 0
+    resume_from = None
+    result = None
+    while True:
+        segments += 1
+        ctl["rounds"] = 0
+        ctl["kill_at"] = (int(rng.integers(2, 12))
+                          if kills_left > 0 else None)
+        res = faults.run_supervised(
+            make_bundle(), app_handlers=(phold.handler,),
+            checkpoint_path=os.path.join(workdir, "ck"),
+            checkpoint_every_windows=checkpoint_every,
+            max_retries=2, sleep=lambda s: None,
+            escalation=faults.EscalationPolicy(max_grow=max_grow),
+            rebuild=rebuild, stop=stop, resume_from=resume_from,
+            on_round=on_round, log=log)
+        escalation_restarts += res.escalation_restarts
+        retries_used += res.retries_used
+        if res.preempted:
+            kills_left -= 1
+            resume_from = res.final_checkpoint
+            continue
+        result = res
+        break
+
+    errors = conserve.check(samples)
+    if result.ok:
+        final = conserve.sample(
+            result.sim, wstart=0, wend=1, next_min=1,
+            processed_total=0)
+        if final.drops:
+            errors.append(f"healed run ended with drops={final.drops} "
+                          f"— overflow latch survived escalation")
+    else:
+        errors.append("chain did not finish ok: "
+                      + json.dumps(result.failure_report()))
+
+    verified = None
+    if verify and result.ok:
+        verified = _verify_final(result.sim, make_bundle, errors)
+
+    report = {
+        "seed": int(seed),
+        "ok": bool(result.ok and not errors),
+        "segments": segments,
+        "kills": kills - kills_left,
+        "escalations": [e.as_dict() for e in result.escalations],
+        "escalation_restarts": escalation_restarts,
+        "retries_used": retries_used,
+        "final_capacities": dict(caps),
+        "windows_sampled": len(samples),
+        "events": (int(result.stats.events_processed)
+                   if result.stats is not None else None),
+        "conservation_errors": errors,
+        "run_id": result.run_id,
+        "resume_of": result.resume_of,
+    }
+    if verified is not None:
+        report["verified_bit_identical"] = verified
+    return report
+
+
+def _verify_final(sim_healed, make_bundle, errors) -> bool:
+    """Re-run uninterrupted at the final capacities; the healed
+    chain's final state must match bit for bit (the escalation
+    acceptance criterion). make_bundle() already builds at the grown
+    caps — escalation mutated the shared dict."""
+    import jax
+
+    from shadow_tpu.apps import phold
+    from shadow_tpu.utils import checkpoint
+
+    sim_ref, _, _ = checkpoint.run_windows(
+        make_bundle(), app_handlers=(phold.handler,))
+    fa = jax.tree_util.tree_flatten_with_path(sim_healed)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sim_ref)[0]
+    same = True
+    for (pa, la), (_, lb) in zip(fa, fb):
+        if not np.array_equal(np.asarray(la), np.asarray(lb)):
+            errors.append("healed final state diverges from the "
+                          "from-scratch run at grown capacity: leaf "
+                          + jax.tree_util.keystr(pa))
+            same = False
+    return same
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="randomized kill/heal soak over the supervised "
+                    "runner (seeded, reproducible)")
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--seed", type=int, default=1,
+                    help="base seed; trial k runs at seed+k")
+    ap.add_argument("--kills", type=int, default=2,
+                    help="preemption kills per trial")
+    ap.add_argument("--hosts", type=int, default=8)
+    ap.add_argument("--load", type=int, default=2)
+    ap.add_argument("--sim-s", type=int, default=1)
+    ap.add_argument("--max-grow", type=int, default=8)
+    ap.add_argument("--verify", action="store_true",
+                    help="also diff each healed run against an "
+                         "uninterrupted run at the final capacities")
+    ap.add_argument("--platform", default=None,
+                    help="force a JAX backend (e.g. cpu)")
+    args = ap.parse_args(argv)
+
+    if args.platform:
+        os.environ["JAX_PLATFORMS"] = args.platform
+
+    failed = 0
+    for k in range(args.trials):
+        rep = run_trial(args.seed + k, hosts=args.hosts, load=args.load,
+                        sim_s=args.sim_s, kills=args.kills,
+                        max_grow=args.max_grow, verify=args.verify)
+        print(json.dumps(rep), flush=True)
+        if not rep["ok"]:
+            failed += 1
+    print(f"chaos soak: {args.trials - failed}/{args.trials} trials ok",
+          file=sys.stderr)
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -17,8 +17,17 @@ examples/faultplan_degraded.json) or a shadow.config.xml whose
 needs a built topology; use --hosts/--vertices for range checks on
 raw-integer plans).
 
+With --checkpoint the plan is additionally cross-checked against a
+snapshot's recorded metadata (utils/checkpoint.py peek_meta): the
+snapshot's num_hosts feeds the range checks, and any target capacity
+flag (--event-capacity / --outbox-capacity / --router-ring) smaller
+than what the snapshot was saved at is an ERROR — resuming into a
+shrunken config cannot transplant (capacities only grow), so it fails
+here at lint time instead of at resume time.
+
 Usage: faultplan_lint.py plan.json [--hosts N] [--vertices N]
-       [--min-jump-ns NS]
+       [--min-jump-ns NS] [--checkpoint SNAP.npz]
+       [--event-capacity N] [--outbox-capacity N] [--router-ring N]
 Exit 0 = clean (warnings allowed), 1 = errors.
 """
 
@@ -85,6 +94,47 @@ def lint_text(text: str, *, hosts=None, vertices=None, min_jump_ns=None):
                             min_jump_ns=min_jump_ns)
 
 
+def lint_against_checkpoint(meta: dict, *, hosts=None,
+                            event_capacity=None, outbox_capacity=None,
+                            router_ring=None):
+    """Cross-check resume intent against a snapshot's __meta__.
+    Returns (errors, warnings, effective_hosts) — effective_hosts is
+    the snapshot's num_hosts, for the plan's range checks."""
+    errors: list = []
+    warnings: list = []
+    caps = meta.get("capacities") or {}
+    snap_hosts = caps.get("num_hosts")
+    if hosts is not None and snap_hosts is not None \
+            and hosts != snap_hosts:
+        errors.append(
+            f"--hosts {hosts} but the snapshot was saved with "
+            f"num_hosts={snap_hosts} — a transplant cannot change "
+            f"the host axis")
+    targets = {"event_capacity": event_capacity,
+               "outbox_capacity": outbox_capacity,
+               "router_ring": router_ring}
+    for knob, want in targets.items():
+        have = caps.get(knob)
+        if want is None or have is None:
+            continue
+        if want < have:
+            errors.append(
+                f"--{knob.replace('_', '-')} {want} is smaller than "
+                f"the snapshot's recorded {knob}={have} — capacities "
+                f"only grow; resuming into a shrunken config would "
+                f"be refused at load time")
+        elif want > have:
+            warnings.append(
+                f"--{knob.replace('_', '-')} {want} grows the "
+                f"snapshot's {knob}={have}; the resume will "
+                f"transplant (pad-with-empty)")
+    if meta.get("shards") is not None:
+        warnings.append(
+            f"snapshot was taken under {meta['shards']} shard(s); "
+            f"state is global-layout, any --workers count resumes it")
+    return errors, warnings, (snap_hosts if hosts is None else hosts)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="validate a fault plan offline (JSON plan or "
@@ -97,15 +147,42 @@ def main(argv=None) -> int:
                          "range checks")
     ap.add_argument("--min-jump-ns", type=int, default=None,
                     help="window length: warn on times that quantize")
+    ap.add_argument("--checkpoint", default=None, metavar="SNAP",
+                    help="cross-check against a snapshot's recorded "
+                         "capacity/shard metadata (resume lint)")
+    ap.add_argument("--event-capacity", type=int, default=None,
+                    help="intended resume event_capacity (checked "
+                         "against the snapshot's)")
+    ap.add_argument("--outbox-capacity", type=int, default=None)
+    ap.add_argument("--router-ring", type=int, default=None)
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings, print errors only")
     args = ap.parse_args(argv)
 
     with open(args.plan) as f:
         text = f.read()
-    errors, warnings = lint_text(text, hosts=args.hosts,
+    hosts = args.hosts
+    ckpt_errors: list = []
+    ckpt_warnings: list = []
+    if args.checkpoint:
+        from shadow_tpu.utils.checkpoint import peek_meta
+
+        try:
+            meta = peek_meta(args.checkpoint)
+        except (OSError, ValueError, KeyError) as e:
+            ckpt_errors.append(f"{args.checkpoint}: {e}")
+            meta = None
+        if meta is not None:
+            ckpt_errors, ckpt_warnings, hosts = lint_against_checkpoint(
+                meta, hosts=args.hosts,
+                event_capacity=args.event_capacity,
+                outbox_capacity=args.outbox_capacity,
+                router_ring=args.router_ring)
+    errors, warnings = lint_text(text, hosts=hosts,
                                  vertices=args.vertices,
                                  min_jump_ns=args.min_jump_ns)
+    errors = ckpt_errors + errors
+    warnings = ckpt_warnings + warnings
     for e in errors:
         print(f"ERROR: {e}", file=sys.stderr)
     if not args.quiet:

@@ -206,6 +206,72 @@ def lint_manifest_obj(man) -> tuple[list, list]:
                 warnings.append(
                     f"conformance: {conf['diverge']} workload(s) "
                     f"diverged between backends: {bad}")
+    # supervisor chain identity (optional): run_id / resume_of are
+    # opaque id strings; a resume_of without a run_id is incoherent
+    for k in ("run_id", "resume_of"):
+        v = man.get(k)
+        if v is not None and (not isinstance(v, str) or not v):
+            errors.append(f"{k} must be a non-empty string, got {v!r}")
+    if man.get("resume_of") is not None and man.get("run_id") is None:
+        errors.append('manifest carries "resume_of" without "run_id" '
+                      '— a chained run must identify itself')
+    # escalation records (optional): the supervisor's healed capacity
+    # trips. Each names a known grow knob, grows strictly (from < to),
+    # and a run that escalated and ended clean must show zero on the
+    # latch counter it healed — a surviving overflow means the heal
+    # lied.
+    esc = man.get("escalations")
+    if esc is not None:
+        if not isinstance(esc, list) or not esc:
+            errors.append("escalations must be a non-empty array "
+                          "(omit the key for runs that never healed)")
+            esc = []
+        known_knobs = {"event_capacity", "outbox_capacity",
+                       "router_ring"}
+        latch_of_knob = {"event_capacity": "events_overflow",
+                         "outbox_capacity": "outbox_overflow",
+                         "router_ring": "rq_overflow"}
+        ctr = man.get("counters", {})
+        verdict = man.get("health", {}).get("verdict")
+        for i, e in enumerate(esc):
+            where = f"escalations[{i}]"
+            if not isinstance(e, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            for k in ("time_ns", "latch", "knob", "from", "to"):
+                if k not in e:
+                    errors.append(f'{where}: missing "{k}"')
+            for k in ("time_ns", "from", "to"):
+                v = e.get(k)
+                if k in e and (not isinstance(v, int)
+                               or isinstance(v, bool) or v < 0):
+                    errors.append(f"{where}: {k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            knob = e.get("knob")
+            if knob is not None and knob not in known_knobs:
+                errors.append(f"{where}: unknown grow knob {knob!r} "
+                              f"(expected one of {sorted(known_knobs)})")
+            if (isinstance(e.get("from"), int)
+                    and isinstance(e.get("to"), int)
+                    and e["to"] <= e["from"]):
+                errors.append(f"{where}: capacities only grow — "
+                              f"from={e['from']} to={e['to']}")
+            latch = latch_of_knob.get(knob)
+            if (latch and verdict == "clean"
+                    and isinstance(ctr.get(latch), int)
+                    and ctr[latch] != 0):
+                errors.append(
+                    f"{where}: run escalated {knob} and reports a "
+                    f"clean verdict, yet counters.{latch}="
+                    f"{ctr[latch]} — the healed run must end with "
+                    f"the latch at zero")
+        if esc:
+            warnings.append(
+                f"{len(esc)} capacity escalation(s) healed this run "
+                f"(final capacities grew; see escalations[])")
+    pre = man.get("preempted")
+    if pre is not None and not isinstance(pre, bool):
+        errors.append(f"preempted must be a bool, got {pre!r}")
     return errors, warnings
 
 
