@@ -160,6 +160,15 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--retry-backoff", type=float, default=0.25,
                    help="base seconds of exponential backoff between "
                         "retries")
+    p.add_argument("--max-run-wallclock", type=float, default=None,
+                   metavar="SECONDS",
+                   help="supervised runs: per-run wallclock deadline "
+                        "— when a round barrier finds it spent, take "
+                        "the preemption-style final snapshot, latch a "
+                        "'deadline' health fault, and exit 3 with the "
+                        "snapshot path in the report (--resume "
+                        "continues); the in-process counterpart of "
+                        "the fleet watchdog (docs/8-fleet.md)")
     p.add_argument("--stall-windows", type=int, default=512,
                    help="consecutive zero-event windows before the "
                         "stall latch trips")
@@ -292,6 +301,14 @@ def _host_kernel_mode(args, b, loaded, logger) -> int:
 
 
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "fleet":
+        # `shadow-tpu fleet ...` is its own sub-CLI (fleet/cli.py);
+        # delegate before the single-run parser sees the argv
+        from shadow_tpu.fleet.cli import main as fleet_main
+
+        return fleet_main(argv[1:])
     args = make_parser().parse_args(argv)
 
     # persist compiled device programs across CLI invocations (the
@@ -546,6 +563,7 @@ def main(argv=None) -> int:
                             if args.auto_grow else None),
                         stop=lambda: stop_flag["v"],
                         resume_from=resume_ckpt,
+                        max_run_wallclock=args.max_run_wallclock,
                         mesh=mesh,
                         config_digest=config_hash(b.cfg),
                         log=lambda m: logger.message(0, "shadow-tpu", m),
@@ -608,6 +626,11 @@ def main(argv=None) -> int:
                     logger.critical(0, "shadow-tpu", msg)
                 report = {"failure": failure,
                           "attempts": result.attempts}
+                if result.deadline_exceeded:
+                    # not a corruption: the final snapshot is clean
+                    # and --resume continues the chain
+                    report["checkpoint"] = result.final_checkpoint
+                    report["resume"] = f"--resume {args.data_directory}"
                 # the trip carries the sim, so the shutdown
                 # diagnostics the success path prints still run:
                 # object accounting (ref: slave.c:237-241) and the

@@ -30,6 +30,7 @@ from shadow_tpu.faults.apply import (  # noqa: F401
 )
 from shadow_tpu.faults.health import RunHealth, gather  # noqa: F401
 from shadow_tpu.faults.supervisor import (  # noqa: F401
+    DeadlineExceeded,
     LatchTrip,
     Preempted,
     SupervisorResult,

@@ -48,6 +48,11 @@ class RunHealth:
     # window telemetry records overwritten before the host drained them
     # (telemetry/harvest.py) — observability loss only, results exact
     telemetry_lost: int = 0
+    # the supervisor's per-run wallclock deadline passed
+    # (faults/supervisor.py max_run_wallclock): the run was stopped
+    # with a preemption-style final snapshot instead of hanging — the
+    # state is healthy, the budget is not
+    deadline_exceeded: bool = False
     # context for diagnostics
     window_start: Optional[int] = None   # wstart when gathered
     suspect_hosts: tuple = ()            # rows at capacity (global ids)
@@ -57,6 +62,7 @@ class RunHealth:
         return bool(
             self.events_overflow or self.outbox_overflow
             or self.rq_overflow or self.time_regression
+            or self.deadline_exceeded
             or (self.stall_limit and self.stalled_windows >= self.stall_limit))
 
     def diagnostics(self) -> list:
@@ -93,6 +99,12 @@ class RunHealth:
                         f"engine stalled: {self.stalled_windows} "
                         f"consecutive windows processed zero events"
                         f"{where}"))
+        if self.deadline_exceeded:
+            out.append(("fatal",
+                        f"run wallclock deadline exceeded{where}: a "
+                        f"final snapshot was taken — state is healthy "
+                        f"but the time budget is spent; --resume "
+                        f"continues it, or raise --max-run-wallclock"))
         if self.narrow_miss:
             out.append(("warning",
                         f"narrow exchange tier missed {self.narrow_miss} "
@@ -120,6 +132,7 @@ class RunHealth:
             "stall_limit": self.stall_limit,
             "time_regression": self.time_regression,
             "telemetry_lost": self.telemetry_lost,
+            "deadline_exceeded": self.deadline_exceeded,
             "window_start": self.window_start,
             "suspect_hosts": [int(h) for h in self.suspect_hosts],
             "diagnostics": [m for _, m in self.diagnostics()],

@@ -77,6 +77,20 @@ class Preempted(RuntimeError):
         super().__init__(f"preempted at t={time_ns}, checkpoint {path}")
 
 
+class DeadlineExceeded(Preempted):
+    """The per-run wallclock deadline (max_run_wallclock) passed at a
+    round barrier: same final-snapshot discipline as preemption, but
+    latched as a `deadline` health fault — the run did not hang, it
+    ran out of budget. The fleet watchdog (shadow_tpu/fleet) is the
+    out-of-process counterpart for runs wedged *inside* a device call,
+    where no round barrier ever comes back to the host."""
+
+    def __init__(self, path: str, time_ns: int, sim=None,
+                 elapsed_s: float = 0.0):
+        super().__init__(path, time_ns, sim)
+        self.elapsed_s = elapsed_s
+
+
 @dataclasses.dataclass
 class SupervisorResult:
     ok: bool
@@ -92,6 +106,7 @@ class SupervisorResult:
     escalation_restarts: int = 0       # heals; unbounded by max_retries
     escalations: tuple = ()            # Escalation records, chain-wide
     preempted: bool = False
+    deadline_exceeded: bool = False    # max_run_wallclock fired
     final_checkpoint: Optional[str] = None  # preemption's last snapshot
     run_id: Optional[str] = None
     resume_of: Optional[str] = None    # run_id of the chain predecessor
@@ -107,6 +122,9 @@ class SupervisorResult:
             rep["escalations"] = [e.as_dict() for e in self.escalations]
         if self.preempted:
             rep["verdict"] = "preempted"
+            rep["final_checkpoint"] = self.final_checkpoint
+        if self.deadline_exceeded:
+            rep["verdict"] = "deadline"
             rep["final_checkpoint"] = self.final_checkpoint
         return rep
 
@@ -130,6 +148,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    harvester=None, sleep=_time.sleep,
                    escalation: escalate_mod.EscalationPolicy | None = None,
                    rebuild=None, stop=None, resume_from=None,
+                   max_run_wallclock: float | None = None,
                    run_id: str | None = None,
                    mesh=None, mesh_axis: str = "hosts",
                    exchange_capacity: int | None = None,
@@ -147,7 +166,14 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     poison the new program. `stop()` is polled at every round barrier
     (preemption flag, set from a signal handler); `resume_from` is a
     snapshot path to continue a previous run's chain (grown-capacity
-    snapshots transplant automatically). `on_round(sim, wstats,
+    snapshots transplant automatically). `max_run_wallclock` is a
+    per-run wallclock budget in seconds, chain-wide (attempts and
+    heals share it): when a round barrier finds it spent, the
+    supervisor takes the preemption-style final snapshot and returns
+    with `deadline_exceeded=True` plus a latched `deadline` health
+    fault instead of running forever — a wedge *inside* a device call
+    never reaches a barrier, which is what the fleet watchdog's
+    out-of-process SIGKILL path is for. `on_round(sim, wstats,
     wstart, wend, next_min)` runs after the health check each round —
     the chaos harness samples its conservation ledger there. `log` is
     a callable taking one message string; `sleep` is injectable for
@@ -160,6 +186,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     rebuild_fn = rebuild if rebuild is not None \
         else getattr(bundle, "rebuild", None)
     run_id = run_id or uuid.uuid4().hex[:12]
+    t_chain0 = _time.monotonic()   # max_run_wallclock origin
     shards = mesh.shape[mesh_axis] if mesh is not None else 1
 
     total_saved = []
@@ -250,6 +277,22 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                               extra=_ckpt_extra(tele["acc"]))
                 total_saved.append((p, next_min))
                 raise Preempted(p, next_min, sim)
+            # The wallclock deadline uses the same final-snapshot
+            # discipline as preemption (round complete, observers
+            # saw it, state healthy) but latches as a health fault:
+            # the caller learns the budget was the problem, and
+            # --resume continues the chain.
+            if max_run_wallclock is not None \
+                    and next_min < simtime.INVALID:
+                el = _time.monotonic() - t_chain0
+                if el >= max_run_wallclock:
+                    p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
+                                  time_ns=next_min, shards=shards,
+                                  config_digest=config_digest,
+                                  extra=_ckpt_extra(tele["acc"]))
+                    total_saved.append((p, next_min))
+                    raise DeadlineExceeded(p, next_min, sim,
+                                           elapsed_s=el)
 
         def _gather(sim):
             return health_mod.gather(
@@ -293,6 +336,16 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             if h.fatal:
                 raise LatchTrip(h, sim)
             return _result(True, sim, h, stats=stats)
+        except DeadlineExceeded as d:
+            say(f"supervisor: wallclock deadline after "
+                f"{d.elapsed_s:.1f}s: {d}")
+            h = dataclasses.replace(_gather(d.sim),
+                                    deadline_exceeded=True)
+            return _result(
+                False, d.sim, h,
+                stats=EngineStats.from_dict(
+                    _ckpt_extra(tele["acc"])["stats"]),
+                deadline_exceeded=True, final_checkpoint=d.path)
         except Preempted as p:
             say(f"supervisor: {p}")
             # the preempting round passed its health check before the

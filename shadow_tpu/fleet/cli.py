@@ -1,0 +1,152 @@
+"""`shadow-tpu fleet` — run and inspect scenario fleets.
+
+    shadow-tpu fleet run --jobs-file sweep.json --fleet-dir out/ \
+        --workers 4
+    shadow-tpu fleet run --fleet-dir out/ --resume
+    shadow-tpu fleet status --fleet-dir out/
+
+Exit codes (docs/8-fleet.md §exit codes):
+  0  fleet complete; every job done (quarantined jobs are parked
+     with their salvage, which is success in salvage mode)
+  1  unsalvaged failures (a non-retryable job, or any quarantine
+     under --no-salvage)
+  2  usage error
+  5  preempted (SIGTERM): in-flight jobs checkpointed and requeued;
+     rerun with --resume
+  6  stalled: jobs remain but every worker (and the respawn budget)
+     is gone
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from shadow_tpu.fleet.spec import FleetPolicy, load_jobs_file
+
+_POLICY_FILE = "fleet_policy.json"
+
+
+def make_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="shadow-tpu fleet",
+        description="fault-tolerant scenario-fleet runner")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    r = sub.add_parser("run", help="execute a fleet of jobs")
+    r.add_argument("--jobs-file",
+                   help="JSON jobs file (optional with --resume: "
+                        "specs reload from the fleet dir)")
+    r.add_argument("--fleet-dir", required=True,
+                   help="durable fleet state: journal, job dirs, "
+                        "fleet_manifest.json")
+    r.add_argument("--workers", type=int, default=2)
+    r.add_argument("--resume", action="store_true",
+                   help="replay the journal; completed jobs are not "
+                        "re-run")
+    r.add_argument("--no-salvage", action="store_true",
+                   help="treat quarantined jobs as fleet failure "
+                        "(exit 1) instead of parked successes")
+    r.add_argument("--drain-timeout", type=float, default=60.0,
+                   help="seconds to wait for preempted jobs to "
+                        "checkpoint on SIGTERM")
+    r.add_argument("--no-fsync", action="store_true",
+                   help="skip journal fsyncs (tests only; forfeits "
+                        "power-loss durability)")
+
+    s = sub.add_parser("status", help="summarize a fleet dir "
+                                      "(read-only)")
+    s.add_argument("--fleet-dir", required=True)
+    return p
+
+
+def _cmd_run(args) -> int:
+    from shadow_tpu.fleet.runner import FleetRunner
+
+    policy_path = os.path.join(args.fleet_dir, _POLICY_FILE)
+    specs = None
+    if args.jobs_file:
+        policy, specs = load_jobs_file(args.jobs_file)
+    elif args.resume and os.path.isfile(policy_path):
+        with open(policy_path) as f:
+            policy = FleetPolicy.from_dict(json.load(f))
+    elif args.resume:
+        policy = FleetPolicy()
+    else:
+        print("error: fleet run needs --jobs-file (or --resume "
+              "with an existing fleet dir)", file=sys.stderr)
+        return 2
+    os.makedirs(args.fleet_dir, exist_ok=True)
+    tmp = policy_path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(policy.as_dict(), f, indent=1, sort_keys=True)
+    os.replace(tmp, policy_path)
+
+    runner = FleetRunner(
+        args.fleet_dir, policy, specs, workers=args.workers,
+        resume=args.resume, fsync=not args.no_fsync,
+        salvage=not args.no_salvage,
+        drain_timeout_s=args.drain_timeout,
+        log=lambda m: print(m, file=sys.stderr))
+    rc = runner.run(install_signals=True)
+    man_path = os.path.join(args.fleet_dir, "fleet_manifest.json")
+    with open(man_path) as f:
+        man = json.load(f)
+    print(json.dumps({"exit": rc, "counts": man["counts"],
+                      "preempted": man["preempted"],
+                      "stalled": man["stalled"],
+                      "manifest": man_path}))
+    return rc
+
+
+def _cmd_status(args) -> int:
+    """Read-only: never touches the journal (a live fleet owns it)."""
+    from shadow_tpu.fleet import journal as journal_mod
+
+    jpath = os.path.join(args.fleet_dir, "journal.log")
+    records, good = journal_mod.replay(jpath)
+    status: dict = {}
+    checkpoints: dict = {}
+    for rec in records:
+        job = rec.get("job")
+        ev = rec.get("ev")
+        if not job:
+            continue
+        if ev in ("job_added",):
+            status.setdefault(job, "queued")
+        elif ev in ("leased", "running"):
+            status[job] = "leased" if ev == "leased" else "running"
+        elif ev == "done":
+            status[job] = "done"
+        elif ev == "failed":
+            status[job] = "failed" if rec.get("final") else "queued"
+        elif ev == "requeued":
+            status[job] = "queued"
+        elif ev == "quarantined":
+            status[job] = "quarantined"
+        if ev == "heartbeat" and rec.get("checkpoint"):
+            checkpoints[job] = rec["checkpoint"]
+    counts: dict = {}
+    for st in status.values():
+        counts[st] = counts.get(st, 0) + 1
+    out = {"journal_events": len(records), "journal_bytes": good,
+           "counts": counts, "jobs": status,
+           "checkpoints": checkpoints}
+    man_path = os.path.join(args.fleet_dir, "fleet_manifest.json")
+    if os.path.isfile(man_path):
+        out["manifest"] = man_path
+    print(json.dumps(out, indent=1, sort_keys=True))
+    return 0
+
+
+def main(argv=None) -> int:
+    args = make_parser().parse_args(argv)
+    if args.cmd == "run":
+        return _cmd_run(args)
+    return _cmd_status(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

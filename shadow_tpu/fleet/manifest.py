@@ -1,0 +1,97 @@
+"""fleet_manifest.json — the roll-up of every job's verdict.
+
+Rewritten atomically after every terminal transition (not just at
+exit), so a fleet killed mid-run still leaves an accurate partial
+manifest next to the journal that supersedes it. `tools/
+telemetry_lint.py --fleet-manifest` validates the schema: attempt
+histories monotone, every terminal job carries a verdict, every
+quarantined job carries its salvage pointers.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from shadow_tpu.fleet import journal as journal_mod
+from shadow_tpu.fleet import state as state_mod
+
+SCHEMA = "shadow-tpu-fleet-manifest"
+SCHEMA_VERSION = 1
+
+_VERDICTS = {state_mod.DONE: "ok",
+             state_mod.FAILED: "failed",
+             state_mod.QUARANTINED: "quarantined"}
+
+
+def _job_entry(queue, j) -> dict:
+    jid = j.spec.id
+    rel = os.path.join("jobs", jid)
+    entry = {
+        "status": j.status,
+        "kind": j.spec.kind,
+        "seed": j.spec.seed,
+        "spec_digest": j.spec.digest(),
+        "attempts": j.attempts,
+        "executions": j.execs,
+        "worker_losses": j.worker_losses,
+        "attempt_history": list(j.attempt_history),
+        "backoff_history": [round(b, 6) for b in j.backoff_history],
+        "verdict": _VERDICTS.get(j.status),
+        "dir": rel,
+        "result": j.result,
+        "failure": j.failure,
+        "quarantine_reason": j.quarantine_reason,
+    }
+    run_man = os.path.join(queue.job_dir(jid), "run_manifest.json")
+    if os.path.isfile(run_man):
+        entry["run_manifest"] = os.path.join(rel, "run_manifest.json")
+    if j.status == state_mod.QUARANTINED:
+        from shadow_tpu.utils import checkpoint as ckpt
+
+        entry["salvage"] = {
+            "dir": rel,
+            "checkpoint": j.checkpoint or ckpt.latest_checkpoint(
+                os.path.join(queue.job_dir(jid), "ck")),
+            "run_manifest": entry.get("run_manifest"),
+            "result": (os.path.join(rel, "result.json")
+                       if os.path.isfile(os.path.join(
+                           queue.job_dir(jid), "result.json"))
+                       else None),
+        }
+    return entry
+
+
+def fleet_manifest(queue, *, workers_alive: int = 0,
+                   preempted: bool = False, stalled: bool = False,
+                   complete: bool = False) -> dict:
+    counts: dict[str, int] = {}
+    jobs = {}
+    for jid in sorted(queue.jobs):
+        j = queue.jobs[jid]
+        counts[j.status] = counts.get(j.status, 0) + 1
+        jobs[jid] = _job_entry(queue, j)
+    return {
+        "schema": SCHEMA,
+        "schema_version": SCHEMA_VERSION,
+        "policy": queue.policy.as_dict(),
+        "preempted": bool(preempted),
+        "stalled": bool(stalled),
+        "complete": bool(complete),
+        "workers_alive": workers_alive,
+        "journal_events": queue.events,
+        "counts": counts,
+        "jobs": jobs,
+    }
+
+
+def write_fleet_manifest(path: str, man: dict) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(man, f, indent=1, sort_keys=True)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    journal_mod.fsync_dir(os.path.dirname(os.path.abspath(path)))
+    return path

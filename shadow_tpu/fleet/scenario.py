@@ -1,0 +1,224 @@
+"""The per-job engine: one scenario, run under the self-healing
+supervisor, inside a worker process.
+
+The fleet layer deliberately reuses the single-run machinery rather
+than reimplementing any of it: `faults.run_supervised` is the engine
+(health latches, escalation, preemption snapshots, the new wallclock
+deadline), `utils/checkpoint.py` is the resume mechanism (a job
+requeued after a worker SIGKILL continues from its own supervisor
+checkpoint, under a different worker process — snapshots are
+process-portable the same way they are shard-count-portable), and
+`telemetry/export.py` writes the per-job `run_manifest.json` the
+fleet manifest rolls up.
+
+Determinism: run_job(spec) is a pure function of the spec — the
+checkpoint contract (run(0->T) == run(0->C) + resume(C->T)) makes
+the result independent of how many times the job was killed and
+requeued, which is what the fleet's bit-identity acceptance test
+asserts (tests/test_fleet_recovery.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import time
+from typing import Optional
+
+from shadow_tpu.fleet.spec import JobSpec
+
+
+def sim_digest(sim) -> str:
+    """sha256 over every leaf's bytes (keyed by leaf path) — the
+    bit-identity fingerprint the fleet compares against a clean
+    serial run of the same spec."""
+    import jax
+    import numpy as np
+
+    h = hashlib.sha256()
+    for path, leaf in jax.tree_util.tree_flatten_with_path(sim)[0]:
+        h.update(jax.tree_util.keystr(path).encode())
+        h.update(np.ascontiguousarray(
+            np.asarray(jax.device_get(leaf))).tobytes())
+    return h.hexdigest()
+
+
+def _write_json(path: str, obj) -> str:
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+    return path
+
+
+def _build_scenario(spec: JobSpec, caps: dict):
+    """chaos_soak's PHOLD-on-one-vertex scenario surface, sized by
+    the spec (undersized caps + auto_grow exercises escalation;
+    undersized caps without auto_grow is the deterministic-failure /
+    quarantine vector)."""
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+
+    from shadow_tpu import faults
+
+    graph = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+    cfg = NetConfig(num_hosts=spec.hosts, tcp=False,
+                    end_time=spec.sim_s * simtime.ONE_SECOND,
+                    seed=spec.seed,
+                    event_capacity=caps["event_capacity"],
+                    outbox_capacity=caps["outbox_capacity"],
+                    router_ring=caps["router_ring"],
+                    in_ring=max(8, 2 * spec.load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
+             for i in range(spec.hosts)]
+    b = build(cfg, graph, hosts)
+    b.sim = phold.setup(b.sim, load=spec.load)
+    if spec.faults:
+        from shadow_tpu.faults.plan import records_from_json
+
+        faults.install(b, records_from_json({"faults":
+                                             list(spec.faults)}))
+    return b
+
+
+def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
+                  stop, heartbeat, log) -> dict:
+    from shadow_tpu import faults, telemetry
+    from shadow_tpu.apps import phold
+    from shadow_tpu.utils import checkpoint as ckpt
+
+    caps = {"event_capacity": spec.event_capacity,
+            "outbox_capacity": spec.outbox_capacity,
+            "router_ring": spec.router_ring}
+    if resume_from:
+        # a post-escalation snapshot is larger than the spec says;
+        # its recorded capacities size the rebuild (same rule as the
+        # CLI's --resume)
+        meta = ckpt.peek_meta(resume_from)
+        for k, v in (meta.get("capacities") or {}).items():
+            if k in caps:
+                caps[k] = max(caps[k], int(v))
+
+    built = {"b": None}   # last-built bundle: cfg/plan for the manifest
+
+    def make_bundle():
+        built["b"] = _build_scenario(spec, caps)
+        return built["b"]
+
+    def rebuild(overrides):
+        caps.update(overrides)
+        return make_bundle()
+
+    prefix = os.path.join(job_dir, "ck")
+    hb_state = {"last": 0.0}
+
+    def on_round(sim, wstats, wstart, wend, next_min):
+        if spec.round_sleep_s:
+            time.sleep(spec.round_sleep_s)
+        now = time.monotonic()
+        if heartbeat is not None and now - hb_state["last"] >= 0.05:
+            hb_state["last"] = now
+            heartbeat({"wstart": int(wstart),
+                       "checkpoint": ckpt.latest_checkpoint(prefix)})
+
+    res = faults.run_supervised(
+        make_bundle(), app_handlers=(phold.handler,),
+        checkpoint_path=prefix,
+        checkpoint_every_windows=spec.checkpoint_every_windows,
+        max_retries=spec.max_retries,
+        escalation=(faults.EscalationPolicy(max_grow=spec.max_grow)
+                    if spec.auto_grow else None),
+        rebuild=rebuild, stop=stop, resume_from=resume_from,
+        max_run_wallclock=spec.max_wallclock_s,
+        on_round=on_round, log=log, sleep=lambda s: None)
+
+    result = {
+        "ok": bool(res.ok),
+        "preempted": bool(res.preempted),
+        "deadline": bool(res.deadline_exceeded),
+        "run_id": res.run_id,
+        "resume_of": res.resume_of,
+        "supervisor_attempts": res.attempts,
+        "escalation_restarts": res.escalation_restarts,
+        "final_capacities": dict(caps),
+        "checkpoint": res.final_checkpoint,
+    }
+    if res.sim is not None:
+        bundle = built["b"]
+        man = telemetry.run_manifest(
+            cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
+            stats=res.stats, health=res.health,
+            fault_plan=bundle.fault_plan,
+            run_id=res.run_id, resume_of=res.resume_of,
+            escalations=res.escalations,
+            preempted=res.preempted or None)
+        result["manifest"] = telemetry.write_manifest(
+            os.path.join(job_dir, "run_manifest.json"), man)
+        result["counters"] = man["counters"]
+        if res.ok:
+            result["digest"] = sim_digest(res.sim)
+    if not res.ok and not res.preempted:
+        result["failure"] = res.failure_report()
+    return result
+
+
+def _run_chaos_trial(spec: JobSpec, job_dir: str, *, heartbeat,
+                     log) -> dict:
+    """One tools/chaos_soak.py trial (the --jobs dogfood path). The
+    trial owns its own kill/heal machinery; the fleet only provides
+    the workdir, the lease, and the salvage."""
+    import importlib.util
+    import pathlib
+
+    tools = pathlib.Path(__file__).resolve().parents[2] / "tools"
+    mod_spec = importlib.util.spec_from_file_location(
+        "chaos_soak", tools / "chaos_soak.py")
+    chaos = importlib.util.module_from_spec(mod_spec)
+    mod_spec.loader.exec_module(chaos)
+    if heartbeat is not None:
+        heartbeat({"wstart": 0, "checkpoint": None})
+    rep = chaos.run_trial(
+        spec.seed, hosts=spec.hosts, load=spec.load,
+        sim_s=spec.sim_s, kills=spec.kills, max_grow=spec.max_grow,
+        workdir=job_dir, verify=spec.verify, log=log)
+    # the trial's product is its report, pass or fail: a trial that
+    # RAN is a done job (retrying a deterministic verdict would just
+    # reproduce it); only an exception is a job failure
+    return {"ok": True, "trial_ok": bool(rep["ok"]), "report": rep,
+            "preempted": False, "deadline": False}
+
+
+def run_job(spec: JobSpec, job_dir: str, *,
+            resume_from: Optional[str] = None, stop=None,
+            heartbeat=None, log=None) -> dict:
+    """Execute one job attempt (or continuation). Always leaves
+    `result.json` in the job dir — the crash-safe copy the supervisor
+    salvages if the worker's pipe dies with the worker."""
+    os.makedirs(job_dir, exist_ok=True)
+    try:
+        if spec.kind == "chaos_trial":
+            result = _run_chaos_trial(spec, job_dir,
+                                      heartbeat=heartbeat, log=log)
+        else:
+            result = _run_scenario(spec, job_dir,
+                                   resume_from=resume_from, stop=stop,
+                                   heartbeat=heartbeat, log=log)
+    except Exception as e:  # noqa: BLE001 — worker must not die on a job
+        result = {"ok": False, "preempted": False, "deadline": False,
+                  "error": f"{type(e).__name__}: {e}"}
+    _write_json(os.path.join(job_dir, "result.json"), result)
+    return result

@@ -14,9 +14,13 @@ pure function of (plan, window end), never of saved state
 
 Torn-snapshot safety (the supervisor in faults/supervisor.py resumes
 from these after trips, possibly after the process itself died
-mid-save): save() writes to a temp file in the target directory and
-os.replace()s it into place — readers see the old snapshot or the new
-one, never a partial write — and every leaf carries a CRC32 that
+mid-save): save() writes to a temp file in the target directory,
+fsyncs it, os.replace()s it into place, then fsyncs the PARENT
+DIRECTORY — readers see the old snapshot or the new one, never a
+partial write, and the rename itself survives power loss rather than
+just process death (an unfsynced directory entry can vanish with the
+page cache; the fleet journal in shadow_tpu/fleet/journal.py follows
+the same discipline for its frames). Every leaf carries a CRC32 that
 load() verifies before resuming.
 """
 
@@ -100,6 +104,10 @@ def save(path: str, sim, *, time_ns: int, extra: dict | None = None,
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, path)  # same directory -> atomic rename
+        # durable rename: without the directory fsync the new entry
+        # (and on some filesystems the whole snapshot) can be lost to
+        # power failure even though the data blocks were fsynced
+        _fsync_dir(d)
     except BaseException:
         try:
             os.unlink(tmp)
@@ -107,6 +115,21 @@ def save(path: str, sim, *, time_ns: int, extra: dict | None = None,
             pass
         raise
     return path
+
+
+def _fsync_dir(path: str) -> None:
+    """Best-effort directory fsync (filesystems that refuse O_RDONLY
+    dir fsync keep the old process-death-only guarantee)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
 
 
 def _check_layout(meta: dict):

@@ -1,0 +1,309 @@
+"""Process-level fleet recovery: the paths that need real worker
+processes and the real engine.
+
+The contract under test (docs/8-fleet.md): a fleet's verdicts are a
+pure function of the jobs file — SIGKILLed workers, SIGTERMed fleets
+and wallclock deadlines change *when* work happens, never *what* the
+surviving jobs compute. Bit-identity rides the checkpoint contract
+(run(0->T) == run(0->C) + resume(C->T)).
+
+Everything here that runs the engine more than once is slow-marked;
+the tier-1 representative is the deadline test (a one-window run).
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from shadow_tpu.fleet import FleetPolicy, FleetRunner, JobSpec
+from shadow_tpu.fleet import journal as journal_mod
+from shadow_tpu.fleet.scenario import run_job
+
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _spec(jid, **kw):
+    kw.setdefault("seed", 7)
+    kw.setdefault("hosts", 8)
+    kw.setdefault("load", 2)
+    kw.setdefault("sim_s", 1)
+    return JobSpec(id=jid, **kw)
+
+
+def _clean_digest(spec, tmp_path, name="clean"):
+    """Serial, uninterrupted run of the same spec (no sleeps)."""
+    d = spec.as_dict()
+    d["round_sleep_s"] = 0.0
+    res = run_job(JobSpec.from_dict(d), str(tmp_path / name))
+    assert res["ok"], res
+    return res
+
+
+# ---------------------------------------------------------------- deadline
+
+def test_run_wallclock_deadline_latches_and_checkpoints(tmp_path):
+    """Satellite: --max-run-wallclock. A zero budget trips at the
+    first round barrier: the run takes a preemption-style final
+    snapshot, latches the `deadline` health fault, and reports the
+    resume path."""
+    spec = _spec("dl-0", max_wallclock_s=0.0,
+                 checkpoint_every_windows=4)
+    res = run_job(spec, str(tmp_path / "job"))
+    assert not res["ok"] and res["deadline"] and not res["preempted"]
+    assert res["checkpoint"] and os.path.exists(res["checkpoint"])
+    assert res["failure"]["verdict"] == "deadline"
+    assert res["failure"]["deadline_exceeded"] is True
+    # the crash-safe result copy is on disk too
+    on_disk = json.load(open(tmp_path / "job" / "result.json"))
+    assert on_disk["deadline"] is True
+
+    # fleet fold: a deadline consumes an attempt (a continuation
+    # would re-trip the same budget forever) and quarantines once
+    # the budget is gone
+    from shadow_tpu.fleet import state
+    from shadow_tpu.fleet.runner import _is_fatal
+
+    assert not _is_fatal(res)
+    q = state.FleetQueue(str(tmp_path / "fleet"),
+                         FleetPolicy(max_attempts=2, backoff_base_s=0,
+                                     backoff_cap_s=0),
+                         [spec], fsync=False)
+    for expect in (state.QUEUED, state.QUARANTINED):
+        q.lease(spec.id, "w0")
+        assert q.fail(spec.id, res["failure"]) == expect
+    assert not q.jobs[spec.id].continuation
+    q.close()
+
+
+def test_cli_exposes_max_run_wallclock():
+    from shadow_tpu.cli import make_parser
+
+    args = make_parser().parse_args(["--test", "--max-run-wallclock",
+                                     "2.5"])
+    assert args.max_run_wallclock == 2.5
+    assert make_parser().parse_args(["--test"]).max_run_wallclock is None
+
+
+# ------------------------------------------------------------ worker loss
+
+@pytest.mark.slow
+def test_worker_sigkill_recovery_bit_identical(tmp_path):
+    """Satellite: SIGKILL a worker mid-job. The job requeues onto a
+    fresh worker, resumes from its supervisor checkpoint, and the
+    final state is bit-identical to an uninterrupted run."""
+    spec = _spec("kill-0", checkpoint_every_windows=2,
+                 round_sleep_s=0.1)
+    killed = {"done": False}
+
+    def on_event(runner, ev):
+        if (not killed["done"] and ev["ev"] == "heartbeat"
+                and ev["job"] == "kill-0" and ev.get("checkpoint")):
+            os.kill(runner.workers[ev["worker"]]["proc"].pid,
+                    signal.SIGKILL)
+            killed["done"] = True
+
+    runner = FleetRunner(
+        str(tmp_path / "fleet"),
+        FleetPolicy(backoff_base_s=0.0, backoff_cap_s=0.0),
+        [spec], workers=1, fsync=False, on_event=on_event)
+    rc = runner.run()
+    assert rc == 0, rc
+    assert killed["done"], "kill never landed — no checkpoint heartbeat"
+
+    man = json.load(open(tmp_path / "fleet" / "fleet_manifest.json"))
+    j = man["jobs"]["kill-0"]
+    assert j["verdict"] == "ok"
+    assert j["worker_losses"] == 1
+    assert j["attempt_history"] == [1, 1]   # continuation, not retry
+    assert j["executions"] == 2
+
+    clean = _clean_digest(spec, tmp_path)
+    assert j["result"]["digest"] == clean["digest"]
+    assert j["result"]["counters"] == clean["counters"]
+
+    # journal shows the requeue carried a checkpoint
+    recs, _ = journal_mod.replay(
+        str(tmp_path / "fleet" / "journal.log"))
+    req = [r for r in recs if r["ev"] == "requeued"]
+    assert len(req) == 1 and req[0]["resume_from"]
+
+
+# ------------------------------------------------------- SIGTERM + resume
+
+def _fleet_cmd(fleet_dir, *extra):
+    return [sys.executable, "-m", "shadow_tpu.fleet", "run",
+            "--fleet-dir", fleet_dir, "--workers", "1",
+            "--no-fsync", *extra]
+
+
+def _journal_status(fleet_dir):
+    recs, _ = journal_mod.replay(os.path.join(fleet_dir, "journal.log"))
+    st = {}
+    for r in recs:
+        if r.get("job"):
+            st.setdefault(r["job"], []).append(r["ev"])
+    return st
+
+
+@pytest.mark.slow
+def test_fleet_sigterm_checkpoints_and_resume_reruns_nothing(tmp_path):
+    """Satellite + tentpole acceptance: SIGTERM mid-fleet exits 5
+    with every in-flight job checkpointed and requeued; `fleet run
+    --resume` finishes the fleet and re-runs zero completed jobs
+    (counted as supervisor leases in the journal)."""
+    jobs = {"jobs": [
+        _spec("sc-a").as_dict(),
+        _spec("sc-b", seed=8, round_sleep_s=0.2,
+              checkpoint_every_windows=2).as_dict(),
+    ], "fleet": {"backoff_base_s": 0.0, "backoff_cap_s": 0.0}}
+    jf = tmp_path / "jobs.json"
+    jf.write_text(json.dumps(jobs))
+    fd = str(tmp_path / "fleet")
+
+    proc = subprocess.Popen(
+        _fleet_cmd(fd, "--jobs-file", str(jf)),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_ENV)
+    try:
+        # wait (read-only journal polls) until sc-a finished and sc-b
+        # is mid-run with at least one checkpoint heartbeat
+        deadline = time.time() + 600
+        while time.time() < deadline:
+            st = _journal_status(fd)
+            if ("done" in st.get("sc-a", [])
+                    and "running" in st.get("sc-b", [])
+                    and any(e == "heartbeat" for e in st["sc-b"])):
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"fleet exited early: {proc.returncode}")
+            time.sleep(0.5)
+        else:
+            pytest.fail("fleet never reached the SIGTERM window")
+        proc.send_signal(signal.SIGTERM)
+        rc = proc.wait(timeout=300)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    assert rc == 5, rc                       # preempted, not failed
+
+    st = _journal_status(fd)
+    assert st["sc-b"][-1] == "requeued"      # checkpointed + parked
+    man = json.load(open(os.path.join(fd, "fleet_manifest.json")))
+    assert man["preempted"] is True
+    assert man["jobs"]["sc-a"]["verdict"] == "ok"
+
+    out = subprocess.run(
+        _fleet_cmd(fd, "--resume"), env=_ENV,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, timeout=900)
+    assert out.returncode == 0, out.stdout
+
+    st = _journal_status(fd)
+    # sc-a ran exactly once across both fleet invocations
+    assert st["sc-a"].count("leased") == 1
+    # sc-b's second lease was a continuation from its checkpoint
+    recs, _ = journal_mod.replay(os.path.join(fd, "journal.log"))
+    leases_b = [r for r in recs
+                if r["ev"] == "leased" and r["job"] == "sc-b"]
+    assert len(leases_b) == 2
+    assert leases_b[1]["attempt"] == 1
+    assert leases_b[1]["resume_from"]
+    man = json.load(open(os.path.join(fd, "fleet_manifest.json")))
+    assert man["complete"] and man["counts"] == {"done": 2}
+
+
+# ------------------------------------------------- 12-scenario acceptance
+
+@pytest.mark.slow
+def test_fleet_acceptance_twelve_scenarios(tmp_path):
+    """ISSUE acceptance: 12 heterogeneous scenarios on 2 workers —
+    one worker SIGKILLed mid-job, one scenario healing through
+    capacity escalation, one quarantined after 3 attempts — completes
+    exit 0 in salvage mode, fleet_manifest.json lints clean, and
+    every non-quarantined job's digest+counters are bit-identical to
+    a clean serial run."""
+    specs = [_spec(f"sweep-{k:02d}", seed=20 + k) for k in range(8)]
+    specs.append(_spec("sweep-faulty", seed=31, faults=(
+        {"time_s": 0.3, "kind": "loss", "a": 0, "b": 0,
+         "value": 0.05},)))
+    specs.append(_spec("sweep-escalate", seed=32, event_capacity=2,
+                       auto_grow=True, max_grow=8))
+    specs.append(_spec("sweep-doomed", seed=33, event_capacity=1,
+                       auto_grow=False, max_attempts=3))
+    specs.append(_spec("sweep-victim", seed=34, round_sleep_s=0.1,
+                       checkpoint_every_windows=2))
+    assert len(specs) == 12
+
+    killed = {"done": False}
+
+    def on_event(runner, ev):
+        if (not killed["done"] and ev["ev"] == "heartbeat"
+                and ev["job"] == "sweep-victim" and ev.get("checkpoint")):
+            os.kill(runner.workers[ev["worker"]]["proc"].pid,
+                    signal.SIGKILL)
+            killed["done"] = True
+
+    fd = str(tmp_path / "fleet")
+    runner = FleetRunner(
+        fd, FleetPolicy(max_attempts=3, backoff_base_s=0.0,
+                        backoff_cap_s=0.0),
+        specs, workers=2, fsync=False, on_event=on_event)
+    rc = runner.run()
+    assert rc == 0, rc                       # salvage mode: exit 0
+    assert killed["done"]
+
+    man = json.load(open(os.path.join(fd, "fleet_manifest.json")))
+    assert man["complete"]
+    assert man["counts"] == {"done": 11, "quarantined": 1}
+
+    from tests.conftest import load_tool
+
+    errs, _ = load_tool("telemetry_lint").lint_fleet_manifest_obj(man)
+    assert errs == []
+
+    doomed = man["jobs"]["sweep-doomed"]
+    assert doomed["verdict"] == "quarantined"
+    assert doomed["attempt_history"] == [1, 2, 3]
+    assert doomed["salvage"]["dir"]
+    esc = man["jobs"]["sweep-escalate"]["result"]
+    assert esc["escalation_restarts"] >= 1   # it healed, not retried
+    assert man["jobs"]["sweep-victim"]["worker_losses"] == 1
+
+    for jid, j in man["jobs"].items():
+        if j["verdict"] != "ok":
+            continue
+        clean = _clean_digest(
+            JobSpec.from_dict(
+                json.load(open(os.path.join(fd, "jobs", jid,
+                                            "spec.json")))),
+            tmp_path, name=f"clean-{jid}")
+        assert j["result"]["digest"] == clean["digest"], jid
+        assert j["result"]["counters"] == clean["counters"], jid
+
+
+# ----------------------------------------------------- chaos soak --jobs
+
+@pytest.mark.slow
+def test_chaos_soak_jobs_byte_identical_to_serial(tmp_path, capsys):
+    """Satellite: chaos_soak --jobs K routes trials through the fleet;
+    the per-trial JSON lines on stdout are byte-identical to the
+    serial path's for the same flags."""
+    from tests.conftest import load_tool
+
+    chaos = load_tool("chaos_soak")
+    flags = ["--trials", "2", "--seed", "5", "--kills", "1"]
+    rc = chaos.main(flags)
+    serial = capsys.readouterr().out
+    assert rc == 0, serial
+    rc = chaos.main(flags + ["--jobs", "2", "--fleet-dir",
+                             str(tmp_path / "fleet")])
+    fleet_out = capsys.readouterr().out
+    assert rc == 0, fleet_out
+    assert fleet_out == serial
+    # and serial is reproducible with itself (deterministic run ids)
+    rc = chaos.main(flags)
+    assert rc == 0
+    assert capsys.readouterr().out == serial

@@ -186,7 +186,11 @@ def run_trial(seed: int, *, hosts: int = 8, load: int = 2,
             max_retries=2, sleep=lambda s: None,
             escalation=faults.EscalationPolicy(max_grow=max_grow),
             rebuild=rebuild, stop=stop, resume_from=resume_from,
-            on_round=on_round, log=log)
+            on_round=on_round, log=log,
+            # deterministic run ids (instead of uuids) make the whole
+            # report reproducible byte for byte — the fleet-vs-serial
+            # identity check depends on it
+            run_id=f"s{seed}.g{segments}")
         escalation_restarts += res.escalation_restarts
         retries_used += res.retries_used
         if res.preempted:
@@ -257,6 +261,43 @@ def _verify_final(sim_healed, make_bundle, errors) -> bool:
     return same
 
 
+def _main_fleet(args) -> int:
+    """--jobs K: dogfood the fleet runner. Each trial becomes a
+    `chaos_trial` job; K worker processes execute them with the full
+    durable-queue / lease / requeue machinery, and the reports come
+    back through the journal. Output order is seed order (not
+    completion order), so the stdout stream is byte-identical to the
+    serial path's for the same flags."""
+    from shadow_tpu.fleet import FleetPolicy, FleetRunner, JobSpec
+
+    fleet_dir = args.fleet_dir or tempfile.mkdtemp(prefix="chaos_fleet.")
+    specs = [JobSpec(id=f"trial-{k:03d}", kind="chaos_trial",
+                     seed=args.seed + k, hosts=args.hosts,
+                     load=args.load, sim_s=args.sim_s,
+                     kills=args.kills, max_grow=args.max_grow,
+                     verify=args.verify)
+             for k in range(args.trials)]
+    runner = FleetRunner(fleet_dir, FleetPolicy(), specs,
+                         workers=args.jobs,
+                         log=lambda m: print(m, file=sys.stderr))
+    rc = runner.run(install_signals=True)
+    failed = 0
+    for k in range(args.trials):
+        j = runner.queue.jobs[f"trial-{k:03d}"]
+        rep = (j.result or {}).get("report")
+        if rep is None:
+            print(json.dumps({"seed": args.seed + k, "ok": False,
+                              "fleet_status": j.status,
+                              "failure": j.failure}), flush=True)
+            failed += 1
+        else:
+            print(json.dumps(rep), flush=True)
+            failed += 0 if rep["ok"] else 1
+    print(f"chaos soak: {args.trials - failed}/{args.trials} trials ok "
+          f"(fleet: {len(specs)} jobs, exit {rc})", file=sys.stderr)
+    return 1 if failed or rc != 0 else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="randomized kill/heal soak over the supervised "
@@ -273,12 +314,23 @@ def main(argv=None) -> int:
     ap.add_argument("--verify", action="store_true",
                     help="also diff each healed run against an "
                          "uninterrupted run at the final capacities")
+    ap.add_argument("--jobs", type=int, default=0,
+                    help="run the trials through the fleet runner "
+                         "(shadow_tpu.fleet) with this many worker "
+                         "processes; 0 = serial in-process. Reports "
+                         "are byte-identical either way (seeded "
+                         "trials, deterministic run ids)")
+    ap.add_argument("--fleet-dir", default=None,
+                    help="fleet state dir for --jobs (default: a "
+                         "fresh temp dir)")
     ap.add_argument("--platform", default=None,
                     help="force a JAX backend (e.g. cpu)")
     args = ap.parse_args(argv)
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.jobs > 0:
+        return _main_fleet(args)
 
     failed = 0
     for k in range(args.trials):

@@ -19,8 +19,15 @@ Checks:
   health warning in the manifest is an error (silent observability
   loss is exactly what the latch design forbids).
 
+- Fleet manifest JSON (--fleet-manifest): shadow_tpu/fleet schema —
+  attempt histories monotone non-decreasing with attempts at the
+  high-water mark, every terminal job carries the matching verdict,
+  every quarantined job carries its salvage pointers, and the counts
+  block agrees with the per-job statuses.
+
 Usage: telemetry_lint.py [--trace trace.json]
                          [--manifest run_manifest.json]
+                         [--fleet-manifest fleet_manifest.json]
 Exit 0 = clean (warnings allowed), 1 = errors.
 """
 
@@ -275,6 +282,118 @@ def lint_manifest_obj(man) -> tuple[list, list]:
     return errors, warnings
 
 
+_FLEET_TERMINAL = {"done": "ok", "failed": "failed",
+                   "quarantined": "quarantined"}
+_FLEET_STATUSES = {"queued", "leased", "running"} | set(_FLEET_TERMINAL)
+
+
+def lint_fleet_manifest_obj(man) -> tuple[list, list]:
+    """(errors, warnings) for a parsed fleet_manifest.json
+    (shadow_tpu/fleet/manifest.py schema)."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(man, dict):
+        return (["fleet manifest must be a JSON object"], [])
+    if man.get("schema") != "shadow-tpu-fleet-manifest":
+        errors.append(f'schema must be "shadow-tpu-fleet-manifest", '
+                      f'got {man.get("schema")!r}')
+    if not isinstance(man.get("schema_version"), int):
+        errors.append("schema_version must be an integer")
+    if not isinstance(man.get("policy"), dict):
+        errors.append('missing the "policy" block')
+    for k in ("preempted", "stalled", "complete"):
+        if not isinstance(man.get(k), bool):
+            errors.append(f"{k} must be a bool, got {man.get(k)!r}")
+    jobs = man.get("jobs")
+    if not isinstance(jobs, dict) or not jobs:
+        errors.append('"jobs" must be a non-empty object')
+        return errors, warnings
+    counts: dict = {}
+    for jid, j in sorted(jobs.items()):
+        where = f"jobs[{jid}]"
+        if not isinstance(j, dict):
+            errors.append(f"{where}: must be an object")
+            continue
+        st = j.get("status")
+        counts[st] = counts.get(st, 0) + 1
+        if st not in _FLEET_STATUSES:
+            errors.append(f"{where}: unknown status {st!r}")
+            continue
+        # attempt accounting: monotone non-decreasing 1-based history,
+        # attempts == the high-water mark, one history entry per
+        # execution (a requeued continuation repeats the attempt
+        # number, it never rewinds it)
+        hist = j.get("attempt_history")
+        if not isinstance(hist, list) or not all(
+                isinstance(a, int) and a >= 1 for a in hist):
+            errors.append(f"{where}: attempt_history must be a list "
+                          f"of attempt numbers >= 1")
+            hist = []
+        if any(b < a for a, b in zip(hist, hist[1:])):
+            errors.append(f"{where}: attempt_history must be "
+                          f"monotone non-decreasing, got {hist}")
+        att = j.get("attempts")
+        if not isinstance(att, int) or att < 0:
+            errors.append(f"{where}: attempts must be a non-negative "
+                          f"integer")
+        elif hist and att != max(hist):
+            errors.append(f"{where}: attempts={att} disagrees with "
+                          f"attempt_history high-water {max(hist)}")
+        ex = j.get("executions")
+        if isinstance(ex, int) and hist and ex != len(hist):
+            errors.append(f"{where}: executions={ex} but "
+                          f"{len(hist)} attempt_history entries")
+        bh = j.get("backoff_history", [])
+        if not isinstance(bh, list) or not all(
+                isinstance(b, (int, float)) and b >= 0 for b in bh):
+            errors.append(f"{where}: backoff_history must hold "
+                          f"non-negative delays")
+        # terminal jobs carry a verdict; the verdict matches status
+        verdict = j.get("verdict")
+        want = _FLEET_TERMINAL.get(st)
+        if want is not None and verdict != want:
+            errors.append(f"{where}: terminal status {st!r} must "
+                          f"carry verdict {want!r}, got {verdict!r}")
+        if want is None and verdict is not None:
+            errors.append(f"{where}: non-terminal job carries a "
+                          f"verdict ({verdict!r})")
+        if st == "done" and not isinstance(j.get("result"), dict):
+            errors.append(f"{where}: done job must carry its result")
+        if st == "failed" and not isinstance(j.get("failure"), dict):
+            errors.append(f"{where}: failed job must carry its "
+                          f"failure report")
+        if st == "quarantined":
+            if not j.get("quarantine_reason"):
+                errors.append(f"{where}: quarantined job must state "
+                              f"its reason")
+            sal = j.get("salvage")
+            if not isinstance(sal, dict) or not sal.get("dir"):
+                errors.append(f"{where}: quarantined job must carry "
+                              f"salvage pointers (at least the job "
+                              f"dir)")
+            elif not any(sal.get(k) for k in
+                         ("checkpoint", "run_manifest", "result")):
+                warnings.append(f"{where}: quarantined with no "
+                                f"checkpoint/manifest/result salvaged "
+                                f"(died before its first checkpoint?)")
+    mc = man.get("counts")
+    if isinstance(mc, dict) and mc != counts:
+        errors.append(f"counts block {mc} disagrees with the jobs "
+                      f"({counts})")
+    if man.get("complete"):
+        stuck = sorted(jid for jid, j in jobs.items()
+                       if isinstance(j, dict)
+                       and j.get("status") not in _FLEET_TERMINAL)
+        if stuck:
+            errors.append(f"manifest claims complete but jobs are "
+                          f"non-terminal: {stuck}")
+    q = counts.get("quarantined", 0)
+    if q:
+        warnings.append(f"{q} job(s) quarantined (parked with "
+                        f"salvage; see jobs[*].salvage)")
+    return errors, warnings
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="validate telemetry exports (Chrome-trace JSON "
@@ -282,16 +401,19 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default=None, help="trace JSON path")
     ap.add_argument("--manifest", default=None,
                     help="run_manifest.json path")
+    ap.add_argument("--fleet-manifest", default=None,
+                    help="fleet_manifest.json path (shadow_tpu.fleet)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings, print errors only")
     args = ap.parse_args(argv)
-    if not args.trace and not args.manifest:
-        ap.error("give --trace and/or --manifest")
+    if not args.trace and not args.manifest and not args.fleet_manifest:
+        ap.error("give --trace, --manifest and/or --fleet-manifest")
 
     errors: list = []
     warnings: list = []
     for path, lint in ((args.trace, lint_trace_obj),
-                       (args.manifest, lint_manifest_obj)):
+                       (args.manifest, lint_manifest_obj),
+                       (args.fleet_manifest, lint_fleet_manifest_obj)):
         if not path:
             continue
         try:
