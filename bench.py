@@ -15,9 +15,13 @@ rate measures raw engine throughput. Env knobs:
   BENCH_REPLICAS=R                ensemble mode: R independent
                                   replicas of the H-host sim in one
                                   device program (aggregate ev/s)
-  BENCH_TOPO=one|ref              'ref' = the reference's real
+  BENCH_TOPO=one|ref|mix          'ref' = the reference's real
                                   183-vertex Internet graph instead of
-                                  the single-vertex 50 ms fixture
+                                  the single-vertex 50 ms fixture;
+                                  'mix' = the 3-vertex heterogeneous
+                                  ~1-3 ms fixture (MIX_VERTICES) whose
+                                  dense event times make the
+                                  small-window dispatch-bound shape
   BENCH_FAULTS=plan.json          same as --faults: run the workload
                                   on a degraded network (injected
                                   loss / flaps / latency spikes; see
@@ -38,6 +42,28 @@ rate measures raw engine throughput. Env knobs:
                                   unset = engine default 256, 0 =
                                   fast path off — the A/B lever for
                                   the sparse-window speedup claim)
+  BENCH_SUPERVISE=1               route PHOLD through the supervised
+                                  host-driven window loop
+                                  (faults.run_supervised) instead of
+                                  the all-on-device engine.run — the
+                                  dispatch-amortization A/B subject
+  BENCH_CHUNK_WINDOWS=K           windows_per_dispatch for the
+                                  supervised loop (K windows per host
+                                  barrier; requires BENCH_SUPERVISE=1)
+  BENCH_ADAPTIVE_JUMP=1           live-table window span instead of
+                                  the static min_jump (requires
+                                  BENCH_SUPERVISE=1)
+  BENCH_MIN_JUMP_MS=M             LOWER the window span to M ms (only
+                                  lowers — a raise would break the
+                                  conservative window invariant): the
+                                  small-window shape that makes
+                                  per-dispatch overhead dominate.
+                                  Scenario knob — applies to both the
+                                  supervised loop and engine.run
+  BENCH_CHECKPOINT_WINDOWS=N      supervised checkpoint cadence in
+                                  windows (default: effectively never,
+                                  so the timed loop measures dispatch,
+                                  not npz writes)
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
@@ -84,6 +110,34 @@ ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
     <node id="poi"><data key="up">102400</data><data key="dn">102400</data>
     </node>
     <edge source="poi" target="poi"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+# Heterogeneous small-latency fixture (BENCH_TOPO=mix): three vertices
+# whose pairwise latencies are mutually incommensurate milliseconds, so
+# PHOLD arrival times — sums of random hop picks — smear densely over
+# sim-time instead of synchronizing on one 50 ms beat the way the
+# single-vertex fixture does. min pair latency 1.1 ms => ~1.1 ms
+# conservative windows, hundreds of windows per simulated second: the
+# SMALL-WINDOW shape where per-dispatch host overhead dominates and
+# chunked dispatch (BENCH_CHUNK_WINDOWS) has something to amortize.
+MIX_VERTICES = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <node id="v1"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <node id="v2"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">1.1</data></edge>
+    <edge source="v1" target="v1"><data key="lat">1.7</data></edge>
+    <edge source="v2" target="v2"><data key="lat">2.3</data></edge>
+    <edge source="v0" target="v1"><data key="lat">1.3</data></edge>
+    <edge source="v0" target="v2"><data key="lat">1.9</data></edge>
+    <edge source="v1" target="v2"><data key="lat">2.9</data></edge>
   </graph>
 </graphml>"""
 
@@ -170,7 +224,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   graph: str | None = None,
                   replica_size: int | None = None, fault_records=None,
                   active_hosts: int | None = None,
-                  sparse_lanes: int | None = None):
+                  sparse_lanes: int | None = None,
+                  min_jump_ns: int | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -188,6 +243,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     def build_at(cap):
         b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
                          fault_records, active_hosts, sparse_lanes)
+        if min_jump_ns is not None:
+            b.min_jump = min(b.min_jump, int(min_jump_ns))
         # pre-build distinct-seed inputs so the timed call measures
         # only the device program, not host-side setup (each carries
         # its own seeded fault wakeups)
@@ -235,6 +292,102 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     go.escalated = False
     go.last_sim = None
     go.last_stats = None
+    go.state = state
+    return go
+
+
+def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
+                             graph: str | None = None,
+                             fault_records=None,
+                             chunk_windows: int | None = None,
+                             adaptive_jump: bool = False,
+                             min_jump_ns: int | None = None,
+                             checkpoint_windows: int | None = None):
+    """PHOLD through faults.run_supervised — the host-driven window
+    loop with health checks at every dispatch barrier. This is the
+    dispatch-amortization A/B subject: at windows_per_dispatch=1 every
+    window pays a host round-trip; at K the loop stays on device for K
+    windows per barrier. `min_jump_ns` LOWERS the bundle's window span
+    (never raises it — larger would break the conservative-window
+    invariant) to manufacture the small-window shape where dispatch
+    overhead dominates. Capacity escalates by doubling on counted
+    overflow, exactly like _phold_runner."""
+    import tempfile
+
+    from shadow_tpu import faults, telemetry
+
+    state = {"n": 0, "cap": None, "bundle": None, "sims": None,
+             "mesh": None}
+    telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    every = checkpoint_windows or (1 << 30)   # default: never fires
+    ckdir = tempfile.mkdtemp(prefix="bench_sup_")
+
+    def build_at(cap):
+        from shadow_tpu.apps import phold
+
+        b = _build_phold(H, load, sim_s, seed, cap, graph, None,
+                         fault_records)
+        # same bulk pass the unsupervised megakernel gets — the
+        # supervised loop honors bundle.app_bulk (checkpoint.run_windows)
+        b.app_bulk = phold.BULK
+        if min_jump_ns is not None:
+            b.min_jump = min(b.min_jump, int(min_jump_ns))
+        sims = [b.sim] + [_build_phold(H, load, sim_s, seed + i, cap,
+                                       graph, None, fault_records).sim
+                          for i in (1, 2)]
+        if telem_on:
+            # production-default ring, grown only when a chunk would
+            # overrun it: the supervised loop drains once per dispatch
+            # (telemetry/ring.py), and every K must carry the SAME
+            # ring the per-window baseline does for an honest A/B
+            from shadow_tpu.telemetry.ring import DEFAULT_CAPACITY
+
+            W = max(DEFAULT_CAPACITY, 2 * (chunk_windows or 1))
+            sims = [telemetry.attach(s, capacity=W) for s in sims]
+        b.sim = sims[0]
+        mesh = (jax.make_mesh((shards,), ("hosts",))
+                if shards > 1 else None)
+        for s in sims:
+            jax.block_until_ready(s.net.rng_keys)
+        state.update(cap=cap, bundle=b, sims=sims, mesh=mesh)
+
+    build_at(max(16, 3 * load))
+
+    def go():
+        go.escalated = False
+        while True:
+            b = state["bundle"]
+            b.sim = state["sims"][state["n"] % len(state["sims"])]
+            state["n"] += 1
+            h = telemetry.Harvester()
+            from shadow_tpu.apps import phold
+
+            result = faults.run_supervised(
+                b, app_handlers=(phold.handler,),
+                checkpoint_path=os.path.join(ckdir, "ck"),
+                checkpoint_every_windows=every,
+                harvester=h, mesh=state["mesh"],
+                windows_per_dispatch=chunk_windows,
+                adaptive_jump=adaptive_jump or None)
+            sim = result.sim
+            overflow = (int(jax.device_get(sim.events.overflow))
+                        + int(jax.device_get(sim.outbox.overflow)))
+            if overflow:
+                build_at(state["cap"] * 2)
+                go.escalated = True
+                continue
+            assert int(jax.device_get(sim.app.rcvd.sum())) > 0
+            go.last_sim = sim
+            go.last_stats = jax.device_get(result.stats)
+            go.last_result = result
+            go.harvester = h
+            return int(result.stats.events_processed)
+
+    go.escalated = False
+    go.last_sim = None
+    go.last_stats = None
+    go.last_result = None
+    go.harvester = None
     go.state = state
     return go
 
@@ -400,7 +553,8 @@ def main(argv=None) -> None:
     H = int(os.environ.get("BENCH_HOSTS", default_h))
     sim_s = int(os.environ.get("BENCH_SIM_SECONDS", "5"))
     load = int(os.environ.get("BENCH_LOAD", "8"))
-    graph = ref_topology_text() if topo == "ref" else None
+    graph = (ref_topology_text() if topo == "ref"
+             else MIX_VERTICES if topo == "mix" else None)
 
     # BENCH_REPLICAS=R: run R independent replicas of the H-host sim
     # in one device program (ensemble mode) — small configs alone
@@ -412,20 +566,60 @@ def main(argv=None) -> None:
     active = int(active) if active else None
     sparse = os.environ.get("BENCH_SPARSE_LANES")
     sparse = int(sparse) if sparse is not None else None
+    supervise = os.environ.get("BENCH_SUPERVISE") == "1"
+    chunk = os.environ.get("BENCH_CHUNK_WINDOWS")
+    chunk = int(chunk) if chunk else None
+    adaptive = os.environ.get("BENCH_ADAPTIVE_JUMP") == "1"
+    mjms = os.environ.get("BENCH_MIN_JUMP_MS")
+    min_jump_ns = None
+    if mjms:
+        from shadow_tpu.core import simtime as _st
+
+        min_jump_ns = int(float(mjms) * _st.ONE_MILLISECOND)
+    ck_w = os.environ.get("BENCH_CHECKPOINT_WINDOWS")
+    ck_w = int(ck_w) if ck_w else None
+    if (chunk or adaptive or ck_w) and not supervise:
+        raise SystemExit(
+            "BENCH_CHUNK_WINDOWS / BENCH_ADAPTIVE_JUMP / "
+            "BENCH_CHECKPOINT_WINDOWS shape the supervised window "
+            "loop; set BENCH_SUPERVISE=1 (the unsupervised engine.run "
+            "megakernel has no dispatch boundaries to amortize). "
+            "BENCH_MIN_JUMP_MS is a scenario knob and applies to both "
+            "paths.")
+    if supervise and workload != "phold":
+        raise SystemExit("BENCH_SUPERVISE=1 is only wired for "
+                         "BENCH_WORKLOAD=phold")
     if workload == "phold":
         if active is not None and replicas > 1:
             raise SystemExit("BENCH_ACTIVE and BENCH_REPLICAS are "
                              "mutually exclusive PHOLD shapes")
-        runner = _phold_runner(H * replicas, load, sim_s, shards=_SHARDS,
-                               graph=graph,
-                               replica_size=H if replicas > 1 else None,
-                               fault_records=fault_records,
-                               active_hosts=active, sparse_lanes=sparse)
+        if supervise:
+            if replicas > 1 or active is not None:
+                raise SystemExit("BENCH_SUPERVISE=1 does not combine "
+                                 "with BENCH_REPLICAS/BENCH_ACTIVE")
+            runner = _phold_supervised_runner(
+                H, load, sim_s, shards=_SHARDS, graph=graph,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w)
+        else:
+            runner = _phold_runner(
+                H * replicas, load, sim_s, shards=_SHARDS, graph=graph,
+                replica_size=H if replicas > 1 else None,
+                fault_records=fault_records,
+                active_hosts=active, sparse_lanes=sparse,
+                min_jump_ns=min_jump_ns)
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
         if replicas > 1:
             name += f"_x{replicas}replicas"
         if active is not None:
             name += f"_active{active}"
+        if supervise:
+            name += f"_supervised_chunk{chunk or 1}"
+            if adaptive:
+                name += "_adaptive"
+        if mjms:
+            name += f"_mj{mjms}ms"
     else:
         if fault_records:
             raise SystemExit(
@@ -439,6 +633,8 @@ def main(argv=None) -> None:
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
     if topo == "ref":
         name += "_reftopo"
+    elif topo == "mix":
+        name += "_mixtopo"
     if fault_records:
         name += "_faults"
     if _SHARDS > 1:
@@ -501,14 +697,36 @@ def main(argv=None) -> None:
     if _SHARDS > 1:
         out["shards"] = _SHARDS
         out["total_events_per_sec"] = round(total_rate, 1)
+    # chunked-dispatch accounting (supervised loop only): the JSON row
+    # and the embedded manifest both carry the dispatch shape so the
+    # sweep's banked lines are self-describing (tools/telemetry_lint)
+    disp = None
+    if supervise and getattr(runner, "last_result", None) is not None:
+        r = runner.last_result
+        wpd = chunk or 1
+        disp = {"windows_per_dispatch": wpd,
+                "dispatches": r.dispatches}
+        if (wpd > 1 and r.dispatch_windows and r.attempts == 1
+                and r.resume_of is None):
+            disp["windows"] = list(r.dispatch_windows)
+        if adaptive and getattr(runner, "harvester", None) is not None:
+            m = runner.harvester.mean_window_ns()
+            if m is not None:
+                disp["adaptive_jump_mean_ns"] = round(m, 1)
+        out["windows_per_dispatch"] = wpd
+        out["dispatches"] = r.dispatches
+        if "adaptive_jump_mean_ns" in disp:
+            out["adaptive_jump_mean_ns"] = disp["adaptive_jump_mean_ns"]
     if getattr(runner, "last_sim", None) is not None and (
             getattr(runner.last_sim, "telem", None) is not None):
         # per-window stats from the device telemetry ring of the TIMED
         # run, plus the run manifest (telemetry/export.py)
         from shadow_tpu import telemetry
 
-        h = telemetry.Harvester()
-        h.drain(runner.last_sim)
+        h = getattr(runner, "harvester", None)
+        if h is None:
+            h = telemetry.Harvester()
+            h.drain(runner.last_sim)
         tel = h.summary()
         if "events_per_window" in tel:
             out["events_per_window"] = {
@@ -527,7 +745,9 @@ def main(argv=None) -> None:
             cfg=b.cfg, seed=b.cfg.seed, shards=max(_SHARDS, 1),
             sim=runner.last_sim, stats=runner.last_stats,
             harvester=h, wall_seconds=wall,
-            compile_s=compile_s, compile_fresh=compile_fresh)
+            compile_s=compile_s, compile_fresh=compile_fresh,
+            fault_plan=getattr(b, "fault_plan", None),
+            dispatch=disp)
     print(json.dumps(out))
 
 

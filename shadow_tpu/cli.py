@@ -149,6 +149,21 @@ def make_parser() -> argparse.ArgumentParser:
                         "periodic checkpoints, and checkpoint-backed "
                         "retry on a latch trip (exit 3 + structured "
                         "failure report when retries are exhausted)")
+    p.add_argument("--chunk-windows", type=int, default=None,
+                   metavar="K",
+                   help="windows per device dispatch for the "
+                        "supervised/host-driven loop: K window rounds "
+                        "run on device between host barriers, "
+                        "amortizing dispatch overhead when windows are "
+                        "small (health checks, harvest and checkpoint "
+                        "cadence then run per chunk; default 1)")
+    p.add_argument("--adaptive-jump", action="store_true", default=None,
+                   help="derive each window's span from the LIVE "
+                        "latency/reliability tables instead of the "
+                        "static precomputed minimum — fault plans that "
+                        "raise latencies let windows grow (fewer "
+                        "windows, same final state; supervised/"
+                        "host-driven loop only)")
     p.add_argument("--checkpoint-every-windows", type=int, default=64,
                    help="supervisor snapshot cadence in windows")
     p.add_argument("--checkpoint-path", default=None,
@@ -218,6 +233,8 @@ def overrides_from_args(args) -> dict:
         "outbox_capacity": args.outbox_capacity,
         "router_ring": args.router_ring,
         "track_paths": args.track_paths,
+        "windows_per_dispatch": args.chunk_windows,
+        "adaptive_jump": args.adaptive_jump,
     }
     return {k: v for k, v in overrides.items() if v is not None}
 
@@ -578,6 +595,22 @@ def main(argv=None) -> int:
                 from shadow_tpu import telemetry
 
                 harvester.drain(sim_)
+                wpd = max(1, int(getattr(b.cfg, "windows_per_dispatch",
+                                         1) or 1))
+                disp = {"windows_per_dispatch": wpd,
+                        "dispatches": result.dispatches}
+                # the per-dispatch window list only equals the chain's
+                # window total for a clean single-attempt run (retries
+                # replay dispatches; resumes offset the counters) —
+                # omit it otherwise so the lint invariant stays exact
+                if (wpd > 1 and result.dispatch_windows
+                        and result.attempts == 1
+                        and result.resume_of is None):
+                    disp["windows"] = list(result.dispatch_windows)
+                if getattr(b.cfg, "adaptive_jump", False):
+                    m = harvester.mean_window_ns()
+                    if m is not None:
+                        disp["adaptive_jump_mean_ns"] = m
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards,
                     sim=sim_, stats=stats_, health=health_,
@@ -585,7 +618,8 @@ def main(argv=None) -> int:
                     harvester=harvester, timers=timers,
                     run_id=result.run_id, resume_of=result.resume_of,
                     escalations=result.escalations,
-                    preempted=result.preempted or None)
+                    preempted=result.preempted or None,
+                    dispatch=disp)
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
@@ -790,6 +824,25 @@ def main(argv=None) -> int:
 
             nshards = mesh.shape["hosts"] if mesh is not None else 1
             with timers.phase("export"):
+                disp = None
+                if sup_result is not None:
+                    wpd = max(1, int(getattr(
+                        b.cfg, "windows_per_dispatch", 1) or 1))
+                    disp = {"windows_per_dispatch": wpd,
+                            "dispatches": sup_result.dispatches}
+                    # only a clean single-attempt run's per-dispatch
+                    # list sums to the chain's window counter — see
+                    # _sup_manifest
+                    if (wpd > 1 and sup_result.dispatch_windows
+                            and sup_result.attempts == 1
+                            and sup_result.resume_of is None):
+                        disp["windows"] = list(
+                            sup_result.dispatch_windows)
+                    if (getattr(b.cfg, "adaptive_jump", False)
+                            and harvester is not None):
+                        m = harvester.mean_window_ns()
+                        if m is not None:
+                            disp["adaptive_jump_mean_ns"] = m
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
                     stats=stats, health=run_health,
@@ -798,7 +851,8 @@ def main(argv=None) -> int:
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,
-                        "escalations": sup_result.escalations}))
+                        "escalations": sup_result.escalations,
+                        "dispatch": disp}))
                 os.makedirs(args.data_directory, exist_ok=True)
                 mpath = telemetry.write_manifest(
                     os.path.join(args.data_directory,

@@ -473,7 +473,8 @@ def load(config: ShadowConfig, *, seed: int = 1,
                     "router_ring", "in_ring", "out_ring", "timers_per_host",
                     "emit_capacity", "nic_drain", "tcp", "tcp_ssthresh",
                     "tcp_windows", "cpu_threshold_ns",
-                    "cpu_precision_ns", "track_paths")},
+                    "cpu_precision_ns", "track_paths",
+                    "windows_per_dispatch", "adaptive_jump")},
     )
     # Validate plugin references BEFORE the expensive device build: a
     # config typo should fail in milliseconds, not after a multi-minute

@@ -320,6 +320,157 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     return sim, stats, next_min
 
 
+def make_wend_fn(*, min_jump: int, end_time: int,
+                 pair_mask=None, fault_times=None, table_fn=None):
+    """Build the window-end rule ``wend = wend_fn(sim, wstart)`` shared
+    by every chunked runner.
+
+    Static (``pair_mask`` is None): the reference's rule — ``wstart +
+    min_jump`` clamped to ``end_time + 1`` (ref: master.c:450-480),
+    with the same positive floor as `run`.
+
+    Adaptive (``pair_mask`` is a [V,V] bool array of host-bearing
+    vertex pairs, see net.build.adaptive_jump_spec): advance by the
+    CURRENT minimum cross-host path latency read from
+    ``sim.net.latency_ns`` — the reference's lazily-recomputed min time
+    jump (topology.c:1374-1385) done live, so fault plans that raise
+    latencies let windows grow. Three guards keep it conservative:
+
+    - floor at the static ``min_jump``: plan validation rejects
+      negative latency deltas (faults/plan.py), so the live tables are
+      always >= boot and the floor only matters for links a fault
+      disabled entirely;
+    - links with ``reliability == 0`` (downed by LINK_DOWN/PARTITION)
+      do not constrain the jump — no packet crosses them — which is
+      only sound together with:
+    - ``fault_times`` (the plan's record times): wend never crosses the
+      next record > wstart, so a LINK_UP/HEAL cannot revive a short
+      link in the middle of a window sized without it, and every
+      record materializes at a window boundary exactly (seed_wakeups
+      pins a pending event at each record time, so wstart reaches it);
+    - ``table_fn`` (faults.apply.make_table_fn, required whenever a
+      plan is installed): the window is sized from the plan-replayed
+      tables at ``wstart + 1`` — records at exactly wstart applied —
+      NOT from the live ``sim.net`` tables. step_window only rewrites
+      the live tables AFTER the span was chosen, so a window starting
+      exactly at a latency-restore record would otherwise be sized by
+      the stale (still-spiked) table: packets flying at the restored
+      short latency then land inside the over-long window, out of
+      conservative order.
+    """
+    if isinstance(min_jump, int) and min_jump <= 0:
+        raise ValueError(f"min_jump must be positive, got {min_jump}")
+    end = jnp.asarray(int(end_time), simtime.DTYPE)
+    jump0 = jnp.maximum(jnp.asarray(min_jump, simtime.DTYPE), 1)
+    ft_c = None
+    if fault_times is not None and len(fault_times):
+        ft_c = jnp.asarray(fault_times, simtime.DTYPE)
+    if pair_mask is None:
+        def wend_fn(sim, wstart):
+            wend = jnp.minimum(wstart + jump0, end + 1)
+            # Static windows take the same clamp as adaptive ones:
+            # without it a window crossing a record would apply the
+            # fault EARLY (step_window rewrites for records < wend),
+            # smearing fault timing by up to min_jump and making the
+            # final state depend on where window boundaries happen to
+            # fall. With it every record lands at a boundary exactly,
+            # in every driver, under every partitioning.
+            if ft_c is not None:
+                nxt = jnp.min(jnp.where(ft_c > wstart, ft_c,
+                                        simtime.INVALID))
+                wend = jnp.minimum(wend, nxt)
+            return wend
+        return wend_fn
+    mask_c = jnp.asarray(pair_mask, bool)
+
+    def wend_fn(sim, wstart):
+        if table_fn is not None:
+            lat, rel = table_fn(wstart + 1)
+        else:
+            lat, rel = sim.net.latency_ns, sim.net.reliability
+        lat = jnp.asarray(lat, simtime.DTYPE)
+        live = mask_c & (rel > 0)
+        jump = jnp.min(jnp.where(live, lat, simtime.INVALID))
+        # Tables are replicated across shards (REPLICATED_FIELDS), so
+        # this min is shard-invariant without a collective. The upper
+        # clip keeps wstart + jump from overflowing i64 when no pair
+        # constrains the window at all (mask empty or every masked
+        # link down): any span is conservative then, and end + 1 ends
+        # the run in one window.
+        jump = jnp.clip(jump, jump0, end + 1)
+        wend = wstart + jump
+        if ft_c is not None:
+            nxt = jnp.min(jnp.where(ft_c > wstart, ft_c, simtime.INVALID))
+            wend = jnp.minimum(wend, nxt)
+        return jnp.minimum(wend, end + 1)
+
+    return wend_fn
+
+
+def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
+                    chunk_windows: int, emit_capacity: int = 4,
+                    lane_fn=None, route_fn=_default_route,
+                    min_fn=_identity, bulk_fn=None, fault_fn=None,
+                    telem_fn=None, sparse_lanes: int = 0,
+                    census_fn=None):
+    """Build ``chunk(sim, stats, wstart) -> (sim, stats, wstart')``:
+    up to `chunk_windows` full window rounds as ONE device program (a
+    lax.fori_loop over step_window), so host-driven loops pay one
+    dispatch per K windows instead of per window.
+
+    The window sequence is identical to `run`'s while_loop: each round
+    computes ``wend = wend_fn(sim, wstart)`` (make_wend_fn) and
+    advances to the min_fn-reduced next pending time. The loop is a
+    lax.while_loop over ``(i < chunk_windows) & (wstart <= end)`` —
+    the same shape as `run`, just bounded — so a round whose wstart
+    already passed end_time (or an empty queue: next_min ==
+    simtime.INVALID > end) exits immediately and a whole chunk
+    dispatched past the end returns its carry unchanged. Callers may
+    therefore keep one speculative chunk in flight and only
+    synchronize on the *previous* chunk's wstart. (A fori_loop with a
+    per-window lax.cond no-op guard is the obvious alternative; it
+    shuttles the entire sim tuple through a conditional every window,
+    which on some backends costs more than the window itself.)
+
+    ``lane_fn(sim)`` supplies step_window's lane_id (None -> identity
+    lanes); it is evaluated once per chunk on the carried sim — lane
+    identity is static for a run. fault_fn/telem_fn/bulk_fn and the
+    sparse fast path all run INSIDE the loop, per window, exactly as
+    in the per-window host loop. The trip condition reads only
+    replicated values (wstart is min_fn-reduced), so shards stay in
+    lockstep exactly as in `run`."""
+    if int(chunk_windows) < 1:
+        raise ValueError(
+            f"chunk_windows must be >= 1, got {chunk_windows}")
+    end = jnp.asarray(int(end_time), simtime.DTYPE)
+    K = int(chunk_windows)
+
+    def chunk(sim, stats, wstart):
+        wstart = jnp.asarray(wstart, simtime.DTYPE)
+        lane = None if lane_fn is None else lane_fn(sim)
+
+        def cond(carry):
+            i, _sim, _stats, ws = carry
+            return (i < K) & (ws <= end)
+
+        def body(carry):
+            i, sim, stats, ws = carry
+            wend = wend_fn(sim, ws)
+            sim, stats, next_min = step_window(
+                sim, stats, step_fn, wend,
+                emit_capacity=emit_capacity, lane_id=lane,
+                route_fn=route_fn, min_fn=min_fn, bulk_fn=bulk_fn,
+                fault_fn=fault_fn, telem_fn=telem_fn, wstart=ws,
+                sparse_lanes=sparse_lanes, census_fn=census_fn)
+            return i + 1, sim, stats, next_min
+
+        _, sim, stats, wstart = jax.lax.while_loop(
+            cond, body, (jnp.asarray(0, jnp.int32), sim, stats, wstart))
+        return sim, stats, wstart
+
+    return chunk
+
+
 def run(
     sim,
     step_fn: StepFn,
@@ -336,6 +487,7 @@ def run(
     telem_fn=None,
     sparse_lanes: int = 0,
     census_fn=None,
+    fault_times=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -343,6 +495,10 @@ def run(
     minJump, clamped to end (ref: master.c:450-480). min_jump is the
     precomputed minimum cross-host path latency with the same 10ms
     floor the reference applies when unknown (ref: master.c:133-159).
+    `fault_times` (the installed plan's record times) additionally
+    clamps each window at the next record > wstart — the same rule as
+    make_wend_fn — so faults take effect exactly at their timestamps
+    instead of up to min_jump early when a window would cross one.
 
     Under shard_map, route_fn carries the only collectives (all-to-all
     + the pmin in min_fn), both outside the inner fixpoint loop, so the
@@ -355,6 +511,9 @@ def run(
     # A non-positive window length would spin the outer loop forever;
     # clamp like the reference's runahead floor (master.c:133-159).
     min_jump = jnp.maximum(jnp.asarray(min_jump, simtime.DTYPE), 1)
+    ft_c = None
+    if fault_times is not None and len(fault_times):
+        ft_c = jnp.asarray(fault_times, simtime.DTYPE)
     stats = EngineStats.create()
 
     def cond(carry):
@@ -364,6 +523,9 @@ def run(
     def body(carry):
         sim, stats, wstart = carry
         wend = jnp.minimum(wstart + min_jump, end_time + 1)
+        if ft_c is not None:
+            nxt = jnp.min(jnp.where(ft_c > wstart, ft_c, simtime.INVALID))
+            wend = jnp.minimum(wend, nxt)
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
             route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,

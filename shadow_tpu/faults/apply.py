@@ -89,29 +89,26 @@ def _boot_rows(boot_leaf, lane_id):
     return jnp.asarray(boot_leaf)[lane_id]
 
 
-def make_fault_fn(plan: FaultPlan, boot_sim):
-    """Compile `plan` against the *boot* sim (the bundle's pristine
-    state — never a restored checkpoint, whose tables may already be
-    fault-mutated) into `fault_fn(sim, wend) -> sim`, applied by
-    core.engine.step_window before each window. Returns None for an
-    empty plan so the engine body is untouched."""
+def make_table_fn(plan: FaultPlan, boot_sim):
+    """Compile just `plan`'s latency/reliability table replay into
+    ``table_fn(t) -> (lat, rel)``: the [V,V] tables with every record
+    ``t_ns < t`` applied (later records win; ties in plan order). A
+    pure function of the plan and the boot tables — no live sim state.
+    make_fault_fn builds its rewrite on it; the adaptive window rule
+    (engine.make_wend_fn) calls it at ``wstart + 1`` so a window that
+    starts exactly at a record time is sized from the POST-record
+    tables (the live sim tables are only rewritten inside step_window,
+    after the window span was already chosen). Returns None for an
+    empty plan."""
     if plan is None or plan.n == 0:
         return None
 
     base_lat = np.asarray(boot_sim.net.latency_ns)
     base_rel = np.asarray(boot_sim.net.reliability)
-    GH = int(boot_sim.net.host_ip.shape[0])
     V = base_rel.shape[0]
     if plan.num_vertices and plan.num_vertices != V:
         raise ValueError(f"plan compiled for {plan.num_vertices} vertices, "
                          f"topology has {V}")
-
-    k_np = plan.kind
-    rel_kinds = np.isin(k_np, (FaultKind.LINK_DOWN, FaultKind.LINK_UP,
-                               FaultKind.LOSS, FaultKind.PARTITION,
-                               FaultKind.HEAL))
-    lat_kinds = k_np == FaultKind.LATENCY
-    has_crash = bool(np.isin(k_np, HOST_KINDS).any())
 
     t_c = jnp.asarray(plan.t_ns)
     k_c = jnp.asarray(plan.kind)
@@ -123,22 +120,7 @@ def make_fault_fn(plan: FaultPlan, boot_sim):
     ri = jnp.arange(V, dtype=I32)[:, None]
     ci = jnp.arange(V, dtype=I32)[None, :]
 
-    # Boot captures for the crash reset — replicated constants whose
-    # local rows are gathered through lane_id inside the (possibly
-    # shard_map'd) body.
-    if has_crash:
-        boot_net = {
-            f.name: jnp.asarray(getattr(boot_sim.net, f.name))
-            for f in dataclasses.fields(NetState) if not _crash_keep(f.name)
-        }
-        boot_app = jax.tree.map(jnp.asarray, boot_sim.app)
-        boot_tcp = jax.tree.map(jnp.asarray, boot_sim.tcp)
-        crash_idx_base = jnp.where(k_c == FaultKind.CRASH, a_c, GH)
-        restart_idx_base = jnp.where(k_c == FaultKind.RESTART, a_c, GH)
-
-    def _replay_tables(wend):
-        """Sequential replay (later records win; ties in plan order)."""
-
+    def table_fn(wend):
         def body(i, tables):
             lat, rel = tables
             act = t_c[i] < wend
@@ -164,6 +146,48 @@ def make_fault_fn(plan: FaultPlan, boot_sim):
 
         lat, rel = jax.lax.fori_loop(0, plan.n, body, (lat0, rel0))
         return lat, rel
+
+    return table_fn
+
+
+def make_fault_fn(plan: FaultPlan, boot_sim):
+    """Compile `plan` against the *boot* sim (the bundle's pristine
+    state — never a restored checkpoint, whose tables may already be
+    fault-mutated) into `fault_fn(sim, wend) -> sim`, applied by
+    core.engine.step_window before each window. Returns None for an
+    empty plan so the engine body is untouched."""
+    if plan is None or plan.n == 0:
+        return None
+
+    base_rel = np.asarray(boot_sim.net.reliability)
+    GH = int(boot_sim.net.host_ip.shape[0])
+    V = base_rel.shape[0]
+
+    k_np = plan.kind
+    rel_kinds = np.isin(k_np, (FaultKind.LINK_DOWN, FaultKind.LINK_UP,
+                               FaultKind.LOSS, FaultKind.PARTITION,
+                               FaultKind.HEAL))
+    lat_kinds = k_np == FaultKind.LATENCY
+    has_crash = bool(np.isin(k_np, HOST_KINDS).any())
+
+    t_c = jnp.asarray(plan.t_ns)
+    k_c = jnp.asarray(plan.kind)
+
+    _replay_tables = make_table_fn(plan, boot_sim)
+
+    # Boot captures for the crash reset — replicated constants whose
+    # local rows are gathered through lane_id inside the (possibly
+    # shard_map'd) body.
+    if has_crash:
+        a_c = jnp.asarray(plan.a)
+        boot_net = {
+            f.name: jnp.asarray(getattr(boot_sim.net, f.name))
+            for f in dataclasses.fields(NetState) if not _crash_keep(f.name)
+        }
+        boot_app = jax.tree.map(jnp.asarray, boot_sim.app)
+        boot_tcp = jax.tree.map(jnp.asarray, boot_sim.tcp)
+        crash_idx_base = jnp.where(k_c == FaultKind.CRASH, a_c, GH)
+        restart_idx_base = jnp.where(k_c == FaultKind.RESTART, a_c, GH)
 
     def _down_vector(wend):
         """down[h] = more crashes than restarts with t < wend."""

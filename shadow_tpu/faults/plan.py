@@ -128,7 +128,9 @@ def validate_records(records, *, num_hosts=None, num_vertices=None,
         if min_jump_ns and r.t_ns % min_jump_ns:
             warnings.append(
                 f"{where}: not aligned to the {min_jump_ns} ns window; "
-                f"effect quantizes to the enclosing window boundary")
+                f"the engine clamps the enclosing window to END at the "
+                f"record (exact fault timing), at the cost of one "
+                f"shortened window per record")
         if r.kind in LINK_KINDS:
             if r.b < 0:
                 errors.append(f"{where}: {NAME_OF_KIND[r.kind]} needs "

@@ -2,9 +2,11 @@
 recovery + capacity escalation + preemption-safe resume chains.
 
 The CLI's `--supervise` mode runs the simulation through here instead
-of the one-shot jitted runner. Every round the supervisor inspects
-the sticky latches (faults/health.py) plus its own stall /
-time-regression telemetry; every N *windows* it snapshots the sim
+of the one-shot jitted runner. At every dispatch barrier — one window,
+or one K-window chunk when cfg.windows_per_dispatch > 1 (the chunked
+loop in checkpoint.run_windows) — the supervisor inspects the sticky
+latches (faults/health.py) plus its own stall / time-regression
+telemetry; every N *windows* it snapshots the sim
 (utils/checkpoint.py — atomic + checksummed, so a trip mid-save can
 never leave a poisoned resume point). Recovery has three distinct
 paths, accounted separately:
@@ -110,6 +112,13 @@ class SupervisorResult:
     final_checkpoint: Optional[str] = None  # preemption's last snapshot
     run_id: Optional[str] = None
     resume_of: Optional[str] = None    # run_id of the chain predecessor
+    # Dispatch accounting for the FINAL attempt (chunked window loop):
+    # how many device dispatches the loop issued and how many windows
+    # each executed. sum(dispatch_windows) == stats.windows for a
+    # clean single-attempt, non-resumed run — the invariant
+    # tools/telemetry_lint.py checks when a manifest embeds the list.
+    dispatches: int = 0
+    dispatch_windows: tuple = ()
 
     def failure_report(self) -> dict:
         rep = self.health.failure_report() if self.health is not None \
@@ -153,6 +162,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    mesh=None, mesh_axis: str = "hosts",
                    exchange_capacity: int | None = None,
                    config_digest: str | None = None,
+                   windows_per_dispatch: int | None = None,
+                   adaptive_jump: bool | None = None,
                    ) -> SupervisorResult:
     """Run bundle to end_time under supervision (host-driven window
     loop; serial by default, shard_map'd over `mesh` when given — the
@@ -174,10 +185,23 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     fault instead of running forever — a wedge *inside* a device call
     never reaches a barrier, which is what the fleet watchdog's
     out-of-process SIGKILL path is for. `on_round(sim, wstats,
-    wstart, wend, next_min)` runs after the health check each round —
-    the chaos harness samples its conservation ledger there. `log` is
-    a callable taking one message string; `sleep` is injectable for
-    tests."""
+    wstart, wend, next_min)` runs after the health check at each
+    round barrier — the chaos harness samples its conservation ledger
+    there. `log` is a callable taking one message string; `sleep` is
+    injectable for tests.
+
+    `windows_per_dispatch` / `adaptive_jump` (default: the bundle
+    cfg's knobs) select the chunked window loop
+    (checkpoint.run_windows): at K windows per dispatch the
+    supervisor's barrier — health latches, harvest, checkpoint
+    cadence, stop/deadline polls, on_round — runs once per CHUNK on
+    per-chunk aggregate stats plus the ring records. Streak and
+    checkpoint cadences are counted in executed windows either way,
+    so `checkpoint_every_windows` and `stall_windows` keep their
+    meaning, quantized up to a chunk boundary; a chunk whose windows
+    all processed zero events extends the stall streak by the whole
+    chunk, but a mixed chunk resets it — pick stall_windows >= a few
+    chunks."""
 
     def say(msg):
         if log is not None:
@@ -223,17 +247,23 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
 
     while True:
         attempt += 1
-        # Per-attempt telemetry the round closure mutates.
+        # Per-attempt telemetry the chunk closure mutates.
         tele = {"zero_streak": 0, "worst_streak": 0, "regressed": False,
-                "wstart": None, "since_ckpt": 0, "acc": {}}
+                "wstart": None, "since_ckpt": 0, "acc": {},
+                "dispatch_windows": []}
 
-        def _on_round(sim, wstats, wstart, wend, next_min):
+        def _on_chunk(sim, wstats, wstart, wend, next_min):
             tele["wstart"] = wstart
             ws = _stats_get(wstats)
             for k, v in ws.items():
                 tele["acc"][k] = tele["acc"].get(k, 0) + v
+            tele["dispatch_windows"].append(ws["windows"])
+            # Streaks count executed WINDOWS (not dispatches), so the
+            # stall limit keeps its meaning at any chunk size — a
+            # whole-chunk zero extends the streak by the chunk's
+            # window count.
             if ws["events_processed"] == 0:
-                tele["zero_streak"] += 1
+                tele["zero_streak"] += ws["windows"]
                 tele["worst_streak"] = max(tele["worst_streak"],
                                            tele["zero_streak"])
             else:
@@ -252,7 +282,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 raise LatchTrip(h, sim)
             # Health precedes every save: snapshots are always clean,
             # which is what makes escalation transplants exact.
-            tele["since_ckpt"] += 1
+            tele["since_ckpt"] += ws["windows"]
             if (tele["since_ckpt"] >= checkpoint_every_windows
                     and next_min < simtime.INVALID):
                 # Healthy at this barrier: snapshot resumes at next_min.
@@ -313,7 +343,9 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 retries_used=retries_used,
                 escalation_restarts=escalation_restarts,
                 escalations=tuple(escalations),
-                run_id=run_id, resume_of=resume_of, **kw)
+                run_id=run_id, resume_of=resume_of,
+                dispatches=len(tele["dispatch_windows"]),
+                dispatch_windows=tuple(tele["dispatch_windows"]), **kw)
 
         from shadow_tpu.core.engine import EngineStats
 
@@ -324,11 +356,13 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 start_time=resume_time,
                 sim=resume_sim,
                 fault_fn=fault_fn,
-                on_round=_on_round,
+                on_chunk=_on_chunk,
                 stats0=(EngineStats.from_dict(base_stats)
                         if base_stats else None),
                 mesh=mesh, mesh_axis=mesh_axis,
                 exchange_capacity=exchange_capacity,
+                windows_per_dispatch=windows_per_dispatch,
+                adaptive_jump=adaptive_jump,
             )
             if harvester is not None:
                 harvester.drain(sim)

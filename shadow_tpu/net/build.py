@@ -14,12 +14,20 @@ from dataclasses import dataclass, field
 from typing import Any, Sequence
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 
 from shadow_tpu.core import simtime
-from shadow_tpu.core.engine import _default_route
+from shadow_tpu.core.engine import (
+    EngineStats,
+    _default_route,
+    make_chunk_body,
+    make_wend_fn,
+    resolve_sparse_lanes,
+)
 from shadow_tpu.core.engine import run as engine_run
 from shadow_tpu.core.events import EventKind, emit_words, push_rows
+from shadow_tpu.telemetry.ring import make_telem_fn
 from shadow_tpu.net.state import (
     NetConfig,
     NetState,
@@ -206,6 +214,76 @@ def _resolve_fault_fn(bundle: SimBundle, fault_fn):
     return None
 
 
+def adaptive_jump_spec(bundle: SimBundle):
+    """Constants for the adaptive time jump (engine.make_wend_fn):
+    ``(pair_mask, fault_times)``.
+
+    pair_mask is the [V,V] bool set of vertex pairs that constrain the
+    conservative window — ordered pairs of distinct host-bearing
+    vertices, plus the self-path of any vertex carrying >= 2 hosts —
+    exactly topology.min_jump_ns's pair rules, but evaluated on device
+    against the LIVE latency/reliability tables each window instead of
+    once at boot. fault_times is the installed plan's record times
+    (None when no plan): wend clamps to the next record so every fault
+    still materializes at a window boundary."""
+    voh = np.asarray(bundle.sim.net.vertex_of_host)
+    V = int(np.asarray(bundle.sim.net.latency_ns).shape[0])
+    mask = np.zeros((V, V), dtype=bool)
+    if voh.size:
+        verts, counts = np.unique(voh, return_counts=True)
+        mask[np.ix_(verts, verts)] = True
+        mask[np.arange(V), np.arange(V)] = False
+        for v, c in zip(verts, counts):
+            if c >= 2:
+                mask[v, v] = True
+    return mask, plan_times(bundle)
+
+
+def plan_times(bundle: SimBundle):
+    """The installed fault plan's unique record times (None without a
+    plan) — the wend clamp every window rule shares so records land at
+    window boundaries exactly (engine.make_wend_fn / engine.run)."""
+    plan = getattr(bundle, "fault_plan", None)
+    if plan is not None and getattr(plan, "n", 0):
+        return np.unique(np.asarray(plan.t_ns, np.int64))
+    return None
+
+
+def resolve_wend_fn(bundle: SimBundle, end_time: int, adaptive: bool,
+                    fault_fn=None):
+    """One window-end rule for every chunked runner: the reference's
+    static ``wstart + min_jump`` (adaptive=False), or the live-table
+    adaptive jump. `fault_fn` is the rule the runner resolved (post
+    _resolve_fault_fn): adaptive mode needs the fault schedule's
+    record times to stay conservative, so an opaque fault_fn with no
+    installed plan is rejected — it could revive a short link in the
+    middle of a window that was sized without it. Both modes clamp
+    wend at the next record time so faults apply exactly on schedule
+    and the executed event stream is invariant to the window
+    partitioning (static vs adaptive, any windows_per_dispatch)."""
+    if not adaptive:
+        return make_wend_fn(min_jump=bundle.min_jump, end_time=end_time,
+                            fault_times=plan_times(bundle))
+    if fault_fn is not None and getattr(bundle, "fault_plan", None) is None:
+        raise ValueError(
+            "adaptive_jump requires the fault plan's record times "
+            "(faults.install) — cannot bound an opaque fault_fn's "
+            "table rewrites")
+    mask, ft = adaptive_jump_spec(bundle)
+    tf = None
+    if getattr(bundle, "fault_plan", None) is not None:
+        from shadow_tpu.faults.apply import make_table_fn
+
+        # Size windows from the plan replay at wstart + 1, never the
+        # live sim tables: step_window rewrites those only after the
+        # span is chosen, so a window starting exactly at a restore
+        # record would see the stale pre-restore latency (see
+        # make_wend_fn's guard list).
+        tf = make_table_fn(bundle.fault_plan, bundle.sim)
+    return make_wend_fn(min_jump=bundle.min_jump, end_time=end_time,
+                        pair_mask=mask, fault_times=ft, table_fn=tf)
+
+
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
                 app_tcp_bulk=None,
@@ -233,8 +311,6 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     (array placement is unknowable under jit), so tracing it against
     CPU-pinned state would compile the TPU-only kernel. Use "sort"
     for CPU-pinned overrides."""
-    import jax
-
     step = make_step_fn(bundle.cfg, app_handlers)
     end = end_time if end_time is not None else bundle.cfg.end_time
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
@@ -249,11 +325,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             return sim.replace(events=q, outbox=out)
 
     # trace-time no-op unless telemetry.attach()ed to the input sim
-    from shadow_tpu.telemetry.ring import make_telem_fn
-
     telem_fn = make_telem_fn()
-
-    from shadow_tpu.core.engine import resolve_sparse_lanes
 
     def _go(sim):
         return engine_run(
@@ -265,6 +337,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             fault_fn=fault_fn,
             telem_fn=telem_fn,
             sparse_lanes=resolve_sparse_lanes(bundle.cfg),
+            fault_times=plan_times(bundle),
         )
 
     return jax.jit(_go)
@@ -274,7 +347,7 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                         end_time: int | None = None, app_bulk=None,
                         app_tcp_bulk=None, chunk_windows: int = 256,
                         tcp_bulk_lossless: bool = False,
-                        fault_fn=None):
+                        fault_fn=None, adaptive_jump: bool = False):
     """make_runner variant that executes `chunk_windows` windows per
     device call with a host-side outer loop — window-for-window the
     SAME sequence engine.run's single while_loop produces (advance
@@ -287,59 +360,63 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
     relay runs on the reference topology die with UNAVAILABLE while
     the identical computation split into shorter calls completes).
     Chunking bounds single-call execution time at a few hundred
-    windows and costs one dispatch per chunk."""
-    import jax
-    import jax.numpy as jnp
+    windows and costs one dispatch per chunk.
 
+    The host loop is pipelined: one speculative chunk is always in
+    flight, and the loop only synchronizes on the PREVIOUS chunk's
+    wstart while the next executes (a chunk dispatched past the end is
+    a no-op — make_chunk_body guards every window on wstart <= end).
+    The sim pytree is donated to each dispatch, so steady-state device
+    allocation is one sim regardless of chunk count; the caller's
+    input sim is copied once at entry and stays intact.
+
+    `adaptive_jump` swaps the static min_jump window for the
+    live-table rule (resolve_wend_fn / engine.make_wend_fn): window
+    boundaries then differ from the static run wherever a fault plan
+    raised latencies, but the final state is reachable-event
+    identical — the conservative window invariant makes results
+    independent of the partition into windows."""
     if chunk_windows < 1:
         raise ValueError(
             f"chunk_windows must be >= 1, got {chunk_windows} "
             "(0 iterations would spin the host loop forever)")
 
-    from shadow_tpu.core import simtime
-    from shadow_tpu.core.engine import EngineStats, step_window
-
     step = make_step_fn(bundle.cfg, app_handlers)
-    end = end_time if end_time is not None else bundle.cfg.end_time
-    end = jnp.asarray(end, simtime.DTYPE)
-    min_jump = max(int(bundle.min_jump), 1)
+    end = int(end_time if end_time is not None else bundle.cfg.end_time)
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
                                tcp_bulk_lossless)
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
-    from shadow_tpu.telemetry.ring import make_telem_fn
-
     telem_fn = make_telem_fn()
+    wend_fn = resolve_wend_fn(bundle, end, adaptive_jump, fault_fn)
 
-    @jax.jit
-    def k_windows(sim, stats, wstart):
-        def body(_i, c):
-            sim, stats, wstart = c
-
-            def run_one(ops):
-                sim, stats, wstart = ops
-                wend = jnp.minimum(wstart + min_jump, end + 1)
-                from shadow_tpu.core.engine import resolve_sparse_lanes
-
-                return step_window(
-                    sim, stats, step, wend,
-                    emit_capacity=bundle.cfg.emit_capacity,
-                    lane_id=sim.net.lane_id, bulk_fn=bulk_fn,
-                    fault_fn=fault_fn, telem_fn=telem_fn,
-                    wstart=wstart,
-                    sparse_lanes=resolve_sparse_lanes(bundle.cfg))
-
-            return jax.lax.cond(wstart <= end, run_one,
-                                lambda ops: ops, (sim, stats, wstart))
-
-        return jax.lax.fori_loop(0, chunk_windows, body,
-                                 (sim, stats, wstart))
+    chunk = make_chunk_body(
+        step, end_time=end, wend_fn=wend_fn,
+        chunk_windows=int(chunk_windows),
+        emit_capacity=bundle.cfg.emit_capacity,
+        lane_fn=lambda s: s.net.lane_id,
+        bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
+        sparse_lanes=resolve_sparse_lanes(bundle.cfg))
+    k_windows = jax.jit(chunk, donate_argnums=(0,))
 
     def go(sim):
+        # Donation consumes the sim argument buffers; copy once so the
+        # caller's (usually bundle.sim) survives repeated go() calls.
+        sim = jax.tree_util.tree_map(jnp.copy, sim)
         stats = EngineStats.create()
         wstart = jnp.min(sim.events.min_time())
-        while int(jax.device_get(wstart)) <= int(end):
-            sim, stats, wstart = k_windows(sim, stats, wstart)
-        return sim, stats
+        sim, stats, wstart = k_windows(sim, stats, wstart)
+        while True:
+            # Keep one chunk in flight: dispatch i+1 on chunk i's
+            # as-yet-unresolved outputs, then block on chunk i's
+            # wstart alone — the old loop's device_get(wstart) barrier
+            # between every chunk left the device idle for a full host
+            # round-trip per chunk.
+            nsim, nstats, nwstart = k_windows(sim, stats, wstart)
+            if int(wstart) > end:
+                # Chunk i already ran past the end, so the speculative
+                # chunk was a pure no-op: its outputs ARE chunk i's.
+                return nsim, nstats
+            sim, stats, wstart = nsim, nstats, nwstart
 
     return go
 

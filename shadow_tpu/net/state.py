@@ -151,6 +151,20 @@ class NetConfig:
                                  # (ref: master.c:261-268)
     end_time: int = simtime.ONE_SECOND
     min_jump: int = 10 * simtime.ONE_MILLISECOND
+    # Windows per device dispatch for the host-driven loops
+    # (checkpoint.run_windows, and --supervise through it): K window
+    # rounds run inside one jitted fori_loop between host barriers
+    # (engine.make_chunk_body), amortizing dispatch overhead when
+    # windows are small. 1 = one dispatch per window (legacy loop).
+    # engine.run — the whole-run megakernel — is unaffected.
+    windows_per_dispatch: int = 1
+    # Adaptive time jump for the chunked loops: derive each window's
+    # span from the CURRENT latency/reliability tables (after fault
+    # rewrites) instead of the static boot-time minimum — fault plans
+    # that raise latencies let windows grow (engine.make_wend_fn).
+    # Off by default: window boundaries shift, so runs are only
+    # window-for-window comparable with it off.
+    adaptive_jump: bool = False
     seed: int = 1
     # Packets drained per micro-step by the NIC send pass (the device
     # form of the reference's drain-while-sendable loop,

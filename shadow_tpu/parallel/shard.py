@@ -323,7 +323,8 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                     end_time: int, min_jump: int, emit_capacity: int,
                     lane_id_fn=None, exchange_capacity: int | None = None,
                     narrow: int | None = None,
-                    bulk_fn=None, fault_fn=None, sparse_lanes: int = 0):
+                    bulk_fn=None, fault_fn=None, sparse_lanes: int = 0,
+                    fault_times=None):
     """Shared factory: a jitted sim -> (sim, stats) running the full
     engine loop under shard_map (used by sharded_engine_run and
     make_sharded_runner — keep their semantics identical)."""
@@ -355,6 +356,9 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             # the active-lane census is a GLOBAL count so every shard
             # takes the same compact/full branch
             census_fn=lambda x: lax.psum(x, axis),
+            # the record-time wend clamp is computed from replicated
+            # constants + the lockstep wstart, so it is shard-invariant
+            fault_times=fault_times,
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 
@@ -392,6 +396,7 @@ def sharded_engine_run(
     bulk_fn=None,
     fault_fn=None,
     sparse_lanes: int = 0,
+    fault_times=None,
 ):
     """shard_map the full engine.run over `mesh[axis]`. `sim` is the
     *global* state (as built for single-shard); sharding/replication
@@ -404,12 +409,13 @@ def sharded_engine_run(
         emit_capacity=emit_capacity, lane_id_fn=lane_id_fn,
         exchange_capacity=exchange_capacity, narrow=narrow,
         bulk_fn=bulk_fn, fault_fn=fault_fn,
-        sparse_lanes=sparse_lanes)(sim)
+        sparse_lanes=sparse_lanes, fault_times=fault_times)(sim)
 
 
 def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
                         exchange_capacity: int | None = None,
-                        narrow: int | None = None, fault_fn=None):
+                        narrow: int | None = None, bulk_fn=None,
+                        fault_fn=None, donate: bool = False):
     """A jitted (sim, wstart, wend) -> (sim, stats, next_min) running
     ONE window round under shard_map — the building block for
     host-driven window loops (ProcessRuntime, checkpoint.run_windows)
@@ -417,7 +423,12 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
     passed unsharded on first call (jit reshards per sim_specs). The
     telemetry hook is threaded with the mesh axis so ring aggregates
     are globally reduced — a trace-time no-op when sim.telem is None,
-    exactly like the whole-run harness."""
+    exactly like the whole-run harness.
+
+    `donate=True` donates the sim argument's buffers to the call
+    (steady-state device allocation stays one sim across a long window
+    loop). Opt-in: callers that re-read the input sim after dispatch —
+    or pass the same sim twice (retry paths) — must leave it off."""
     from shadow_tpu.core.engine import step_window
 
     num_shards, specs, stats_specs = _harness_specs(mesh, axis,
@@ -432,7 +443,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             route_fn=_sharded_route_fn(axis, num_shards, lane,
                                        exchange_capacity, narrow),
             min_fn=lambda x: lax.pmin(x, axis),
-            fault_fn=fault_fn,
+            bulk_fn=bulk_fn, fault_fn=fault_fn,
             telem_fn=make_telem_fn(axis), wstart=wstart,
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
@@ -444,7 +455,59 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
         _body, mesh=mesh, in_specs=(specs, P(), P()),
         out_specs=(specs, stats_specs, P()), check_vma=False,
     )
-    return jax.jit(shmapped)
+    return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
+
+
+def make_sharded_chunk(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
+                       *, end_time: int, wend_fn, chunk_windows: int,
+                       exchange_capacity: int | None = None,
+                       narrow: int | None = None, bulk_fn=None,
+                       fault_fn=None, donate: bool = False):
+    """make_sharded_window's chunked sibling: a jitted
+    (sim, stats, wstart) -> (sim, stats, next_min) running up to
+    `chunk_windows` full window rounds per dispatch under ONE
+    shard_map (engine.make_chunk_body) — the per-window all-to-all,
+    pmin barrier, fault rewrites, telemetry stores and sparse-census
+    psum all stay on device between host barriers, so the host pays
+    one dispatch per K windows.
+
+    Stats accumulate in the carry: pass EngineStats.create() to get
+    per-chunk deltas (what the supervisor's on_chunk consumes). Scalar
+    replication (_replicate_scalars) runs once per chunk against the
+    chunk's ENTRY state — correct because it psums deltas, and deltas
+    over K windows compose. The window-end rule `wend_fn` comes from
+    net.build.resolve_wend_fn (static min_jump or the adaptive live
+    -table jump); rounds whose wstart passed end_time are no-ops, so a
+    caller may keep one speculative chunk in flight past the end."""
+    from shadow_tpu.core.engine import make_chunk_body
+
+    num_shards, specs, stats_specs = _harness_specs(mesh, axis,
+                                                    sim_template)
+
+    def _body(local_sim, stats, wstart):
+        lane = local_sim.net.lane_id
+        chunk = make_chunk_body(
+            step_fn, end_time=end_time, wend_fn=wend_fn,
+            chunk_windows=chunk_windows,
+            emit_capacity=cfg.emit_capacity,
+            lane_fn=lambda s: s.net.lane_id,
+            route_fn=_sharded_route_fn(axis, num_shards, lane,
+                                       exchange_capacity, narrow),
+            min_fn=lambda x: lax.pmin(x, axis),
+            bulk_fn=bulk_fn, fault_fn=fault_fn,
+            telem_fn=make_telem_fn(axis),
+            sparse_lanes=resolve_sparse_lanes(cfg),
+            census_fn=lambda x: lax.psum(x, axis),
+        )
+        out_sim, stats, next_min = chunk(local_sim, stats, wstart)
+        out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
+        return out_sim, stats, next_min
+
+    shmapped = _shard_map(
+        _body, mesh=mesh, in_specs=(specs, stats_specs, P()),
+        out_specs=(specs, stats_specs, P()), check_vma=False,
+    )
+    return jax.jit(shmapped, donate_argnums=(0,) if donate else ())
 
 
 def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
@@ -475,7 +538,7 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
 
         bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
                                    lossless=tcp_bulk_lossless)
-    from shadow_tpu.net.build import _resolve_fault_fn
+    from shadow_tpu.net.build import _resolve_fault_fn, plan_times
 
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
     return _make_whole_run(
@@ -485,7 +548,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
         bulk_fn=bulk_fn, fault_fn=fault_fn,
-        sparse_lanes=resolve_sparse_lanes(bundle.cfg))
+        sparse_lanes=resolve_sparse_lanes(bundle.cfg),
+        fault_times=plan_times(bundle))
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),

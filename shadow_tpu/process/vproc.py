@@ -2096,6 +2096,12 @@ class ProcessRuntime:
         checkpoint.run_windows)."""
         end = end_time if end_time is not None else self.cfg.end_time
         min_jump = max(int(self.bundle.min_jump), 1)
+        # host-side twin of the record-time wend clamp (engine.make_wend_fn
+        # / checkpoint.run_windows): fault records take effect exactly at
+        # their timestamps, never early because a window crossed one.
+        from shadow_tpu.net.build import plan_times
+
+        _pt = plan_times(self.bundle)
 
         total = EngineStats.create()
         now = 0
@@ -2141,6 +2147,10 @@ class ProcessRuntime:
                 now = int(wstart)
                 continue
             wend = min(wstart + min_jump, end + 1)
+            if _pt is not None:
+                i = int(np.searchsorted(_pt, wstart, side="right"))
+                if i < len(_pt):
+                    wend = min(wend, int(_pt[i]))
             self.sim, stats, next_min = self._jit_window(
                 self.sim, wstart, wend)
             # the device window mutated readiness state (flags/gens):

@@ -160,14 +160,19 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  run_id: str | None = None,
                  resume_of: str | None = None,
                  escalations=None,
-                 preempted: bool | None = None) -> dict:
+                 preempted: bool | None = None,
+                 dispatch: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
     served from the persistent compilation cache (False). `run_id` /
     `resume_of` chain preemption-split runs (--resume); `escalations`
     lists the supervisor's healed capacity trips (Escalation records
-    or their dicts)."""
+    or their dicts). `dispatch` records the chunked window loop's
+    shape: {"windows_per_dispatch": K, "dispatches": N, "windows":
+    [per-dispatch executed-window counts], "adaptive_jump_mean_ns":
+    mean harvested window span} — the "windows" list, when present,
+    must sum to counters.windows (tools/telemetry_lint.py)."""
     man = {
         "config_hash": config_hash(cfg),
         "seed": int(seed),
@@ -208,6 +213,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
             for e in escalations]
     if preempted is not None:
         man["preempted"] = bool(preempted)
+    if dispatch is not None:
+        man["dispatch"] = dispatch
     return man
 
 
@@ -243,6 +250,12 @@ def metrics_from_manifest(man: dict) -> dict:
             e["knob"]: e["to"] for e in esc if "knob" in e}
     if "preempted" in man:
         out["preempted"] = bool(man["preempted"])
+    if "dispatch" in man:
+        d = man["dispatch"]
+        out["windows_per_dispatch"] = d.get("windows_per_dispatch", 1)
+        out["dispatches"] = d.get("dispatches", 0)
+        if "adaptive_jump_mean_ns" in d:
+            out["adaptive_jump_mean_ns"] = d["adaptive_jump_mean_ns"]
     return out
 
 

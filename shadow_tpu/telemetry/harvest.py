@@ -87,14 +87,26 @@ class Harvester:
         take = min(new, W)
         idx = np.arange(c - take, c)
         slots = idx % W
-        planes = {name: np.asarray(getattr(ring, name))[slots]
-                  for name, _ in PLANES}
-        for k in range(take):
-            self.records.append(WindowRecord(
-                index=int(idx[k]),
-                **{name: int(planes[name][k]) for name, _ in PLANES}))
+        # one bulk ndarray->list conversion per plane, then positional
+        # construction (WindowRecord fields are (index,) + PLANES in
+        # order) — per-record int() conversions would make the drain
+        # the dominant per-window host cost under chunked dispatch
+        cols = [np.asarray(getattr(ring, name))[slots].tolist()
+                for name, _ in PLANES]
+        self.records.extend(
+            WindowRecord(*row) for row in zip(idx.tolist(), *cols))
         self.seen = c
         return take
+
+    def mean_window_ns(self) -> float | None:
+        """Mean harvested window span (wend - wstart) in ns, or None
+        when nothing was harvested. Under adaptive_jump this is the
+        manifest's evidence that windows actually grew past the static
+        min_jump floor."""
+        if not self.records:
+            return None
+        return float(np.mean(
+            [r.wend - r.wstart for r in self.records]))
 
     def summary(self) -> dict:
         """Aggregates for the run manifest / bench line."""
@@ -117,6 +129,7 @@ class Harvester:
                 sum(r.fastpath for r in self.records))
             out["active_lanes_max"] = int(
                 max(r.active_lanes for r in self.records))
+            out["window_span_ns_mean"] = self.mean_window_ns()
         if self.escalation_marks:
             out["escalations"] = len(self.escalation_marks)
         return out

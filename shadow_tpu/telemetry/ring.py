@@ -44,6 +44,14 @@ net/state.py cap_count); the host-side harvester detects count
 advancing more than `capacity` since its last drain and latches the
 lost-record total as a *warning* in faults/health.py — results stay
 exact, only observability degraded.
+
+Chunked dispatch: host-driven loops with windows_per_dispatch > 1
+(utils/checkpoint.run_windows, net/build.make_chunked_runner) drain
+the ring only once per K-window chunk, so size the capacity >=
+windows_per_dispatch or the middle of each chunk is overwritten before
+the host ever sees it. The overrun latch above is the safety net — the
+loss is reported, never silent — but a ring that fits a whole chunk is
+the intended configuration.
 """
 
 from __future__ import annotations

@@ -30,6 +30,7 @@ import json
 import os
 import tempfile
 import zlib
+from functools import partial
 
 import jax
 import numpy as np
@@ -257,67 +258,162 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 start_time: int = 0, sim=None,
                 checkpoint_every_ns: int | None = None,
                 checkpoint_path: str | None = None,
-                on_window=None, on_round=None, fault_fn=None,
-                stats0=None, mesh=None, mesh_axis: str = "hosts",
-                exchange_capacity: int | None = None):
+                on_window=None, on_round=None, on_chunk=None,
+                fault_fn=None, stats0=None, mesh=None,
+                mesh_axis: str = "hosts",
+                exchange_capacity: int | None = None,
+                windows_per_dispatch: int | None = None,
+                adaptive_jump: bool | None = None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
-    master.c:450-480; one jitted step_window per round so the host
-    regains control at every barrier). Returns (sim, stats,
-    checkpoints) where checkpoints lists the saved (path, time_ns).
-    `on_window(sim, wend)` runs after every round — pcap drains,
-    heartbeats, progress hooks. `on_round(sim, wstats, wstart, wend,
-    next_min)` additionally sees the per-round stats and times — the
-    supervisor (faults/supervisor.py) hangs its health latches and
-    window-counted checkpoints off it; it may raise to abort the loop.
-    `fault_fn` (faults.apply) is threaded into step_window.
+    master.c:450-480). Returns (sim, stats, checkpoints) where
+    checkpoints lists the saved (path, time_ns).
+
+    `windows_per_dispatch` (default: cfg.windows_per_dispatch, 1)
+    sets how many window rounds run on device per host barrier. At 1
+    the loop dispatches one jitted step_window per round, exactly as
+    before. At K > 1 it dispatches engine.make_chunk_body fori_loop
+    chunks — fault rewrites, telemetry-ring stores, the sparse fast
+    path and the sharded all-to-all all run INSIDE the chunk — and
+    the host keeps ONE speculative chunk in flight: hooks, ring
+    harvest and checkpoint device_gets for chunk N overlap the device
+    executing chunk N+1 (a chunk dispatched past the end is a device
+    no-op). Chunked dispatch trades hook/checkpoint granularity for
+    dispatch amortization: cadences snap to chunk boundaries.
+
+    `adaptive_jump` (default: cfg.adaptive_jump) derives each
+    window's span from the LIVE latency/reliability tables instead of
+    the boot-time bundle.min_jump (net.build.resolve_wend_fn) —
+    fault plans that raise latencies let windows grow. Final state
+    keeps all conservation/event counters; per-window counters and
+    window counts differ wherever the partition into windows does.
+
+    `on_window(sim, wend)` runs after every dispatch — pcap drains,
+    heartbeats, progress hooks. `on_chunk(sim, wstats, wstart, wend,
+    next_min)` additionally sees the dispatch's aggregate stats
+    delta and times — the supervisor (faults/supervisor.py) hangs
+    its health latches and window-counted checkpoint cadence off it;
+    it may raise to abort the loop. `on_round` is the same hook's
+    historical name (one dispatch == one round at K=1) and is called
+    only when on_chunk is not given. `fault_fn` (faults.apply) is
+    threaded into step_window. The bundle's config-installed bulk
+    pass (bundle.app_bulk) rides every path — bit-identical final
+    state, fewer fixpoint iterations, exactly as in the whole-run
+    factories.
 
     `stats0` seeds the running totals (resume chains and escalation
     restarts carry processed-event counts across program rebuilds).
-    `mesh` switches the per-round window to the shard_map harness
-    (parallel.shard.make_sharded_window) over `mesh_axis` — same
-    advance rule, same host-side loop, so supervision and checkpoints
-    work identically multi-chip; state stays in global layout at the
-    host boundary, so snapshots remain shard-count portable.
+    `mesh` switches the window body to the shard_map harness
+    (parallel.shard.make_sharded_window / make_sharded_chunk) over
+    `mesh_axis` — same advance rule, same host-side loop, so
+    supervision and checkpoints work identically multi-chip; state
+    stays in global layout at the host boundary, so snapshots remain
+    shard-count portable.
+
+    The per-window path donates the sim argument to each dispatch
+    (steady-state device allocation is one sim); the caller's input
+    sim is copied once at entry and never consumed. The chunked path
+    does NOT donate: the host still reads chunk N's sim while chunk
+    N+1 executes — the two live pytrees are the double buffer that
+    buys the overlap.
     """
     import jax.numpy as jnp
 
     from shadow_tpu.core import simtime
-    from shadow_tpu.core.engine import EngineStats, step_window
+    from shadow_tpu.core.engine import (
+        EngineStats,
+        make_chunk_body,
+        resolve_sparse_lanes,
+        step_window,
+    )
     from shadow_tpu.net.step import make_step_fn
+    from shadow_tpu.telemetry.ring import make_telem_fn
 
     cfg = bundle.cfg
     step = make_step_fn(cfg, app_handlers)
-    end = end_time if end_time is not None else cfg.end_time
+    end = int(end_time if end_time is not None else cfg.end_time)
     min_jump = max(int(bundle.min_jump), 1)
+    # host-side twin of the record-time wend clamp (make_wend_fn /
+    # engine.run): faults apply exactly at their timestamps, never
+    # early because a window happened to cross one. Sorted by
+    # np.unique, so searchsorted finds the next record past wstart.
+    from shadow_tpu.net.build import plan_times
+
+    _pt = plan_times(bundle)
+
+    def _clamp_record(wstart, wend):
+        if _pt is None:
+            return wend
+        i = int(np.searchsorted(_pt, wstart, side="right"))
+        return min(wend, int(_pt[i])) if i < len(_pt) else wend
     sim = sim if sim is not None else bundle.sim
+    caller_fault_fn = fault_fn
     if fault_fn is None:
         from shadow_tpu.net.build import _resolve_fault_fn
 
         fault_fn = _resolve_fault_fn(bundle, None)
+    # honor the bundle's config-installed bulk pass (bundle.app_bulk,
+    # net/bulk.py) exactly like the whole-run factories: bulk consumes
+    # eligible hosts' windows in one vectorized pass, bit-identical
+    # final state, far fewer fixpoint iterations — without it the
+    # host-driven loop could never close the throughput gap to
+    # engine.run no matter how many windows a dispatch amortizes
+    from shadow_tpu.net.build import _resolve_bulk_fn
 
-    shards = 1
-    if mesh is not None:
+    bulk_fn = _resolve_bulk_fn(bundle, getattr(bundle, "app_bulk", None),
+                               None)
+    wpd = (int(windows_per_dispatch) if windows_per_dispatch is not None
+           else max(1, int(getattr(cfg, "windows_per_dispatch", 1) or 1)))
+    if wpd < 1:
+        raise ValueError(f"windows_per_dispatch must be >= 1, got {wpd}")
+    adaptive = (bool(adaptive_jump) if adaptive_jump is not None
+                else bool(getattr(cfg, "adaptive_jump", False)))
+    chunked = wpd > 1 or adaptive
+    hook = on_chunk if on_chunk is not None else on_round
+
+    shards = 1 if mesh is None else mesh.shape[mesh_axis]
+    if chunked:
+        from shadow_tpu.net.build import resolve_wend_fn
+
+        # the adaptive rule needs the PLAN's record times; an opaque
+        # caller fault_fn is only acceptable when the bundle carries
+        # the plan it was derived from (resolve_wend_fn enforces)
+        wend_fn = resolve_wend_fn(bundle, end, adaptive, caller_fault_fn)
+        if mesh is not None:
+            from shadow_tpu.parallel.shard import make_sharded_chunk
+
+            chunk_fn = make_sharded_chunk(
+                mesh, mesh_axis, bundle.sim, cfg, step,
+                end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
+                exchange_capacity=exchange_capacity,
+                bulk_fn=bulk_fn, fault_fn=fault_fn)
+        else:
+            telem_fn = make_telem_fn()  # trace-time no-op, telem None
+            body = make_chunk_body(
+                step, end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
+                emit_capacity=cfg.emit_capacity,
+                lane_fn=lambda s: s.net.lane_id,
+                bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
+                sparse_lanes=resolve_sparse_lanes(cfg))
+            chunk_fn = jax.jit(body)
+    elif mesh is not None:
         from shadow_tpu.parallel.shard import make_sharded_window
 
-        shards = mesh.shape[mesh_axis]
         one_window = make_sharded_window(
             mesh, mesh_axis, bundle.sim, cfg, step,
-            exchange_capacity=exchange_capacity, fault_fn=fault_fn)
+            exchange_capacity=exchange_capacity,
+            bulk_fn=bulk_fn, fault_fn=fault_fn,
+            donate=True)
     else:
-        from shadow_tpu.telemetry.ring import make_telem_fn
-
         telem_fn = make_telem_fn()  # trace-time no-op, telem is None
 
-        from shadow_tpu.core.engine import resolve_sparse_lanes
-
-        @jax.jit
+        @partial(jax.jit, donate_argnums=(0,))
         def one_window(sim, wstart, wend):
             stats = EngineStats.create()
             return step_window(sim, stats, step, wend,
                                emit_capacity=cfg.emit_capacity,
                                lane_id=sim.net.lane_id,
-                               fault_fn=fault_fn,
+                               bulk_fn=bulk_fn, fault_fn=fault_fn,
                                telem_fn=telem_fn, wstart=wstart,
                                sparse_lanes=resolve_sparse_lanes(cfg))
 
@@ -326,6 +422,52 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     next_ckpt = (start_time + checkpoint_every_ns
                  if checkpoint_every_ns else None)
     wstart = max(int(jnp.min(sim.events.min_time())), start_time)
+
+    if chunked:
+        if wstart > end:
+            return sim, total, saved
+        cur = chunk_fn(sim, EngineStats.create(),
+                       jnp.asarray(wstart, simtime.DTYPE))
+        cur_start = wstart
+        while True:
+            csim, cstats, cnext = cur
+            # Speculative one-ahead dispatch on chunk N's as-yet-
+            # unresolved outputs: the int(cnext) below blocks on chunk
+            # N while chunk N+1 is already executing, so every host-
+            # side read (stats, harvest, checkpoint device_get,
+            # manifest writes in hooks) overlaps device compute. Past
+            # the end the chunk no-ops, so the last speculation is
+            # harmless and discarded.
+            nxt = chunk_fn(csim, EngineStats.create(), cnext)
+            nm = int(cnext)
+            total = total.replace(
+                events_processed=(total.events_processed
+                                  + cstats.events_processed),
+                micro_steps=total.micro_steps + cstats.micro_steps,
+                windows=total.windows + cstats.windows,
+                fastpath_hit=total.fastpath_hit + cstats.fastpath_hit,
+                fastpath_miss=total.fastpath_miss + cstats.fastpath_miss,
+            )
+            wend_c = min(nm, end + 1)
+            if (next_ckpt is not None and checkpoint_path is not None
+                    and nm >= next_ckpt and nm <= end):
+                p = save(f"{checkpoint_path}.{nm}.npz", csim,
+                         time_ns=nm, shards=shards)
+                saved.append((p, nm))
+                while next_ckpt <= nm:
+                    next_ckpt += checkpoint_every_ns
+            if on_window is not None:
+                on_window(csim, wend_c)
+            if hook is not None:
+                hook(csim, cstats, cur_start, wend_c, nm)
+            if nm >= simtime.INVALID or nm > end:
+                return csim, total, saved
+            cur, cur_start = nxt, nm
+
+    # Per-window path: one dispatch per round. one_window donates its
+    # sim argument, so the caller's pytree must not be consumed — copy
+    # once at entry (supervisor retries re-enter with bundle.sim).
+    sim = jax.tree_util.tree_map(jnp.copy, sim)
     while wstart <= end:
         if (next_ckpt is not None and wstart >= next_ckpt
                 and checkpoint_path is not None):
@@ -333,7 +475,7 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                      time_ns=wstart, shards=shards)
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
-        wend = min(wstart + min_jump, end + 1)
+        wend = _clamp_record(wstart, min(wstart + min_jump, end + 1))
         sim, stats, next_min = one_window(sim, wstart, wend)
         total = total.replace(
             events_processed=total.events_processed + stats.events_processed,
@@ -345,8 +487,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
         nm = int(next_min)
         if on_window is not None:
             on_window(sim, wend)
-        if on_round is not None:
-            on_round(sim, stats, wstart, wend, nm)
+        if hook is not None:
+            hook(sim, stats, wstart, wend, nm)
         if nm >= simtime.INVALID:
             break
         wstart = nm

@@ -1,0 +1,396 @@
+"""Chunked supervised dispatch + adaptive time jump (ISSUE PR 7).
+
+The contract under test: window PARTITIONING is a performance knob,
+never a semantics knob. Whatever slices the timeline — one window per
+host barrier, K windows fused into one device chunk, or adaptive
+spans sized from the live latency tables — the executed event stream
+is identical, fault records take effect exactly at their timestamps
+(the record-time wend clamp, engine.make_wend_fn / checkpoint
+run_windows / vproc.run), and final state matches bit-for-bit modulo
+storage that is partition-dependent by nature (dead heap slots, slot
+permutation, exchange staging watermarks)."""
+
+import jax
+import numpy as np
+import pytest
+
+from shadow_tpu import faults
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.net.build import HostSpec, build
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.utils import checkpoint
+
+SEC = simtime.ONE_SECOND
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+# two vertices, heterogeneous latencies: min path (1.3 ms) sets the
+# conservative min_jump, so a +5 ms spike on every path lets the
+# adaptive rule grow windows ~5x while the static rule keeps slicing
+# at 1.3 ms — the shape where adaptive sizing actually pays
+GRAPH2 = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <node id="v1"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">1.3</data></edge>
+    <edge source="v1" target="v1"><data key="lat">1.7</data></edge>
+    <edge source="v0" target="v1"><data key="lat">2.3</data></edge>
+  </graph>
+</graphml>"""
+
+# latency-only spike (adds 5 ms on every path at 0.1 s, restores at
+# 0.35 s): raises the conservative bound without dropping anything,
+# so the circulating phold load survives and both rules must process
+# the exact same events
+SPIKE_PLAN = [
+    faults.FaultRecord(t_ns=int(0.1 * SEC), kind=faults.FaultKind.LATENCY,
+                       a=a, b=b, value=5_000_000)
+    for (a, b) in ((0, 0), (1, 1), (0, 1))
+] + [
+    faults.FaultRecord(t_ns=int(0.35 * SEC), kind=faults.FaultKind.LATENCY,
+                       a=a, b=b, value=0)
+    for (a, b) in ((0, 0), (1, 1), (0, 1))
+]
+
+# single-vertex twin of SPIKE_PLAN for the uniform GRAPH fixtures
+SPIKE_PLAN_1V = [
+    faults.FaultRecord(t_ns=int(0.1 * SEC), kind=faults.FaultKind.LATENCY,
+                       a=0, b=0, value=5_000_000),
+    faults.FaultRecord(t_ns=int(0.35 * SEC), kind=faults.FaultKind.LATENCY,
+                       a=0, b=0, value=0),
+]
+
+# exchange-tier staging watermarks are shard/partition-layout-
+# dependent by nature (same carve-out as test_checkpoint.py's
+# cross-shard test and test_faults.py's shard-independence test)
+TELEMETRY = {".outbox.max_occupied", ".outbox.narrow_hit",
+             ".outbox.narrow_miss"}
+
+
+def _build(H=16, load=4, sim_s=2, seed=7):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * SEC, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _build2(H=8, load=2, end=SEC // 2, seed=7):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False, end_time=end, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH2, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _assert_sims_equal(sa, sb, exclude=()):
+    fa = jax.tree_util.tree_flatten_with_path(sa)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sb)[0]
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        key = jax.tree_util.keystr(pa)
+        if key in exclude:
+            continue
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=f"{key} diverged")
+
+
+def _live_rows(sim, container):
+    """Canonical per-host multiset of LIVE slots: different window
+    partitions permute heap-slot assignment and leave different stale
+    payloads in dead (time == INVALID) slots, but the live contents
+    must be the same set of events."""
+    c = getattr(sim, container)
+    t = np.asarray(c.time)
+    out = {}
+    for h in range(t.shape[0]):
+        mask = t[h] < simtime.INVALID
+        cols = []
+        for name in ("time", "kind", "src", "seq"):
+            if hasattr(c, name):
+                cols.append(np.asarray(getattr(c, name))[h][mask])
+        if hasattr(c, "words"):
+            w = np.asarray(c.words)[h][mask]
+            cols.append(w.reshape(w.shape[0], -1).sum(axis=1)
+                        if w.size else np.zeros(mask.sum(), np.int64))
+        out[h] = sorted(zip(*[x.tolist() for x in cols]))
+    return out
+
+
+def _assert_same_modulo_partition(sa, sb):
+    """Full compare for partition-different runs: every non-slot leaf
+    bit-identical (minus the watermark carve-out), slot containers
+    compared as canonical live multisets."""
+    slotted = tuple(f".{c}.{n}" for c in ("events", "outbox")
+                    for n in ("time", "kind", "src", "dst", "seq",
+                              "words", "payload"))
+    fa = jax.tree_util.tree_flatten_with_path(sa)[0]
+    fb = jax.tree_util.tree_flatten_with_path(sb)[0]
+    assert len(fa) == len(fb)
+    for (pa, la), (_, lb) in zip(fa, fb):
+        key = jax.tree_util.keystr(pa)
+        if key in TELEMETRY or key.startswith(slotted):
+            continue
+        np.testing.assert_array_equal(np.asarray(la), np.asarray(lb),
+                                      err_msg=f"{key} diverged")
+    for cont in ("events", "outbox"):
+        assert _live_rows(sa, cont) == _live_rows(sb, cont), (
+            f"live {cont} slots diverged")
+
+
+# ---------------------------------------------------------------- chunked
+
+
+@pytest.mark.faults
+def test_chunked_matches_per_window_with_faults():
+    """K windows fused into one device dispatch — fault rewrites,
+    telemetry and the bulk pass all inside the chunk — lands on the
+    same final state as one dispatch per window. Same serial layout
+    and same window partitioning, so the match is full-tree
+    bit-identical, dead slots included."""
+    b1 = _build(H=8, load=2, sim_s=1)
+    faults.install(b1, SPIKE_PLAN_1V)
+    sim_a, st_a, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+
+    b2 = _build(H=8, load=2, sim_s=1)
+    faults.install(b2, SPIKE_PLAN_1V)
+    sim_b, st_b, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), windows_per_dispatch=8)
+
+    assert int(st_a.events_processed) == int(st_b.events_processed)
+    assert int(st_a.windows) == int(st_b.windows)
+    _assert_sims_equal(sim_a, sim_b)
+    assert int(sim_b.events.overflow) == 0
+
+
+@pytest.mark.faults
+def test_chunked_matches_per_window_sharded():
+    """Same bit-identity under the 8-shard mesh harness: the chunked
+    fori_loop body wraps the shard_map window with the all-to-all
+    exchange inside the chunk. Exchange staging watermarks are
+    layout-dependent and carved out, everything else must match the
+    serial per-window run exactly."""
+    from jax.sharding import Mesh
+
+    b1 = _build(H=8, load=2, sim_s=1)
+    faults.install(b1, SPIKE_PLAN_1V)
+    sim_a, st_a, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+
+    mesh8 = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    b2 = _build(H=8, load=2, sim_s=1)
+    faults.install(b2, SPIKE_PLAN_1V)
+    sim_b, st_b, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), windows_per_dispatch=8,
+        mesh=mesh8)
+
+    assert int(st_a.events_processed) == int(st_b.events_processed)
+    assert int(st_a.windows) == int(st_b.windows)
+    _assert_sims_equal(sim_a, sim_b, exclude=TELEMETRY)
+
+
+def test_chunk_boundary_checkpoint_resume_bit_identical(tmp_path):
+    """Snapshots under chunked dispatch land at chunk boundaries; a
+    resume from one (still chunked) must be bit-identical to the
+    straight chunked run."""
+    straight = _build(H=8, load=2, sim_s=2)
+    sim_a, _, _ = checkpoint.run_windows(
+        straight, app_handlers=(phold.handler,), windows_per_dispatch=8)
+
+    b2 = _build(H=8, load=2, sim_s=2)
+    _, _, saved = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), windows_per_dispatch=8,
+        end_time=SEC, checkpoint_every_ns=SEC // 2,
+        checkpoint_path=str(tmp_path / "ck"))
+    assert saved, "no snapshot at a chunk boundary"
+    path, t_ck = saved[-1]
+
+    b3 = _build(H=8, load=2, sim_s=2)
+    sim_r, t0, _ = checkpoint.load(path, b3.sim)
+    assert t0 == t_ck
+    sim_b, _, _ = checkpoint.run_windows(
+        b3, app_handlers=(phold.handler,), sim=sim_r, start_time=t0,
+        windows_per_dispatch=8)
+    _assert_sims_equal(sim_a, sim_b)
+
+
+def test_dispatch_accounting_sums_to_window_count():
+    """The supervision hook sees one call per DISPATCH with that
+    chunk's aggregate stats; summed chunk window counts must equal the
+    run total (what bench.py's manifest dispatch block and
+    tools/telemetry_lint.py validate)."""
+    per_dispatch = []
+
+    def on_chunk(sim, wstats, wstart, wend, next_min):
+        per_dispatch.append(int(wstats.windows))
+
+    b = _build(H=8, load=2, sim_s=1)
+    _, st, _ = checkpoint.run_windows(
+        b, app_handlers=(phold.handler,), windows_per_dispatch=8,
+        on_chunk=on_chunk)
+    assert sum(per_dispatch) == int(st.windows)
+    # amortization actually happened: strictly fewer host barriers
+    # than windows
+    assert len(per_dispatch) < int(st.windows)
+    assert max(per_dispatch) <= 8
+
+
+def test_per_window_donation_steady_state_objcount():
+    """The K=1 path donates its sim argument: steady-state device
+    allocation is ONE sim, so the process-wide live-buffer count must
+    be flat across windows (the donation-audit assertion), not grow
+    per dispatch."""
+    counts = []
+
+    def on_window(sim, wend):
+        counts.append(len(jax.live_arrays()))
+
+    b = _build(H=8, load=2, sim_s=2)
+    checkpoint.run_windows(b, app_handlers=(phold.handler,),
+                           on_window=on_window)
+    assert len(counts) > 8
+    steady = counts[4:]
+    assert max(steady) - min(steady) <= 2, (
+        f"live-array count grew across windows: {steady[:16]}...")
+
+
+# ------------------------------------------------------------- adaptive
+
+
+def test_adaptive_uniform_graph_is_identical():
+    """With one uniform 50 ms path and no faults the live tables equal
+    the boot tables, so the adaptive rule must reproduce the static
+    partition exactly — same windows, bit-identical state."""
+    b1 = _build(H=8, load=2, sim_s=1)
+    sim_a, st_a, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+    b2 = _build(H=8, load=2, sim_s=1)
+    sim_b, st_b, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), adaptive_jump=True)
+    assert int(st_a.windows) == int(st_b.windows)
+    _assert_sims_equal(sim_a, sim_b, exclude=TELEMETRY)
+
+
+@pytest.mark.faults
+def test_adaptive_spike_fewer_windows_same_events():
+    """The acceptance scenario: a latency spike raises every path by
+    5 ms mid-run. The adaptive rule grows windows while the spike is
+    live and must land on the SAME executed event stream — equal
+    event totals, equal conservation counters, equal live state —
+    with strictly fewer windows."""
+    b1 = _build2()
+    faults.install(b1, SPIKE_PLAN)
+    sim_s, st_s, _ = checkpoint.run_windows(b1, app_handlers=(phold.handler,))
+
+    b2 = _build2()
+    faults.install(b2, SPIKE_PLAN)
+    sim_a, st_a, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), adaptive_jump=True)
+
+    assert int(st_a.windows) < int(st_s.windows), (
+        f"adaptive did not reduce windows: "
+        f"{int(st_a.windows)} vs {int(st_s.windows)}")
+    assert int(st_a.events_processed) == int(st_s.events_processed)
+    _assert_same_modulo_partition(sim_s, sim_a)
+
+
+@pytest.mark.faults
+def test_adaptive_spike_matches_under_chunked_dispatch():
+    """Adaptive sizing composes with chunked dispatch: the fused
+    chunk runs the same adaptive wend rule on device."""
+    b1 = _build2()
+    faults.install(b1, SPIKE_PLAN)
+    sim_a, st_a, _ = checkpoint.run_windows(
+        b1, app_handlers=(phold.handler,), adaptive_jump=True)
+
+    b2 = _build2()
+    faults.install(b2, SPIKE_PLAN)
+    sim_b, st_b, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), adaptive_jump=True,
+        windows_per_dispatch=8)
+    assert int(st_a.windows) == int(st_b.windows)
+    assert int(st_a.events_processed) == int(st_b.events_processed)
+    _assert_sims_equal(sim_a, sim_b)
+
+
+def test_adaptive_static_tcp_relay_identical():
+    """TCP shape: a relay bulk transfer under uniform latency must be
+    partition-invariant too — adaptive reproduces static exactly and
+    every byte lands."""
+    from shadow_tpu.apps import relay
+
+    def mk():
+        cap = 64
+        cfg = NetConfig(num_hosts=4, seed=3, end_time=6 * SEC,
+                        sockets_per_host=4, event_capacity=cap,
+                        outbox_capacity=cap, router_ring=cap)
+        hosts = [HostSpec(name=f"n{i}", proc_start_time=SEC)
+                 for i in range(4)]
+        b = build(cfg, GRAPH, hosts)
+        b.sim = relay.setup(b.sim, circuits=[[0, 1], [2, 3]],
+                            total_bytes=20_000)
+        return b
+
+    b1 = mk()
+    sim_a, st_a, _ = checkpoint.run_windows(b1, app_handlers=(relay.handler,))
+    b2 = mk()
+    sim_b, st_b, _ = checkpoint.run_windows(
+        b2, app_handlers=(relay.handler,), adaptive_jump=True)
+    assert int(st_a.windows) == int(st_b.windows)
+    _assert_sims_equal(sim_a, sim_b, exclude=TELEMETRY)
+    servers = np.asarray(sim_b.app.role) == relay.ROLE_SERVER
+    assert (np.asarray(sim_b.app.rcvd)[servers] == 20_000).all()
+
+
+# ---------------------------------------------------- record-time clamp
+
+
+@pytest.mark.faults
+def test_record_time_wend_clamp():
+    """Fault records end the enclosing window exactly at the record
+    time, in the device wend rule and in the host K=1 loop: a window
+    must never CROSS a record (step_window would apply it a whole
+    window early)."""
+    from shadow_tpu.core.engine import make_wend_fn
+
+    ft = np.array([1_000, 5_000], np.int64)
+    wf = make_wend_fn(min_jump=1_300, end_time=100_000, fault_times=ft)
+    assert int(wf(None, 0)) == 1_000          # clamped to the record
+    assert int(wf(None, 1_000)) == 2_300      # record at wstart: applied
+    assert int(wf(None, 4_000)) == 5_000      # clamped to the next one
+    assert int(wf(None, 5_000)) == 6_300      # past the last record
+
+    # and end-to-end: every record time appears as a window boundary
+    # of the host loop
+    boundaries = []
+
+    def on_chunk(sim, wstats, wstart, wend, next_min):
+        boundaries.append((int(wstart), int(wend)))
+
+    b = _build2(end=SEC // 2)
+    faults.install(b, SPIKE_PLAN)
+    checkpoint.run_windows(b, app_handlers=(phold.handler,),
+                           on_chunk=on_chunk)
+    for t in (int(0.1 * SEC), int(0.35 * SEC)):
+        crossing = [w for w in boundaries if w[0] < t < w[1]]
+        assert not crossing, f"window {crossing} crosses record t={t}"

@@ -17,7 +17,10 @@ Checks:
   (config_hash, seed, shards, counters); the telemetry block's
   records_lost is SURFACED — a nonzero loss count without a matching
   health warning in the manifest is an error (silent observability
-  loss is exactly what the latch design forbids).
+  loss is exactly what the latch design forbids). The optional
+  "dispatch" block (chunked window loop) must be internally coherent:
+  windows_per_dispatch >= 1, every per-dispatch window count fits the
+  chunk, and the counts sum to counters.windows when both are present.
 
 - Fleet manifest JSON (--fleet-manifest): shadow_tpu/fleet schema —
   attempt histories monotone non-decreasing with attempts at the
@@ -279,6 +282,55 @@ def lint_manifest_obj(man) -> tuple[list, list]:
     pre = man.get("preempted")
     if pre is not None and not isinstance(pre, bool):
         errors.append(f"preempted must be a bool, got {pre!r}")
+    # dispatch block (optional): the chunked window loop's shape.
+    # windows_per_dispatch >= 1, dispatches >= 0, and when the
+    # per-dispatch "windows" list is present (clean single-attempt
+    # non-resumed runs only) each entry fits the chunk and the sum
+    # equals the engine's executed-window counter exactly.
+    disp = man.get("dispatch")
+    if disp is not None:
+        if not isinstance(disp, dict):
+            errors.append("dispatch must be an object")
+        else:
+            wpd = disp.get("windows_per_dispatch")
+            if (not isinstance(wpd, int) or isinstance(wpd, bool)
+                    or wpd < 1):
+                errors.append(f"dispatch.windows_per_dispatch must be "
+                              f"an integer >= 1, got {wpd!r}")
+            nd = disp.get("dispatches")
+            if (not isinstance(nd, int) or isinstance(nd, bool)
+                    or nd < 0):
+                errors.append(f"dispatch.dispatches must be a "
+                              f"non-negative integer, got {nd!r}")
+            dw = disp.get("windows")
+            if dw is not None:
+                if not isinstance(dw, list) or not all(
+                        isinstance(w, int) and not isinstance(w, bool)
+                        and w >= 0 for w in dw):
+                    errors.append("dispatch.windows must be a list of "
+                                  "non-negative integers")
+                else:
+                    if isinstance(nd, int) and len(dw) != nd:
+                        errors.append(
+                            f"dispatch.windows has {len(dw)} entries "
+                            f"but dispatches={nd}")
+                    if isinstance(wpd, int) and any(
+                            w > wpd for w in dw):
+                        errors.append(
+                            f"dispatch.windows entry exceeds "
+                            f"windows_per_dispatch={wpd}: {dw}")
+                    if cw is not None and sum(dw) != cw:
+                        errors.append(
+                            f"dispatch.windows sums to {sum(dw)} but "
+                            f"counters.windows={cw} — per-dispatch "
+                            f"accounting must cover every executed "
+                            f"window exactly")
+            aj = disp.get("adaptive_jump_mean_ns")
+            if aj is not None and (
+                    not isinstance(aj, (int, float))
+                    or isinstance(aj, bool) or aj < 0):
+                errors.append(f"dispatch.adaptive_jump_mean_ns must "
+                              f"be a non-negative number, got {aj!r}")
     return errors, warnings
 
 
