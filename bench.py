@@ -64,6 +64,22 @@ rate measures raw engine throughput. Env knobs:
                                   windows (default: effectively never,
                                   so the timed loop measures dispatch,
                                   not npz writes)
+  BENCH_INJECT_TRACE=path         open-system injection scenario:
+                                  replay this trace file
+                                  (inject/trace.py format; see
+                                  tools/trace_gen.py) into a tgen-app
+                                  run through the supervised window
+                                  loop — measures the streamed
+                                  host->device on-ramp end to end
+                                  (staging refills + device merge +
+                                  UDP delivery)
+  BENCH_INJECT_RATE=R             synthesize the trace instead of
+                                  replaying one: R events/s aggregate,
+                                  round-robin source, each a datagram
+                                  to the next host, for the whole run.
+                                  Exclusive with BENCH_INJECT_TRACE;
+                                  both imply the supervised loop and
+                                  accept BENCH_CHUNK_WINDOWS
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
@@ -392,6 +408,144 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
     return go
 
 
+def _rate_trace(H: int, rate: float, sim_s: int) -> list:
+    """Synthesized uniform injection trace: aggregate `rate` events/s,
+    round-robin source host, each a KIND_TGEN datagram to the next
+    host. Pure arithmetic — no RNG — so the trace is a function of
+    (H, rate, sim_s) alone."""
+    from shadow_tpu.apps.tgen import KIND_TGEN
+    from shadow_tpu.core import simtime
+
+    period = max(1, int(simtime.ONE_SECOND / rate))
+    end = sim_s * simtime.ONE_SECOND
+    events = []
+    t, i = period, 0
+    while t < end:
+        src = i % H
+        events.append({"t_ns": t, "host": src, "kind": KIND_TGEN,
+                       "payload": [(src + 1) % H, 9100, 64]})
+        i += 1
+        t += period
+    return events
+
+
+def _inject_runner(H, sim_s, seed=1, shards: int = 0,
+                   graph: str | None = None,
+                   trace_path: str | None = None,
+                   rate: float | None = None,
+                   fault_records=None,
+                   chunk_windows: int | None = None,
+                   adaptive_jump: bool = False,
+                   min_jump_ns: int | None = None,
+                   checkpoint_windows: int | None = None):
+    """Open-system injection scenario: the tgen app (every host binds
+    a UDP socket; injected KIND_TGEN events fire datagrams) driven by
+    a streamed trace through the supervised window loop — the feeder
+    refills the device staging buffer at every dispatch barrier, so
+    the measured rate covers the whole on-ramp, not just the engine.
+    Capacity escalates by doubling on counted overflow like the other
+    runners; injection drops are accounted (never silent) but a bench
+    run that drops trace events is resized rather than reported."""
+    import tempfile
+
+    from shadow_tpu import faults, telemetry
+    from shadow_tpu.apps import tgen
+    from shadow_tpu.core import simtime
+    from shadow_tpu.inject import Feeder, read_trace
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+
+    if trace_path is not None:
+        n_ev = sum(1 for _ in read_trace(trace_path))
+        mem_events = None
+    else:
+        mem_events = _rate_trace(H, rate, sim_s)
+        n_ev = len(mem_events)
+    lanes = tgen.lanes_for(n_ev)
+    state = {"n": 0, "cap": None, "bundle": None, "sims": None,
+             "mesh": None}
+    telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    every = checkpoint_windows or (1 << 30)
+    ckdir = tempfile.mkdtemp(prefix="bench_inj_")
+
+    def build_one(cap, s):
+        cfg = NetConfig(num_hosts=H, tcp=False,
+                        end_time=sim_s * simtime.ONE_SECOND, seed=s,
+                        event_capacity=cap, outbox_capacity=cap,
+                        router_ring=cap, in_ring=16,
+                        inject_lanes=lanes)
+        hosts = [HostSpec(name=f"peer{i}", proc_start_time=0)
+                 for i in range(H)]
+        b = build(cfg, graph or ONE_VERTEX, hosts)
+        b.sim = tgen.setup(b.sim)
+        if fault_records:
+            faults.install(b, fault_records)
+        if min_jump_ns is not None:
+            b.min_jump = min(b.min_jump, int(min_jump_ns))
+        return b
+
+    def build_at(cap):
+        b = build_one(cap, seed)
+        sims = [b.sim] + [build_one(cap, seed + i).sim for i in (1, 2)]
+        if telem_on:
+            from shadow_tpu.telemetry.ring import DEFAULT_CAPACITY
+
+            W = max(DEFAULT_CAPACITY, 2 * (chunk_windows or 1))
+            sims = [telemetry.attach(s, capacity=W) for s in sims]
+        b.sim = sims[0]
+        mesh = (jax.make_mesh((shards,), ("hosts",))
+                if shards > 1 else None)
+        for s in sims:
+            jax.block_until_ready(s.net.rng_keys)
+        state.update(cap=cap, bundle=b, sims=sims, mesh=mesh)
+
+    build_at(64)
+
+    def go():
+        go.escalated = False
+        while True:
+            b = state["bundle"]
+            b.sim = state["sims"][state["n"] % len(state["sims"])]
+            state["n"] += 1
+            # a fresh feeder per run: every timed iteration replays
+            # the trace from position 0 against a t=0 sim
+            feeder = Feeder(trace_path if trace_path is not None
+                            else list(mem_events))
+            h = telemetry.Harvester()
+            result = faults.run_supervised(
+                b, app_handlers=(tgen.handler,),
+                checkpoint_path=os.path.join(ckdir, "ck"),
+                checkpoint_every_windows=every,
+                harvester=h, mesh=state["mesh"],
+                windows_per_dispatch=chunk_windows,
+                adaptive_jump=adaptive_jump or None,
+                feeder=feeder)
+            sim = result.sim
+            overflow = (int(jax.device_get(sim.events.overflow))
+                        + int(jax.device_get(sim.outbox.overflow))
+                        + int(jax.device_get(sim.inject.dropped)))
+            if overflow:
+                build_at(state["cap"] * 2)
+                go.escalated = True
+                continue
+            assert int(jax.device_get(sim.app.rcvd.sum())) > 0
+            go.last_sim = sim
+            go.last_stats = jax.device_get(result.stats)
+            go.last_result = result
+            go.last_feeder = feeder
+            go.harvester = h
+            return int(result.stats.events_processed)
+
+    go.escalated = False
+    go.last_sim = None
+    go.last_stats = None
+    go.last_result = None
+    go.last_feeder = None
+    go.harvester = None
+    go.state = state
+    return go
+
+
 def _pingpong_runner(H, sim_s):
     from __graft_entry__ import _build
     from shadow_tpu.apps import pingpong
@@ -578,7 +732,15 @@ def main(argv=None) -> None:
         min_jump_ns = int(float(mjms) * _st.ONE_MILLISECOND)
     ck_w = os.environ.get("BENCH_CHECKPOINT_WINDOWS")
     ck_w = int(ck_w) if ck_w else None
-    if (chunk or adaptive or ck_w) and not supervise:
+    inj_trace = os.environ.get("BENCH_INJECT_TRACE")
+    inj_rate = os.environ.get("BENCH_INJECT_RATE")
+    inj_rate = float(inj_rate) if inj_rate else None
+    inject_on = bool(inj_trace or inj_rate)
+    if inj_trace and inj_rate:
+        raise SystemExit("BENCH_INJECT_TRACE and BENCH_INJECT_RATE "
+                         "are mutually exclusive (replay xor "
+                         "synthesize)")
+    if (chunk or adaptive or ck_w) and not (supervise or inject_on):
         raise SystemExit(
             "BENCH_CHUNK_WINDOWS / BENCH_ADAPTIVE_JUMP / "
             "BENCH_CHECKPOINT_WINDOWS shape the supervised window "
@@ -589,7 +751,35 @@ def main(argv=None) -> None:
     if supervise and workload != "phold":
         raise SystemExit("BENCH_SUPERVISE=1 is only wired for "
                          "BENCH_WORKLOAD=phold")
-    if workload == "phold":
+    if inject_on:
+        # the injection scenario is its own workload: the tgen app
+        # under the supervised loop (streaming needs the host-driven
+        # barrier), so the loop-shaping knobs apply but the PHOLD
+        # shapes do not
+        if workload != "phold":
+            raise SystemExit("BENCH_INJECT_* defines its own "
+                             "scenario; leave BENCH_WORKLOAD unset")
+        if supervise or replicas > 1 or active is not None \
+                or sparse is not None:
+            raise SystemExit(
+                "BENCH_INJECT_* does not combine with "
+                "BENCH_SUPERVISE / BENCH_REPLICAS / BENCH_ACTIVE / "
+                "BENCH_SPARSE_LANES — it is already a supervised "
+                "tgen scenario")
+        runner = _inject_runner(
+            H, sim_s, shards=_SHARDS, graph=graph,
+            trace_path=inj_trace, rate=inj_rate,
+            fault_records=fault_records, chunk_windows=chunk,
+            adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+            checkpoint_windows=ck_w)
+        name = f"events_per_sec_per_chip@{H}hosts_inject"
+        name += "_trace" if inj_trace else f"_rate{int(inj_rate)}"
+        name += f"_chunk{chunk or 1}"
+        if adaptive:
+            name += "_adaptive"
+        if mjms:
+            name += f"_mj{mjms}ms"
+    elif workload == "phold":
         if active is not None and replicas > 1:
             raise SystemExit("BENCH_ACTIVE and BENCH_REPLICAS are "
                              "mutually exclusive PHOLD shapes")
@@ -701,7 +891,8 @@ def main(argv=None) -> None:
     # and the embedded manifest both carry the dispatch shape so the
     # sweep's banked lines are self-describing (tools/telemetry_lint)
     disp = None
-    if supervise and getattr(runner, "last_result", None) is not None:
+    if (supervise or inject_on) \
+            and getattr(runner, "last_result", None) is not None:
         r = runner.last_result
         wpd = chunk or 1
         disp = {"windows_per_dispatch": wpd,
@@ -741,13 +932,20 @@ def main(argv=None) -> None:
             out["wallclock_per_window_ms"] = round(
                 wall * 1000.0 / windows, 4)
         b = runner.state["bundle"]
+        inj_blk = None
+        if getattr(runner.last_sim, "inject", None) is not None:
+            from shadow_tpu import inject as inject_mod
+
+            inj_blk = inject_mod.manifest_block(
+                runner.last_sim, getattr(runner, "last_feeder", None))
+            out["injected"] = inj_blk["injected"]
         out["manifest"] = telemetry.run_manifest(
             cfg=b.cfg, seed=b.cfg.seed, shards=max(_SHARDS, 1),
             sim=runner.last_sim, stats=runner.last_stats,
             harvester=h, wall_seconds=wall,
             compile_s=compile_s, compile_fresh=compile_fresh,
             fault_plan=getattr(b, "fault_plan", None),
-            dispatch=disp)
+            dispatch=disp, injection=inj_blk)
     print(json.dumps(out))
 
 

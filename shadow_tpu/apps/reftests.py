@@ -418,3 +418,9 @@ def unistd_main(env):
     name = yield vproc.gethostname()
     expected = env["args"][1] if len(env["args"]) > 1 else env["host"]
     assert name == expected, (name, expected)
+
+
+# the tgen traffic model's dual-mode twin lives with its compiler
+# (apps/tgen.py); re-exported here because the hostrun catalog
+# resolves workload programs from this module by name
+from shadow_tpu.apps.tgen import tgen_main  # noqa: E402,F401

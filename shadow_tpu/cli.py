@@ -120,6 +120,19 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--event-capacity", type=int, default=None)
     p.add_argument("--outbox-capacity", type=int, default=None)
     p.add_argument("--router-ring", type=int, default=None)
+    # --- open-system injection (shadow_tpu/inject) -------------------
+    p.add_argument("--inject-trace", default=None, metavar="PATH",
+                   help="stream an injection trace (newline-JSON or "
+                        "binary, see docs/9-injection.md) into the "
+                        "simulated hosts; overrides a config's "
+                        "<traffic> elements. The injected kinds must "
+                        "have a device handler (the tgen plugin, or "
+                        "tools/trace_gen.py targeting one)")
+    p.add_argument("--inject-lanes", type=int, default=None,
+                   help="device staging lanes for injection "
+                        "(power of two; default sized from the trace "
+                        "length, capped at 1024 — longer traces "
+                        "stream through a host-driven loop)")
     # --- window telemetry (shadow_tpu/telemetry) ---------------------
     p.add_argument("--trace-out", default=None,
                    help="write a Chrome-trace/Perfetto JSON of "
@@ -235,6 +248,7 @@ def overrides_from_args(args) -> dict:
         "track_paths": args.track_paths,
         "windows_per_dispatch": args.chunk_windows,
         "adaptive_jump": args.adaptive_jump,
+        "inject_lanes": args.inject_lanes,
     }
     return {k: v for k, v in overrides.items() if v is not None}
 
@@ -391,6 +405,16 @@ def main(argv=None) -> int:
                 if k in ("event_capacity", "outbox_capacity",
                          "router_ring"):
                     overrides[k] = max(int(overrides.get(k) or 0), int(v))
+        if args.inject_trace and "inject_lanes" not in overrides:
+            # size the staging buffer from the trace before the build
+            # (the same default the loader applies to <traffic>
+            # elements); one extra sequential read of the file is
+            # cheap next to the device build
+            from shadow_tpu.apps.tgen import lanes_for
+            from shadow_tpu.inject import read_trace
+
+            n_ev = sum(1 for _ in read_trace(args.inject_trace))
+            overrides["inject_lanes"] = lanes_for(n_ev)
         # relative <topology path> / <plugin path="*.py"> entries are
         # relative to the CONFIG FILE, not the cwd (the reference
         # resolves the same way) — load() handles both via base_dir
@@ -411,6 +435,33 @@ def main(argv=None) -> int:
         logger.message(0, "shadow-tpu", f"built {b.cfg.num_hosts} hosts, "
                        f"min window {b.min_jump} ns, "
                        f"end {b.cfg.end_time} ns")
+
+        # open-system injection: an explicit --inject-trace beats the
+        # config's compiled <traffic> trace (the CLI-beats-XML
+        # precedence every other knob follows)
+        feeder = None
+        if args.inject_trace or loaded.inject_events:
+            from shadow_tpu.inject import Feeder
+
+            if loaded.vprocs:
+                print("error: event injection needs the on-device "
+                      "window loop; .py-plugin virtual processes "
+                      "cannot consume injected events",
+                      file=sys.stderr)
+                logger.flush()
+                return 1
+            if args.inject_trace and loaded.inject_events:
+                logger.warning(
+                    0, "shadow-tpu",
+                    "--inject-trace overrides the config's <traffic> "
+                    "elements")
+            feeder = Feeder(args.inject_trace
+                            or list(loaded.inject_events))
+            logger.message(
+                0, "shadow-tpu",
+                f"injection staging: {b.sim.inject.lanes} lanes, "
+                f"source "
+                f"{args.inject_trace or '<traffic> elements'}")
 
         t0 = time.time()
 
@@ -584,7 +635,8 @@ def main(argv=None) -> int:
                         mesh=mesh,
                         config_digest=config_hash(b.cfg),
                         log=lambda m: logger.message(0, "shadow-tpu", m),
-                        on_window=sup_hook, harvester=harvester)
+                        on_window=sup_hook, harvester=harvester,
+                        feeder=feeder)
             finally:
                 for _sg, _h in prev_handlers.items():
                     with contextlib.suppress(ValueError, TypeError):
@@ -611,6 +663,11 @@ def main(argv=None) -> int:
                     m = harvester.mean_window_ns()
                     if m is not None:
                         disp["adaptive_jump_mean_ns"] = m
+                inj_blk = None
+                if feeder is not None:
+                    from shadow_tpu import inject as inject_mod
+
+                    inj_blk = inject_mod.manifest_block(sim_, feeder)
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards,
                     sim=sim_, stats=stats_, health=health_,
@@ -619,7 +676,7 @@ def main(argv=None) -> int:
                     run_id=result.run_id, resume_of=result.resume_of,
                     escalations=result.escalations,
                     preempted=result.preempted or None,
-                    dispatch=disp)
+                    dispatch=disp, injection=inj_blk)
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
@@ -697,10 +754,16 @@ def main(argv=None) -> int:
             with (timers.phase("window-loop") if timers is not None
                   else contextlib.nullcontext()):
                 sim, stats, _ = ckpt.run_windows(
-                    b, app_handlers=loaded.handlers, on_window=pcap_hook)
+                    b, app_handlers=loaded.handlers, on_window=pcap_hook,
+                    feeder=feeder)
         elif mesh is not None:
             from shadow_tpu.parallel.shard import run_sharded
 
+            if feeder is not None:
+                # whole-run jitted path: the entire trace must fit the
+                # staging lanes (fill_all errors with the streaming
+                # alternative spelled out when it does not)
+                b.sim = feeder.fill_all(b.sim)
             if timers is not None:
                 with timers.phase("device-execute"):
                     sim, stats = run_sharded(
@@ -712,6 +775,8 @@ def main(argv=None) -> int:
                     b, mesh, app_handlers=loaded.handlers,
                     app_bulk=b.app_bulk)
         else:
+            if feeder is not None:
+                b.sim = feeder.fill_all(b.sim)
             if timers is not None:
                 # split trace+compile from device execution so the
                 # wall-time trace track shows where a cold start went
@@ -813,6 +878,13 @@ def main(argv=None) -> int:
             "overflow": int(sim.events.overflow) + int(sim.outbox.overflow)
             + int(sim.net.rq_overflow),
         }
+        inj_blk = None
+        if feeder is not None:
+            from shadow_tpu import inject as inject_mod
+
+            inj_blk = inject_mod.manifest_block(sim, feeder)
+            if inj_blk is not None:
+                report["injection"] = inj_blk
         if sup_result is not None:
             if sup_result.escalations:
                 report["escalations"] = [
@@ -848,6 +920,7 @@ def main(argv=None) -> int:
                     stats=stats, health=run_health,
                     fault_plan=b.fault_plan, harvester=harvester,
                     timers=timers, wall_seconds=wall,
+                    injection=inj_blk,
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,

@@ -166,6 +166,24 @@ def _udp_only_hints(assignments):
 
 _configure_pingpong.hints = _udp_only_hints
 
+def _configure_tgen(bundle: SimBundle, assignments):
+    """Open-system traffic endpoints (apps/tgen.py): every host binds
+    the tgen UDP socket; the send schedule itself comes from the
+    config's <traffic> elements (or --inject-trace), not from here."""
+    from shadow_tpu.apps import tgen
+
+    port = 9100
+    for _, spec in assignments:
+        kv = kv_arguments(spec.arguments)
+        port = int(kv.get("port", port))
+    bundle.sim = tgen.setup(bundle.sim, port=port)
+    return (tgen.handler,)
+
+
+_configure_tgen.hints = _udp_only_hints
+register_plugin("tgen", _configure_tgen)
+
+
 def _configure_testtcp(bundle: SimBundle, assignments):
     """The reference's dual-mode tcp test plugin (shd-test-tcp):
     positional arguments `<iomode> server` / `<iomode> client
@@ -374,6 +392,9 @@ class LoadedSim:
     # virtual-process coroutines from .py plugins:
     # (host_index, proc_fn(host)->generator, start_ns, stop_ns)
     vprocs: tuple = ()
+    # <traffic> elements compiled to an injection trace
+    # (apps/tgen.py compile_trace; feed to inject.Feeder)
+    inject_events: tuple = ()
 
 
 def load(config: ShadowConfig, *, seed: int = 1,
@@ -438,6 +459,22 @@ def load(config: ShadowConfig, *, seed: int = 1,
             model = config.plugins[p.plugin].path
             assignments.setdefault(model, []).append((idx, p))
 
+    # <traffic> elements compile BEFORE the build: host indices
+    # follow expanded_hosts() order (the same order host_specs was
+    # filled in above), and the trace length sizes the default
+    # staging width the same way plugin hints size the rings
+    inject_events: tuple = ()
+    if config.traffics:
+        from shadow_tpu.apps import tgen
+
+        name_to_index = {name: i for i, (name, _)
+                         in enumerate(config.expanded_hosts())}
+        inject_events = tuple(tgen.compile_trace(
+            config.traffics, name_to_index,
+            end_time=config.stoptime))
+        overrides.setdefault("inject_lanes",
+                             tgen.lanes_for(len(inject_events)))
+
     # model-provided capacity hints (CLI overrides still win)
     hinted: dict = {}
     for model, asg in assignments.items():
@@ -474,7 +511,8 @@ def load(config: ShadowConfig, *, seed: int = 1,
                     "emit_capacity", "nic_drain", "tcp", "tcp_ssthresh",
                     "tcp_windows", "cpu_threshold_ns",
                     "cpu_precision_ns", "track_paths",
-                    "windows_per_dispatch", "adaptive_jump")},
+                    "windows_per_dispatch", "adaptive_jump",
+                    "inject_lanes")},
     )
     # Validate plugin references BEFORE the expensive device build: a
     # config typo should fail in milliseconds, not after a multi-minute
@@ -559,6 +597,26 @@ def load(config: ShadowConfig, *, seed: int = 1,
         records = faults_mod.records_from_config(config, bundle)
         faults_mod.install(bundle, records)
 
+    if config.traffics:
+        from shadow_tpu.apps import tgen
+
+        if vprocs:
+            raise ValueError(
+                "<traffic> injection requires the on-device window "
+                "loop; .py-plugin virtual processes are host-driven "
+                "and cannot consume injected device events")
+        if not handlers:
+            # traffic-only config: tgen IS the app
+            bundle.sim = tgen.setup(bundle.sim,
+                                    port=config.traffics[0].port)
+            handlers.append(tgen.handler)
+        elif not any(h is tgen.handler for h in handlers):
+            raise ValueError(
+                "<traffic> elements compile to tgen events, but "
+                "another device app owns the app state; run the "
+                "traffic hosts under the 'tgen' plugin or drop the "
+                "<traffic> elements")
+
     def _rebuild(new_overrides: dict) -> SimBundle:
         # Full reload — topology placement, app setup, fault install —
         # at the merged capacities. Everything but the overridden
@@ -572,4 +630,5 @@ def load(config: ShadowConfig, *, seed: int = 1,
 
     bundle.rebuild = _rebuild
     return LoadedSim(bundle=bundle, handlers=tuple(handlers),
-                     config=config, vprocs=tuple(vprocs))
+                     config=config, vprocs=tuple(vprocs),
+                     inject_events=inject_events)

@@ -83,6 +83,58 @@ class FaultSpec:
 
 
 @dataclass
+class TrafficPhase:
+    """One phase of a <traffic> element's open-loop schedule. Which
+    fields mean anything depends on `kind`:
+
+    - stream: `rate` events/s for `count` events or `duration`
+      seconds (whichever is given; count wins when both are).
+    - pause: silence for `duration` seconds.
+    - markov: a two-state on/off chain sampled per send slot at
+      `rate` — in ON the slot emits, then flips OFF with p_off; in
+      OFF it stays silent, then flips ON with p_on. `seed` makes the
+      sampled trace reproducible (and part of the config, so two
+      runs of one config inject identical events).
+    """
+
+    kind: str                      # stream | pause | markov
+    rate: float = 1.0              # events/s (stream, markov)
+    count: Optional[int] = None    # stream: stop after N events
+    duration_ns: Optional[int] = None
+    size: int = 64                 # payload bytes carried per event
+    p_on: float = 0.5              # markov OFF->ON per slot
+    p_off: float = 0.5             # markov ON->OFF per slot
+    seed: int = 0                  # markov sampling stream
+
+
+@dataclass
+class TrafficSpec:
+    """One <traffic> element — a tgen-style open-system workload
+    (shadow-tpu extension): an external source drives `host` on a
+    declarative phase schedule, compiled by apps/tgen.py into an
+    injection trace that streams in through inject/feeder.py instead
+    of living in the closed-loop event population.
+
+      <traffic id="crowd" host="client" dst="server" start="1.0">
+        <stream rate="2000" count="500" size="512"/>
+        <pause duration="0.5"/>
+        <markov rate="4000" duration="2.0" p_on="0.2" p_off="0.6"/>
+      </traffic>
+
+    `host`/`dst` are host names (indices resolved once placement is
+    known, like FaultSpec); `dst` defaults to `host` itself (self-
+    directed work, the PHOLD shape).
+    """
+
+    id: str
+    host: str
+    dst: Optional[str] = None
+    start_ns: int = 0
+    port: int = 9100               # UDP dst port tgen sends to
+    phases: list[TrafficPhase] = field(default_factory=list)
+
+
+@dataclass
 class ShadowConfig:
     stoptime: int                  # ns
     bootstraptime: int             # ns
@@ -91,6 +143,7 @@ class ShadowConfig:
     plugins: dict[str, PluginSpec]
     hosts: list[HostElem]
     faults: list[FaultSpec] = field(default_factory=list)
+    traffics: list[TrafficSpec] = field(default_factory=list)
 
     def expanded_hosts(self):
         """Yield (name, HostElem) with quantity stamped out the way the
@@ -134,6 +187,7 @@ def parse_config(text: str) -> ShadowConfig:
     plugins: dict[str, PluginSpec] = {}
     hosts: list[HostElem] = []
     faults: list[FaultSpec] = []
+    traffics: list[TrafficSpec] = []
 
     for child in root:
         if child.tag == "kill":
@@ -194,6 +248,47 @@ def parse_config(text: str) -> ShadowConfig:
             faults.append(FaultSpec(
                 time_ns=t, kind=kind, a=a, b=child.get("b"),
                 value=None if v is None else float(v)))
+        elif child.tag == "traffic":
+            hid = child.get("host") or child.get("src")
+            if hid is None:
+                raise ValueError("<traffic> requires host")
+            phases = []
+            for sub in child:
+                if sub.tag == "stream":
+                    phases.append(TrafficPhase(
+                        kind="stream",
+                        rate=float(sub.get("rate", "1")),
+                        count=_int_attr(sub, "count"),
+                        duration_ns=_seconds_attr(sub, "duration"),
+                        size=_int_attr(sub, "size", default=64)))
+                elif sub.tag == "pause":
+                    phases.append(TrafficPhase(
+                        kind="pause",
+                        duration_ns=_seconds_attr(
+                            sub, "duration", default=_SECONDS)))
+                elif sub.tag == "markov":
+                    phases.append(TrafficPhase(
+                        kind="markov",
+                        rate=float(sub.get("rate", "1")),
+                        duration_ns=_seconds_attr(
+                            sub, "duration", default=_SECONDS),
+                        size=_int_attr(sub, "size", default=64),
+                        p_on=float(sub.get("p_on", "0.5")),
+                        p_off=float(sub.get("p_off", "0.5")),
+                        seed=_int_attr(sub, "seed", default=0)))
+                else:
+                    raise ValueError(
+                        f"<traffic> phase <{sub.tag}> unknown "
+                        f"(stream | pause | markov)")
+            if not phases:
+                raise ValueError(
+                    f"<traffic host={hid!r}> has no phases")
+            traffics.append(TrafficSpec(
+                id=child.get("id", hid), host=hid,
+                dst=child.get("dst"),
+                start_ns=_seconds_attr(child, "start", default=0),
+                port=_int_attr(child, "port", default=9100),
+                phases=phases))
         # unknown elements are ignored (forward compatible)
 
     if stoptime is None:
@@ -208,6 +303,7 @@ def parse_config(text: str) -> ShadowConfig:
         plugins=plugins,
         hosts=hosts,
         faults=sorted(faults, key=lambda f: f.time_ns),
+        traffics=traffics,
     )
 
 

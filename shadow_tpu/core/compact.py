@@ -32,7 +32,10 @@ def _replicated(path, sim) -> bool:
     from shadow_tpu.net.state import REPLICATED_FIELDS, NetState
 
     names = [k.name for k in path if hasattr(k, "name")]
-    if names and names[0] == "telem":
+    # The telemetry ring and the injection staging buffer are whole-sim
+    # replicated state: their 1-D planes are ring/lane slots, not host
+    # rows — gather/scatter must pass them through untouched.
+    if names and names[0] in ("telem", "inject"):
         return True
     if names and names[-1] in REPLICATED_FIELDS and (
         names[-2] == "net" if len(names) > 1

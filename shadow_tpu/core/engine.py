@@ -255,6 +255,17 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     if telem_fn is not None:
         ev0 = stats.events_processed
         ms0 = stats.micro_steps
+    # Open-system injection (inject/staging.py) merges FIRST, before
+    # the fault rewrite and the bulk/census passes: an injected event
+    # with timestamp inside this window must be census-visible and
+    # drain exactly like one an application scheduled. Trace-time
+    # no-op when Sim.inject is None (the default).
+    inject_deltas = None
+    if getattr(sim, "inject", None) is not None:
+        from shadow_tpu.inject.staging import merge_staged
+        sim, inj_w, drop_w, def_w = merge_staged(
+            sim, 0 if wstart is None else wstart, wend, lane_id)
+        inject_deltas = (inj_w, drop_w, def_w)
     if fault_fn is not None:
         sim = fault_fn(sim, wend)
     if bulk_fn is not None:
@@ -310,13 +321,25 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         sim, stats = window_fixpoint(sim, stats, step_fn, wend,
                                      emit_capacity, lane_id)
     if telem_fn is not None:
+        # inject_deltas is passed only when injection is live, so
+        # hand-written telem_fns without the kwarg keep working
+        kw = ({"inject_deltas": inject_deltas}
+              if inject_deltas is not None else {})
         sim = telem_fn(sim, wend if wstart is None else wstart, wend,
                        stats.events_processed - ev0,
                        stats.micro_steps - ms0,
-                       n_active, fastpath)
+                       n_active, fastpath, **kw)
     sim = route_fn(sim)
     stats = stats.replace(windows=stats.windows + 1)
-    next_min = min_fn(jnp.min(sim.events.min_time()))
+    local_min = jnp.min(sim.events.min_time())
+    if getattr(sim, "inject", None) is not None:
+        # staged-but-unmerged events join the advance rule: a quiet
+        # queue must still jump to the next injected timestamp
+        # instead of declaring the run over
+        from shadow_tpu.inject.staging import staged_pending_min
+        local_min = jnp.minimum(local_min,
+                                staged_pending_min(sim.inject))
+    next_min = min_fn(local_min)
     return sim, stats, next_min
 
 
@@ -448,14 +471,27 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
     def chunk(sim, stats, wstart):
         wstart = jnp.asarray(wstart, simtime.DTYPE)
         lane = None if lane_fn is None else lane_fn(sim)
+        # Streamed injection: no window may start at (or cross) the
+        # staging horizon — the first trace event the host has NOT
+        # yet staged — or that event would merge late once staged.
+        # The chunk hands control back to the host there; the feeder
+        # refills, horizon advances, and the loop is redispatched.
+        # INVALID horizon (no feeder / whole trace staged) never
+        # binds, so closed-loop runs are untouched.
+        streamed = getattr(sim, "inject", None) is not None
 
         def cond(carry):
             i, _sim, _stats, ws = carry
-            return (i < K) & (ws <= end)
+            ok = (i < K) & (ws <= end)
+            if streamed:
+                ok = ok & (ws < _sim.inject.horizon)
+            return ok
 
         def body(carry):
             i, sim, stats, ws = carry
             wend = wend_fn(sim, ws)
+            if streamed:
+                wend = jnp.minimum(wend, sim.inject.horizon)
             sim, stats, next_min = step_window(
                 sim, stats, step_fn, wend,
                 emit_capacity=emit_capacity, lane_id=lane,
@@ -533,8 +569,17 @@ def run(
         )
         return sim, stats, next_min
 
+    local_min = jnp.min(sim.events.min_time())
+    if getattr(sim, "inject", None) is not None:
+        # Whole-run programs never return to the host, so the feeder
+        # must have staged the ENTIRE trace (Feeder.fill_all; horizon
+        # stays INVALID). The staged minimum joins the first-window
+        # rule so a trace-only run (empty queue) still starts.
+        from shadow_tpu.inject.staging import staged_pending_min
+        local_min = jnp.minimum(local_min,
+                                staged_pending_min(sim.inject))
     first = jnp.maximum(
-        min_fn(jnp.min(sim.events.min_time())),
+        min_fn(local_min),
         jnp.asarray(start_time, simtime.DTYPE),
     )
     sim, stats, _ = jax.lax.while_loop(cond, body, (sim, stats, first))

@@ -53,6 +53,16 @@ class RunHealth:
     # with a preemption-style final snapshot instead of hanging — the
     # state is healthy, the budget is not
     deadline_exceeded: bool = False
+    # open-system injection (inject/staging.py): injected events
+    # dropped because the destination row was full. A WARNING, not
+    # fatal — external load that was refused is accounted (the
+    # injected+dropped+deferred reconciliation still closes), but the
+    # results are missing those trace events.
+    inject_dropped: int = 0
+    # injected events whose window had already run when they merged —
+    # the feeder's horizon contract makes this impossible, so any
+    # nonzero count means timestamps were perturbed (clamped up)
+    inject_late: int = 0
     # context for diagnostics
     window_start: Optional[int] = None   # wstart when gathered
     suspect_hosts: tuple = ()            # rows at capacity (global ids)
@@ -118,6 +128,20 @@ class RunHealth:
                         f"them — results remain exact, the trace has "
                         f"gaps; raise --telemetry-capacity or drain "
                         f"more often"))
+        if self.inject_dropped:
+            out.append(("warning",
+                        f"injection drops x{self.inject_dropped}{where}: "
+                        f"injected events were refused by full host "
+                        f"rows — accounted, but the results are missing "
+                        f"those trace events; raise --event-capacity or "
+                        f"thin the trace"))
+        if self.inject_late:
+            out.append(("warning",
+                        f"late injections x{self.inject_late}: events "
+                        f"merged after their window had run and were "
+                        f"clamped forward — the feeder's horizon "
+                        f"contract was violated (file a bug); "
+                        f"timestamps are perturbed, not lost"))
         return out
 
     def failure_report(self) -> dict:
@@ -133,6 +157,8 @@ class RunHealth:
             "time_regression": self.time_regression,
             "telemetry_lost": self.telemetry_lost,
             "deadline_exceeded": self.deadline_exceeded,
+            "inject_dropped": self.inject_dropped,
+            "inject_late": self.inject_late,
             "window_start": self.window_start,
             "suspect_hosts": [int(h) for h in self.suspect_hosts],
             "diagnostics": [m for _, m in self.diagnostics()],
@@ -152,6 +178,7 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         full = np.flatnonzero(fill >= sim.events.capacity)
         lane = np.asarray(sim.net.lane_id)
         suspects = tuple(int(lane[h]) for h in full[:max_suspects])
+    inj = getattr(sim, "inject", None)
     return RunHealth(
         events_overflow=ev,
         outbox_overflow=int(np.asarray(sim.outbox.overflow)),
@@ -161,6 +188,9 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         stall_limit=int(stall_limit),
         time_regression=bool(time_regression),
         telemetry_lost=int(telemetry_lost),
+        inject_dropped=(0 if inj is None
+                        else int(np.asarray(inj.dropped))),
+        inject_late=0 if inj is None else int(np.asarray(inj.late)),
         window_start=None if window_start is None else int(window_start),
         suspect_hosts=suspects,
     )

@@ -164,6 +164,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    config_digest: str | None = None,
                    windows_per_dispatch: int | None = None,
                    adaptive_jump: bool | None = None,
+                   feeder=None,
                    ) -> SupervisorResult:
     """Run bundle to end_time under supervision (host-driven window
     loop; serial by default, shard_map'd over `mesh` when given — the
@@ -363,6 +364,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 exchange_capacity=exchange_capacity,
                 windows_per_dispatch=windows_per_dispatch,
                 adaptive_jump=adaptive_jump,
+                feeder=feeder,
             )
             if harvester is not None:
                 harvester.drain(sim)
@@ -409,12 +411,23 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                     if harvester is not None:
                         harvester.mark_escalation(ev)
                 old_telem = getattr(bundle.sim, "telem", None)
+                old_inject = getattr(bundle.sim, "inject", None)
                 bundle = rebuild_fn(grow)
                 if old_telem is not None:
                     from shadow_tpu.telemetry.ring import attach
 
                     bundle.sim = attach(bundle.sim,
                                         capacity=old_telem.capacity)
+                if old_inject is not None:
+                    # keep the staging buffer across the heal (same
+                    # lane count) so the snapshot transplant below
+                    # finds matching .inject leaves and the feeder's
+                    # sync() resumes the trace without replay
+                    from shadow_tpu.inject.staging import attach as \
+                        inject_attach
+
+                    bundle.sim = inject_attach(bundle.sim,
+                                               old_inject.lanes)
                 # a caller-supplied fault_fn closes over the OLD
                 # shapes; drop it — run_windows re-resolves from the
                 # rebuilt bundle's installed plan

@@ -76,13 +76,26 @@ def _build_scenario(spec: JobSpec, caps: dict):
     <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
   </graph>
 </graphml>"""
+    lanes = 0
+    if spec.inject_trace:
+        # lane count must be stable across rebuilds/requeues — the
+        # checkpoint's .inject leaves are [lanes]-shaped
+        if spec.inject_lanes:
+            lanes = int(spec.inject_lanes)
+        else:
+            from shadow_tpu.apps.tgen import lanes_for
+            from shadow_tpu.inject import read_trace
+
+            lanes = lanes_for(sum(1 for _ in
+                                  read_trace(spec.inject_trace)))
     cfg = NetConfig(num_hosts=spec.hosts, tcp=False,
                     end_time=spec.sim_s * simtime.ONE_SECOND,
                     seed=spec.seed,
                     event_capacity=caps["event_capacity"],
                     outbox_capacity=caps["outbox_capacity"],
                     router_ring=caps["router_ring"],
-                    in_ring=max(8, 2 * spec.load))
+                    in_ring=max(8, 2 * spec.load),
+                    inject_lanes=lanes)
     hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
              for i in range(spec.hosts)]
     b = build(cfg, graph, hosts)
@@ -135,6 +148,15 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             heartbeat({"wstart": int(wstart),
                        "checkpoint": ckpt.latest_checkpoint(prefix)})
 
+    # a fresh Feeder per attempt is correct even on resume: the window
+    # loop syncs it to the snapshot's trace cursor before the first
+    # refill, so a requeued job replays nothing and drops nothing
+    feeder = None
+    if spec.inject_trace:
+        from shadow_tpu.inject import Feeder
+
+        feeder = Feeder(spec.inject_trace)
+
     res = faults.run_supervised(
         make_bundle(), app_handlers=(phold.handler,),
         checkpoint_path=prefix,
@@ -144,7 +166,8 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                     if spec.auto_grow else None),
         rebuild=rebuild, stop=stop, resume_from=resume_from,
         max_run_wallclock=spec.max_wallclock_s,
-        on_round=on_round, log=log, sleep=lambda s: None)
+        on_round=on_round, log=log, sleep=lambda s: None,
+        feeder=feeder)
 
     result = {
         "ok": bool(res.ok),
@@ -159,13 +182,16 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
     }
     if res.sim is not None:
         bundle = built["b"]
+        from shadow_tpu import inject as inject_mod
+
         man = telemetry.run_manifest(
             cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
             stats=res.stats, health=res.health,
             fault_plan=bundle.fault_plan,
             run_id=res.run_id, resume_of=res.resume_of,
             escalations=res.escalations,
-            preempted=res.preempted or None)
+            preempted=res.preempted or None,
+            injection=inject_mod.manifest_block(res.sim, feeder))
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
         result["counters"] = man["counters"]

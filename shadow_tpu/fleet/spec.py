@@ -103,6 +103,14 @@ class JobSpec:
     checkpoint_every_windows: int = 8
     max_attempts: Optional[int] = None      # None = fleet default
     max_wallclock_s: Optional[float] = None  # per-job deadline
+    # open-system injection (shadow_tpu/inject/): a trace file the
+    # job streams into the scenario. The staging buffer's lane count
+    # sizes from the trace unless pinned; resume-after-kill continues
+    # the trace from the checkpoint's cursor without replay (the
+    # feeder syncs to the snapshot), so injected jobs keep the fleet's
+    # bit-identity contract.
+    inject_trace: Optional[str] = None
+    inject_lanes: Optional[int] = None
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -121,6 +129,14 @@ class JobSpec:
                              f"{self.kind!r}")
         self.faults = tuple(
             f if isinstance(f, dict) else dict(f) for f in self.faults)
+        if self.inject_trace is not None and self.kind != "scenario":
+            raise ValueError(f"job {self.id}: inject_trace only "
+                             f"applies to kind 'scenario'")
+        if self.inject_lanes is not None:
+            n = int(self.inject_lanes)
+            if n <= 0 or n & (n - 1):
+                raise ValueError(f"job {self.id}: inject_lanes must "
+                                 f"be a positive power of two")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)

@@ -79,6 +79,13 @@ WORKLOADS = {
         Workload("signal", "signal_main"),
         Workload("pthreads", "pthreads_main"),
         Workload("unistd", "unistd_main"),
+        # the open-system traffic model (apps/tgen.py): the SAME
+        # phase walk that compiles <traffic> injection traces drives
+        # real sendto calls here, so the workload's wire behavior is
+        # conformance-gated before the injection path replays it
+        Workload("tgen", "tgen_main", seconds=10,
+                 procs=((1, ("server",), 0),
+                        (0, ("client", "server"), 1))),
     )
 }
 

@@ -1,0 +1,60 @@
+"""Open-system traffic injection (ISSUE 8 / ROADMAP item 5).
+
+Closed-loop apps (PHOLD, the TCP relay) generate their own load; this
+package is the on-ramp for *external* load — recorded traces or live
+generators feeding the simulated hosts, the device-era analog of the
+reference's tgen traffic plugin:
+
+- staging.py  device-resident bounded staging buffer merged into the
+              EventQueue at window boundaries (replicated across
+              shards; overflow counted, never silent)
+- trace.py    the on-disk trace formats: newline-JSON records and a
+              CRC-framed binary fast path (fleet-journal framing)
+- feeder.py   the host-side streamer: iterator/trace -> staging
+              refills at chunk granularity, overlapping device_put of
+              the next batch with device compute
+
+apps/tgen.py compiles declarative <traffic> specs into these traces.
+"""
+
+from shadow_tpu.inject.staging import (   # noqa: F401
+    InjectStaging,
+    attach,
+    merge_staged,
+    staged_pending_min,
+)
+from shadow_tpu.inject.feeder import Feeder   # noqa: F401
+from shadow_tpu.inject.trace import (     # noqa: F401
+    read_trace,
+    write_trace,
+)
+
+
+def manifest_block(sim, feeder=None):
+    """The run manifest's `injection` block: device latches plus the
+    feeder's host-side accounting. `deferred` closes the
+    reconciliation the lint checks — every trace event is injected,
+    dropped, or deferred past end-of-run, never silently lost. None
+    when the sim carries no staging buffer."""
+    st = getattr(sim, "inject", None)
+    if st is None:
+        return None
+    import numpy as np
+
+    injected = int(np.asarray(st.injected))
+    dropped = int(np.asarray(st.dropped))
+    blk = {
+        "lanes": int(st.lanes),
+        "injected": injected,
+        "dropped": dropped,
+        "late": int(np.asarray(st.late)),
+    }
+    if feeder is not None:
+        blk.update(feeder.stats())
+        te = feeder.trace_events
+        # trace_events is unknown until the source drains (a trace
+        # outliving end_time is legal); deferred is only defined once
+        # the total is
+        blk["deferred"] = (None if te is None
+                           else max(0, te - injected - dropped))
+    return blk

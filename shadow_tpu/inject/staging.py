@@ -1,0 +1,202 @@
+"""Device-resident injection staging buffer.
+
+A bounded ring of host->device injected events, merged into the
+EventQueue at every window boundary (core/engine.step_window) before
+the window drains — so an injected event with timestamp inside
+[wstart, wend) executes in that window under the normal deterministic
+(time, src, seq) total order, exactly as if an application had
+scheduled it.
+
+Layout: L lanes (power of two), slot = seq % L, where `seq` is the
+event's global position in the trace. The slot rule is canonical — it
+depends only on the trace, never on window timing — so the staged
+planes are bit-identical across shard counts and chunk sizes for the
+same feeder state.
+
+Replication: the staging planes are REPLICATED across shards
+(parallel/shard.sim_specs gives the inject subtree P(), like the
+telemetry ring). Every shard sees every staged event and inserts only
+the ones whose destination row it owns; `seq_floor` (entries below it
+are already merged) advances by the same replicated computation on
+every shard. The cumulative counters (injected / dropped / late) are
+per-shard partials, aggregated by the generic delta-psum in
+parallel/shard._replicate_scalars.
+
+Merge bookkeeping, never silent:
+
+- `dropped`: the destination row was full. insert_flat counts the
+  drop; the delta is moved OFF the fatal EventQueue.overflow latch
+  onto the injection's own sticky counter, which faults/health.py
+  latches as a *warning* (the trace events are external load — losing
+  one is an admission failure to surface, not engine-state
+  corruption, and the reconciliation injected + dropped + deferred ==
+  trace length still closes).
+- `late`: an event was staged after the window containing its
+  timestamp had already run; its time is clamped up to wstart so it
+  still executes (zero loss), but the timestamp was perturbed. The
+  feeder's horizon clamp makes this structurally impossible (windows
+  never cross the first unstaged event's time), so a nonzero count
+  means the feeder contract was violated — latched as a warning.
+- `seq_floor` dedupe: the host may re-stage entries that were already
+  merged (refills are built from a host-side mirror without reading
+  device state back); the device skips seq < seq_floor, so refills
+  are idempotent and overlap-friendly.
+
+`horizon` is the timestamp of the first trace event NOT yet staged
+(simtime.INVALID when the whole remaining trace is staged). The
+chunked window loop clamps every wend to it and stops dispatching at
+it, which is what guarantees `late` stays zero under streaming.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import insert_flat
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# Injected events' per-source sequence numbers start here: organic
+# events use the per-host next_seq counter (small), so injected events
+# tie-break AFTER any organic event with the same (time, src) — a
+# fixed, shard-count-independent rule. Trace positions wrap modulo
+# SEQ_BASE into the i32 queue seq; two injected events collide in the
+# tie key only at the same time, same host, and trace positions 2^30
+# apart.
+SEQ_BASE = 1 << 30
+
+
+@struct.dataclass
+class InjectStaging:
+    """Bounded staging ring for host->device injected events."""
+
+    time: jax.Array   # [L] i64 (simtime.INVALID = empty lane)
+    host: jax.Array   # [L] i32 global destination host id
+    kind: jax.Array   # [L] i32 event kind
+    seq: jax.Array    # [L] i64 global trace position
+    words: jax.Array  # [L, NWORDS] i32 payload
+    # entries with seq < seq_floor were already merged (replicated —
+    # the advance is the same pure function of the planes on every
+    # shard); the host's refill dedupe key
+    seq_floor: jax.Array  # [] i64
+    # timestamp of the first trace event not yet staged; INVALID when
+    # the whole remaining trace is on device. Written by the host
+    # feeder only; the chunked loop's wend clamp + stop condition.
+    horizon: jax.Array    # [] i64
+    # sticky per-shard partial counters (delta-psummed to globals at
+    # the shard_map boundary, like every scalar counter)
+    injected: jax.Array   # [] i64 events merged into local rows
+    dropped: jax.Array    # [] i64 local-row-full drops (warning latch)
+    late: jax.Array       # [] i64 timestamps clamped up to wstart
+
+    @property
+    def lanes(self) -> int:
+        return self.time.shape[0]
+
+    @staticmethod
+    def create(lanes: int, nwords: int) -> "InjectStaging":
+        if lanes < 1 or (lanes & (lanes - 1)) != 0:
+            raise ValueError(
+                f"inject lanes must be a power of two >= 1, got {lanes} "
+                f"(slot = seq % lanes must be a mask)")
+        z64 = jnp.zeros((), I64)
+        return InjectStaging(
+            time=jnp.full((lanes,), simtime.INVALID, simtime.DTYPE),
+            host=jnp.zeros((lanes,), I32),
+            kind=jnp.zeros((lanes,), I32),
+            seq=jnp.zeros((lanes,), I64),
+            words=jnp.zeros((lanes, nwords), I32),
+            seq_floor=z64,
+            horizon=jnp.asarray(simtime.INVALID, simtime.DTYPE),
+            injected=z64, dropped=z64, late=z64,
+        )
+
+
+def attach(sim, lanes: int):
+    """Return `sim` with an injection staging buffer attached (no-op
+    when one already is). Sim.inject defaults to None — a None field
+    contributes no pytree leaves, so programs and checkpoints built
+    without injection are untouched; attaching is an explicit opt-in
+    retrace, exactly like telemetry.attach."""
+    if getattr(sim, "inject", None) is not None:
+        return sim
+    return sim.replace(inject=InjectStaging.create(
+        int(lanes), int(sim.events.words.shape[-1])))
+
+
+def staged_pending_min(st: InjectStaging) -> jax.Array:
+    """[] i64 earliest staged-but-unmerged timestamp (INVALID if
+    none). Joins the queue minimum in the window-advance rule so a run
+    whose queues went quiet still advances to the next injected event
+    instead of terminating early. Replicated planes -> replicated
+    value, no collective needed."""
+    pend = (st.time != simtime.INVALID) & (st.seq >= st.seq_floor)
+    return jnp.min(jnp.where(pend, st.time, simtime.INVALID))
+
+
+def wend_clamp(sim, wend):
+    """Clamp a window end to the staging horizon: a window must never
+    cross the first NOT-yet-staged event's timestamp, or that event
+    would merge late (clamped, counted) once the host stages it.
+    Trace-time no-op when injection is off; INVALID horizon (whole
+    trace staged) never binds."""
+    st = getattr(sim, "inject", None)
+    if st is None:
+        return wend
+    return jnp.minimum(wend, st.horizon)
+
+
+def merge_staged(sim, wstart, wend, lane_id=None):
+    """Merge staged events with timestamp < wend into this shard's
+    EventQueue rows. Returns (sim, injected_w, dropped_w, deferred_w)
+    where the _w values are THIS WINDOW's shard-local injected/dropped
+    deltas plus the (replicated) still-deferred count — the telemetry
+    ring psums the first two at the barrier it already pays for.
+
+    Determinism: the trace is sorted by time with seq = position, so
+    `time < wend` selects a seq-contiguous prefix of the pending
+    entries and the replicated seq_floor advance equals the taken
+    count on every shard. Insertion order within a row follows lane
+    order == seq order (insert_flat's caller-order contract), and the
+    queue seq SEQ_BASE + trace position makes the (time, src, seq)
+    total order independent of shard count and chunk size."""
+    st = sim.inject
+    wstart = jnp.asarray(wstart, simtime.DTYPE)
+    wend = jnp.asarray(wend, simtime.DTYPE)
+
+    pend = (st.time != simtime.INVALID) & (st.seq >= st.seq_floor)
+    take = pend & (st.time < wend)
+    late = take & (st.time < wstart)
+    t_ins = jnp.maximum(st.time, wstart)
+
+    H = sim.events.num_hosts
+    base = (jnp.zeros((), I32) if lane_id is None
+            else jnp.asarray(lane_id, I32)[0])
+    row = st.host - base
+    local = take & (row >= 0) & (row < H)
+
+    ov0 = sim.events.overflow
+    q = insert_flat(
+        sim.events, local, row.astype(I32), t_ins, st.kind, st.host,
+        (SEQ_BASE + (st.seq % SEQ_BASE)).astype(I32), st.words)
+    # Row-full drops of injected events latch on the injection's own
+    # sticky counter (a health WARNING), not the fatal engine latch:
+    # external load that did not fit is surfaced and reconciled, but
+    # the engine state itself is not corrupt.
+    drop_w = (q.overflow - ov0).astype(I64)
+    q = q.replace(overflow=ov0)
+
+    inj_w = jnp.sum(local, dtype=I64) - drop_w
+    late_w = jnp.sum(late & local, dtype=I64)
+    st = st.replace(
+        seq_floor=st.seq_floor + jnp.sum(take, dtype=I64),
+        injected=st.injected + inj_w,
+        dropped=st.dropped + drop_w,
+        late=st.late + late_w,
+    )
+    deferred_w = jnp.sum(pend & ~take, dtype=I64)
+    return sim.replace(events=q, inject=st), inj_w, drop_w, deferred_w

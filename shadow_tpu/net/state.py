@@ -165,6 +165,12 @@ class NetConfig:
     # Off by default: window boundaries shift, so runs are only
     # window-for-window comparable with it off.
     adaptive_jump: bool = False
+    # Open-system injection staging lanes (shadow_tpu/inject/): a
+    # bounded device-resident buffer of host->device injected events
+    # merged into the EventQueue at every window boundary. Power of
+    # two (slot = trace position % lanes); 0 = off (Sim.inject stays
+    # None and programs are byte-identical to pre-injection builds).
+    inject_lanes: int = 0
     seed: int = 1
     # Packets drained per micro-step by the NIC send pass (the device
     # form of the reference's drain-while-sendable loop,
@@ -412,6 +418,10 @@ class Sim:
     # programs built without telemetry are byte-identical to pre-telem
     # builds; telemetry.attach() is the explicit opt-in.
     telem: Any = None
+    # InjectStaging (inject/staging.py) when open-system injection is
+    # on — same None-contributes-no-leaves contract as telem;
+    # inject.attach() / NetConfig.inject_lanes is the opt-in.
+    inject: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:
@@ -580,7 +590,7 @@ def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
             cfg.num_hosts, cfg.sockets_per_host,
             init_cwnd=initial_cwnd(cfg),
             init_ssthresh=initial_ssthresh(cfg))
-    return Sim(
+    sim = Sim(
         events=EventQueue.create(cfg.num_hosts, cfg.event_capacity,
                                  cfg.words_width),
         outbox=Outbox.create(cfg.num_hosts, cfg.outbox_capacity,
@@ -589,6 +599,10 @@ def make_sim(cfg: NetConfig, net: NetState, app: Any = None) -> Sim:
         app=app,
         tcp=tcp,
     )
+    if getattr(cfg, "inject_lanes", 0):
+        from shadow_tpu.inject import staging as _inject_staging
+        sim = _inject_staging.attach(sim, cfg.inject_lanes)
+    return sim
 
 
 def host_of_ip(net: NetState, ip):

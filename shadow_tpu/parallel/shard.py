@@ -77,8 +77,10 @@ def sim_specs(sim, axis: str):
         # ring slots, not host rows, and every value stored is already
         # globally reduced at the window barrier (telemetry/ring.py).
         # This check must come first — the 1-D planes would otherwise
-        # fall through to P(axis).
-        if names and names[0] == "telem":
+        # fall through to P(axis). The injection staging buffer is
+        # replicated the same way: every shard sees every staged
+        # event and merges only the rows it owns (inject/staging.py).
+        if names and names[0] in ("telem", "inject"):
             return P()
         # Replicated lookup tables are identified by NetState field
         # name, scoped to the NetState subtree ("net" in a Sim, or a
@@ -256,6 +258,13 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     # prev_*) and planes already hold globally-reduced values — the
     # delta-psum below would multiply them by the shard count.
     telem = getattr(sim, "telem", None)
+    # Injection staging: seq_floor and horizon are REPLICATED values
+    # (the floor advance is the same pure function of the replicated
+    # planes on every shard) — the delta-psum would multiply the
+    # advance by the shard count. Pin both; the cumulative counters
+    # (injected/dropped/late) are per-shard partials and take the
+    # generic delta-psum below like every other counter.
+    inject = getattr(sim, "inject", None)
     # The per-path matrix is declared replicated (REPLICATED_FIELDS)
     # but each shard scatter-adds only its own hosts' sends into its
     # replica — psum the [V,V] delta so the reassembled matrix equals
@@ -277,6 +286,9 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
         max_occupied=narrow_pinned[2], route_elided=narrow_pinned[3]))
     if telem is not None:
         sim = sim.replace(telem=telem)
+    if inject is not None:
+        sim = sim.replace(inject=sim.inject.replace(
+            seq_floor=inject.seq_floor, horizon=inject.horizon))
     if path_pinned is not None:
         sim = sim.replace(net=sim.net.replace(
             ctr_path_packets=path_pinned))

@@ -57,6 +57,9 @@ def chrome_trace(records, timers=None, num_shards: int = 1) -> dict:
                     "sum": r.qocc_sum},
                 "active_lanes": r.active_lanes,
                 "fastpath": r.fastpath,
+                "injected": r.injected,
+                "inj_dropped": r.inj_dropped,
+                "inj_deferred": r.inj_deferred,
             },
         })
     if timers is not None:
@@ -161,7 +164,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  resume_of: str | None = None,
                  escalations=None,
                  preempted: bool | None = None,
-                 dispatch: dict | None = None) -> dict:
+                 dispatch: dict | None = None,
+                 injection: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -215,6 +219,11 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         man["preempted"] = bool(preempted)
     if dispatch is not None:
         man["dispatch"] = dispatch
+    if injection is not None:
+        # open-system event injection (inject/__init__.py
+        # manifest_block): device latches + feeder accounting; the
+        # lint reconciles injected+dropped+deferred == trace_events
+        man["injection"] = injection
     return man
 
 
@@ -256,6 +265,11 @@ def metrics_from_manifest(man: dict) -> dict:
         out["dispatches"] = d.get("dispatches", 0)
         if "adaptive_jump_mean_ns" in d:
             out["adaptive_jump_mean_ns"] = d["adaptive_jump_mean_ns"]
+    if "injection" in man:
+        inj = man["injection"]
+        for k in ("injected", "dropped", "late", "backpressure"):
+            if inj.get(k) is not None:
+                out[f"inject_{k}"] = inj[k]
     return out
 
 

@@ -45,6 +45,9 @@ class WindowRecord:
     qocc_sum: int
     active_lanes: int  # host rows live at window start (global)
     fastpath: int      # 1 = drained on the compact [S]-lane branch
+    injected: int      # staged events merged this window (global)
+    inj_dropped: int   # injected merges lost to full rows (global)
+    inj_deferred: int  # staged, still pending beyond wend (gauge)
 
 
 @dataclass
@@ -130,6 +133,16 @@ class Harvester:
             out["active_lanes_max"] = int(
                 max(r.active_lanes for r in self.records))
             out["window_span_ns_mean"] = self.mean_window_ns()
+            # injection plane aggregates: the lint cross-checks the
+            # manifest's injection.injected against injected_sum when
+            # no records were lost; inj_deferred is a gauge, so only
+            # the final value means anything
+            out["injected_sum"] = int(
+                sum(r.injected for r in self.records))
+            out["inj_dropped_sum"] = int(
+                sum(r.inj_dropped for r in self.records))
+            out["inj_deferred_last"] = int(
+                self.records[-1].inj_deferred)
         if self.escalation_marks:
             out["escalations"] = len(self.escalation_marks)
         return out

@@ -81,6 +81,10 @@ PLANES = (
     ("qocc_sum", I64),
     ("active_lanes", I64),
     ("fastpath", I32),
+    # open-system injection (inject/staging.py), all zero when off:
+    ("injected", I64),      # staged events merged this window (global)
+    ("inj_dropped", I64),   # merges lost to full rows this window
+    ("inj_deferred", I64),  # staged, pending beyond wend (replicated)
 )
 
 DEFAULT_CAPACITY = 4096
@@ -104,6 +108,9 @@ class TelemetryRing:
     qocc_sum: jax.Array      # [W] i64
     active_lanes: jax.Array  # [W] i64
     fastpath: jax.Array      # [W] i32
+    injected: jax.Array      # [W] i64
+    inj_dropped: jax.Array   # [W] i64
+    inj_deferred: jax.Array  # [W] i64
     # monotonic windows-recorded counter; slot = count % W. The host
     # detects overruns from count jumps (never a device-side latch:
     # the whole-run device program cannot see host drains).
@@ -183,11 +190,15 @@ def make_telem_fn(axis: str | None = None):
             return lax.pmin(x, axis)
 
     def telem_fn(sim, wstart, wend, ev_delta, ms_delta,
-                 active_lanes=None, fastpath=None):
+                 active_lanes=None, fastpath=None, inject_deltas=None):
         """active_lanes is the SHARD-LOCAL live-lane count (psummed
         into the record below so it rides the existing collective);
         fastpath is the replicated census-branch indicator. Both
-        default to zero for callers predating the sparse fast path."""
+        default to zero for callers predating the sparse fast path.
+        inject_deltas is the window's (injected, dropped, deferred)
+        from inject.merge_staged — the first two are SHARD-LOCAL
+        partials that ride the psum stack, deferred is replicated;
+        the engine passes it only when injection is live."""
         ring = getattr(sim, "telem", None)
         if ring is None:
             return sim
@@ -215,9 +226,13 @@ def make_telem_fn(axis: str | None = None):
 
         active_l = (jnp.zeros((), I64) if active_lanes is None
                     else jnp.asarray(active_lanes).astype(I64))
+        z64 = jnp.zeros((), I64)
+        inj_l, injdrop_l, injdef = ((z64, z64, z64)
+                                    if inject_deltas is None
+                                    else inject_deltas)
         sums = psum(jnp.stack([
             ev_delta.astype(I64), n_local, n_cross, drops_cum, retx_cum,
-            qsum_l, active_l,
+            qsum_l, active_l, inj_l.astype(I64), injdrop_l.astype(I64),
         ]))
         maxes = pmax(jnp.stack([
             ms_delta.astype(I64), qmax_l.astype(I64),
@@ -239,6 +254,9 @@ def make_telem_fn(axis: str | None = None):
             active_lanes=sums[6],
             fastpath=(jnp.zeros((), I32) if fastpath is None
                       else jnp.asarray(fastpath).astype(I32)),
+            injected=sums[7],
+            inj_dropped=sums[8],
+            inj_deferred=injdef.astype(I64),
         ))
         ring = ring.replace(prev_drops=sums[3], prev_retx=sums[4])
         return sim.replace(telem=ring)

@@ -46,6 +46,13 @@ LAYOUT_VERSION = 3  # v2: protocol-independent packet words 0..5,
                     # cannot be resumed (load()'s per-leaf key check
                     # would also catch it, but with a config-mismatch
                     # message; the layout gate names the real cause)
+                    # (The Sim.inject staging buffer did NOT bump the
+                    # version: like Sim.telem it defaults to None, so
+                    # pytrees built without injection are leaf-for-
+                    # leaf identical to v3 snapshots, and injection
+                    # snapshots simply carry extra .inject leaves that
+                    # resume only into injection-enabled builds — the
+                    # per-leaf key check names the mismatch.)
 
 
 def _leaf_dict(sim) -> dict:
@@ -263,7 +270,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 mesh_axis: str = "hosts",
                 exchange_capacity: int | None = None,
                 windows_per_dispatch: int | None = None,
-                adaptive_jump: bool | None = None):
+                adaptive_jump: bool | None = None,
+                feeder=None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
     master.c:450-480). Returns (sim, stats, checkpoints) where
@@ -316,6 +324,18 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     does NOT donate: the host still reads chunk N's sim while chunk
     N+1 executes — the two live pytrees are the double buffer that
     buys the overlap.
+
+    `feeder` (inject.Feeder) streams an open-system injection trace
+    into the sim's staging buffer (docs/9-injection.md). On entry
+    feeder.sync(sim) reconciles against the (possibly
+    checkpoint-restored) device staging state — a supervised resume
+    replays nothing and drops nothing — then every dispatch boundary
+    prunes merged entries and stages fresh ones at chunk granularity.
+    The staging horizon bounds every window, so streamed runs are
+    bit-identical to fully-staged ones; the chunked loop runs
+    non-speculatively while events remain (the refill must land
+    before the next dispatch) and falls back to the speculative
+    double-buffer once the trace is exhausted.
     """
     import jax.numpy as jnp
 
@@ -422,10 +442,75 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     next_ckpt = (start_time + checkpoint_every_ns
                  if checkpoint_every_ns else None)
     wstart = max(int(jnp.min(sim.events.min_time())), start_time)
+    if feeder is not None:
+        if getattr(sim, "inject", None) is None:
+            raise ValueError(
+                "run_windows(feeder=...) needs a sim with injection "
+                "staging attached (NetConfig.inject_lanes > 0 or "
+                "inject.attach)")
+        # reconcile against (possibly checkpoint-restored) device
+        # staging state, then stage the first batch; staged events
+        # join the first-window rule so a trace-only run (empty
+        # queue) still starts at the trace's first timestamp
+        feeder.sync(sim)
+        sim = feeder.refill(sim)
+        wstart = max(min(int(jnp.min(sim.events.min_time())),
+                         feeder.pending_min()), start_time)
+
+    def _stall_msg(t):
+        return (f"injection stalled at t={t}: all {sim.inject.lanes} "
+                f"staging lanes hold events at one timestamp and more "
+                f"remain in the trace — raise --inject-lanes (or "
+                f"NetConfig.inject_lanes) past the largest "
+                f"same-timestamp burst")
 
     if chunked:
         if wstart > end:
             return sim, total, saved
+        if feeder is not None:
+            # Streaming loop: non-speculative while trace events
+            # remain — each refill must land in the staging planes
+            # BEFORE the next dispatch reads them. Falls through to
+            # the speculative double-buffer for the closed-loop tail
+            # once the trace is fully staged and merged.
+            prev_state = (None, None)
+            while not feeder.done:
+                csim, cstats, cnext = chunk_fn(
+                    sim, EngineStats.create(),
+                    jnp.asarray(wstart, simtime.DTYPE))
+                # the device's next_min only sees the queue and the
+                # STAGED events; an un-staged trace event below it
+                # must pull the next window start back or it would
+                # merge late once staged (measured before the refill
+                # moves the horizon)
+                nm = min(int(cnext), feeder.horizon)
+                total = total.add(cstats)
+                wend_c = min(nm, end + 1)
+                if (next_ckpt is not None and checkpoint_path is not None
+                        and nm >= next_ckpt and nm <= end):
+                    p = save(f"{checkpoint_path}.{nm}.npz", csim,
+                             time_ns=nm, shards=shards)
+                    saved.append((p, nm))
+                    while next_ckpt <= nm:
+                        next_ckpt += checkpoint_every_ns
+                if on_window is not None:
+                    on_window(csim, wend_c)
+                if hook is not None:
+                    hook(csim, cstats, wstart, wend_c, nm)
+                sim = feeder.refill(csim, nm)
+                if nm >= simtime.INVALID:
+                    # quiet queue: jump to the next staged event
+                    nm = feeder.pending_min()
+                if nm > end or nm >= simtime.INVALID:
+                    return sim, total, saved
+                if not feeder.done and feeder.horizon <= nm:
+                    raise RuntimeError(_stall_msg(nm))
+                if (nm, feeder.cursor) == prev_state:
+                    raise RuntimeError(_stall_msg(nm))
+                prev_state = (nm, feeder.cursor)
+                wstart = nm
+            if wstart > end:
+                return sim, total, saved
         cur = chunk_fn(sim, EngineStats.create(),
                        jnp.asarray(wstart, simtime.DTYPE))
         cur_start = wstart
@@ -476,6 +561,13 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
         wend = _clamp_record(wstart, min(wstart + min_jump, end + 1))
+        if feeder is not None:
+            # prune merged (everything < this window's start), stage
+            # fresh events, and keep the window inside the horizon
+            sim = feeder.refill(sim, wstart)
+            wend = min(wend, feeder.horizon)
+            if wend <= wstart:
+                raise RuntimeError(_stall_msg(wstart))
         sim, stats, next_min = one_window(sim, wstart, wend)
         total = total.replace(
             events_processed=total.events_processed + stats.events_processed,
@@ -485,11 +577,23 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             fastpath_miss=total.fastpath_miss + stats.fastpath_miss,
         )
         nm = int(next_min)
+        if feeder is not None:
+            # same horizon rule as the chunked streaming loop: the
+            # first un-staged trace event bounds the next window start
+            nm = min(nm, feeder.horizon)
         if on_window is not None:
             on_window(sim, wend)
         if hook is not None:
             hook(sim, stats, wstart, wend, nm)
         if nm >= simtime.INVALID:
+            if feeder is not None and not feeder.done:
+                # queue and staging both drained, but the trace still
+                # holds events: stage the next batch and jump there
+                sim = feeder.refill(sim, nm)
+                nm = feeder.pending_min()
+                if nm < simtime.INVALID:
+                    wstart = nm
+                    continue
             break
         wstart = nm
     return sim, total, saved

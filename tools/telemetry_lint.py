@@ -21,6 +21,10 @@ Checks:
   "dispatch" block (chunked window loop) must be internally coherent:
   windows_per_dispatch >= 1, every per-dispatch window count fits the
   chunk, and the counts sum to counters.windows when both are present.
+  The optional "injection" block (open-system traffic) must reconcile
+  (injected + dropped + deferred == trace_events), its drops must be
+  latched in health, and the per-window injected plane must sum to
+  the device latch when no telemetry records were lost.
 
 - Fleet manifest JSON (--fleet-manifest): shadow_tpu/fleet schema —
   attempt histories monotone non-decreasing with attempts at the
@@ -331,6 +335,92 @@ def lint_manifest_obj(man) -> tuple[list, list]:
                     or isinstance(aj, bool) or aj < 0):
                 errors.append(f"dispatch.adaptive_jump_mean_ns must "
                               f"be a non-negative number, got {aj!r}")
+    # injection block (optional): open-system traffic accounting
+    # (inject/__init__.py manifest_block). The device latches must be
+    # coherent ints, drops must be SURFACED in health (latch design:
+    # never a silent integer), the per-window telemetry plane must sum
+    # to the device total when no records were lost, and the feeder's
+    # reconciliation must close: every trace event is injected,
+    # dropped, or deferred past end-of-run — nothing vanishes.
+    inj = man.get("injection")
+    if inj is not None:
+        if not isinstance(inj, dict):
+            errors.append("injection must be an object")
+            inj = {}
+        for k in ("lanes", "injected", "dropped", "late"):
+            v = inj.get(k)
+            if (not isinstance(v, int) or isinstance(v, bool)
+                    or v < 0):
+                errors.append(f"injection.{k} must be a non-negative "
+                              f"integer, got {v!r}")
+        lanes = inj.get("lanes")
+        if isinstance(lanes, int) and lanes >= 1 \
+                and lanes & (lanes - 1):
+            errors.append(f"injection.lanes must be a power of two "
+                          f"(slot = trace position % lanes), got "
+                          f"{lanes}")
+        health = man.get("health", {})
+        dropped = inj.get("dropped")
+        if isinstance(dropped, int) and dropped:
+            latched = health.get("inject_dropped", 0) == dropped \
+                or any("injection drops" in d
+                       for d in health.get("diagnostics", []))
+            if not latched:
+                errors.append(
+                    f"injection.dropped={dropped} but the health "
+                    f"block does not surface it — refused injections "
+                    f"must be latched (faults/health.py), never "
+                    f"silent")
+            else:
+                warnings.append(
+                    f"{dropped} injected event(s) dropped by full "
+                    f"host rows (latched in health; results are "
+                    f"missing those trace events)")
+        late = inj.get("late")
+        if isinstance(late, int) and late:
+            errors.append(
+                f"injection.late={late}: events merged after their "
+                f"window had run — the feeder's horizon contract "
+                f"was violated (timestamps perturbed)")
+        # per-window plane vs device latch: lossless telemetry must
+        # account for every injected event window by window
+        if (tel.get("records_lost", 0) == 0
+                and isinstance(tel.get("injected_sum"), int)
+                and isinstance(inj.get("injected"), int)
+                and tel["injected_sum"] != inj["injected"]):
+            errors.append(
+                f"telemetry.injected_sum={tel['injected_sum']} but "
+                f"injection.injected={inj['injected']} with zero "
+                f"records lost — the per-window plane must sum to "
+                f"the device latch")
+        # feeder reconciliation (only defined once the trace drained
+        # and latched its total)
+        te = inj.get("trace_events")
+        dfr = inj.get("deferred")
+        if isinstance(te, int) and isinstance(dfr, int) and all(
+                isinstance(inj.get(k), int)
+                for k in ("injected", "dropped")):
+            if inj["injected"] + inj["dropped"] + dfr != te:
+                errors.append(
+                    f"injection does not reconcile: injected="
+                    f"{inj['injected']} + dropped={inj['dropped']} + "
+                    f"deferred={dfr} != trace_events={te} — every "
+                    f"trace event must be injected, dropped, or "
+                    f"deferred, never silently lost")
+            if dfr:
+                warnings.append(
+                    f"{dfr} trace event(s) deferred past end-of-run "
+                    f"(timestamps beyond the simulation horizon)")
+        bp = inj.get("backpressure")
+        if bp is not None and (not isinstance(bp, int)
+                               or isinstance(bp, bool) or bp < 0):
+            errors.append(f"injection.backpressure must be a "
+                          f"non-negative integer, got {bp!r}")
+        elif isinstance(bp, int) and bp:
+            warnings.append(
+                f"feeder hit backpressure on {bp} refill(s) — the "
+                f"staging buffer filled; raise --inject-lanes if "
+                f"wallclock suffers")
     return errors, warnings
 
 
