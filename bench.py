@@ -204,6 +204,15 @@ def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
     b = build(cfg, graph or ONE_VERTEX, hosts)
     b.sim = phold.setup(b.sim, load=load, replica_size=replica_size,
                         active_hosts=active_hosts)
+    if replica_size and H > replica_size \
+            and os.environ.get("BENCH_LANE_ISOLATION", "0") != "0":
+        # packed ensemble rows carry lane-scoped health latches so the
+        # bench measures the blast-radius machinery's true overhead
+        # (attach BEFORE telemetry — the ring sizes its per-lane
+        # planes off sim.lanes)
+        from shadow_tpu.core import lanes as lanes_mod
+
+        b.sim = lanes_mod.attach(b.sim, H // replica_size)
     if fault_records:
         # degraded-network scenario: the plan rides the bundle, so the
         # same runner factories apply it on 1 shard and N shards alike
@@ -802,6 +811,8 @@ def main(argv=None) -> None:
         name = f"events_per_sec_per_chip@{H}hosts_phold_load{load}"
         if replicas > 1:
             name += f"_x{replicas}replicas"
+            if os.environ.get("BENCH_LANE_ISOLATION", "0") != "0":
+                name += "_lanes"
         if active is not None:
             name += f"_active{active}"
         if supervise:

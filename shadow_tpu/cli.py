@@ -200,6 +200,19 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--stall-windows", type=int, default=512,
                    help="consecutive zero-event windows before the "
                         "stall latch trips")
+    p.add_argument("--lane-isolation", type=int, default=None,
+                   metavar="R",
+                   help="partition the hosts into R contiguous lanes "
+                        "with lane-scoped health latches "
+                        "(core/lanes.py): a capacity trip quarantines "
+                        "only the tripped lane — its hosts freeze at "
+                        "the window barrier while healthy lanes run to "
+                        "completion (blast-radius containment for "
+                        "packed ensemble runs; supervised runs salvage "
+                        "the sick lane's slice from the last clean "
+                        "checkpoint). Lanes must not exchange traffic "
+                        "for healthy-lane bit-exactness; single-shard "
+                        "only (docs/6-robustness.md)")
     p.add_argument("--auto-grow", action="store_true",
                    help="supervisor escalation: a fatal capacity "
                         "overflow (event queue / outbox / router ring) "
@@ -483,6 +496,35 @@ def main(argv=None) -> int:
                         "sim_seconds": round(int(wend) / 1e9, 3),
                         "wall_seconds": round(time.time() - t0, 3)}))
 
+        # lane-isolated health (core/lanes.py): attach BEFORE the
+        # telemetry ring — the ring sizes its per-lane fan-out planes
+        # off sim.lanes. Single-shard, on-device window loop only.
+        if args.lane_isolation:
+            if loaded.vprocs:
+                logger.warning(0, "shadow-tpu",
+                               "--lane-isolation is unavailable with "
+                               ".py plugins (ProcessRuntime window "
+                               "loop); ignored")
+            elif args.workers > 1:
+                logger.warning(0, "shadow-tpu",
+                               "--lane-isolation is single-shard only; "
+                               f"--workers {args.workers} wins, lane "
+                               "isolation disabled")
+            else:
+                from shadow_tpu.core import lanes as lanes_mod
+
+                try:
+                    b.sim = lanes_mod.attach(b.sim, args.lane_isolation)
+                except ValueError as e:
+                    print(f"error: --lane-isolation: {e}",
+                          file=sys.stderr)
+                    logger.flush()
+                    return 1
+                logger.message(
+                    0, "shadow-tpu",
+                    f"lane isolation: {args.lane_isolation} lanes x "
+                    f"{b.cfg.num_hosts // args.lane_isolation} hosts")
+
         # window telemetry (shadow_tpu/telemetry): attach the on-device
         # ring BEFORE any run path branches so checkpoint templates,
         # the supervisor's resume template, and the compiled programs
@@ -668,6 +710,9 @@ def main(argv=None) -> int:
                     from shadow_tpu import inject as inject_mod
 
                     inj_blk = inject_mod.manifest_block(sim_, feeder)
+                from shadow_tpu.telemetry.export import \
+                    lanes_manifest_block
+
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards,
                     sim=sim_, stats=stats_, health=health_,
@@ -676,7 +721,9 @@ def main(argv=None) -> int:
                     run_id=result.run_id, resume_of=result.resume_of,
                     escalations=result.escalations,
                     preempted=result.preempted or None,
-                    dispatch=disp, injection=inj_blk)
+                    dispatch=disp, injection=inj_blk,
+                    lanes=lanes_manifest_block(
+                        health_, result.lane_incidents))
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
@@ -915,12 +962,19 @@ def main(argv=None) -> int:
                         m = harvester.mean_window_ns()
                         if m is not None:
                             disp["adaptive_jump_mean_ns"] = m
+                from shadow_tpu.telemetry.export import \
+                    lanes_manifest_block
+
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
                     stats=stats, health=run_health,
                     fault_plan=b.fault_plan, harvester=harvester,
                     timers=timers, wall_seconds=wall,
                     injection=inj_blk,
+                    lanes=lanes_manifest_block(
+                        run_health,
+                        sup_result.lane_incidents
+                        if sup_result is not None else ()),
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,

@@ -32,10 +32,12 @@ def _replicated(path, sim) -> bool:
     from shadow_tpu.net.state import REPLICATED_FIELDS, NetState
 
     names = [k.name for k in path if hasattr(k, "name")]
-    # The telemetry ring and the injection staging buffer are whole-sim
-    # replicated state: their 1-D planes are ring/lane slots, not host
-    # rows — gather/scatter must pass them through untouched.
-    if names and names[0] in ("telem", "inject"):
+    # The telemetry ring, the injection staging buffer, and the lane
+    # health latches are whole-sim replicated state: their 1-D planes
+    # are ring/staging/lane slots, not host rows — gather/scatter must
+    # pass them through untouched. (Per-host overflow_h planes live on
+    # events/outbox/net and DO gather, keeping row attribution exact.)
+    if names and names[0] in ("telem", "inject", "lanes"):
         return True
     if names and names[-1] in REPLICATED_FIELDS and (
         names[-2] == "net" if len(names) > 1

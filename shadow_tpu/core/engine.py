@@ -173,6 +173,12 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
     # micro-step.
     buf0 = EmitBuffer.create(sim.events.num_hosts, emit_capacity,
                              nwords=sim.events.words.shape[-1])
+    if getattr(sim.events, "overflow_h", None) is not None:
+        # lane isolation (core/lanes.py): emission overflow must carry
+        # per-host attribution too, or the queue plane would drift
+        # from the scalar latch at apply_emissions
+        buf0 = buf0.replace(
+            overflow_h=jnp.zeros((sim.events.num_hosts,), I32))
     with_census = _takes_census(step_fn)
 
     def cond(carry):
@@ -330,6 +336,14 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                        stats.micro_steps - ms0,
                        n_active, fastpath, **kw)
     sim = route_fn(sim)
+    if getattr(sim, "lanes", None) is not None:
+        # lane-isolated health (core/lanes.py): reduce the per-host
+        # latch planes per lane, trip + freeze sick lanes at this
+        # barrier — after the route so this window's deliveries are
+        # attributed, before the min so frozen lanes stop holding the
+        # global advance back
+        from shadow_tpu.core.lanes import window_update
+        sim = window_update(sim, wend)
     stats = stats.replace(windows=stats.windows + 1)
     local_min = jnp.min(sim.events.min_time())
     if getattr(sim, "inject", None) is not None:

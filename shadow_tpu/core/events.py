@@ -18,7 +18,7 @@ sharded mesh that shuffle is the all-to-all exchange point.
 
 from __future__ import annotations
 
-from typing import NamedTuple
+from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -103,6 +103,14 @@ class EventQueue:
     # side checks this between windows and re-runs with a larger K
     # (the reference never drops events; neither do we silently).
     overflow: jax.Array   # [] i32
+    # Optional per-host attribution plane for the same latch ([H] i32),
+    # attached by core/lanes.attach for lane-isolated ensemble runs.
+    # None (the default) contributes no pytree leaves, so programs and
+    # checkpoints built without lane isolation stay byte-identical
+    # (same contract as Sim.telem / Sim.inject). Invariant when
+    # attached: overflow == sum(overflow_h) — every bump site below
+    # updates both, attributing drops to the DESTINATION row.
+    overflow_h: Any = None
 
     @property
     def num_hosts(self) -> int:
@@ -242,6 +250,9 @@ def push_rows(
         words=_put(q.words, sel, words),
         overflow=q.overflow + jnp.sum(mask & ~has_free, dtype=I32),
     )
+    if q.overflow_h is not None:
+        q = q.replace(
+            overflow_h=q.overflow_h + (mask & ~has_free).astype(I32))
     return q
 
 
@@ -274,6 +285,9 @@ class Outbox:
     # the all-to-all's cheap branch). Running total, like the narrow
     # counters.
     route_elided: jax.Array  # [] i32 windows with an empty exchange
+    # Optional per-SOURCE-host overflow attribution ([H] i32) — same
+    # opt-in / invariant contract as EventQueue.overflow_h.
+    overflow_h: Any = None
 
     @property
     def num_hosts(self) -> int:
@@ -322,6 +336,10 @@ def outbox_append(
     words = fit_words(words, out.words.shape[-1])
     ok = mask & (out.count < out.capacity)
     sel = _onehot(ok, out.count, out.capacity)
+    if out.overflow_h is not None:
+        out = out.replace(
+            overflow_h=out.overflow_h
+            + (mask & ~(out.count < out.capacity)).astype(I32))
     return out.replace(
         dst=_put(out.dst, sel, dst),
         time=_put(out.time, sel, time),
@@ -434,8 +452,9 @@ def _queue_packed(q: EventQueue):
          q.src[:, :, None], q.seq[:, :, None], q.words], axis=2)
 
 
-def _queue_unpacked(q: EventQueue, packed_q, overflow_add):
-    return q.replace(
+def _queue_unpacked(q: EventQueue, packed_q, overflow_add,
+                    overflow_add_h=None):
+    q = q.replace(
         time=_unpack_time(packed_q[:, :, 0], packed_q[:, :, 1]),
         kind=packed_q[:, :, 2],
         src=packed_q[:, :, 3],
@@ -443,6 +462,9 @@ def _queue_unpacked(q: EventQueue, packed_q, overflow_add):
         words=packed_q[:, :, 5:],
         overflow=q.overflow + overflow_add,
     )
+    if q.overflow_h is not None and overflow_add_h is not None:
+        q = q.replace(overflow_h=q.overflow_h + overflow_add_h)
+    return q
 
 
 def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
@@ -486,6 +508,12 @@ def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
     packed_q = _queue_packed(q)
 
     Wn = INSERT_SWEEP
+    # per-row overflow attribution (lane isolation): both writers drop
+    # exactly the arrivals beyond a row's free slots, so the plane add
+    # is max(cnt - nfree, 0) either way — computed once, outside the
+    # cond, only when the plane is attached (trace-time no-op else)
+    ofl_h = (jnp.maximum(cnt - nfree, 0).astype(I32)
+             if q.overflow_h is not None else None)
 
     def _select_sweep(_):
         # each row's arrivals as a contiguous window of the stream
@@ -551,7 +579,7 @@ def _insert_sorted_scatter(q: EventQueue, rowc, packed, n, H, K):
 
     packed_q, ofl = jax.lax.cond(
         jnp.max(cnt) <= Wn, _select_sweep, _sorted_scatter, 0)
-    return _queue_unpacked(q, packed_q, ofl)
+    return _queue_unpacked(q, packed_q, ofl, ofl_h)
 
 
 def insert_flat(
@@ -636,8 +664,15 @@ def insert_flat(
     s = jnp.where(fits, cand, K)
 
     packed_q = _queue_packed(q).at[r, s].set(packed_o, mode="drop")
+    ofl_h = None
+    if q.overflow_h is not None:
+        # destination-row attribution: non-fitting valid entries
+        # scatter-added onto their (clipped; masked-off when invalid)
+        # destination rows
+        ofl_h = jnp.zeros((H,), I32).at[jnp.clip(row_o, 0, H - 1)].add(
+            (valid_o & ~fits).astype(I32))
     return _queue_unpacked(q, packed_q,
-                           jnp.sum(valid_o & ~fits, dtype=I32))
+                           jnp.sum(valid_o & ~fits, dtype=I32), ofl_h)
 
 
 def clear_outbox(out: Outbox) -> Outbox:
@@ -676,6 +711,11 @@ def _route_width(q: EventQueue, out: Outbox, width: int,
         out.words[:, :width].reshape(n, out.words.shape[-1]),
         impl=impl,
     )
+    if q.overflow_h is not None:
+        # bad_dst is flattened row-major from the SOURCE rows — the
+        # destination is out of range, so attribute to the sender
+        q = q.replace(overflow_h=q.overflow_h + jnp.sum(
+            bad_dst.reshape(H, width), axis=1, dtype=I32))
     return q.replace(overflow=q.overflow + jnp.sum(bad_dst, dtype=I32))
 
 
@@ -753,6 +793,10 @@ class EmitBuffer:
     words: jax.Array  # [H, E, NWORDS] i32
     count: jax.Array  # [H] i32
     overflow: jax.Array  # [] i32
+    # Optional per-host overflow attribution ([H] i32) — attached by
+    # window_fixpoint when the queue carries its own plane, folded into
+    # EventQueue.overflow_h by apply_emissions.
+    overflow_h: Any = None
 
     @property
     def num_hosts(self) -> int:
@@ -788,6 +832,10 @@ def emit(
     kind = jnp.broadcast_to(jnp.asarray(kind, I32), (H,))
     ok = mask & (buf.count < buf.capacity)
     sel = _onehot(ok, buf.count, buf.capacity)
+    if buf.overflow_h is not None:
+        buf = buf.replace(
+            overflow_h=buf.overflow_h
+            + (mask & ~(buf.count < buf.capacity)).astype(I32))
     return buf.replace(
         dst=_put(buf.dst, sel, dst),
         time=_put(buf.time, sel, time),
@@ -845,6 +893,8 @@ def apply_emissions(
         nvalid = nvalid + v.astype(I32)
     q = q.replace(next_seq=q.next_seq + nvalid,
                   overflow=q.overflow + buf.overflow)
+    if q.overflow_h is not None and buf.overflow_h is not None:
+        q = q.replace(overflow_h=q.overflow_h + buf.overflow_h)
     return q, out
 
 

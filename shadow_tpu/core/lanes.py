@@ -1,0 +1,274 @@
+"""Lane-scoped health latches and blast-radius containment.
+
+An ensemble-packed program (the BENCH_REPLICAS axis / fleet packed
+jobs) partitions its H host rows into R contiguous *lanes* of H/R
+hosts — each lane one tenant's scenario. The global sticky latches
+(EventQueue.overflow, Outbox.overflow, NetState.rq_overflow) stay
+authoritative, but they cannot say WHICH tenant tripped, so one lane's
+overflow would abort or escalate every tenant sharing the compiled
+program.
+
+This module makes health lane-scoped end to end, inside the jitted
+window body:
+
+- Per-host attribution planes (`overflow_h` on EventQueue/Outbox,
+  `rq_overflow_h` on NetState) ride every latch bump site, invariant
+  scalar == sum(plane).
+- A LaneHealth struct (Sim.lanes) carries [R]-shaped latch planes —
+  overflow / stall / time-regression / injection-drop counters — plus
+  a lane quarantine mask.
+- window_update() runs at every window barrier (core/engine.py
+  step_window, after the route): it reduces the host planes per lane,
+  trips sick lanes, and FREEZES a quarantined lane's hosts — their
+  pending events are flushed (counted in `flushed`, never silently)
+  so they pop nothing, stage nothing, and stop holding the global
+  min-time advance back, while healthy lanes run to completion.
+
+Opt-in contract (same as Sim.telem / Sim.inject): every new field
+defaults to None and contributes no pytree leaves, so programs and
+checkpoints built without lane isolation are byte-identical; attach()
+is the explicit opt-in. Lane blocks are contiguous in host-index
+order (lane of host h = h // (H/R)), matching the replica blocks
+apps/phold.py peer_base/peer_span carve out — single-controller,
+single-shard programs only (the fleet's packed jobs run shards=1).
+
+Host-side consumers: faults/health.py gathers the per-lane report and
+treats lane-CONTAINED capacity trips as non-fatal; faults/supervisor.py
+performs checkpoint lane surgery (faults/escalate.py extract_lane) and
+hands the sick lane to the fleet for requeue with salvage artifacts.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+from shadow_tpu.core import simtime
+
+I32 = jnp.int32
+I64 = jnp.int64
+
+# Trip-bit vocabulary (LaneHealth.trip_bits; mirrored by
+# faults/health.py diagnostics and the manifest "lanes" block).
+TRIP_EVENTS = 1    # EventQueue row overflow inside the lane
+TRIP_OUTBOX = 2    # Outbox overflow from one of the lane's hosts
+TRIP_RQ = 4        # router-ring overflow inside the lane
+TRIP_STALL = 8     # lane min-time pinned for >= stall_limit windows
+TRIP_REGRESS = 16  # lane pending time behind the window barrier
+
+TRIP_NAMES = {
+    TRIP_EVENTS: "events_overflow",
+    TRIP_OUTBOX: "outbox_overflow",
+    TRIP_RQ: "rq_overflow",
+    TRIP_STALL: "stall",
+    TRIP_REGRESS: "time_regression",
+}
+
+
+def trip_names(bits: int) -> list:
+    """Human-readable names of the set trip bits."""
+    return [n for b, n in sorted(TRIP_NAMES.items()) if int(bits) & b]
+
+
+@struct.dataclass
+class LaneHealth:
+    """[R]-shaped per-lane latch planes + quarantine mask.
+
+    The overflow planes are cumulative SNAPSHOTS (re-reduced from the
+    per-host planes at each barrier, not deltas), so they equal the
+    lane share of the scalar latches at every window boundary."""
+
+    overflow_events: jax.Array   # [R] i32 lane share of events.overflow
+    overflow_outbox: jax.Array   # [R] i32 lane share of outbox.overflow
+    overflow_rq: jax.Array       # [R] i32 lane share of net.rq_overflow
+    inj_dropped: jax.Array       # [R] i64 injected-event drops (warning)
+    stall_streak: jax.Array      # [R] i32 consecutive no-progress windows
+    regress: jax.Array           # [R] i32 windows with pending < barrier
+    prev_min: jax.Array          # [R] i64 lane min pending at last barrier
+    quarantined: jax.Array       # [R] bool sticky quarantine mask
+    quarantined_at: jax.Array    # [R] i64 barrier time of the trip
+    trip_bits: jax.Array         # [R] i32 OR of TRIP_* causes
+    flushed: jax.Array           # [R] i64 events flushed from frozen rows
+    # Windows a lane may sit with an unchanged min pending time before
+    # the stall latch trips; 0 disables the stall trip (host-side
+    # zero-streak supervision still applies globally).
+    stall_limit: int = struct.field(pytree_node=False, default=0)
+
+    @property
+    def replicas(self) -> int:
+        return self.quarantined.shape[0]
+
+    @staticmethod
+    def create(replicas: int, stall_limit: int = 0) -> "LaneHealth":
+        R = int(replicas)
+        return LaneHealth(
+            overflow_events=jnp.zeros((R,), I32),
+            overflow_outbox=jnp.zeros((R,), I32),
+            overflow_rq=jnp.zeros((R,), I32),
+            inj_dropped=jnp.zeros((R,), I64),
+            stall_streak=jnp.zeros((R,), I32),
+            regress=jnp.zeros((R,), I32),
+            prev_min=jnp.full((R,), simtime.INVALID, simtime.DTYPE),
+            quarantined=jnp.zeros((R,), bool),
+            quarantined_at=jnp.full((R,), simtime.INVALID, simtime.DTYPE),
+            trip_bits=jnp.zeros((R,), I32),
+            flushed=jnp.zeros((R,), I64),
+            stall_limit=int(stall_limit),
+        )
+
+
+def lane_sum(x: jax.Array, replicas: int) -> jax.Array:
+    """Reduce an [H]-leading plane to [R] lane totals (contiguous lane
+    blocks). Bool inputs are counted."""
+    R = int(replicas)
+    if x.dtype == jnp.bool_:
+        x = x.astype(I32)
+    return jnp.sum(x.reshape(R, -1, *x.shape[1:]), axis=1, dtype=x.dtype)
+
+
+def lane_min(x: jax.Array, replicas: int) -> jax.Array:
+    """[H] -> [R] per-lane minimum (contiguous lane blocks)."""
+    return jnp.min(x.reshape(int(replicas), -1), axis=1)
+
+
+def host_mask(lane_mask: jax.Array, num_hosts: int) -> jax.Array:
+    """[R] bool lane mask -> [H] bool host mask."""
+    R = lane_mask.shape[0]
+    return jnp.repeat(lane_mask, num_hosts // R)
+
+
+def lane_of_host(h, num_hosts: int, replicas: int):
+    """Lane index of host row h (int or array)."""
+    return h // (num_hosts // int(replicas))
+
+
+def attach(sim, replicas: int, stall_limit: int = 0):
+    """Opt into lane-isolated health: attach the per-host attribution
+    planes and the LaneHealth struct. H must divide evenly into R
+    contiguous lane blocks (the replica layout apps/phold.py packs)."""
+    R = int(replicas)
+    H = sim.events.num_hosts
+    if R < 1 or H % R != 0:
+        raise ValueError(
+            f"lane isolation needs num_hosts % replicas == 0, got "
+            f"H={H} R={R}")
+    sim = sim.replace(
+        events=sim.events.replace(overflow_h=jnp.zeros((H,), I32)),
+        outbox=sim.outbox.replace(overflow_h=jnp.zeros((H,), I32)),
+        net=sim.net.replace(rq_overflow_h=jnp.zeros((H,), I32)),
+        lanes=LaneHealth.create(R, stall_limit),
+    )
+    return sim
+
+
+def window_update(sim, wend):
+    """The per-window lane barrier (runs inside the jitted window body,
+    after route_fn delivered the outbox): reduce the per-host latch
+    planes to [R], trip sick lanes, and freeze quarantined lanes by
+    flushing their pending events (counted per lane in `flushed`).
+
+    Freezing at the barrier is exact containment: inserts are per-row
+    independent, so a sick lane's overflow never perturbs another
+    lane's rows, and flushing removes the lane from the global
+    min-time advance so healthy lanes keep running to completion."""
+    lanes = sim.lanes
+    R = lanes.replicas
+    H = sim.events.num_hosts
+    wend = jnp.asarray(wend, simtime.DTYPE)
+
+    ev = lane_sum(sim.events.overflow_h, R)
+    ob = lane_sum(sim.outbox.overflow_h, R)
+    rq = lane_sum(sim.net.rq_overflow_h, R)
+
+    lmin = lane_min(sim.events.min_time(), R)          # [R] i64
+    active = lmin != simtime.INVALID
+    # stall: the lane's earliest pending time survived a whole window
+    # unchanged (first barrier never matches: prev_min is INVALID,
+    # an active lane's min is < INVALID)
+    stalled = active & (lmin == lanes.prev_min)
+    streak = jnp.where(stalled, lanes.stall_streak + 1, 0)
+    # time regression: pending work behind the barrier after the
+    # fixpoint drained everything < wend — the conservative-order
+    # safety latch, per lane
+    regressed = active & (lmin < wend)
+    regress = lanes.regress + regressed.astype(I32)
+
+    trip = (jnp.where(ev > 0, TRIP_EVENTS, 0)
+            | jnp.where(ob > 0, TRIP_OUTBOX, 0)
+            | jnp.where(rq > 0, TRIP_RQ, 0)
+            | jnp.where(regressed, TRIP_REGRESS, 0)).astype(I32)
+    if lanes.stall_limit > 0:
+        trip = trip | jnp.where(
+            streak >= lanes.stall_limit, TRIP_STALL, 0).astype(I32)
+
+    tripped = trip != 0
+    newly = tripped & ~lanes.quarantined
+    quarantined = lanes.quarantined | tripped
+    quarantined_at = jnp.where(newly, wend, lanes.quarantined_at)
+    trip_bits = lanes.trip_bits | trip
+
+    # freeze: flush every quarantined lane's pending events (cross-lane
+    # traffic routed into a frozen lane this window included), counted
+    mask_h = host_mask(quarantined, H)                 # [H] bool
+    to_flush = sim.events.valid() & mask_h[:, None]    # [H, K]
+    flushed = lanes.flushed + lane_sum(
+        jnp.sum(to_flush, axis=1, dtype=I64), R)
+    q = sim.events.replace(
+        time=jnp.where(to_flush, simtime.INVALID, sim.events.time))
+
+    lanes = lanes.replace(
+        overflow_events=ev, overflow_outbox=ob, overflow_rq=rq,
+        stall_streak=streak, regress=regress,
+        prev_min=jnp.where(quarantined, simtime.INVALID, lmin),
+        quarantined=quarantined, quarantined_at=quarantined_at,
+        trip_bits=trip_bits, flushed=flushed)
+    return sim.replace(events=q, lanes=lanes)
+
+
+def lane_events_exec(sim) -> jax.Array:
+    """[R] i64 cumulative executed-event count per lane (lane share of
+    net.ctr_events_exec) — the telemetry ring's per-lane plane basis."""
+    return lane_sum(sim.net.ctr_events_exec, sim.lanes.replicas)
+
+
+def lane_report(sim) -> list:
+    """Host-side: one dict per lane for the manifest "lanes" block.
+    Values are pulled once per call — call between device steps."""
+    import numpy as np
+
+    lanes = sim.lanes
+    R = lanes.replicas
+    ev = np.asarray(lanes.overflow_events)
+    ob = np.asarray(lanes.overflow_outbox)
+    rq = np.asarray(lanes.overflow_rq)
+    inj = np.asarray(lanes.inj_dropped)
+    stall = np.asarray(lanes.stall_streak)
+    reg = np.asarray(lanes.regress)
+    quar = np.asarray(lanes.quarantined)
+    qat = np.asarray(lanes.quarantined_at)
+    bits = np.asarray(lanes.trip_bits)
+    flushed = np.asarray(lanes.flushed)
+    exec_ = np.asarray(lane_events_exec(sim))
+    out = []
+    for r in range(R):
+        d = {
+            "lane": r,
+            "events_overflow": int(ev[r]),
+            "outbox_overflow": int(ob[r]),
+            "rq_overflow": int(rq[r]),
+            "inj_dropped": int(inj[r]),
+            "stall_streak": int(stall[r]),
+            "time_regression": int(reg[r]),
+            "events_exec": int(exec_[r]),
+            "quarantined": bool(quar[r]),
+            "flushed": int(flushed[r]),
+        }
+        if bool(quar[r]):
+            d["quarantined_at_ns"] = int(qat[r])
+            d["trip_bits"] = int(bits[r])
+            d["trip"] = trip_names(int(bits[r]))
+        out.append(d)
+    return out

@@ -68,7 +68,8 @@ F32 = jnp.float32
 # down (static config fields are equal to boot, so restoring them is
 # the identity).
 _CRASH_KEEP = frozenset(REPLICATED_FIELDS) | {
-    "lane_id", "rng_keys", "rng_ctr", "rq_overflow", "last_drop_status",
+    "lane_id", "rng_keys", "rng_ctr", "rq_overflow", "rq_overflow_h",
+    "last_drop_status",
 }
 
 
@@ -182,7 +183,9 @@ def make_fault_fn(plan: FaultPlan, boot_sim):
         a_c = jnp.asarray(plan.a)
         boot_net = {
             f.name: jnp.asarray(getattr(boot_sim.net, f.name))
-            for f in dataclasses.fields(NetState) if not _crash_keep(f.name)
+            for f in dataclasses.fields(NetState)
+            if not _crash_keep(f.name)
+            and getattr(boot_sim.net, f.name) is not None
         }
         boot_app = jax.tree.map(jnp.asarray, boot_sim.app)
         boot_tcp = jax.tree.map(jnp.asarray, boot_sim.tcp)

@@ -115,6 +115,99 @@ def check(samples) -> list[str]:
     return errors
 
 
+@dataclasses.dataclass(frozen=True)
+class LaneWindowSample:
+    """The ledger at one window barrier, split per lane (lane-isolated
+    packed runs, core/lanes.py). Packed ensembles carry no cross-lane
+    traffic (each lane is an independent replica; apps/phold.py keeps
+    peers inside the replica block), so every term of the global
+    ledger decomposes by contiguous lane block — plus one new term:
+    `flushed`, the quarantine freeze's loudly-discarded pending events
+    (they carried identities, so they stay on the books)."""
+
+    wstart: int
+    wend: int
+    pushed: tuple      # [R] lane sums of next_seq
+    processed: tuple   # [R] lane shares of ctr_events_exec (cumulative)
+    queued: tuple      # [R] lane sums of fill_count
+    outboxed: tuple    # [R] lane sums of outbox.count
+    drops: tuple       # [R] lane shares of events+outbox overflow
+    flushed: tuple     # [R] quarantine-flush counts (lanes.flushed)
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+def lane_sample(sim, *, wstart: int, wend: int) -> LaneWindowSample:
+    """Read the per-lane ledger off a lane-isolated sim at a window
+    barrier. Requires the attribution planes (core.lanes.attach) —
+    drops cannot be attributed per lane from the scalars alone."""
+    lanes = sim.lanes
+    R = lanes.replicas
+    q = sim.events
+
+    def ls(x):
+        return tuple(int(v) for v in
+                     np.asarray(x, dtype=np.int64).reshape(R, -1).sum(1))
+
+    ev_h = np.asarray(q.overflow_h, np.int64)
+    ob_h = np.asarray(sim.outbox.overflow_h, np.int64)
+    return LaneWindowSample(
+        wstart=int(wstart), wend=int(wend),
+        pushed=ls(q.next_seq),
+        processed=ls(sim.net.ctr_events_exec),
+        queued=ls(q.fill_count()),
+        outboxed=ls(sim.outbox.count),
+        drops=tuple(int(a + b) for a, b in
+                    zip(ev_h.reshape(R, -1).sum(1),
+                        ob_h.reshape(R, -1).sum(1))),
+        flushed=tuple(int(v) for v in np.asarray(lanes.flushed)),
+    )
+
+
+def lane_check(samples) -> list[str]:
+    """Validate a per-lane sample sequence: the global check()'s
+    conservation rules applied to every lane independently, with the
+    flushed term on the accounted side. A healthy lane must stay EXACT
+    even while a neighbor lane overflows and is quarantined — that is
+    the blast-radius containment oracle."""
+    errors: list[str] = []
+    prev = None
+    for i, s in enumerate(samples):
+        where = f"window[{i}] (wstart={s.wstart})"
+        R = len(s.pushed)
+        for r in range(R):
+            lw = f"{where} lane[{r}]"
+            if prev is not None and s.pushed[r] < prev.pushed[r]:
+                errors.append(
+                    f"{lw}: pushed count went backwards "
+                    f"({prev.pushed[r]} -> {s.pushed[r]})")
+            if prev is not None and s.processed[r] < prev.processed[r]:
+                errors.append(
+                    f"{lw}: processed count went backwards "
+                    f"({prev.processed[r]} -> {s.processed[r]})")
+            accounted = (s.processed[r] + s.queued[r] + s.outboxed[r]
+                         + s.flushed[r])
+            if s.drops[r] == 0:
+                if s.pushed[r] != accounted:
+                    errors.append(
+                        f"{lw}: conservation violated — pushed="
+                        f"{s.pushed[r]} != processed={s.processed[r]} "
+                        f"+ queued={s.queued[r]} + outboxed="
+                        f"{s.outboxed[r]} + flushed={s.flushed[r]}")
+            else:
+                # same degradation as check(): drops mix seq-carrying
+                # and seq-less losses, so bounds only
+                if not (accounted <= s.pushed[r]
+                        <= accounted + s.drops[r]):
+                    errors.append(
+                        f"{lw}: pushed={s.pushed[r]} outside "
+                        f"[{accounted}, {accounted + s.drops[r]}] "
+                        f"(drops={s.drops[r]})")
+        prev = s
+    return errors
+
+
 def stitch(before: list, after: list, resume_time: int) -> list:
     """Splice sample sequences across a kill/heal boundary: the resumed
     attempt replays from its checkpoint, so `before` samples at or

@@ -119,6 +119,83 @@ def plan_growth(health, capacities: dict, policy: EscalationPolicy,
     return overrides, events
 
 
+# LaneHealth trip bit (core/lanes.py TRIP_*) -> the capacity knob a
+# lane-local regrow doubles when the fleet requeues the lane as a
+# standalone job. Stall/regression bits map to no knob (not healable
+# by growing anything — the requeue retries at the same shapes).
+TRIP_BIT_KNOBS = {
+    1: "event_capacity",   # TRIP_EVENTS
+    2: "outbox_capacity",  # TRIP_OUTBOX
+    4: "router_ring",      # TRIP_RQ
+}
+
+
+def plan_lane_regrow(trip_bits: int, capacities: dict,
+                     factor: int = 2) -> dict:
+    """Capacity overrides for requeuing a quarantined lane as its own
+    job: every capacity knob named by the lane's trip bits, doubled —
+    the lane-local analog of plan_growth, without the shared program's
+    grow budget (the requeued job budgets its own attempts)."""
+    overrides = {}
+    for bit, knob in TRIP_BIT_KNOBS.items():
+        if int(trip_bits) & bit:
+            overrides[knob] = int(capacities[knob]) * int(factor)
+    return overrides
+
+
+def extract_lane(leaves: dict, meta: dict, lane: int,
+                 replicas: int) -> tuple[dict, dict]:
+    """Checkpoint lane surgery: slice one lane's share out of a packed
+    snapshot's leaves (utils.checkpoint.load_leaves format).
+
+    Every leaf with a leading host axis is cut to the lane's
+    contiguous host block; [R]-shaped lane-health planes (".lanes.")
+    are cut to the lane's entry; replicated whole-sim state (telem /
+    inject planes, [V,V] tables, scalars) rides along whole.
+
+    The result is a salvage ARTIFACT: post-mortem evidence plus the
+    requeue context the fleet needs (what tripped, at which time, at
+    what shapes). It is NOT a bit-resumable standalone checkpoint —
+    per-host identity state (rng keys, IPs, lane_id) is seeded by
+    global host index, so the requeued job re-runs the scenario fresh
+    at regrown capacities instead of resuming the slice."""
+    R = int(replicas)
+    lane = int(lane)
+    if not 0 <= lane < R:
+        raise ValueError(f"lane {lane} out of range for replicas={R}")
+    caps = dict(meta.get("capacities") or {})
+    H = caps.get("num_hosts")
+    if H is None:
+        hk = next((k for k in leaves if k.endswith(".rq_head")), None)
+        H = leaves[hk].shape[0] if hk is not None else None
+    if H is None or H % R != 0:
+        raise ValueError(
+            f"cannot slice lane {lane}/{R} out of num_hosts={H}")
+    hs = H // R
+    lo, hi = lane * hs, (lane + 1) * hs
+    out = {}
+    for key, arr in leaves.items():
+        a = np.asarray(arr)
+        if key.startswith((".telem", ".inject")):
+            out[key] = a
+        elif key.startswith(".lanes"):
+            out[key] = a[lane:lane + 1] if a.ndim else a
+        elif a.ndim and a.shape[0] == H:
+            out[key] = a[lo:hi]
+        else:
+            out[key] = a
+    caps["num_hosts"] = hs
+    lane_meta = {
+        "time_ns": int(meta.get("time_ns", 0)),
+        "capacities": caps,
+        "lane": lane,
+        "replicas": R,
+        "packed_num_hosts": int(H),
+        "extra": dict(meta.get("extra") or {}),
+    }
+    return out, lane_meta
+
+
 def _fill_for(key: str):
     """Empty-slot encoding for a padded region of leaf `key`."""
     if key.endswith(".time"):

@@ -63,16 +63,36 @@ class RunHealth:
     # the feeder's horizon contract makes this impossible, so any
     # nonzero count means timestamps were perturbed (clamped up)
     inject_late: int = 0
+    # torn-tail truncation messages from the binary trace reader
+    # (inject/trace.py): the tail frame a dying writer never finished
+    # was dropped — a WARNING; everything before it was read intact
+    trace_warnings: tuple = ()
     # context for diagnostics
     window_start: Optional[int] = None   # wstart when gathered
     suspect_hosts: tuple = ()            # rows at capacity (global ids)
+    # --- lane-isolated runs (core/lanes.py) --------------------------
+    # lanes_total > 0 means the sim carried LaneHealth: `lanes` is the
+    # per-lane report (core.lanes.lane_report dicts), lanes_quarantined
+    # the tripped lane indices, and lane_contained says every capacity
+    # / regression trip is attributed to a quarantined lane — the
+    # blast radius held, so those trips DEGRADE the run (sick lanes
+    # are frozen + requeued) instead of aborting the healthy tenants.
+    lanes_total: int = 0
+    lanes: tuple = ()
+    lanes_quarantined: tuple = ()
+    lane_contained: bool = False
 
     @property
     def fatal(self) -> bool:
-        return bool(
+        cap_trip = bool(
             self.events_overflow or self.outbox_overflow
-            or self.rq_overflow or self.time_regression
-            or self.deadline_exceeded
+            or self.rq_overflow or self.time_regression)
+        if cap_trip and self.lanes_total and self.lane_contained:
+            # contained trips are survivable — unless no healthy lane
+            # remains, in which case the program serves nobody
+            cap_trip = len(self.lanes_quarantined) >= self.lanes_total
+        return bool(
+            cap_trip or self.deadline_exceeded
             or (self.stall_limit and self.stalled_windows >= self.stall_limit))
 
     def diagnostics(self) -> list:
@@ -83,22 +103,46 @@ class RunHealth:
                  if self.window_start is not None else "")
         hosts = (f" (suspect host rows at capacity: "
                  f"{list(self.suspect_hosts)})" if self.suspect_hosts else "")
+        # lane-contained capacity trips degrade instead of abort: the
+        # sick lanes are frozen + requeued, healthy lanes' results are
+        # exact — report as warnings, with per-lane attribution below
+        contained = bool(
+            self.lanes_total and self.lane_contained
+            and len(self.lanes_quarantined) < self.lanes_total)
+        cap_sev = "warning" if contained else "fatal"
+        cap_sfx = (" [contained: attributed to quarantined lane(s) "
+                   f"{list(self.lanes_quarantined)}; healthy lanes "
+                   "unaffected]" if contained else "")
         if self.events_overflow:
-            out.append(("fatal",
+            out.append((cap_sev,
                         f"event queue overflow x{self.events_overflow}"
                         f"{where}{hosts}: events were dropped — results "
                         f"are invalid; rerun with a larger "
-                        f"--event-capacity"))
+                        f"--event-capacity{cap_sfx}"))
         if self.outbox_overflow:
-            out.append(("fatal",
+            out.append((cap_sev,
                         f"outbox overflow x{self.outbox_overflow}{where}: "
                         f"cross-host sends were dropped; rerun with a "
-                        f"larger emit/exchange capacity"))
+                        f"larger emit/exchange capacity{cap_sfx}"))
         if self.rq_overflow:
-            out.append(("fatal",
+            out.append((cap_sev,
                         f"router ring overflow x{self.rq_overflow}{where}: "
                         f"upstream packets were dropped un-modelled; grow "
-                        f"the router ring (config router_ring)"))
+                        f"the router ring (config router_ring){cap_sfx}"))
+        for d in self.lanes:
+            if d.get("quarantined"):
+                out.append((
+                    "fatal" if not contained else "warning",
+                    f"lane {d['lane']} quarantined at "
+                    f"t={d.get('quarantined_at_ns')} "
+                    f"(trip={d.get('trip', [])}): {d.get('flushed', 0)} "
+                    f"pending event(s) flushed — the lane's results are "
+                    f"discarded; salvage + fleet requeue apply"))
+        if (self.lanes_total
+                and len(self.lanes_quarantined) >= self.lanes_total):
+            out.append(("fatal",
+                        f"all {self.lanes_total} lanes quarantined"
+                        f"{where}: no healthy tenant remains"))
         if self.time_regression:
             out.append(("fatal",
                         f"simulated time regressed{where}: a window "
@@ -142,6 +186,8 @@ class RunHealth:
                         f"clamped forward — the feeder's horizon "
                         f"contract was violated (file a bug); "
                         f"timestamps are perturbed, not lost"))
+        for w in self.trace_warnings:
+            out.append(("warning", w))
         return out
 
     def failure_report(self) -> dict:
@@ -159,15 +205,22 @@ class RunHealth:
             "deadline_exceeded": self.deadline_exceeded,
             "inject_dropped": self.inject_dropped,
             "inject_late": self.inject_late,
+            "trace_warnings": list(self.trace_warnings),
             "window_start": self.window_start,
             "suspect_hosts": [int(h) for h in self.suspect_hosts],
             "diagnostics": [m for _, m in self.diagnostics()],
+            **({"lanes": {
+                "replicas": self.lanes_total,
+                "quarantined": [int(r) for r in self.lanes_quarantined],
+                "contained": bool(self.lane_contained),
+                "per_lane": [dict(d) for d in self.lanes],
+            }} if self.lanes_total else {}),
         }
 
 
 def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
            time_regression=False, telemetry_lost=0,
-           max_suspects=8) -> RunHealth:
+           trace_warnings=(), max_suspects=8) -> RunHealth:
     """Pull the device latches into a RunHealth. Cheap (a handful of
     scalars plus one fill_count) — fine to call once per checkpoint
     interval and after every run."""
@@ -179,7 +232,25 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         lane = np.asarray(sim.net.lane_id)
         suspects = tuple(int(lane[h]) for h in full[:max_suspects])
     inj = getattr(sim, "inject", None)
+    lanes_total, lane_rep, quar, contained = 0, (), (), False
+    if getattr(sim, "lanes", None) is not None:
+        from shadow_tpu.core.lanes import lane_report
+
+        lane_rep = tuple(lane_report(sim))
+        lanes_total = len(lane_rep)
+        quar = tuple(d["lane"] for d in lane_rep if d["quarantined"])
+        # contained: no un-quarantined lane carries a latched trip —
+        # window_update trips at the same barrier the latch bumps, so
+        # by host-gather time this holds whenever isolation worked
+        contained = not any(
+            d["events_overflow"] or d["outbox_overflow"]
+            or d["rq_overflow"] or d["time_regression"]
+            for d in lane_rep if not d["quarantined"])
     return RunHealth(
+        lanes_total=lanes_total,
+        lanes=lane_rep,
+        lanes_quarantined=quar,
+        lane_contained=contained,
         events_overflow=ev,
         outbox_overflow=int(np.asarray(sim.outbox.overflow)),
         rq_overflow=int(np.asarray(sim.net.rq_overflow)),
@@ -191,6 +262,7 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         inject_dropped=(0 if inj is None
                         else int(np.asarray(inj.dropped))),
         inject_late=0 if inj is None else int(np.asarray(inj.late)),
+        trace_warnings=tuple(trace_warnings),
         window_start=None if window_start is None else int(window_start),
         suspect_hosts=suspects,
     )

@@ -93,6 +93,33 @@ class DeadlineExceeded(Preempted):
         self.elapsed_s = elapsed_s
 
 
+@dataclasses.dataclass(frozen=True)
+class LaneIncident:
+    """One quarantined lane, detected at a chunk barrier of a packed
+    (lane-isolated) run. Carries the blast-radius evidence plus the
+    requeue context the fleet consumes (fleet/scenario.py packed
+    jobs): which capacity knobs the trip bits say to regrow, and
+    where the lane's salvage slice landed."""
+
+    lane: int
+    time_ns: int          # window barrier the device quarantined at
+    detected_ns: int      # chunk barrier the host noticed it at
+    trip_bits: int
+    trip: tuple           # TRIP_* names (core.lanes.trip_names)
+    flushed: int          # pending events flushed when frozen
+    salvage: Optional[str] = None       # lane-surgery artifact path
+    salvaged_from: Optional[str] = None  # snapshot the slice came from
+    regrow: dict = dataclasses.field(default_factory=dict)
+
+    def as_dict(self) -> dict:
+        return {"lane": self.lane, "time_ns": self.time_ns,
+                "detected_ns": self.detected_ns,
+                "trip_bits": self.trip_bits, "trip": list(self.trip),
+                "flushed": self.flushed, "salvage": self.salvage,
+                "salvaged_from": self.salvaged_from,
+                "regrow": dict(self.regrow)}
+
+
 @dataclasses.dataclass
 class SupervisorResult:
     ok: bool
@@ -119,6 +146,9 @@ class SupervisorResult:
     # tools/telemetry_lint.py checks when a manifest embeds the list.
     dispatches: int = 0
     dispatch_windows: tuple = ()
+    # Lane-isolated runs: every lane quarantined across the chain,
+    # with salvage pointers — the fleet's requeue feed.
+    lane_incidents: tuple = ()
 
     def failure_report(self) -> dict:
         rep = self.health.failure_report() if self.health is not None \
@@ -129,6 +159,9 @@ class SupervisorResult:
         rep["escalation_restarts"] = self.escalation_restarts
         if self.escalations:
             rep["escalations"] = [e.as_dict() for e in self.escalations]
+        if self.lane_incidents:
+            rep["lane_incidents"] = [i.as_dict()
+                                     for i in self.lane_incidents]
         if self.preempted:
             rep["verdict"] = "preempted"
             rep["final_checkpoint"] = self.final_checkpoint
@@ -165,6 +198,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    windows_per_dispatch: int | None = None,
                    adaptive_jump: bool | None = None,
                    feeder=None,
+                   on_lane_quarantine=None,
                    ) -> SupervisorResult:
     """Run bundle to end_time under supervision (host-driven window
     loop; serial by default, shard_map'd over `mesh` when given — the
@@ -190,6 +224,15 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     round barrier — the chaos harness samples its conservation ledger
     there. `log` is a callable taking one message string; `sleep` is
     injectable for tests.
+
+    Lane-isolated runs (core/lanes.py attached): a CONTAINED lane
+    quarantine is not fatal (faults/health.py), so the run keeps going
+    while the supervisor performs checkpoint lane surgery at the
+    detecting barrier — the sick lane's slice is cut out of the last
+    clean snapshot (faults/escalate.py extract_lane) and written as a
+    salvage artifact next to the checkpoints; `on_lane_quarantine`
+    (callable taking one LaneIncident) fires once per lane, chain-wide
+    — the fleet's requeue hook.
 
     `windows_per_dispatch` / `adaptive_jump` (default: the bundle
     cfg's knobs) select the chunked window loop
@@ -225,6 +268,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     resumed_from = None
     resume_of = None
     base_stats = {}                    # chain totals at the resume point
+    lane_incidents: list = []          # chain-wide, one per lane
+    lanes_seen: set = set()            # lanes already surgeried
 
     if resume_from is not None:
         leaves, meta = ckpt.load_leaves(resume_from)
@@ -245,6 +290,52 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                            "fastpath_hit", "fastpath_miss")}
         return {"stats": stats, "run_id": run_id,
                 "escalations": [e.as_dict() for e in escalations]}
+
+    def _lane_surgery(h, detected_ns):
+        """Record newly quarantined lanes (once per lane, chain-wide)
+        and cut each lane's slice out of the last clean snapshot —
+        every snapshot predates the trip (health precedes every save),
+        so the salvage is the lane's best pre-corruption evidence."""
+        if not h.lanes_total:
+            return
+        caps = ckpt.capacities_of_sim(bundle.sim)
+        for d in h.lanes:
+            if not d.get("quarantined") or d["lane"] in lanes_seen:
+                continue
+            lanes_seen.add(d["lane"])
+            bits = int(d.get("trip_bits", 0))
+            salvage, src = None, None
+            if total_saved:
+                src = total_saved[-1][0]
+                try:
+                    leaves, meta = ckpt.load_leaves(src)
+                    ll, lm = escalate_mod.extract_lane(
+                        leaves, meta, d["lane"], h.lanes_total)
+                    lm["trip_bits"] = bits
+                    lm["trip"] = list(d.get("trip", []))
+                    lm["quarantined_at_ns"] = d.get("quarantined_at_ns")
+                    salvage = ckpt.save_salvage(
+                        f"{checkpoint_path}.lane{d['lane']}.salvage",
+                        ll, lm)
+                except (OSError, ValueError, KeyError) as e:
+                    say(f"supervisor: lane {d['lane']} salvage "
+                        f"failed: {e}")
+            inc = LaneIncident(
+                lane=int(d["lane"]),
+                time_ns=int(d.get("quarantined_at_ns") or 0),
+                detected_ns=int(detected_ns), trip_bits=bits,
+                trip=tuple(d.get("trip", ())),
+                flushed=int(d.get("flushed", 0)),
+                salvage=salvage, salvaged_from=src,
+                regrow=escalate_mod.plan_lane_regrow(bits, caps))
+            lane_incidents.append(inc)
+            say(f"supervisor: lane {inc.lane} quarantined at "
+                f"t={inc.time_ns} (trip={list(inc.trip)}), "
+                f"{inc.flushed} event(s) flushed"
+                + (f"; salvage {salvage}" if salvage
+                   else "; no snapshot to salvage"))
+            if on_lane_quarantine is not None:
+                on_lane_quarantine(inc)
 
     while True:
         attempt += 1
@@ -276,6 +367,9 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             if harvester is not None:
                 harvester.drain(sim)
             h = _gather(sim)
+            # Lane surgery BEFORE the fatal check: even the
+            # all-lanes-quarantined abort should leave salvage behind.
+            _lane_surgery(h, wend)
             if h.fatal:
                 # before the user hooks on purpose: a tripped round's
                 # state is corrupt and will be replayed after the heal
@@ -334,6 +428,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 time_regression=tele["regressed"],
                 telemetry_lost=(harvester.records_lost
                                 if harvester is not None else 0),
+                trace_warnings=tuple(
+                    getattr(feeder, "warnings", ()) or ()),
             )
 
         def _result(ok, sim, h, **kw):
@@ -346,7 +442,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 escalations=tuple(escalations),
                 run_id=run_id, resume_of=resume_of,
                 dispatches=len(tele["dispatch_windows"]),
-                dispatch_windows=tuple(tele["dispatch_windows"]), **kw)
+                dispatch_windows=tuple(tele["dispatch_windows"]),
+                lane_incidents=tuple(lane_incidents), **kw)
 
         from shadow_tpu.core.engine import EngineStats
 
@@ -369,6 +466,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             if harvester is not None:
                 harvester.drain(sim)
             h = _gather(sim)
+            _lane_surgery(h, tele["wstart"] or 0)
             if h.fatal:
                 raise LatchTrip(h, sim)
             return _result(True, sim, h, stats=stats)
@@ -412,7 +510,19 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                         harvester.mark_escalation(ev)
                 old_telem = getattr(bundle.sim, "telem", None)
                 old_inject = getattr(bundle.sim, "inject", None)
+                old_lanes = getattr(bundle.sim, "lanes", None)
                 bundle = rebuild_fn(grow)
+                if old_lanes is not None:
+                    # re-attach lane isolation at the grown shapes
+                    # FIRST (the telemetry ring sizes its per-lane
+                    # planes off sim.lanes) so the transplant finds
+                    # matching .lanes / overflow-plane leaves and
+                    # containment survives the heal
+                    from shadow_tpu.core import lanes as lanes_mod
+
+                    bundle.sim = lanes_mod.attach(
+                        bundle.sim, old_lanes.replicas,
+                        stall_limit=old_lanes.stall_limit)
                 if old_telem is not None:
                     from shadow_tpu.telemetry.ring import attach
 

@@ -43,6 +43,15 @@ def _job_entry(queue, j) -> dict:
         "failure": j.failure,
         "quarantine_reason": j.quarantine_reason,
     }
+    if getattr(j.spec, "replicas", 1) > 1:
+        # packed job: surface the per-lane verdicts + requeue children
+        # at the entry level so the lint (and the operator) need not
+        # dig through result — lane children carry lane_of back-links
+        entry["replicas"] = int(j.spec.replicas)
+        if j.result and j.result.get("lanes"):
+            entry["lanes"] = j.result["lanes"]
+    if getattr(j.spec, "lane_of", None):
+        entry["lane_of"] = j.spec.lane_of
     run_man = os.path.join(queue.job_dir(jid), "run_manifest.json")
     if os.path.isfile(run_man):
         entry["run_manifest"] = os.path.join(rel, "run_manifest.json")

@@ -205,6 +205,7 @@ class FleetRunner:
         if result.get("ok"):
             self.queue.complete(job, result)
             self._emit("done", job=job)
+            self._backfill_lanes(job, result)
         elif result.get("preempted") and not result.get("deadline"):
             # graceful drain: the run snapshotted and yielded — park it
             # back in the queue as a continuation of the same attempt
@@ -230,6 +231,25 @@ class FleetRunner:
                                          failure.get("verdict")))
         if j.terminal:
             self.write_manifest()
+
+    def _backfill_lanes(self, job: str, result: dict) -> None:
+        """A completed packed job may carry lane-requeue specs for
+        its quarantined lanes (fleet/scenario.py): enqueue each as a
+        standalone child job — the freed lane slots backfill into the
+        normal scheduler, with the usual attempt/backoff/quarantine
+        accounting applying to the children."""
+        from shadow_tpu.fleet.spec import JobSpec
+
+        for child in (result.get("lanes") or {}).get("requeues", []):
+            try:
+                spec = JobSpec.from_dict(child)
+            except (ValueError, TypeError) as e:
+                self._emit("lane_requeue_rejected", job=job,
+                           error=str(e))
+                continue
+            if self.queue.add_job(spec):
+                self._emit("lane_requeued", job=job, child=spec.id,
+                           lane_of=spec.lane_of)
 
     def _poll(self, timeout: float) -> None:
         conns = {w["conn"]: wid for wid, w in self.workers.items()}

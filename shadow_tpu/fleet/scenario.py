@@ -88,7 +88,13 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
             lanes = lanes_for(sum(1 for _ in
                                   read_trace(spec.inject_trace)))
-    cfg = NetConfig(num_hosts=spec.hosts, tcp=False,
+    # packed job: R lane copies of the scenario in one program —
+    # `hosts` is per-lane, the build carries hosts*replicas rows with
+    # contiguous lane blocks (apps/phold.py replica_size) and lane-
+    # isolated health attached below
+    R = max(1, int(getattr(spec, "replicas", 1)))
+    H = spec.hosts * R
+    cfg = NetConfig(num_hosts=H, tcp=False,
                     end_time=spec.sim_s * simtime.ONE_SECOND,
                     seed=spec.seed,
                     event_capacity=caps["event_capacity"],
@@ -97,9 +103,14 @@ def _build_scenario(spec: JobSpec, caps: dict):
                     in_ring=max(8, 2 * spec.load),
                     inject_lanes=lanes)
     hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
-             for i in range(spec.hosts)]
+             for i in range(H)]
     b = build(cfg, graph, hosts)
-    b.sim = phold.setup(b.sim, load=spec.load)
+    b.sim = phold.setup(b.sim, load=spec.load,
+                        replica_size=spec.hosts if R > 1 else None)
+    if R > 1:
+        from shadow_tpu.core import lanes as lanes_mod
+
+        b.sim = lanes_mod.attach(b.sim, R)
     if spec.faults:
         from shadow_tpu.faults.plan import records_from_json
 
@@ -180,9 +191,29 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         "final_capacities": dict(caps),
         "checkpoint": res.final_checkpoint,
     }
+    incidents = tuple(getattr(res, "lane_incidents", ()) or ())
+    if incidents:
+        # packed job: each quarantined lane becomes a standalone
+        # replicas=1 requeue spec at the regrown capacities its trip
+        # bits name — the runner backfills these into the queue
+        requeues = []
+        for inc in incidents:
+            child = spec.as_dict()
+            child.update({"id": f"{spec.id}.lane{inc.lane}",
+                          "replicas": 1, "lane_of": spec.id})
+            for knob, val in (inc.regrow or {}).items():
+                child[knob] = max(int(child.get(knob) or 0), int(val))
+            requeues.append(child)
+        result["lanes"] = {
+            "replicas": int(getattr(spec, "replicas", 1)),
+            "quarantined": [int(i.lane) for i in incidents],
+            "incidents": [i.as_dict() for i in incidents],
+            "requeues": requeues,
+        }
     if res.sim is not None:
         bundle = built["b"]
         from shadow_tpu import inject as inject_mod
+        from shadow_tpu.telemetry.export import lanes_manifest_block
 
         man = telemetry.run_manifest(
             cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
@@ -191,7 +222,8 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             run_id=res.run_id, resume_of=res.resume_of,
             escalations=res.escalations,
             preempted=res.preempted or None,
-            injection=inject_mod.manifest_block(res.sim, feeder))
+            injection=inject_mod.manifest_block(res.sim, feeder),
+            lanes=lanes_manifest_block(res.health, incidents))
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
         result["counters"] = man["counters"]

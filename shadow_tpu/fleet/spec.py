@@ -111,6 +111,16 @@ class JobSpec:
     # bit-identity contract.
     inject_trace: Optional[str] = None
     inject_lanes: Optional[int] = None
+    # Packed ensemble job (docs/8-fleet.md §packed jobs): replicas > 1
+    # runs R copies of the scenario in ONE compiled program — `hosts`
+    # is the per-lane host count, the program carries hosts*replicas
+    # rows — with lane-isolated health (core/lanes.py) attached. A
+    # lane that trips is quarantined on device (healthy lanes finish
+    # bit-identically), salvaged from the last clean checkpoint, and
+    # requeued by the fleet as a standalone replicas=1 job at regrown
+    # capacities; `lane_of` records that provenance on the child.
+    replicas: int = 1
+    lane_of: Optional[str] = None  # parent packed-job id (requeues)
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -132,6 +142,15 @@ class JobSpec:
         if self.inject_trace is not None and self.kind != "scenario":
             raise ValueError(f"job {self.id}: inject_trace only "
                              f"applies to kind 'scenario'")
+        if int(self.replicas) < 1:
+            raise ValueError(f"job {self.id}: replicas must be >= 1")
+        if self.replicas > 1 and self.kind != "scenario":
+            raise ValueError(f"job {self.id}: packed jobs (replicas > "
+                             f"1) only apply to kind 'scenario'")
+        if self.replicas > 1 and self.inject_trace is not None:
+            raise ValueError(
+                f"job {self.id}: inject_trace addresses a single "
+                f"scenario's host ids — packed jobs can't stream it")
         if self.inject_lanes is not None:
             n = int(self.inject_lanes)
             if n <= 0 or n & (n - 1):

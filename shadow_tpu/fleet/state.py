@@ -175,6 +175,18 @@ class FleetQueue:
         self.record({"ev": "job_added", "job": spec.id,
                      "spec_digest": spec.digest()})
 
+    def add_job(self, spec: JobSpec) -> bool:
+        """Backfill a job mid-run (the packed-job lane-requeue path):
+        journal a job_added frame and write its spec dir, exactly like
+        boot-time enqueue. Idempotent by id — on --resume the spec-dir
+        scan restores the child spec and the replayed journal keeps
+        its state, so a crash between requeue and lease loses
+        nothing."""
+        if spec.id in self.jobs:
+            return False
+        self._add_job(spec)
+        return True
+
     # -- journal fold -------------------------------------------------
     def record(self, rec: dict) -> dict:
         rec.setdefault("t", round(self.now(), 3))

@@ -57,9 +57,13 @@ class Feeder:
 
     def __init__(self, source: Union[str, os.PathLike, Iterable[dict],
                                      Iterator[dict]]):
+        # torn-tail truncation warnings from the binary trace reader
+        # (trace.py) — surfaced through stats() into the manifest's
+        # injection block and health diagnostics
+        self.warnings: list = []
         if isinstance(source, (str, os.PathLike)):
             self.path: Optional[str] = str(source)
-            self._it = read_trace(self.path)
+            self._it = read_trace(self.path, self._warn)
             self._it_pos = 0
             self._mem = None
             self._mem_pos = 0
@@ -79,6 +83,12 @@ class Feeder:
         self.backpressure = 0     # refills that found no free lane
 
     # ---------------------------------------------------------- source
+
+    def _warn(self, msg: str) -> None:
+        # re-reads (sync/_reposition reopen the file) re-hit the same
+        # torn tail; keep one copy of each distinct warning
+        if msg not in self.warnings:
+            self.warnings.append(msg)
 
     def _read_next(self) -> Optional[dict]:
         """Next normalized event from the source, None when drained
@@ -114,7 +124,7 @@ class Feeder:
         self._buf.clear()
         if self.path is not None:
             if self._it_pos > pos:
-                self._it = read_trace(self.path)
+                self._it = read_trace(self.path, self._warn)
                 self._it_pos = 0
             while self._it_pos < pos:
                 if self._read_next() is None:
@@ -289,9 +299,12 @@ class Feeder:
 
     def stats(self) -> dict:
         """Host-side half of the manifest's injection block."""
-        return {
+        out = {
             "trace_path": self.path,
             "trace_events": self.trace_events,
             "staged_cursor": self.cursor,
             "backpressure": self.backpressure,
         }
+        if self.warnings:
+            out["trace_warnings"] = list(self.warnings)
+        return out

@@ -180,6 +180,7 @@ def merge_staged(sim, wstart, wend, lane_id=None):
     local = take & (row >= 0) & (row < H)
 
     ov0 = sim.events.overflow
+    ov0_h = sim.events.overflow_h
     q = insert_flat(
         sim.events, local, row.astype(I32), t_ins, st.kind, st.host,
         (SEQ_BASE + (st.seq % SEQ_BASE)).astype(I32), st.words)
@@ -189,6 +190,18 @@ def merge_staged(sim, wstart, wend, lane_id=None):
     # the engine state itself is not corrupt.
     drop_w = (q.overflow - ov0).astype(I64)
     q = q.replace(overflow=ov0)
+    if ov0_h is not None:
+        # mirror the scalar diversion on the per-host plane (lane
+        # isolation): the delta is this merge's per-row drops —
+        # diverted to the per-lane injection counter, restored so the
+        # plane keeps matching the scalar latch
+        drop_h = (q.overflow_h - ov0_h).astype(I64)
+        q = q.replace(overflow_h=ov0_h)
+        if getattr(sim, "lanes", None) is not None:
+            from shadow_tpu.core.lanes import lane_sum
+            sim = sim.replace(lanes=sim.lanes.replace(
+                inj_dropped=sim.lanes.inj_dropped
+                + lane_sum(drop_h, sim.lanes.replicas)))
 
     inj_w = jnp.sum(local, dtype=I64) - drop_w
     late_w = jnp.sum(late & local, dtype=I64)

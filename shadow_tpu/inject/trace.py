@@ -20,9 +20,13 @@ Two encodings, sniffed by the first two bytes:
 - binary fast path: the fleet journal's frame layout (journal.py)
   with magic b"SI" — magic(2) + u32 length + u32 crc32 + payload +
   b"\\n", payload = little-endian i64 t_ns, i32 host, i32 kind,
-  u32 word count, then the words as i32. Unlike the journal, a torn
-  or corrupt frame mid-file raises: a trace is an INPUT, not a
-  crash-recovery log, so damage is an error, never a truncation.
+  u32 word count, then the words as i32. A torn or CRC-corrupt
+  TRAILING frame — the one a dying writer never finished — is
+  truncated with a warning (the fleet journal's torn-tail policy;
+  the warning reaches the run manifest and health diagnostics via
+  the feeder). Damage anywhere BEFORE the tail still raises: a
+  mid-file bad frame followed by intact frames is corruption, not a
+  torn write, and silently skipping it would drop real events.
 
 Both readers are generators — the feeder streams chunk-sized batches
 without holding million-event traces in memory.
@@ -95,15 +99,27 @@ def _read_json(f) -> Iterator[dict]:
         yield ev
 
 
-def _read_binary(f) -> Iterator[dict]:
+def _warn_tail(on_warning, msg: str) -> None:
+    if on_warning is not None:
+        on_warning(msg)
+    else:
+        import sys
+        print(f"WARNING: {msg}", file=sys.stderr)
+
+
+def _read_binary(f, on_warning=None) -> Iterator[dict]:
     prev, pos = 0, 0
     while True:
         head = f.read(_HEADER.size)
         if not head:
             return
         if len(head) < _HEADER.size:
-            raise TraceFormatError(
-                f"trace record {pos}: truncated frame header")
+            # a short header can only be the torn tail — truncate
+            _warn_tail(on_warning,
+                       f"trace: torn trailing frame at record {pos} "
+                       f"(short header) — truncated; the writer died "
+                       f"mid-append")
+            return
         magic, length, crc = _HEADER.unpack(head)
         if magic != MAGIC:
             raise TraceFormatError(
@@ -111,9 +127,23 @@ def _read_binary(f) -> Iterator[dict]:
         payload = f.read(length)
         nl = f.read(1)
         if len(payload) < length or nl != b"\n":
-            raise TraceFormatError(
-                f"trace record {pos}: truncated frame payload")
+            # ran off the end of the file mid-frame: torn tail
+            _warn_tail(on_warning,
+                       f"trace: torn trailing frame at record {pos} "
+                       f"(short payload) — truncated; the writer "
+                       f"died mid-append")
+            return
         if zlib.crc32(payload) != crc:
+            # CRC-corrupt LAST frame is the torn-tail case (a partial
+            # overwrite the length field happened to cover); corrupt
+            # frames with intact successors are mid-file damage and
+            # still raise — truncating would drop real events
+            if not f.read(1):
+                _warn_tail(on_warning,
+                           f"trace: CRC-corrupt trailing frame at "
+                           f"record {pos} — truncated; the writer "
+                           f"died mid-append")
+                return
             raise TraceFormatError(
                 f"trace record {pos}: frame CRC mismatch")
         if len(payload) < _FIXED.size:
@@ -129,15 +159,18 @@ def _read_binary(f) -> Iterator[dict]:
         yield ev
 
 
-def read_trace(path: str) -> Iterator[dict]:
+def read_trace(path: str, on_warning=None) -> Iterator[dict]:
     """Stream normalized events from a trace file, sniffing the
     encoding from the first two bytes. Raises TraceFormatError on
-    malformed records or t_ns ordering violations."""
+    malformed records or t_ns ordering violations — except a torn /
+    CRC-corrupt TRAILING binary frame, which is truncated with a
+    warning (delivered to `on_warning(msg)` when given, stderr
+    otherwise; the Feeder routes it into health diagnostics)."""
     with open(path, "rb") as f:
         head = f.read(2)
         f.seek(0)
         if head == MAGIC:
-            yield from _read_binary(f)
+            yield from _read_binary(f, on_warning)
         else:
             import io
             yield from _read_json(io.TextIOWrapper(f, "utf-8"))

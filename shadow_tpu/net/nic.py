@@ -261,6 +261,9 @@ def handle_nic_recv(cfg: NetConfig, sim, popped, buf):
         rq_count=net.rq_count + aok.astype(I32),
         rq_bytes=net.rq_bytes + jnp.where(aok, awl, 0).astype(I64),
         rq_overflow=net.rq_overflow + jnp.sum(arr & ~aok & ~qdrop, dtype=I32),
+        **({"rq_overflow_h": net.rq_overflow_h
+            + (arr & ~aok & ~qdrop).astype(I32)}
+           if net.rq_overflow_h is not None else {}),
         ctr_drop_codel=net.ctr_drop_codel + qdrop.astype(I64),
         last_drop_status=jnp.where(
             qdrop, popped.words[:, pf.W_STATUS] | pf.PDS_ROUTER_DROPPED,

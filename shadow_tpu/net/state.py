@@ -402,6 +402,12 @@ class NetState:
     cap_meta: jax.Array          # [H,C] i32: src_host | dir<<24 (1=in)
     cap_count: jax.Array         # [H] i32 monotonic
     rq_overflow: jax.Array       # [] i32 router ring overflow (grow R!)
+    # Optional per-host attribution plane for rq_overflow ([H] i32),
+    # attached by core/lanes.attach for lane-isolated ensemble runs —
+    # None (the default) contributes no pytree leaves, so checkpoints
+    # and compiled programs without lane isolation are byte-identical.
+    # Invariant when attached: rq_overflow == sum(rq_overflow_h).
+    rq_overflow_h: Any = None
 
 
 @struct.dataclass
@@ -422,6 +428,10 @@ class Sim:
     # on — same None-contributes-no-leaves contract as telem;
     # inject.attach() / NetConfig.inject_lanes is the opt-in.
     inject: Any = None
+    # LaneHealth (core/lanes.py) when lane-isolated health latches are
+    # on for packed ensemble runs — same None-contributes-no-leaves
+    # contract; core.lanes.attach() is the opt-in.
+    lanes: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

@@ -80,7 +80,11 @@ def sim_specs(sim, axis: str):
         # fall through to P(axis). The injection staging buffer is
         # replicated the same way: every shard sees every staged
         # event and merges only the rows it owns (inject/staging.py).
-        if names and names[0] in ("telem", "inject"):
+        # The lane-health latches (core/lanes.py) are [R] lane planes,
+        # also not host rows — but their window_update reduces
+        # shard-LOCAL host planes, so lane isolation is a
+        # single-shard feature today (enforced by the attach sites).
+        if names and names[0] in ("telem", "inject", "lanes"):
             return P()
         # Replicated lookup tables are identified by NetState field
         # name, scoped to the NetState subtree ("net" in a Sim, or a

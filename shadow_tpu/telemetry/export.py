@@ -154,6 +154,39 @@ def final_counters(sim, stats=None) -> dict:
     return out
 
 
+def lanes_manifest_block(health, incidents=()) -> dict | None:
+    """Build the manifest's top-level "lanes" block for a lane-isolated
+    (packed) run: per-lane counters from the health gather, with each
+    quarantined lane carrying its salvage pointer + requeue context
+    from the supervisor's LaneIncident records. None when the run
+    carried no lane isolation. tools/telemetry_lint.py checks that the
+    per-lane overflow counts sum to the run totals and that every
+    quarantined lane names its salvage artifact."""
+    if health is None or not getattr(health, "lanes_total", 0):
+        return None
+    inc_dicts = [i if isinstance(i, dict) else i.as_dict()
+                 for i in (incidents or ())]
+    by_lane = {d["lane"]: d for d in inc_dicts}
+    per = []
+    for d in health.lanes:
+        d = dict(d)
+        inc = by_lane.get(d["lane"])
+        if inc is not None:
+            d["salvage"] = inc.get("salvage")
+            d["requeue"] = {"regrow": dict(inc.get("regrow") or {}),
+                            "salvaged_from": inc.get("salvaged_from")}
+        per.append(d)
+    out = {
+        "replicas": int(health.lanes_total),
+        "quarantined": [int(r) for r in health.lanes_quarantined],
+        "contained": bool(health.lane_contained),
+        "per_lane": per,
+    }
+    if inc_dicts:
+        out["incidents"] = inc_dicts
+    return out
+
+
 def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  health=None, fault_plan=None, harvester=None,
                  timers=None, wall_seconds: float | None = None,
@@ -165,7 +198,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  escalations=None,
                  preempted: bool | None = None,
                  dispatch: dict | None = None,
-                 injection: dict | None = None) -> dict:
+                 injection: dict | None = None,
+                 lanes: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -224,6 +258,10 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # manifest_block): device latches + feeder accounting; the
         # lint reconciles injected+dropped+deferred == trace_events
         man["injection"] = injection
+    if lanes is not None:
+        # lane-isolated packed run (lanes_manifest_block): per-lane
+        # counters, quarantine verdicts, salvage/requeue pointers
+        man["lanes"] = lanes
     return man
 
 
@@ -270,6 +308,14 @@ def metrics_from_manifest(man: dict) -> dict:
         for k in ("injected", "dropped", "late", "backpressure"):
             if inj.get(k) is not None:
                 out[f"inject_{k}"] = inj[k]
+    if "lanes" in man:
+        ln = man["lanes"]
+        out["lanes_replicas"] = ln.get("replicas", 0)
+        out["lanes_quarantined_total"] = len(ln.get("quarantined", []))
+        out["lanes_contained"] = bool(ln.get("contained", False))
+        out["lane_events_exec"] = {
+            str(d["lane"]): d.get("events_exec", 0)
+            for d in ln.get("per_lane", [])}
     return out
 
 

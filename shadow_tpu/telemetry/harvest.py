@@ -48,6 +48,9 @@ class WindowRecord:
     injected: int      # staged events merged this window (global)
     inj_dropped: int   # injected merges lost to full rows (global)
     inj_deferred: int  # staged, still pending beyond wend (gauge)
+    # lane-isolated runs: events executed per lane this window
+    # (ring.lane_events row); empty tuple when lane fan-out is off
+    lane_events: tuple = ()
 
 
 @dataclass
@@ -96,8 +99,14 @@ class Harvester:
         # the dominant per-window host cost under chunked dispatch
         cols = [np.asarray(getattr(ring, name))[slots].tolist()
                 for name, _ in PLANES]
+        extras = []
+        lane_pl = getattr(ring, "lane_events", None)
+        if lane_pl is not None:
+            extras.append([tuple(row) for row in
+                           np.asarray(lane_pl)[slots].tolist()])
         self.records.extend(
-            WindowRecord(*row) for row in zip(idx.tolist(), *cols))
+            WindowRecord(*row)
+            for row in zip(idx.tolist(), *cols, *extras))
         self.seen = c
         return take
 
@@ -143,6 +152,14 @@ class Harvester:
                 sum(r.inj_dropped for r in self.records))
             out["inj_deferred_last"] = int(
                 self.records[-1].inj_deferred)
+            # lane-isolated runs: per-lane harvested event totals —
+            # the lint cross-checks these against the manifest's
+            # per-lane counters when no records were lost
+            if self.records[-1].lane_events:
+                R = len(self.records[-1].lane_events)
+                out["lane_events_sum"] = [
+                    int(sum(r.lane_events[i] for r in self.records
+                            if r.lane_events)) for i in range(R)]
         if self.escalation_marks:
             out["escalations"] = len(self.escalation_marks)
         return out

@@ -56,6 +56,8 @@ the intended configuration.
 
 from __future__ import annotations
 
+from typing import Any
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -119,6 +121,14 @@ class TelemetryRing:
     # of counters that only exist as running totals in NetState/TcpState)
     prev_drops: jax.Array    # [] i64
     prev_retx: jax.Array     # [] i64
+    # --- lane-isolated runs (core/lanes.py), both None when off -----
+    # Per-lane fan-out of the events plane: lane_events[w, r] is the
+    # events lane r executed in window w (delta of the cumulative
+    # ctr_events_exec lane share). Single-shard only, like lane
+    # isolation itself. None-default: programs without lanes are
+    # byte-identical.
+    lane_events: Any = None      # [W, R] i64
+    prev_lane_exec: Any = None   # [R] i64 cumulative at last record
 
     @property
     def capacity(self) -> int:
@@ -139,10 +149,19 @@ def attach(sim, capacity: int = DEFAULT_CAPACITY):
     already is). Sim.telem defaults to None — a None field contributes
     no pytree leaves, so checkpoints and jitted programs built without
     telemetry are untouched; attaching is an explicit opt-in that
-    changes the pytree structure (and therefore retraces)."""
+    changes the pytree structure (and therefore retraces).
+
+    Lane-isolated sims (core/lanes.py — attach lanes FIRST) get the
+    per-lane event fan-out planes sized off sim.lanes.replicas."""
     if getattr(sim, "telem", None) is not None:
         return sim
-    return sim.replace(telem=TelemetryRing.create(capacity))
+    ring = TelemetryRing.create(capacity)
+    lanes = getattr(sim, "lanes", None)
+    if lanes is not None:
+        ring = ring.replace(
+            lane_events=jnp.zeros((capacity, lanes.replicas), I64),
+            prev_lane_exec=jnp.zeros((lanes.replicas,), I64))
+    return sim.replace(telem=ring)
 
 
 def _record(ring: TelemetryRing, vals: dict) -> TelemetryRing:
@@ -259,6 +278,25 @@ def make_telem_fn(axis: str | None = None):
             inj_deferred=injdef.astype(I64),
         ))
         ring = ring.replace(prev_drops=sums[3], prev_retx=sums[4])
+
+        # per-lane event fan-out (single-shard: lane isolation's
+        # contract — no collective needed). Stored into the slot
+        # _record just wrote (count - 1).
+        lanes_st = getattr(sim, "lanes", None)
+        if getattr(ring, "lane_events", None) is not None \
+                and lanes_st is not None:
+            from shadow_tpu.core.lanes import lane_sum
+
+            cum = lane_sum(sim.net.ctr_events_exec,
+                           lanes_st.replicas).astype(I64)
+            delta = cum - ring.prev_lane_exec
+            W = ring.capacity
+            sel = (jnp.arange(W, dtype=I32)
+                   == ((ring.count - 1) % W).astype(I32))
+            ring = ring.replace(
+                lane_events=jnp.where(sel[:, None], delta[None, :],
+                                      ring.lane_events),
+                prev_lane_exec=cum)
         return sim.replace(telem=ring)
 
     return telem_fn

@@ -203,6 +203,39 @@ def load_leaves(path: str) -> tuple[dict, dict]:
     return leaves, meta
 
 
+def save_salvage(path: str, leaves: dict, meta: dict) -> str:
+    """Write a raw-leaves artifact (the lane-surgery output of
+    faults/escalate.py extract_lane) with the same atomic tmp + rename
+    + dir-fsync discipline and per-leaf CRC32 as save(). The artifact
+    reads back through load_leaves(); meta rides verbatim plus the
+    layout stamp and a kind marker so tooling can tell a salvage slice
+    from a resumable snapshot."""
+    meta = dict(meta)
+    meta.setdefault("layout", LAYOUT_VERSION)
+    meta["kind"] = "lane_salvage"
+    leaves = {k: np.asarray(v) for k, v in leaves.items()}
+    meta["keys"] = sorted(leaves)
+    meta["crc32"] = {k: _crc(v) for k, v in leaves.items()}
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    d = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(prefix=".salvage.", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, __meta__=json.dumps(meta), **leaves)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return path
+
+
 # leaf-key prefixes -> the capacity knob that sizes them, for shape
 # mismatch diagnostics (the knob names match NetConfig fields and the
 # loader's override keys, so the message is directly actionable)

@@ -7,24 +7,85 @@ tools/tpu_watch.py) depends on one entry point's compile being every
 other entry point's cache hit. One helper, four callers — the three
 config knobs live nowhere else.
 
-Known tradeoff: XLA:CPU cache entries embed the compile machine's CPU
-features; executing them on a host with fewer features logs a
-cpu_aot_loader mismatch warning (observed benign in this container,
-documented in docs/4-performance.md). Set SHADOW_NO_COMPILE_CACHE=1
-to opt out if a foreign cache entry ever misbehaves.
+XLA:CPU cache entries embed the compile machine's CPU features (the
+AOT loader refuses — or worse, mis-executes wide-vector code paths —
+when the executing host lacks features the compiling host had). The
+cache directory is therefore CLAIMED by the first host that writes
+it: `enable_compile_cache` records the host's CPU-feature fingerprint
+in a sidecar (machine.json) and, when a later host's fingerprint
+disagrees, logs a warning and redirects that host to a
+per-fingerprint subdirectory — a fresh compile namespace instead of
+loading foreign AOT entries. Same-featured hosts keep sharing the
+primary cache; SHADOW_NO_COMPILE_CACHE=1 opts out entirely.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pathlib
+import platform
+import sys
 
 
-def enable_compile_cache() -> None:
+def machine_fingerprint() -> str:
+    """Stable digest of the CPU features that XLA:CPU AOT entries
+    depend on: ISA + the feature flags /proc/cpuinfo advertises. Two
+    hosts with equal fingerprints can safely exchange cache entries;
+    unequal fingerprints may not (a narrower host would load code
+    compiled for vector extensions it lacks)."""
+    feats = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        feats = platform.processor()
+    blob = f"{platform.machine()}|{feats}"
+    return hashlib.sha256(blob.encode()).hexdigest()[:16]
+
+
+def _claim_or_redirect(cache: pathlib.Path, fp: str,
+                       log=None) -> pathlib.Path:
+    """First fingerprint to write machine.json owns `cache`; a
+    mismatched host is redirected to cache/hosts/<fp> with a logged
+    warning (fresh compiles there, never foreign AOT loads)."""
+    say = log or (lambda m: print(m, file=sys.stderr))
+    sidecar = cache / "machine.json"
+    try:
+        recorded = json.loads(sidecar.read_text()).get("fingerprint")
+    except (OSError, ValueError):
+        recorded = None
+    if recorded is None:
+        try:
+            cache.mkdir(parents=True, exist_ok=True)
+            tmp = sidecar.with_suffix(".json.tmp")
+            tmp.write_text(json.dumps(
+                {"fingerprint": fp, "machine": platform.machine()},
+                sort_keys=True) + "\n")
+            os.replace(tmp, sidecar)
+        except OSError:
+            pass  # read-only checkout: cache still usable, unclaimed
+        return cache
+    if recorded == fp:
+        return cache
+    redirect = cache / "hosts" / fp
+    say(f"WARNING: compile cache at {cache} holds XLA:CPU AOT entries "
+        f"compiled on a host with different CPU features (recorded "
+        f"{recorded}, this host {fp}); falling back to fresh compiles "
+        f"under {redirect}")
+    return redirect
+
+
+def enable_compile_cache(log=None) -> None:
     import jax
 
     if os.environ.get("SHADOW_NO_COMPILE_CACHE"):
         return
     cache = pathlib.Path(__file__).resolve().parents[2] / ".jax_cache"
+    cache = _claim_or_redirect(cache, machine_fingerprint(), log)
     jax.config.update("jax_compilation_cache_dir", str(cache))
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)

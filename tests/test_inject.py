@@ -10,6 +10,7 @@ nothing is ever silently lost. Resume from a mid-trace checkpoint
 replays nothing and drops nothing.
 """
 
+import os
 import json
 
 import jax
@@ -402,3 +403,75 @@ def test_fleet_jobspec_inject_fields_roundtrip():
         JobSpec(id="x", inject_lanes=48)     # not a power of two
     with pytest.raises(ValueError):
         JobSpec(id="x", kind="chaos_trial", inject_trace="t")
+
+
+# --------------------------------------------------------- torn tails
+
+
+def _binary_trace(tmp_path, n=5):
+    p = str(tmp_path / "torn.trace")
+    evs = [{"t_ns": 10 * i, "host": 0, "kind": 7, "payload": [i]}
+           for i in range(n)]
+    assert write_trace(p, evs, binary=True) == n
+    return p
+
+
+def test_torn_tail_short_frame_truncates_with_warning(tmp_path):
+    """A writer that dies mid-append leaves a partial trailing frame;
+    the reader must deliver every intact record and surface the
+    truncation as a warning (fleet-journal torn-tail policy), never
+    raise and never silently drop."""
+    p = _binary_trace(tmp_path)
+    size = os.path.getsize(p)
+    with open(p, "r+b") as f:
+        f.truncate(size - 7)            # tear the last frame
+    warns = []
+    evs = list(read_trace(p, warns.append))
+    assert [e["payload"] for e in evs] == [[0], [1], [2], [3]]
+    assert len(warns) == 1 and "torn trailing frame" in warns[0]
+
+
+def test_crc_corrupt_tail_truncates_mid_file_raises(tmp_path):
+    from shadow_tpu.inject.trace import TraceFormatError
+
+    p = _binary_trace(tmp_path)
+    size = os.path.getsize(p)
+    # flip a payload byte of the LAST frame (frame = 10B header +
+    # 20B fixed + 4B word + newline = 35B)
+    with open(p, "r+b") as f:
+        f.seek(size - 3)
+        b = f.read(1)
+        f.seek(size - 3)
+        f.write(bytes([b[0] ^ 0xFF]))
+    warns = []
+    evs = list(read_trace(p, warns.append))
+    assert len(evs) == 4
+    assert len(warns) == 1 and "CRC-corrupt trailing frame" in warns[0]
+    # the same damage MID-file is corruption, not a torn tail: raise
+    p2 = _binary_trace(tmp_path)
+    with open(p2, "r+b") as f:
+        f.seek(20)                      # inside frame 0's payload
+        b = f.read(1)
+        f.seek(20)
+        f.write(bytes([b[0] ^ 0xFF]))
+    with pytest.raises(TraceFormatError, match="CRC mismatch"):
+        list(read_trace(p2))
+
+
+def test_feeder_surfaces_torn_tail_in_stats_and_health(tmp_path):
+    from shadow_tpu.faults.health import RunHealth
+
+    p = _binary_trace(tmp_path)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) - 7)
+    fd = Feeder(p)
+    while fd._read_next() is not None:
+        pass
+    assert fd.trace_events == 4
+    st = fd.stats()
+    assert len(st["trace_warnings"]) == 1
+    h = RunHealth(trace_warnings=tuple(fd.warnings))
+    assert not h.fatal
+    assert any(sev == "warning" and "torn trailing frame" in msg
+               for sev, msg in h.diagnostics())
+    assert h.failure_report()["trace_warnings"] == fd.warnings

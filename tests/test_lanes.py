@@ -1,0 +1,342 @@
+"""Lane-isolated health latches + blast-radius containment
+(core/lanes.py): packed ensemble runs carry per-lane latch planes and
+a quarantine mask, so one tenant's capacity trip freezes that lane at
+the window barrier while every healthy lane runs to completion
+bit-exactly. The oracles here:
+
+- R=1 attach is byte-identical to the global-latch path (checkpoint
+  leaf CRCs + event counters) — lane isolation adds state, never
+  perturbs results;
+- a flooded victim lane quarantines on its own latch while neighbor
+  lanes' final per-host state matches a clean packed run exactly;
+- the per-lane conservation ledger (faults/conserve.py lane_check)
+  holds per lane through the overflow + flush;
+- the supervisor's lane surgery extracts the victim's slice from the
+  last clean snapshot into a salvage artifact and plans a regrown
+  replicas=1 requeue (faults/supervisor.py), and the manifest "lanes"
+  block passes tools/telemetry_lint.py;
+- the fleet layer accepts packed specs and backfills lane-requeue
+  children idempotently (shadow_tpu/fleet).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench import _build_phold, _make_phold_fn
+from conftest import load_tool
+from shadow_tpu.apps import phold
+from shadow_tpu.core import lanes as lanes_mod
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import push_rows
+from shadow_tpu.net.build import make_runner
+
+RS, R, LOAD = 4, 4, 2
+H = RS * R
+VICTIM = 1
+
+
+def _flood_fn(victim, cap, trig):
+    """Seq-conserving flood: push cap+1 far-future events into the
+    victim lane's rows each window past `trig`, bumping next_seq per
+    ATTEMPT (apply_emissions semantics) — so the per-lane ledger's
+    pushed == accounted + drops stays exact through the overflow."""
+
+    def flood(sim, wend):
+        Hn = sim.events.num_hosts
+        mask = ((jnp.arange(Hn) >= victim * RS)
+                & (jnp.arange(Hn) < (victim + 1) * RS)
+                & (jnp.asarray(wend, simtime.DTYPE) > trig))
+        t = jnp.full((Hn,), simtime.INVALID - 1, simtime.DTYPE)
+        z = jnp.zeros((Hn,), jnp.int32)
+        w = jnp.zeros((Hn, sim.events.words.shape[-1]), jnp.int32)
+        q = sim.events
+        for _ in range(cap + 1):
+            q = push_rows(q, mask, t, z, z, q.next_seq, w)
+            q = q.replace(next_seq=q.next_seq + mask.astype(jnp.int32))
+        return sim.replace(events=q)
+
+    return flood
+
+
+def _build_packed():
+    b = _build_phold(H, LOAD, 1, replica_size=RS)
+    b.sim = lanes_mod.attach(b.sim, R)
+    return b
+
+
+@pytest.fixture(scope="module")
+def packed_clean():
+    b = _build_packed()
+    fn = _make_phold_fn(b, 0)
+    return jax.block_until_ready(fn(b.sim))
+
+
+@pytest.fixture(scope="module")
+def packed_flooded():
+    b = _build_packed()
+    cap = int(b.sim.events.capacity)
+    fn = make_runner(b, app_handlers=(phold.handler,),
+                     app_bulk=phold.BULK,
+                     fault_fn=_flood_fn(VICTIM, cap,
+                                        simtime.ONE_SECOND // 2))
+    return jax.block_until_ready(fn(b.sim))
+
+
+def test_lane_helpers_units():
+    x = jnp.arange(8, dtype=jnp.int32)
+    assert np.asarray(lanes_mod.lane_sum(x, 4)).tolist() == [1, 5, 9, 13]
+    m = lanes_mod.host_mask(
+        jnp.asarray([True, False, True, False]), 8)
+    assert np.asarray(m).tolist() \
+        == [True, True, False, False, True, True, False, False]
+    assert np.asarray(
+        lanes_mod.lane_of_host(jnp.arange(8), 8, 4)).tolist() \
+        == [0, 0, 1, 1, 2, 2, 3, 3]
+    assert lanes_mod.trip_names(lanes_mod.TRIP_EVENTS
+                                | lanes_mod.TRIP_STALL) \
+        == ["events_overflow", "stall"]
+
+
+def test_attach_validates_divisibility():
+    b = _build_phold(6, LOAD, 1)
+    with pytest.raises(ValueError):
+        lanes_mod.attach(b.sim, 4)      # 6 % 4 != 0
+
+
+def test_r1_lane_isolation_bit_identical():
+    """The R=1 lane-isolated path must reproduce the global-latch
+    path bit for bit: same event counters, and checkpoint-leaf CRCs
+    equal on every shared leaf — the lanes struct only ADDS leaves."""
+    from shadow_tpu.utils import checkpoint as ckpt
+
+    b0 = _build_phold(8, LOAD, 1)
+    fn0 = _make_phold_fn(b0, 0)
+    sim0, stats0 = jax.block_until_ready(fn0(b0.sim))
+
+    b1 = _build_phold(8, LOAD, 1)
+    b1.sim = lanes_mod.attach(b1.sim, 1)
+    fn1 = _make_phold_fn(b1, 0)
+    sim1, stats1 = jax.block_until_ready(fn1(b1.sim))
+
+    assert int(stats0.events_processed) == int(stats1.events_processed)
+    d0 = {k: ckpt._crc(v) for k, v in ckpt._leaf_dict(sim0).items()}
+    d1 = {k: ckpt._crc(v) for k, v in ckpt._leaf_dict(sim1).items()}
+    extra = set(d1) - set(d0)
+    allowed = {".events.overflow_h", ".outbox.overflow_h",
+               ".net.rq_overflow_h"}
+    assert extra and all(".lanes" in k or k in allowed
+                         for k in extra), extra
+    assert not set(d0) - set(d1)
+    diff = [k for k in d0 if d0[k] != d1[k]]
+    assert not diff, diff
+    rep = lanes_mod.lane_report(sim1)
+    assert len(rep) == 1 and not rep[0]["quarantined"]
+    assert rep[0]["events_exec"] == int(
+        np.asarray(sim0.net.ctr_events_exec).sum())
+
+
+def test_clean_packed_run_no_trips(packed_clean):
+    sim, stats = packed_clean
+    rep = lanes_mod.lane_report(sim)
+    assert all(not d["quarantined"] for d in rep), rep
+    assert int(sim.events.overflow) == 0
+    # companion-plane invariant: the scalar stays authoritative
+    assert int(np.asarray(sim.events.overflow_h).sum()) \
+        == int(sim.events.overflow)
+    # symmetric replicas execute identical per-lane event totals
+    ex = [d["events_exec"] for d in rep]
+    assert len(set(ex)) == 1, ex
+
+
+def test_flooded_lane_quarantines_neighbors_exact(packed_clean,
+                                                  packed_flooded):
+    sim, _ = packed_clean
+    sim3, _ = packed_flooded
+    rep3 = lanes_mod.lane_report(sim3)
+    assert rep3[VICTIM]["quarantined"], rep3
+    assert rep3[VICTIM]["trip"] == ["events_overflow"], rep3[VICTIM]
+    assert rep3[VICTIM]["flushed"] > 0
+    assert rep3[VICTIM]["quarantined_at_ns"] > 0
+    for r in range(R):
+        if r != VICTIM:
+            assert not rep3[r]["quarantined"], rep3[r]
+    # blast radius: healthy lanes' per-host state byte-identical to
+    # the clean packed run
+    healthy = [r for r in range(R) if r != VICTIM]
+    for a, c in ((sim.app.rcvd, sim3.app.rcvd),
+                 (sim.net.ctr_events_exec, sim3.net.ctr_events_exec),
+                 (sim.events.time, sim3.events.time)):
+        a, c = np.asarray(a), np.asarray(c)
+        for r in healthy:
+            np.testing.assert_array_equal(a[r * RS:(r + 1) * RS],
+                                          c[r * RS:(r + 1) * RS])
+    assert int(sim3.events.overflow) \
+        == int(np.asarray(sim3.events.overflow_h).sum())
+
+
+def test_per_lane_conservation_ledger(packed_flooded):
+    """pushed == processed + queued + outboxed + flushed, exactly for
+    healthy lanes (zero drops) and within the drops bound for the
+    flooded victim — the ledger holds per lane through quarantine."""
+    from shadow_tpu.faults import conserve
+
+    sim3, _ = packed_flooded
+    s = conserve.lane_sample(sim3, wstart=0,
+                             wend=simtime.ONE_SECOND)
+    assert conserve.lane_check([s]) == []
+    assert s.drops[VICTIM] > 0 and s.flushed[VICTIM] > 0
+    for r in range(R):
+        if r != VICTIM:
+            assert s.drops[r] == 0 and s.flushed[r] == 0
+            assert s.pushed[r] == (s.processed[r] + s.queued[r]
+                                   + s.outboxed[r])
+
+
+def test_lane_check_flags_violation():
+    from shadow_tpu.faults import conserve
+
+    good = conserve.LaneWindowSample(
+        wstart=0, wend=10, pushed=(5, 5), processed=(3, 2),
+        queued=(2, 2), outboxed=(0, 1), drops=(0, 0), flushed=(0, 0))
+    assert conserve.lane_check([good]) == []
+    bad = conserve.LaneWindowSample(
+        wstart=0, wend=10, pushed=(5, 5), processed=(3, 2),
+        queued=(2, 2), outboxed=(0, 0), drops=(0, 0), flushed=(0, 0))
+    errs = conserve.lane_check([bad])
+    assert len(errs) == 1 and "lane[1]" in errs[0], errs
+
+
+def test_supervisor_lane_surgery(tmp_path):
+    """The supervised packed run survives a one-lane overflow as a
+    CONTAINED degrade: result ok, victim quarantined with a salvage
+    artifact sliced from the last clean snapshot, a regrown requeue
+    plan, and a manifest lanes block that lints clean."""
+    from shadow_tpu import faults, telemetry
+    from shadow_tpu.telemetry.export import lanes_manifest_block
+    from shadow_tpu.utils import checkpoint as ckpt
+
+    b = _build_packed()
+    cap = int(b.sim.events.capacity)
+    incidents_seen = []
+    res = faults.run_supervised(
+        b, app_handlers=(phold.handler,),
+        fault_fn=_flood_fn(VICTIM, cap, simtime.ONE_SECOND // 2),
+        checkpoint_path=str(tmp_path / "ck"),
+        checkpoint_every_windows=4, max_retries=0,
+        sleep=lambda s: None,
+        on_lane_quarantine=incidents_seen.append)
+    assert res.ok, res.failure_report()
+    h = res.health
+    assert h.lanes_total == R and h.lane_contained
+    assert tuple(h.lanes_quarantined) == (VICTIM,)
+    assert not h.fatal                     # contained -> degrade
+    assert any("contained" in m for _, m in h.diagnostics())
+
+    assert len(res.lane_incidents) == 1
+    inc = res.lane_incidents[0]
+    assert inc.lane == VICTIM
+    assert [i.lane for i in incidents_seen] == [VICTIM]
+    assert "events_overflow" in inc.trip
+    assert inc.regrow.get("event_capacity", 0) > cap
+    # the salvage artifact: the victim's slice of a PRE-TRIP snapshot
+    assert inc.salvage and os.path.isfile(inc.salvage)
+    leaves, meta = ckpt.load_leaves(inc.salvage)
+    assert meta["kind"] == "lane_salvage"
+    assert meta["capacities"]["num_hosts"] == RS
+    assert meta["lane"] == VICTIM and meta["replicas"] == R
+    for k, v in leaves.items():
+        if ".lanes" not in k and v.ndim and v.shape[0] == RS:
+            break
+    else:
+        raise AssertionError("no [RS]-sliced leaf in salvage")
+
+    man = telemetry.run_manifest(
+        cfg=b.cfg, seed=1, shards=1, sim=res.sim, stats=res.stats,
+        health=h, run_id=res.run_id,
+        lanes=lanes_manifest_block(h, res.lane_incidents))
+    lanes_blk = man["lanes"]
+    assert lanes_blk["replicas"] == R
+    assert lanes_blk["quarantined"] == [VICTIM]
+    per = lanes_blk["per_lane"][VICTIM]
+    assert per["salvage"] == inc.salvage
+    assert per["requeue"]["regrow"] == inc.regrow
+    lint = load_tool("telemetry_lint")
+    errors, _ = lint.lint_manifest_obj(man)
+    assert errors == [], errors
+
+
+def test_fleet_packed_spec_and_backfill(tmp_path):
+    from shadow_tpu.fleet import FleetPolicy, JobSpec
+    from shadow_tpu.fleet.state import FleetQueue
+
+    with pytest.raises(ValueError):
+        JobSpec(id="x", kind="chaos_trial", seed=1, replicas=4)
+    with pytest.raises(ValueError):
+        JobSpec(id="x", kind="scenario", seed=1, replicas=0)
+    parent = JobSpec(id="packed", kind="scenario", seed=1, hosts=RS,
+                     replicas=R)
+    q = FleetQueue(str(tmp_path / "fleet"), FleetPolicy(),
+                   [parent], fsync=False)
+    child = JobSpec(id="packed.lane1", kind="scenario", seed=1,
+                    hosts=RS, lane_of="packed")
+    assert q.add_job(child) is True
+    assert q.add_job(child) is False          # idempotent by id
+    assert "packed.lane1" in q.jobs
+    # the spec dir survives for --resume's spec scan
+    assert os.path.isfile(os.path.join(q.job_dir("packed.lane1"),
+                                       "spec.json"))
+
+
+def test_fleet_manifest_lanes_lint():
+    lint = load_tool("telemetry_lint")
+    base = {
+        "schema": "shadow-tpu-fleet-manifest", "schema_version": 1,
+        "policy": {}, "preempted": False, "stalled": False,
+        "complete": False,
+        "counts": {"done": 1, "queued": 1},
+    }
+    jobs = {
+        "packed": {
+            "status": "done", "attempts": 1, "executions": 1,
+            "attempt_history": [1], "backoff_history": [],
+            "verdict": "ok", "result": {"ok": True},
+            "replicas": R,
+            "lanes": {"quarantined": [VICTIM],
+                      "requeues": [{"id": "packed.lane1",
+                                    "replicas": 1,
+                                    "lane_of": "packed"}]},
+        },
+        "packed.lane1": {
+            "status": "queued", "attempts": 0, "executions": 0,
+            "attempt_history": [], "backoff_history": [],
+            "lane_of": "packed",
+        },
+    }
+    errors, _ = lint.lint_fleet_manifest_obj({**base, "jobs": jobs})
+    assert errors == [], errors
+    # broken back-link is caught
+    bad = {**jobs, "packed": {**jobs["packed"], "lanes": {
+        "quarantined": [VICTIM],
+        "requeues": [{"id": "packed.lane1", "replicas": 1,
+                      "lane_of": "elsewhere"}]}}}
+    errors, _ = lint.lint_fleet_manifest_obj({**base, "jobs": bad})
+    assert any("back-link" in e for e in errors), errors
+    # lane_of pointing at a non-packed parent is caught
+    bad2 = {**jobs, "packed": {k: v for k, v in jobs["packed"].items()
+                               if k not in ("replicas", "lanes")}}
+    errors, _ = lint.lint_fleet_manifest_obj({**base, "jobs": bad2})
+    assert any("not a packed job" in e for e in errors), errors
+
+
+def test_chaos_soak_replica_mode():
+    """tools/chaos_soak.py --replicas: the containment soak's oracle
+    (fixed seed, tier-1 sized; the multi-trial soak is the slow CLI)."""
+    chaos = load_tool("chaos_soak")
+    rep = chaos.run_replica_trial(3, replicas=R, hosts=RS, load=LOAD)
+    assert rep["ok"], rep
+    assert rep["victim_trip"] == ["events_overflow"]
+    assert rep["containment_errors"] == []

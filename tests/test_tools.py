@@ -489,3 +489,38 @@ def test_faultplan_lint_checkpoint_cli(tmp_path):
     # an unreadable snapshot is an error, not a crash
     assert fl.main([str(plan), "--checkpoint",
                     str(tmp_path / "missing.npz"), "-q"]) == 1
+
+
+def test_compcache_machine_claim_and_redirect(tmp_path):
+    """The persistent compile cache is claimed by the first host's
+    CPU-feature fingerprint; a host with different features is
+    redirected to a per-fingerprint subdirectory with a warning
+    (XLA:CPU AOT entries embed the compile machine's features —
+    loading foreign ones would mis-execute), and a corrupt sidecar is
+    re-claimed instead of crashing."""
+    import json
+    import pathlib
+
+    from shadow_tpu.utils import compcache
+
+    fp = compcache.machine_fingerprint()
+    assert fp == compcache.machine_fingerprint()     # stable
+    cache = pathlib.Path(tmp_path) / ".jax_cache"
+    msgs = []
+    # first claim: recorded and kept
+    assert compcache._claim_or_redirect(cache, fp, msgs.append) == cache
+    assert json.loads((cache / "machine.json").read_text())[
+        "fingerprint"] == fp
+    # same host again: no warning, same dir
+    assert compcache._claim_or_redirect(cache, fp, msgs.append) == cache
+    assert msgs == []
+    # a different host: redirected to a fresh-compile namespace
+    other = compcache._claim_or_redirect(cache, "feedfacedeadbeef",
+                                         msgs.append)
+    assert other == cache / "hosts" / "feedfacedeadbeef"
+    assert len(msgs) == 1 and "different CPU features" in msgs[0]
+    # corrupt sidecar: re-claimed, not fatal
+    (cache / "machine.json").write_text("{not json")
+    assert compcache._claim_or_redirect(cache, fp, msgs.append) == cache
+    assert json.loads((cache / "machine.json").read_text())[
+        "fingerprint"] == fp

@@ -31,7 +31,13 @@ grown capacity".
 
 Usage:
   chaos_soak.py --trials 20 --seed 1 [--kills 2] [--verify]
+  chaos_soak.py --trials 5 --replicas 4     # blast-radius mode
 One JSON line per trial on stdout; exit 1 if any trial fails.
+--replicas R packs R PHOLD lanes into one program (core/lanes.py),
+floods one seeded victim lane's event rows mid-run, and asserts the
+victim quarantines while every neighbor lane's final per-host state
+stays byte-identical to a clean packed run — the containment oracle
+for lane-isolated health latches.
 tests/test_escalate.py imports run_trial() for the fixed-seed tier-1
 smoke; the multi-trial soak is the `slow`-marked variant.
 """
@@ -261,6 +267,145 @@ def _verify_final(sim_healed, make_bundle, errors) -> bool:
     return same
 
 
+def _build_packed(replicas, hosts, load, sim_s, seed, caps):
+    """R lane copies of the PHOLD scenario in one program: contiguous
+    lane blocks (apps/phold.py replica_size) with lane-isolated health
+    latches attached."""
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import lanes as lanes_mod
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import HostSpec, build
+    from shadow_tpu.net.state import NetConfig
+
+    H = hosts * replicas
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=caps["event_capacity"],
+                    outbox_capacity=caps["outbox_capacity"],
+                    router_ring=caps["router_ring"],
+                    in_ring=max(8, 2 * load))
+    specs = [HostSpec(name=f"p{i}", proc_start_time=0)
+             for i in range(H)]
+    b = build(cfg, GRAPH, specs)
+    b.sim = phold.setup(b.sim, load=load, replica_size=hosts)
+    b.sim = lanes_mod.attach(b.sim, replicas)
+    return b
+
+
+def _lane_digests(sim, replicas: int) -> list:
+    """sha256 per lane over every [H]-leading leaf's lane slice. The
+    lane-latch planes and the telemetry ring are excluded (they are
+    the containment mechanism under test, not lane state), as are
+    global scalars (the run-total overflow latch legitimately differs
+    once the victim lane trips)."""
+    import hashlib
+
+    import jax
+
+    H = sim.events.num_hosts
+    rs = H // replicas
+    hs = [hashlib.sha256() for _ in range(replicas)]
+    for path, leaf in jax.tree_util.tree_flatten_with_path(sim)[0]:
+        key = jax.tree_util.keystr(path)
+        if ".lanes" in key or ".telem" in key:
+            continue
+        a = np.asarray(jax.device_get(leaf))
+        if a.ndim == 0 or a.shape[0] != H:
+            continue
+        for r in range(replicas):
+            hs[r].update(key.encode())
+            hs[r].update(np.ascontiguousarray(
+                a[r * rs:(r + 1) * rs]).tobytes())
+    return [h.hexdigest() for h in hs]
+
+
+def run_replica_trial(seed: int, *, replicas: int = 4, hosts: int = 4,
+                      load: int = 2, sim_s: int = 1,
+                      log=None) -> dict:
+    """Blast-radius containment oracle for packed ensemble runs: run
+    the R-lane scenario clean, then again with a seeded flood fault
+    overflowing exactly one victim lane's event rows mid-run. The
+    victim must quarantine (events_overflow trip, flushed rows), and
+    every OTHER lane's final per-host state must be byte-identical to
+    the clean run's — a one-lane fault must never perturb a neighbor
+    lane."""
+    import jax
+    import jax.numpy as jnp
+
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import lanes as lanes_mod
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.events import push_rows
+    from shadow_tpu.net.build import make_runner
+
+    rng = np.random.default_rng(seed)
+    victim = int(rng.integers(0, replicas))
+    roomy = max(32, 4 * load)
+    caps = {"event_capacity": roomy, "outbox_capacity": roomy,
+            "router_ring": roomy}
+    trig = sim_s * simtime.ONE_SECOND // 2
+
+    b = _build_packed(replicas, hosts, load, sim_s, seed, caps)
+    fn = make_runner(b, app_handlers=(phold.handler,),
+                     app_bulk=phold.BULK)
+    sim_clean, _ = jax.block_until_ready(fn(b.sim))
+
+    cap = int(b.sim.events.capacity)
+
+    def flood_fn(sim, wend):
+        Hn = sim.events.num_hosts
+        mask = ((jnp.arange(Hn) >= victim * hosts)
+                & (jnp.arange(Hn) < (victim + 1) * hosts)
+                & (jnp.asarray(wend, simtime.DTYPE) > trig))
+        t = jnp.full((Hn,), simtime.INVALID - 1, simtime.DTYPE)
+        z = jnp.zeros((Hn,), jnp.int32)
+        w = jnp.zeros((Hn, sim.events.words.shape[-1]), jnp.int32)
+        q = sim.events
+        for _ in range(cap + 1):
+            q = push_rows(q, mask, t, z, z, z, w)
+        return sim.replace(events=q)
+
+    b2 = _build_packed(replicas, hosts, load, sim_s, seed, caps)
+    fn2 = make_runner(b2, app_handlers=(phold.handler,),
+                      app_bulk=phold.BULK, fault_fn=flood_fn)
+    sim_fault, _ = jax.block_until_ready(fn2(b2.sim))
+
+    errors = []
+    rep = lanes_mod.lane_report(sim_fault)
+    if not rep[victim]["quarantined"]:
+        errors.append(f"victim lane {victim} did not quarantine: "
+                      f"{rep[victim]}")
+    elif "events_overflow" not in rep[victim].get("trip", []):
+        errors.append(f"victim lane {victim} tripped on "
+                      f"{rep[victim].get('trip')} instead of the "
+                      f"flooded events_overflow latch")
+    for r in range(replicas):
+        if r != victim and rep[r]["quarantined"]:
+            errors.append(f"healthy lane {r} quarantined — the "
+                          f"victim's fault leaked: {rep[r]}")
+    dig_clean = _lane_digests(sim_clean, replicas)
+    dig_fault = _lane_digests(sim_fault, replicas)
+    perturbed = [r for r in range(replicas)
+                 if r != victim and dig_clean[r] != dig_fault[r]]
+    if perturbed:
+        errors.append(f"lane(s) {perturbed} diverged from the clean "
+                      f"run — one-lane fault perturbed neighbor-lane "
+                      f"state (blast radius NOT contained)")
+    if log:
+        log(f"replica trial seed={seed}: victim={victim} "
+            f"trip={rep[victim].get('trip')} errors={len(errors)}")
+    return {
+        "seed": int(seed),
+        "ok": not errors,
+        "replicas": int(replicas),
+        "victim": victim,
+        "victim_trip": rep[victim].get("trip"),
+        "victim_flushed": rep[victim].get("flushed"),
+        "lane_events_exec": [d["events_exec"] for d in rep],
+        "containment_errors": errors,
+    }
+
+
 def _main_fleet(args) -> int:
     """--jobs K: dogfood the fleet runner. Each trial becomes a
     `chaos_trial` job; K worker processes execute them with the full
@@ -325,10 +470,32 @@ def main(argv=None) -> int:
                          "fresh temp dir)")
     ap.add_argument("--platform", default=None,
                     help="force a JAX backend (e.g. cpu)")
+    ap.add_argument("--replicas", type=int, default=0,
+                    help="blast-radius mode: pack this many PHOLD "
+                         "lanes per trial, flood one victim lane's "
+                         "event rows mid-run, and assert neighbor "
+                         "lanes' final state is byte-identical to a "
+                         "clean packed run (core/lanes.py "
+                         "containment)")
     args = ap.parse_args(argv)
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.replicas > 1:
+        if args.jobs > 0:
+            ap.error("--replicas is a standalone containment soak; "
+                     "it does not combine with --jobs")
+        failed = 0
+        for k in range(args.trials):
+            rep = run_replica_trial(
+                args.seed + k, replicas=args.replicas,
+                hosts=args.hosts, load=args.load, sim_s=args.sim_s)
+            print(json.dumps(rep), flush=True)
+            if not rep["ok"]:
+                failed += 1
+        print(f"containment soak: {args.trials - failed}/"
+              f"{args.trials} trials ok", file=sys.stderr)
+        return 1 if failed else 0
     if args.jobs > 0:
         return _main_fleet(args)
 

@@ -25,12 +25,18 @@ Checks:
   (injected + dropped + deferred == trace_events), its drops must be
   latched in health, and the per-window injected plane must sum to
   the device latch when no telemetry records were lost.
+  The optional "lanes" block (lane-isolated packed runs) must carry
+  one per_lane entry per replica whose overflow shares sum to the
+  run-total latch counters exactly, and every quarantined lane must
+  name its trips and (when the supervisor's lane surgery ran) its
+  salvage pointer + requeue context.
 
 - Fleet manifest JSON (--fleet-manifest): shadow_tpu/fleet schema —
   attempt histories monotone non-decreasing with attempts at the
   high-water mark, every terminal job carries the matching verdict,
-  every quarantined job carries its salvage pointers, and the counts
-  block agrees with the per-job statuses.
+  every quarantined job carries its salvage pointers, the counts
+  block agrees with the per-job statuses, and packed jobs' lane
+  requeues are replicas=1 children back-linked via lane_of.
 
 Usage: telemetry_lint.py [--trace trace.json]
                          [--manifest run_manifest.json]
@@ -421,6 +427,144 @@ def lint_manifest_obj(man) -> tuple[list, list]:
                 f"feeder hit backpressure on {bp} refill(s) — the "
                 f"staging buffer filled; raise --inject-lanes if "
                 f"wallclock suffers")
+    # lanes block (optional): lane-isolated packed-run accounting
+    # (telemetry/export.py lanes_manifest_block). The per-lane counters
+    # are [R] companion planes of the run-total latches, accumulated in
+    # lockstep with the scalars — each latch's lane shares must sum to
+    # the run total EXACTLY (the scalars stay authoritative). Every
+    # quarantined lane must be fully described (trip names, quarantine
+    # time), and when the supervisor's lane surgery ran, carry its
+    # salvage pointer + requeue context.
+    lb = man.get("lanes")
+    if lb is not None:
+        if not isinstance(lb, dict):
+            errors.append("lanes must be an object")
+            lb = {}
+        nlanes = lb.get("replicas")
+        if (not isinstance(nlanes, int) or isinstance(nlanes, bool)
+                or nlanes < 1):
+            errors.append(f"lanes.replicas must be an integer >= 1, "
+                          f"got {nlanes!r}")
+            nlanes = None
+        if not isinstance(lb.get("contained"), bool):
+            errors.append("lanes.contained must be a bool")
+        per = lb.get("per_lane")
+        if not isinstance(per, list) or not per:
+            errors.append("lanes.per_lane must be a non-empty array")
+            per = []
+        if nlanes is not None and per and len(per) != nlanes:
+            errors.append(f"lanes.per_lane has {len(per)} entries but "
+                          f"replicas={nlanes}")
+        quar = lb.get("quarantined")
+        if not isinstance(quar, list) or not all(
+                isinstance(q, int) and not isinstance(q, bool)
+                for q in quar):
+            errors.append("lanes.quarantined must be a list of lane "
+                          "indices")
+            quar = []
+        lane_counts = ("events_overflow", "outbox_overflow",
+                       "rq_overflow", "inj_dropped", "stall_streak",
+                       "time_regression", "events_exec", "flushed")
+        sums = dict.fromkeys(lane_counts, 0)
+        rows_ok = bool(per)
+        seen_quar = []
+        for i, d in enumerate(per):
+            where = f"lanes.per_lane[{i}]"
+            if not isinstance(d, dict):
+                errors.append(f"{where}: must be an object")
+                rows_ok = False
+                continue
+            if d.get("lane") != i:
+                errors.append(f"{where}: lane={d.get('lane')!r} out "
+                              f"of order (expected {i})")
+            for k in lane_counts:
+                v = d.get(k)
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errors.append(f"{where}: {k} must be a "
+                                  f"non-negative integer, got {v!r}")
+                    rows_ok = False
+                else:
+                    sums[k] += v
+            if d.get("quarantined"):
+                seen_quar.append(i)
+                for k in ("quarantined_at_ns", "trip_bits"):
+                    if not isinstance(d.get(k), int):
+                        errors.append(f"{where}: quarantined lane "
+                                      f"must carry {k}")
+                if not d.get("trip"):
+                    errors.append(f"{where}: quarantined lane must "
+                                  f"name its trip(s)")
+        if per and sorted(quar) != seen_quar:
+            errors.append(f"lanes.quarantined={sorted(quar)} disagrees "
+                          f"with the per-lane quarantined flags "
+                          f"({seen_quar})")
+        if rows_ok:
+            for k in ("events_overflow", "outbox_overflow",
+                      "rq_overflow"):
+                total = ctr.get(k)
+                if (isinstance(total, int)
+                        and not isinstance(total, bool)
+                        and sums[k] != total):
+                    errors.append(
+                        f"per-lane {k} sums to {sums[k]} but "
+                        f"counters.{k}={total} — the [R] companion "
+                        f"plane must cover the run-total latch "
+                        f"exactly")
+        # incidents = the supervisor's lane-surgery records: each one
+        # merges into its per_lane entry as salvage + requeue context
+        incs = lb.get("incidents")
+        if incs is not None and not isinstance(incs, list):
+            errors.append("lanes.incidents must be an array")
+            incs = None
+        if incs:
+            inc_lanes = {d.get("lane") for d in incs
+                         if isinstance(d, dict)}
+            for i, d in enumerate(per):
+                if not (isinstance(d, dict) and d.get("quarantined")
+                        and d.get("lane") in inc_lanes):
+                    continue
+                where = f"lanes.per_lane[{i}]"
+                if "salvage" not in d or "requeue" not in d:
+                    errors.append(f"{where}: quarantined lane with an "
+                                  f"incident must carry its salvage "
+                                  f"pointer + requeue context")
+                elif not d.get("salvage"):
+                    warnings.append(f"{where}: lane surgery ran but "
+                                    f"the salvage write failed (lane "
+                                    f"requeues without clean-slice "
+                                    f"evidence)")
+                rq_ = d.get("requeue")
+                if isinstance(rq_, dict) and not isinstance(
+                        rq_.get("regrow"), dict):
+                    errors.append(f"{where}: requeue.regrow must map "
+                                  f"trip knobs to grown capacities")
+            for q in seen_quar:
+                if q not in inc_lanes:
+                    warnings.append(
+                        f"lane {q} quarantined with no incident "
+                        f"record (unsupervised run, or quarantine "
+                        f"predates this supervisor chain)")
+        elif seen_quar:
+            warnings.append(
+                f"{len(seen_quar)} lane(s) quarantined with no "
+                f"salvage (unsupervised run — nothing extracted)")
+        # per-window telemetry fan-out vs the device counter: on a
+        # lossless single-chain run the [W,R] ring plane's deltas must
+        # sum to each lane's cumulative events_exec
+        les = tel.get("lane_events_sum")
+        if (isinstance(les, list) and rows_ok
+                and tel.get("records_lost", 0) == 0
+                and man.get("resume_of") is None
+                and not man.get("escalations")):
+            got = [d.get("events_exec", 0) for d in per
+                   if isinstance(d, dict)]
+            if len(les) == len(got) and les != got:
+                warnings.append(
+                    f"telemetry.lane_events_sum={les} vs per-lane "
+                    f"events_exec={got} on a lossless run — the "
+                    f"per-window fan-out should cover every executed "
+                    f"event")
     return errors, warnings
 
 
@@ -518,6 +662,57 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
                 warnings.append(f"{where}: quarantined with no "
                                 f"checkpoint/manifest/result salvaged "
                                 f"(died before its first checkpoint?)")
+        # packed jobs (replicas > 1) surface per-lane verdicts at the
+        # entry level; every quarantined lane's requeue child must be
+        # a replicas=1 standalone spec back-linked via lane_of, and
+        # the runner backfills it into this same queue
+        rep = j.get("replicas")
+        if rep is not None and (not isinstance(rep, int)
+                                or isinstance(rep, bool) or rep < 2):
+            errors.append(f"{where}: replicas must be an integer >= 2 "
+                          f"when present, got {rep!r}")
+        lanes = j.get("lanes")
+        if lanes is not None:
+            if not isinstance(lanes, dict):
+                errors.append(f"{where}: lanes must be an object")
+                lanes = {}
+            if rep is None:
+                errors.append(f"{where}: lane verdicts on a job that "
+                              f"does not declare replicas")
+            ql = lanes.get("quarantined")
+            if not isinstance(ql, list) or not ql:
+                errors.append(f"{where}: lanes block without "
+                              f"quarantined lanes (omit the block for "
+                              f"all-healthy packed jobs)")
+                ql = []
+            for ci, child in enumerate(lanes.get("requeues") or []):
+                cw = f"{where}.lanes.requeues[{ci}]"
+                if not isinstance(child, dict):
+                    errors.append(f"{cw}: must be an object")
+                    continue
+                if child.get("lane_of") != jid:
+                    errors.append(f"{cw}: lane_of="
+                                  f"{child.get('lane_of')!r} must "
+                                  f"back-link the packed parent "
+                                  f"{jid!r}")
+                if child.get("replicas", 1) != 1:
+                    errors.append(f"{cw}: a lane requeue must be a "
+                                  f"replicas=1 standalone spec")
+                cid = child.get("id")
+                if isinstance(cid, str) and cid not in jobs:
+                    warnings.append(f"{cw}: child {cid!r} not (yet) "
+                                    f"backfilled into the queue — "
+                                    f"fleet killed between fold and "
+                                    f"backfill?")
+        lof = j.get("lane_of")
+        if lof is not None:
+            parent = jobs.get(lof)
+            if not isinstance(parent, dict):
+                errors.append(f"{where}: lane_of names unknown job "
+                              f"{lof!r}")
+            elif not parent.get("replicas"):
+                errors.append(f"{where}: lane_of parent {lof!r} is "
+                              f"not a packed job")
     mc = man.get("counts")
     if isinstance(mc, dict) and mc != counts:
         errors.append(f"counts block {mc} disagrees with the jobs "
