@@ -561,6 +561,11 @@ def main(argv=None) -> int:
             cap = CaptureSession(b, args.data_directory)
         mesh = None
         sup_result = None  # set by the --supervise branch
+        # warm-program serving (compile/serve.py): every run path
+        # hands this dict to its runner; the manifest records the
+        # realized {key, hit, load_s|compile_s} block from it (the
+        # supervised path uses the supervisor's own copy instead)
+        cinfo: dict = {}
         # track_paths no longer forces serial: shard-local [V,V]
         # partials are psummed at the window barrier
         # (parallel/shard.py _replicate_scalars)
@@ -722,6 +727,7 @@ def main(argv=None) -> int:
                     escalations=result.escalations,
                     preempted=result.preempted or None,
                     dispatch=disp, injection=inj_blk,
+                    compile_info=result.compile_info,
                     lanes=lanes_manifest_block(
                         health_, result.lane_incidents))
                 os.makedirs(args.data_directory, exist_ok=True)
@@ -802,7 +808,7 @@ def main(argv=None) -> int:
                   else contextlib.nullcontext()):
                 sim, stats, _ = ckpt.run_windows(
                     b, app_handlers=loaded.handlers, on_window=pcap_hook,
-                    feeder=feeder)
+                    feeder=feeder, compile_info=cinfo)
         elif mesh is not None:
             from shadow_tpu.parallel.shard import run_sharded
 
@@ -815,12 +821,12 @@ def main(argv=None) -> int:
                 with timers.phase("device-execute"):
                     sim, stats = run_sharded(
                         b, mesh, app_handlers=loaded.handlers,
-                        app_bulk=b.app_bulk)
+                        app_bulk=b.app_bulk, compile_info=cinfo)
                     jax.block_until_ready(sim)
             else:
                 sim, stats = run_sharded(
                     b, mesh, app_handlers=loaded.handlers,
-                    app_bulk=b.app_bulk)
+                    app_bulk=b.app_bulk, compile_info=cinfo)
         else:
             if feeder is not None:
                 b.sim = feeder.fill_all(b.sim)
@@ -830,8 +836,13 @@ def main(argv=None) -> int:
                 from shadow_tpu.net.build import make_runner
 
                 runner = make_runner(b, app_handlers=loaded.handlers,
-                                     app_bulk=b.app_bulk)
+                                     app_bulk=b.app_bulk,
+                                     compile_info=cinfo)
                 with timers.phase("trace-compile"):
+                    # a warm-serving runner (compile/serve.WarmFn)
+                    # resolves load-or-compile here via its lower()
+                    # adapter, so a store hit shows up as a short
+                    # trace-compile phase
                     compiled = runner.lower(b.sim).compile()
                 with timers.phase("device-execute"):
                     sim, stats = compiled(b.sim)
@@ -971,6 +982,9 @@ def main(argv=None) -> int:
                     fault_plan=b.fault_plan, harvester=harvester,
                     timers=timers, wall_seconds=wall,
                     injection=inj_blk,
+                    compile_info=(sup_result.compile_info
+                                  if sup_result is not None
+                                  else (cinfo or None)),
                     lanes=lanes_manifest_block(
                         run_health,
                         sup_result.lane_incidents

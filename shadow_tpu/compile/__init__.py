@@ -1,0 +1,43 @@
+"""Shape-bucketed AOT program cache and warm-start serving.
+
+Three pieces (docs/4-performance.md has the measured numbers):
+
+- `buckets`: quantize every shape-bearing capacity knob to its
+  power-of-two bucket and derive the canonical program key that
+  identifies one compiled executable across runs and processes.
+- `store`: the persistent on-disk map from program key to serialized
+  compiled executable, with sidecar manifests, atomic writes,
+  corruption/version fallback, and LRU gc.
+- `serve`: the lazy warm wrapper dispatch paths use instead of
+  calling `jax.jit(...)` results directly, plus the `prewarm` entry
+  point that populates the store ahead of a run.
+
+The supervised loop (utils/checkpoint.py run_windows), the whole-run
+factories (net/build.py), the sharded harness (parallel/shard.py) and
+the fleet (fleet/scenario.py, which also orders ready jobs by program
+key — fleet/affinity.py) all dispatch through here when warm serving
+is enabled.
+"""
+
+from shadow_tpu.compile.buckets import (  # noqa: F401
+    BUCKET_KNOBS,
+    BucketPlan,
+    bucket_config,
+    code_version,
+    is_program_key,
+    kind_census,
+    program_key,
+    quantize_caps,
+    quantize_pow2,
+    shape_vector,
+    shape_vector_for_sim,
+)
+from shadow_tpu.compile.serve import (  # noqa: F401
+    maybe_warm,
+    prewarm,
+    warm_enabled,
+)
+from shadow_tpu.compile.store import (  # noqa: F401
+    ProgramStore,
+    default_store,
+)

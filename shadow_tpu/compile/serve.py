@@ -1,0 +1,179 @@
+"""Warm-start serving: the adapter between live `jax.jit` callables
+and the persistent AOT store.
+
+A dispatch path (utils/checkpoint.py run_windows, net/build.py whole
+runners, parallel/shard.py sharded runners) builds its jitted function
+exactly as before, then wraps it in `maybe_warm(jitted, key)`. The
+wrapper is lazy: the FIRST call resolves against the program store
+using the actual call arguments as the AOT example — a hit loads the
+serialized executable (milliseconds, no retrace), a miss compiles
+through the live jit object and persists for next time. Either way
+the wrapper's `info` dict ends up holding the manifest `compile`
+block (key, hit, load_s/compile_s) the caller records.
+
+Fallback discipline: a loaded executable that rejects its arguments
+(avals drift the sidecar digest missed, donation mismatch) triggers
+ONE fallback to the live jitted function, recorded in info — a stale
+cache entry may cost a recompile, never a crash. When serving is
+disabled (`warm_enabled()` false) `maybe_warm` returns the jitted
+callable untouched: zero overhead, identical semantics.
+"""
+
+from __future__ import annotations
+
+import os
+
+from shadow_tpu.compile.store import default_store
+
+ENV_FLAG = "SHADOW_WARM_PROGRAMS"
+
+
+def warm_enabled(default: bool = False) -> bool:
+    """Is warm-program serving on? SHADOW_WARM_PROGRAMS=1/0 wins;
+    unset falls back to the caller's default (fleet scenarios default
+    on — repeated shapes are their whole workload; ad-hoc runs default
+    off). SHADOW_NO_COMPILE_CACHE=1 disables unconditionally — it is
+    the master opt-out for every persistent-compile artifact."""
+    if os.environ.get("SHADOW_NO_COMPILE_CACHE"):
+        return False
+    v = os.environ.get(ENV_FLAG)
+    if v is None:
+        return bool(default)
+    return v.strip().lower() not in ("0", "", "false", "no")
+
+
+class WarmFn:
+    """Lazy warm wrapper: behaves like the wrapped jitted callable,
+    resolves hit-or-compile against the store at first call. `key`
+    may be a callable (args, kwargs) -> key for factories whose
+    program shapes are only known from the first call's arguments
+    (net/build.py runners take any telemetry/lane-attached sim)."""
+
+    def __init__(self, jitted, key, *, store=None, meta=None,
+                 info=None):
+        self._jitted = jitted
+        self._key = key
+        self._store = store
+        self._meta = meta
+        self._compiled = None
+        # shared, caller-visible: run_windows hands this dict to the
+        # supervisor/manifest, the wrapper fills it at first dispatch
+        self.info = info if info is not None else {}
+        if isinstance(key, str):
+            self.info.setdefault("key", key)
+        self.info.setdefault("warm", True)
+
+    def _resolve(self, args, kwargs):
+        key = self._key
+        if callable(key):
+            try:
+                key = key(args, kwargs)
+            except Exception as e:
+                self.info.update(
+                    {"hit": False, "fallback": f"key:{type(e).__name__}"})
+                return self._jitted
+        if key is None:
+            self.info.update({"warm": False, "hit": False})
+            return self._jitted
+        self.info["key"] = key
+        store = self._store if self._store is not None else default_store()
+        try:
+            compiled, info = store.get_or_compile(
+                key, self._jitted, args, kwargs, meta=self._meta)
+        except Exception as e:
+            # AOT machinery itself failed (serialization unsupported on
+            # this backend, unreadable store root, ...): serve the live
+            # jit — correctness must not depend on the cache.
+            self.info.update({"hit": False,
+                              "fallback": f"store:{type(e).__name__}"})
+            return self._jitted
+        self.info.update(info)
+        return compiled
+
+    def _ensure(self, args, kwargs):
+        if self._compiled is None:
+            self._compiled = self._resolve(args, kwargs)
+
+    def lower(self, *args, **kwargs):
+        """Keep the `fn.lower(*args).compile()` protocol alive through
+        the wrapper (cli.py uses it to split trace+compile from device
+        execution in the wall-time trace): compile() resolves
+        load-or-compile against the store — so the load/compile cost
+        lands in the caller's compile phase — and returns the WarmFn
+        itself, preserving the stale-executable fallback discipline of
+        __call__."""
+        return _WarmLowered(self, args, kwargs)
+
+    def __call__(self, *args, **kwargs):
+        self._ensure(args, kwargs)
+        try:
+            return self._compiled(*args, **kwargs)
+        except Exception as e:
+            if self._compiled is self._jitted:
+                raise
+            # a loaded executable rejected the call — demote to the
+            # live jit permanently and re-execute (argument buffers are
+            # intact: the rejection happens before execution)
+            self.info["fallback"] = f"call:{type(e).__name__}"
+            self.info["hit"] = False
+            self._compiled = self._jitted
+            return self._jitted(*args, **kwargs)
+
+
+class _WarmLowered:
+    """Adapter returned by WarmFn.lower(): .compile() forces the
+    store resolution with the lowering arguments as the AOT example
+    and hands back the (now-resolved) WarmFn."""
+
+    def __init__(self, warm, args, kwargs):
+        self._warm = warm
+        self._args = args
+        self._kwargs = kwargs
+
+    def compile(self):
+        self._warm._ensure(self._args, self._kwargs)
+        return self._warm
+
+
+def maybe_warm(jitted, key: str | None, *, enabled: bool,
+               store=None, meta=None, info=None):
+    """Wrap `jitted` for warm serving when enabled and keyed;
+    otherwise return it untouched (and mark info warm=False so the
+    manifest still records that serving was off)."""
+    if not enabled or key is None:
+        if info is not None:
+            info.setdefault("warm", False)
+            # lazy key factories (net/build.py) stay unresolved when
+            # serving is off — a callable must never leak into the
+            # manifest's compile block
+            if isinstance(key, str):
+                info.setdefault("key", key)
+        return jitted
+    return WarmFn(jitted, key, store=store, meta=meta, info=info)
+
+
+def prewarm(bundle, app_handlers=(), *, end_time=None,
+            mesh=None, mesh_axis: str = "hosts",
+            exchange_capacity=None, windows_per_dispatch=None,
+            adaptive_jump=None, store=None, log=None) -> dict:
+    """Compile (or confirm warm) the supervised-loop program for a
+    built bundle's shape, populating the store so the NEXT run of
+    this shape starts dispatching instead of compiling. Constructs
+    the exact dispatch function run_windows would use and forces it
+    through the store with example arguments (the bundle's own sim) —
+    the persisted program IS the one a later run_windows loads.
+    Returns the compile-info block ({key, hit, ...}); callers who
+    want bucket sharing build the bundle from a bucketed config
+    (compile.buckets.bucket_config) first."""
+    from shadow_tpu.utils import checkpoint
+
+    say = log or (lambda m: None)
+    info = checkpoint.prewarm_dispatch(
+        bundle, app_handlers, end_time=end_time, mesh=mesh,
+        mesh_axis=mesh_axis, exchange_capacity=exchange_capacity,
+        windows_per_dispatch=windows_per_dispatch,
+        adaptive_jump=adaptive_jump, store=store)
+    say(f"prewarm {info.get('key')}: "
+        + ("hit" if info.get("hit") else
+           f"compiled in {info.get('compile_s', 0.0):.1f}s"))
+    return info

@@ -109,10 +109,18 @@ def plan_growth(health, capacities: dict, policy: EscalationPolicy,
             f"is spent (--max-grow)")
     overrides: dict = {}
     events: list[Escalation] = []
+    from shadow_tpu.compile.buckets import quantize_pow2
+
     for latch in latches:
         knob = LATCH_KNOBS[latch]
         old = int(capacities[knob])
-        new = old * policy.factor
+        # grow to the NEXT POWER-OF-TWO BUCKET at or above old*factor:
+        # a pow2 capacity doubles exactly as before, a bespoke one
+        # (say 24 -> 48 -> 64) lands on a bucket the AOT program
+        # store has likely already compiled (compile/buckets.py), so
+        # the heal restarts on a warm program instead of paying a
+        # bespoke-shape trace
+        new = quantize_pow2(old * policy.factor)
         overrides[knob] = new
         events.append(Escalation(time_ns=int(time_ns), latch=latch,
                                  knob=knob, old=old, new=new))
@@ -136,10 +144,15 @@ def plan_lane_regrow(trip_bits: int, capacities: dict,
     job: every capacity knob named by the lane's trip bits, doubled —
     the lane-local analog of plan_growth, without the shared program's
     grow budget (the requeued job budgets its own attempts)."""
+    from shadow_tpu.compile.buckets import quantize_pow2
+
     overrides = {}
     for bit, knob in TRIP_BIT_KNOBS.items():
         if int(trip_bits) & bit:
-            overrides[knob] = int(capacities[knob]) * int(factor)
+            # next-bucket regrow, same rule as plan_growth: the
+            # requeued lane-job lands on a warm program bucket
+            overrides[knob] = quantize_pow2(
+                int(capacities[knob]) * int(factor))
     return overrides
 
 

@@ -149,6 +149,10 @@ class SupervisorResult:
     # Lane-isolated runs: every lane quarantined across the chain,
     # with salvage pointers — the fleet's requeue feed.
     lane_incidents: tuple = ()
+    # Manifest `compile` block for the FINAL attempt's dispatch
+    # program (compile/serve.py): {key, warm, hit, load_s|compile_s}.
+    # None when the loop never dispatched or warm accounting was off.
+    compile_info: Optional[dict] = None
 
     def failure_report(self) -> dict:
         rep = self.health.failure_report() if self.health is not None \
@@ -199,6 +203,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    adaptive_jump: bool | None = None,
                    feeder=None,
                    on_lane_quarantine=None,
+                   warm_start: bool | None = None,
                    ) -> SupervisorResult:
     """Run bundle to end_time under supervision (host-driven window
     loop; serial by default, shard_map'd over `mesh` when given — the
@@ -343,6 +348,11 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
         tele = {"zero_streak": 0, "worst_streak": 0, "regressed": False,
                 "wstart": None, "since_ckpt": 0, "acc": {},
                 "dispatch_windows": []}
+        # Filled by run_windows' warm wrapper at the first dispatch of
+        # this attempt; the FINAL attempt's block lands in the result
+        # (an escalation restart compiles a new program — that is the
+        # one the manifest should report).
+        cinfo: dict = {}
 
         def _on_chunk(sim, wstats, wstart, wend, next_min):
             tele["wstart"] = wstart
@@ -443,7 +453,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 run_id=run_id, resume_of=resume_of,
                 dispatches=len(tele["dispatch_windows"]),
                 dispatch_windows=tuple(tele["dispatch_windows"]),
-                lane_incidents=tuple(lane_incidents), **kw)
+                lane_incidents=tuple(lane_incidents),
+                compile_info=(dict(cinfo) if cinfo else None), **kw)
 
         from shadow_tpu.core.engine import EngineStats
 
@@ -462,6 +473,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 windows_per_dispatch=windows_per_dispatch,
                 adaptive_jump=adaptive_jump,
                 feeder=feeder,
+                warm_start=warm_start,
+                compile_info=cinfo,
             )
             if harvester is not None:
                 harvester.drain(sim)

@@ -43,6 +43,15 @@ def _job_entry(queue, j) -> dict:
         "failure": j.failure,
         "quarantine_reason": j.quarantine_reason,
     }
+    # bucket-affinity fields (fleet/affinity.py): the spec-derived
+    # scheduling key, plus the realized program key once the job's
+    # run_manifest reported one — equal affinity_keys must map to
+    # equal program_keys (the lint's consistency check)
+    from shadow_tpu.fleet.affinity import affinity_key
+
+    entry["affinity_key"] = affinity_key(j.spec)
+    if j.result and j.result.get("program_key"):
+        entry["program_key"] = j.result["program_key"]
     if getattr(j.spec, "replicas", 1) > 1:
         # packed job: surface the per-lane verdicts + requeue children
         # at the entry level so the lint (and the operator) need not

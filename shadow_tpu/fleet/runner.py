@@ -88,6 +88,11 @@ class FleetRunner:
         self._drain_deadline: Optional[float] = None
         self._stalled = False
         self._hb_journaled: dict[str, float] = {}
+        # worker id -> affinity key of its last-leased job: the
+        # bucket-affinity pairing state (fleet/affinity.py). In-memory
+        # only — after a runner restart every worker process is new,
+        # so stale affinity would be wrong anyway.
+        self._worker_last_key: dict[str, str] = {}
 
     # -- events -------------------------------------------------------
     def _emit(self, ev: str, **payload) -> None:
@@ -124,6 +129,7 @@ class FleetRunner:
                      kill: bool = False) -> None:
         """Remove a worker from the pool; requeue whatever it held."""
         w = self.workers.pop(wid, None)
+        self._worker_last_key.pop(wid, None)
         if w is None:
             return
         if kill and w["proc"].is_alive():
@@ -147,13 +153,20 @@ class FleetRunner:
     def _dispatch(self, now: float) -> None:
         if self._draining:
             return
+        from shadow_tpu.fleet import affinity
+
         idle = [wid for wid, w in self.workers.items()
                 if w["job"] is None and w["proc"].is_alive()]
-        for j in self.queue.ready(now):
-            if not idle:
-                break
-            wid = idle.pop(0)
+        # bucket-affinity pairing (fleet/affinity.py): a worker that
+        # just ran a job takes the first ready job sharing its program
+        # key — the compiled program is still warm in that process —
+        # while everything else keeps plain FIFO order
+        pairs = affinity.assign(
+            self.queue.ready(now), idle, self._worker_last_key,
+            key_of=lambda j: affinity.affinity_key(j.spec))
+        for wid, j in pairs:
             rec = self.queue.lease(j.spec.id, wid)
+            self._worker_last_key[wid] = affinity.affinity_key(j.spec)
             w = self.workers[wid]
             w["job"] = j.spec.id
             w["attempt"] = rec["attempt"]

@@ -102,9 +102,19 @@ def _build_scenario(spec: JobSpec, caps: dict):
                     router_ring=caps["router_ring"],
                     in_ring=max(8, 2 * spec.load),
                     inject_lanes=lanes)
+    # quantize every shape-bearing knob to its power-of-two bucket so
+    # jobs of nearby sizes share one compiled program (and one AOT
+    # store entry). Padding is behavior-neutral until the first
+    # overflow, so the run is bit-identical to the exact-capacity
+    # build at the same bucket (compile/buckets.py; the lint checks
+    # the recorded plan). The plan rides the bundle for the manifest.
+    from shadow_tpu.compile.buckets import bucket_config
+
+    cfg, bucket_plan = bucket_config(cfg)
     hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
              for i in range(H)]
     b = build(cfg, graph, hosts)
+    b.bucket_plan = bucket_plan
     b.sim = phold.setup(b.sim, load=spec.load,
                         replica_size=spec.hosts if R > 1 else None)
     if R > 1:
@@ -178,7 +188,11 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         rebuild=rebuild, stop=stop, resume_from=resume_from,
         max_run_wallclock=spec.max_wallclock_s,
         on_round=on_round, log=log, sleep=lambda s: None,
-        feeder=feeder)
+        feeder=feeder,
+        # fleets live on repeated shapes: serve dispatch programs from
+        # the persistent AOT store by default (compile/serve.py;
+        # SHADOW_WARM_PROGRAMS=0 / SHADOW_NO_COMPILE_CACHE opt out)
+        warm_start=True)
 
     result = {
         "ok": bool(res.ok),
@@ -215,6 +229,11 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         from shadow_tpu import inject as inject_mod
         from shadow_tpu.telemetry.export import lanes_manifest_block
 
+        cinfo = dict(res.compile_info or {})
+        plan = getattr(bundle, "bucket_plan", None)
+        if plan is not None:
+            cinfo["buckets"] = plan.as_dict()
+        result["program_key"] = cinfo.get("key")
         man = telemetry.run_manifest(
             cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
             stats=res.stats, health=res.health,
@@ -223,7 +242,8 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             escalations=res.escalations,
             preempted=res.preempted or None,
             injection=inject_mod.manifest_block(res.sim, feeder),
-            lanes=lanes_manifest_block(res.health, incidents))
+            lanes=lanes_manifest_block(res.health, incidents),
+            compile_info=cinfo or None)
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
         result["counters"] = man["counters"]

@@ -284,12 +284,51 @@ def resolve_wend_fn(bundle: SimBundle, end_time: int, adaptive: bool,
                         pair_mask=mask, fault_times=ft, table_fn=tf)
 
 
+def _whole_run_key_fn(bundle: SimBundle, app_handlers, *, end, path,
+                      chunk_windows, adaptive, fault_fn, app_bulk,
+                      app_tcp_bulk, tcp_bulk_lossless=False,
+                      route_impl=None, shards=1,
+                      exchange_capacity=None):
+    """Lazy program-key rule for the whole-run factories (compile/):
+    the shape vector comes from the FIRST call's sim (telemetry /
+    lane / injection attachments change the traced pytree, and the
+    factory's callable accepts any of them), everything else is fixed
+    at factory time. Returns None — warm serving disabled — when the
+    caller passed an opaque fault_fn: its closure constants are baked
+    into the trace but invisible to the key."""
+    if fault_fn is not None:
+        return None
+
+    def _key(args, kwargs):
+        from shadow_tpu.compile import buckets
+        from shadow_tpu.telemetry.export import fault_plan_digest
+
+        fp = getattr(bundle, "fault_plan", None)
+        extra = {"path": path, "route_impl": route_impl,
+                 "tcp_bulk_lossless": bool(tcp_bulk_lossless),
+                 "tcp_bulk": (type(app_tcp_bulk).__name__
+                              if app_tcp_bulk is not None else None)}
+        census = buckets.kind_census(
+            app_handlers, app_bulk,
+            fault_plan_digest=(fault_plan_digest(fp)
+                               if fp is not None else None))
+        shapes = buckets.shape_vector_for_sim(bundle.cfg, args[0])
+        return buckets.program_key(
+            shapes, shards=int(shards), chunk_windows=chunk_windows,
+            adaptive=adaptive, census=census, end_time=int(end),
+            min_jump=bundle.min_jump,
+            exchange_capacity=exchange_capacity, extra=extra)
+
+    return _key
+
+
 def make_runner(bundle: SimBundle, app_handlers=(),
                 end_time: int | None = None, app_bulk=None,
                 app_tcp_bulk=None,
                 route_impl: str | None = None,
                 tcp_bulk_lossless: bool = False,
-                fault_fn=None):
+                fault_fn=None, warm_start: bool | None = None,
+                compile_info: dict | None = None):
     """Build a jitted sim -> (sim, stats) callable for the whole run.
     Reuse it across calls: tracing the full netstack in Python costs
     seconds per call at this op count; a reused jitted callable pays
@@ -310,7 +349,14 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     mailbox kernel is gated on jax.default_backend() at trace time
     (array placement is unknowable under jit), so tracing it against
     CPU-pinned state would compile the TPU-only kernel. Use "sort"
-    for CPU-pinned overrides."""
+    for CPU-pinned overrides.
+
+    `warm_start` serves the program from the persistent AOT store
+    (compile/) — a stored program for this shape loads without
+    retracing the netstack; SHADOW_WARM_PROGRAMS overrides, and
+    `compile_info` (a dict) receives the {key, hit, load_s|compile_s}
+    block at the first call."""
+    caller_fault_fn = fault_fn
     step = make_step_fn(bundle.cfg, app_handlers)
     end = end_time if end_time is not None else bundle.cfg.end_time
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
@@ -340,14 +386,27 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             fault_times=plan_times(bundle),
         )
 
-    return jax.jit(_go)
+    from shadow_tpu.compile import serve
+
+    return serve.maybe_warm(
+        jax.jit(_go),
+        _whole_run_key_fn(bundle, app_handlers, end=end, path="whole",
+                          chunk_windows=0, adaptive=False,
+                          fault_fn=caller_fault_fn, app_bulk=app_bulk,
+                          app_tcp_bulk=app_tcp_bulk,
+                          tcp_bulk_lossless=tcp_bulk_lossless,
+                          route_impl=route_impl),
+        enabled=serve.warm_enabled(default=bool(warm_start)),
+        info=compile_info)
 
 
 def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                         end_time: int | None = None, app_bulk=None,
                         app_tcp_bulk=None, chunk_windows: int = 256,
                         tcp_bulk_lossless: bool = False,
-                        fault_fn=None, adaptive_jump: bool = False):
+                        fault_fn=None, adaptive_jump: bool = False,
+                        warm_start: bool | None = None,
+                        compile_info: dict | None = None):
     """make_runner variant that executes `chunk_windows` windows per
     device call with a host-side outer loop — window-for-window the
     SAME sequence engine.run's single while_loop produces (advance
@@ -381,6 +440,7 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
             f"chunk_windows must be >= 1, got {chunk_windows} "
             "(0 iterations would spin the host loop forever)")
 
+    caller_fault_fn = fault_fn
     step = make_step_fn(bundle.cfg, app_handlers)
     end = int(end_time if end_time is not None else bundle.cfg.end_time)
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
@@ -396,7 +456,19 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
         lane_fn=lambda s: s.net.lane_id,
         bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
         sparse_lanes=resolve_sparse_lanes(bundle.cfg))
-    k_windows = jax.jit(chunk, donate_argnums=(0,))
+    from shadow_tpu.compile import serve
+
+    k_windows = serve.maybe_warm(
+        jax.jit(chunk, donate_argnums=(0,)),
+        _whole_run_key_fn(bundle, app_handlers, end=end,
+                          path="whole_chunk",
+                          chunk_windows=int(chunk_windows),
+                          adaptive=bool(adaptive_jump),
+                          fault_fn=caller_fault_fn, app_bulk=app_bulk,
+                          app_tcp_bulk=app_tcp_bulk,
+                          tcp_bulk_lossless=tcp_bulk_lossless),
+        enabled=serve.warm_enabled(default=bool(warm_start)),
+        info=compile_info)
 
     def go(sim):
         # Donation consumes the sim argument buffers; copy once so the

@@ -340,10 +340,19 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
                     lane_id_fn=None, exchange_capacity: int | None = None,
                     narrow: int | None = None,
                     bulk_fn=None, fault_fn=None, sparse_lanes: int = 0,
-                    fault_times=None):
+                    fault_times=None, warm_key=None,
+                    warm_start: bool | None = None,
+                    compile_info: dict | None = None):
     """Shared factory: a jitted sim -> (sim, stats) running the full
     engine loop under shard_map (used by sharded_engine_run and
-    make_sharded_runner — keep their semantics identical)."""
+    make_sharded_runner — keep their semantics identical).
+
+    `warm_key` (a program key or a lazy (args, kwargs) -> key rule,
+    compile/buckets.py) routes the jitted program through the
+    persistent AOT store when `warm_start`/SHADOW_WARM_PROGRAMS says
+    so — callers that know the bundle derive the key
+    (net.build._whole_run_key_fn); without one, serving stays off
+    (this factory only sees opaque closures it cannot key)."""
     num_shards, specs, stats_specs = _harness_specs(mesh, axis, sim)
 
     def _body(local_sim):
@@ -387,7 +396,12 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
         _body, mesh=mesh, in_specs=(specs,), out_specs=(specs, stats_specs),
         check_vma=False,
     )
-    jitted = jax.jit(shmapped)
+    from shadow_tpu.compile import serve
+
+    jitted = serve.maybe_warm(
+        jax.jit(shmapped), warm_key,
+        enabled=serve.warm_enabled(default=bool(warm_start)),
+        info=compile_info)
     in_shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                                 is_leaf=lambda x: isinstance(x, P))
 
@@ -531,7 +545,8 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
                         exchange_capacity: int | None = None,
                         app_bulk=None, app_tcp_bulk=None,
                         tcp_bulk_lossless: bool = False,
-                        fault_fn=None):
+                        fault_fn=None, warm_start: bool | None = None,
+                        compile_info: dict | None = None):
     """Multi-chip variant of shadow_tpu.net.build.make_runner: a
     REUSABLE jitted sim -> (sim, stats) callable running the whole
     window loop under shard_map (benchmarks must reuse one callable —
@@ -554,25 +569,39 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
 
         bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
                                    lossless=tcp_bulk_lossless)
-    from shadow_tpu.net.build import _resolve_fault_fn, plan_times
+    from shadow_tpu.net.build import (_resolve_fault_fn,
+                                      _whole_run_key_fn, plan_times)
 
+    caller_fault_fn = fault_fn
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
+    end = end_time if end_time is not None else bundle.cfg.end_time
     return _make_whole_run(
         mesh, axis, bundle.sim, step,
-        end_time=end_time if end_time is not None else bundle.cfg.end_time,
+        end_time=end,
         min_jump=bundle.min_jump,
         emit_capacity=bundle.cfg.emit_capacity,
         exchange_capacity=exchange_capacity,
         bulk_fn=bulk_fn, fault_fn=fault_fn,
         sparse_lanes=resolve_sparse_lanes(bundle.cfg),
-        fault_times=plan_times(bundle))
+        fault_times=plan_times(bundle),
+        warm_key=_whole_run_key_fn(
+            bundle, app_handlers, end=end, path="sharded_whole",
+            chunk_windows=0, adaptive=False, fault_fn=caller_fault_fn,
+            app_bulk=app_bulk, app_tcp_bulk=app_tcp_bulk,
+            tcp_bulk_lossless=tcp_bulk_lossless,
+            shards=mesh.shape[axis],
+            exchange_capacity=exchange_capacity),
+        warm_start=warm_start, compile_info=compile_info)
 
 
 def run_sharded(bundle, mesh: Mesh, axis: str = "hosts", app_handlers=(),
                 end_time: int | None = None,
                 exchange_capacity: int | None = None,
-                app_bulk=None, app_tcp_bulk=None):
+                app_bulk=None, app_tcp_bulk=None,
+                warm_start: bool | None = None,
+                compile_info: dict | None = None):
     """One-shot multi-chip variant of shadow_tpu.net.build.run."""
     return make_sharded_runner(
         bundle, mesh, axis, app_handlers, end_time,
-        exchange_capacity, app_bulk, app_tcp_bulk)(bundle.sim)
+        exchange_capacity, app_bulk, app_tcp_bulk,
+        warm_start=warm_start, compile_info=compile_info)(bundle.sim)

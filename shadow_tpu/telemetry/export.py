@@ -199,7 +199,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  preempted: bool | None = None,
                  dispatch: dict | None = None,
                  injection: dict | None = None,
-                 lanes: dict | None = None) -> dict:
+                 lanes: dict | None = None,
+                 compile_info: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -262,6 +263,13 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # lane-isolated packed run (lanes_manifest_block): per-lane
         # counters, quarantine verdicts, salvage/requeue pointers
         man["lanes"] = lanes
+    if compile_info is not None:
+        # warm-program serving (compile/): program key, bucket plan,
+        # hit/miss, and the compile-path timing (load_s on a hit,
+        # lower_s+compile_s on a miss). tools/telemetry_lint.py
+        # checks key format, hit/timing consistency, and that every
+        # bucketed capacity >= its requested value
+        man["compile"] = dict(compile_info)
     return man
 
 
@@ -283,6 +291,13 @@ def metrics_from_manifest(man: dict) -> dict:
         out["compile_seconds"] = man["compile_s"]
         if "compile_fresh" in man:
             out["compile_fresh"] = bool(man["compile_fresh"])
+    if "compile" in man:
+        c = man["compile"]
+        if "hit" in c:
+            out["compile_program_hit"] = bool(c["hit"])
+        for k in ("load_s", "compile_s", "lower_s"):
+            if c.get(k) is not None:
+                out[f"compile_program_{k}"] = c[k]
     if "wall_phases_s" in man:
         out["wall_phase_seconds"] = man["wall_phases_s"]
     if "conformance" in man:

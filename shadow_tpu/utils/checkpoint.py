@@ -294,6 +294,193 @@ def load(path: str, template_sim):
     return sim, meta["time_ns"], meta["extra"]
 
 
+class _LoopPlan:
+    """Resolved loop parameters shared by run_windows and
+    prewarm_dispatch — one resolution rule so the program a prewarm
+    persists is bit-for-bit the program a later run_windows loads."""
+
+    __slots__ = ("cfg", "step", "end", "min_jump", "fault_fn",
+                 "caller_fault_fn", "bulk_fn", "wpd", "adaptive",
+                 "chunked", "shards")
+
+
+def _resolve_loop(bundle, app_handlers, *, end_time, fault_fn, mesh,
+                  mesh_axis, windows_per_dispatch, adaptive_jump):
+    from shadow_tpu.net.build import _resolve_bulk_fn, _resolve_fault_fn
+    from shadow_tpu.net.step import make_step_fn
+
+    p = _LoopPlan()
+    cfg = p.cfg = bundle.cfg
+    p.step = make_step_fn(cfg, app_handlers)
+    p.end = int(end_time if end_time is not None else cfg.end_time)
+    p.min_jump = max(int(bundle.min_jump), 1)
+    p.caller_fault_fn = fault_fn
+    p.fault_fn = (fault_fn if fault_fn is not None
+                  else _resolve_fault_fn(bundle, None))
+    # honor the bundle's config-installed bulk pass (bundle.app_bulk,
+    # net/bulk.py) exactly like the whole-run factories: bulk consumes
+    # eligible hosts' windows in one vectorized pass, bit-identical
+    # final state, far fewer fixpoint iterations — without it the
+    # host-driven loop could never close the throughput gap to
+    # engine.run no matter how many windows a dispatch amortizes
+    p.bulk_fn = _resolve_bulk_fn(bundle, getattr(bundle, "app_bulk", None),
+                                 None)
+    wpd = (int(windows_per_dispatch) if windows_per_dispatch is not None
+           else max(1, int(getattr(cfg, "windows_per_dispatch", 1) or 1)))
+    if wpd < 1:
+        raise ValueError(f"windows_per_dispatch must be >= 1, got {wpd}")
+    p.wpd = wpd
+    p.adaptive = (bool(adaptive_jump) if adaptive_jump is not None
+                  else bool(getattr(cfg, "adaptive_jump", False)))
+    p.chunked = wpd > 1 or p.adaptive
+    p.shards = 1 if mesh is None else mesh.shape[mesh_axis]
+    return p
+
+
+def _program_key_for(bundle, plan, sim, app_handlers, *, sharded,
+                     exchange_capacity):
+    """Canonical program key for this loop's dispatch function
+    (compile/buckets.py), or None when the caller passed an opaque
+    fault_fn — its closure constants are baked into the trace but
+    invisible to the key, so warm serving would risk serving a
+    program traced with someone else's constants."""
+    if plan.caller_fault_fn is not None:
+        return None
+    import hashlib
+
+    from shadow_tpu.compile import buckets
+    from shadow_tpu.telemetry.export import fault_plan_digest
+
+    fp = getattr(bundle, "fault_plan", None)
+    extra = {"path": ("sharded_" if sharded else "")
+             + ("chunk" if plan.chunked else "window")}
+    if plan.adaptive:
+        # the adaptive wend rule bakes the host->vertex map into the
+        # traced pair mask (net.build.adaptive_jump_spec)
+        voh = np.asarray(bundle.sim.net.vertex_of_host)
+        extra["voh"] = hashlib.sha256(voh.tobytes()).hexdigest()[:16]
+    census = buckets.kind_census(
+        app_handlers, getattr(bundle, "app_bulk", None),
+        fault_plan_digest=fault_plan_digest(fp) if fp is not None else None)
+    shapes = buckets.shape_vector_for_sim(bundle.cfg, sim)
+    return buckets.program_key(
+        shapes, shards=plan.shards,
+        chunk_windows=plan.wpd if plan.chunked else 1,
+        adaptive=plan.adaptive, census=census, end_time=plan.end,
+        min_jump=bundle.min_jump, exchange_capacity=exchange_capacity,
+        extra=extra)
+
+
+def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
+                       mesh_axis, exchange_capacity, warm,
+                       store=None, compile_info=None):
+    """Build the loop's dispatch program — the chunked body or the
+    per-window body, serial or sharded — and route it through the AOT
+    store when warm serving is on. Returns (chunk_fn, one_window,
+    key, raw_fn, example_args): exactly one of chunk_fn/one_window is
+    non-None; raw_fn/example_args let prewarm_dispatch compile the
+    identical program without executing it."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.core import simtime
+    from shadow_tpu.core.engine import (
+        EngineStats,
+        make_chunk_body,
+        resolve_sparse_lanes,
+        step_window,
+    )
+    from shadow_tpu.compile import serve
+    from shadow_tpu.telemetry.ring import make_telem_fn
+
+    cfg = bundle.cfg
+    key = None
+    if warm or compile_info is not None:
+        key = _program_key_for(bundle, plan, sim, app_handlers,
+                               sharded=mesh is not None,
+                               exchange_capacity=exchange_capacity)
+    step, end, wpd = plan.step, plan.end, plan.wpd
+    bulk_fn, fault_fn = plan.bulk_fn, plan.fault_fn
+    if plan.chunked:
+        from shadow_tpu.net.build import resolve_wend_fn
+
+        # the adaptive rule needs the PLAN's record times; an opaque
+        # caller fault_fn is only acceptable when the bundle carries
+        # the plan it was derived from (resolve_wend_fn enforces)
+        wend_fn = resolve_wend_fn(bundle, end, plan.adaptive,
+                                  plan.caller_fault_fn)
+        if mesh is not None:
+            from shadow_tpu.parallel.shard import make_sharded_chunk
+
+            raw = make_sharded_chunk(
+                mesh, mesh_axis, bundle.sim, cfg, step,
+                end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
+                exchange_capacity=exchange_capacity,
+                bulk_fn=bulk_fn, fault_fn=fault_fn)
+        else:
+            telem_fn = make_telem_fn()  # trace-time no-op, telem None
+            body = make_chunk_body(
+                step, end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
+                emit_capacity=cfg.emit_capacity,
+                lane_fn=lambda s: s.net.lane_id,
+                bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
+                sparse_lanes=resolve_sparse_lanes(cfg))
+            raw = jax.jit(body)
+        example = (sim, EngineStats.create(),
+                   jnp.asarray(0, simtime.DTYPE))
+        chunk_fn = serve.maybe_warm(raw, key, enabled=warm, store=store,
+                                    info=compile_info)
+        return chunk_fn, None, key, raw, example
+    if mesh is not None:
+        from shadow_tpu.parallel.shard import make_sharded_window
+
+        raw = make_sharded_window(
+            mesh, mesh_axis, bundle.sim, cfg, step,
+            exchange_capacity=exchange_capacity,
+            bulk_fn=bulk_fn, fault_fn=fault_fn,
+            donate=True)
+    else:
+        telem_fn = make_telem_fn()  # trace-time no-op, telem is None
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def raw(sim, wstart, wend):
+            stats = EngineStats.create()
+            return step_window(sim, stats, step, wend,
+                               emit_capacity=cfg.emit_capacity,
+                               lane_id=sim.net.lane_id,
+                               bulk_fn=bulk_fn, fault_fn=fault_fn,
+                               telem_fn=telem_fn, wstart=wstart,
+                               sparse_lanes=resolve_sparse_lanes(cfg))
+    example = (sim, 0, plan.min_jump)
+    one_window = serve.maybe_warm(raw, key, enabled=warm, store=store,
+                                  info=compile_info)
+    return None, one_window, key, raw, example
+
+
+def prewarm_dispatch(bundle, app_handlers=(), *, end_time=None, sim=None,
+                     mesh=None, mesh_axis: str = "hosts",
+                     exchange_capacity=None, windows_per_dispatch=None,
+                     adaptive_jump=None, store=None) -> dict:
+    """Compile (or confirm already stored) the exact dispatch program
+    run_windows would use for this bundle, WITHOUT executing a single
+    window — the engine behind compile.serve.prewarm and the
+    compcache_ctl `prewarm` subcommand. Returns the compile-info
+    block ({key, hit, compile_s|load_s})."""
+    from shadow_tpu.compile.store import default_store
+
+    plan = _resolve_loop(bundle, app_handlers, end_time=end_time,
+                         fault_fn=None, mesh=mesh, mesh_axis=mesh_axis,
+                         windows_per_dispatch=windows_per_dispatch,
+                         adaptive_jump=adaptive_jump)
+    sim = sim if sim is not None else bundle.sim
+    _, _, key, raw, example = _make_dispatch_fns(
+        bundle, plan, sim, app_handlers, mesh=mesh, mesh_axis=mesh_axis,
+        exchange_capacity=exchange_capacity, warm=False, store=store,
+        compile_info={})
+    st = store if store is not None else default_store()
+    _, info = st.get_or_compile(key, raw, example)
+    return info
+
+
 def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 start_time: int = 0, sim=None,
                 checkpoint_every_ns: int | None = None,
@@ -304,7 +491,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 exchange_capacity: int | None = None,
                 windows_per_dispatch: int | None = None,
                 adaptive_jump: bool | None = None,
-                feeder=None):
+                feeder=None, warm_start: bool | None = None,
+                compile_info: dict | None = None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
     master.c:450-480). Returns (sim, stats, checkpoints) where
@@ -369,23 +557,32 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
     non-speculatively while events remain (the refill must land
     before the next dispatch) and falls back to the speculative
     double-buffer once the trace is exhausted.
+
+    `warm_start` asks for the dispatch program from the persistent
+    AOT store (compile/) instead of jitting inline: a stored program
+    for this shape bucket loads in milliseconds where a fresh trace
+    costs seconds-to-minutes. SHADOW_WARM_PROGRAMS=1/0 overrides the
+    caller's choice; a store miss compiles and persists for the next
+    run; any store trouble falls back to the inline jit
+    (compile/serve.py). `compile_info`, when given, is filled with
+    the manifest `compile` block ({key, warm, hit, load_s|compile_s})
+    at the first dispatch — the supervisor threads it into the run
+    manifest. An opaque caller `fault_fn` disables warm serving (its
+    closure constants cannot be keyed).
     """
     import jax.numpy as jnp
 
     from shadow_tpu.core import simtime
-    from shadow_tpu.core.engine import (
-        EngineStats,
-        make_chunk_body,
-        resolve_sparse_lanes,
-        step_window,
-    )
-    from shadow_tpu.net.step import make_step_fn
-    from shadow_tpu.telemetry.ring import make_telem_fn
+    from shadow_tpu.core.engine import EngineStats
 
-    cfg = bundle.cfg
-    step = make_step_fn(cfg, app_handlers)
-    end = int(end_time if end_time is not None else cfg.end_time)
-    min_jump = max(int(bundle.min_jump), 1)
+    plan = _resolve_loop(bundle, app_handlers, end_time=end_time,
+                         fault_fn=fault_fn,
+                         mesh=mesh, mesh_axis=mesh_axis,
+                         windows_per_dispatch=windows_per_dispatch,
+                         adaptive_jump=adaptive_jump)
+    cfg, end, min_jump = plan.cfg, plan.end, plan.min_jump
+    chunked, wpd, adaptive = plan.chunked, plan.wpd, plan.adaptive
+    shards = plan.shards
     # host-side twin of the record-time wend clamp (make_wend_fn /
     # engine.run): faults apply exactly at their timestamps, never
     # early because a window happened to cross one. Sorted by
@@ -400,75 +597,15 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
         i = int(np.searchsorted(_pt, wstart, side="right"))
         return min(wend, int(_pt[i])) if i < len(_pt) else wend
     sim = sim if sim is not None else bundle.sim
-    caller_fault_fn = fault_fn
-    if fault_fn is None:
-        from shadow_tpu.net.build import _resolve_fault_fn
-
-        fault_fn = _resolve_fault_fn(bundle, None)
-    # honor the bundle's config-installed bulk pass (bundle.app_bulk,
-    # net/bulk.py) exactly like the whole-run factories: bulk consumes
-    # eligible hosts' windows in one vectorized pass, bit-identical
-    # final state, far fewer fixpoint iterations — without it the
-    # host-driven loop could never close the throughput gap to
-    # engine.run no matter how many windows a dispatch amortizes
-    from shadow_tpu.net.build import _resolve_bulk_fn
-
-    bulk_fn = _resolve_bulk_fn(bundle, getattr(bundle, "app_bulk", None),
-                               None)
-    wpd = (int(windows_per_dispatch) if windows_per_dispatch is not None
-           else max(1, int(getattr(cfg, "windows_per_dispatch", 1) or 1)))
-    if wpd < 1:
-        raise ValueError(f"windows_per_dispatch must be >= 1, got {wpd}")
-    adaptive = (bool(adaptive_jump) if adaptive_jump is not None
-                else bool(getattr(cfg, "adaptive_jump", False)))
-    chunked = wpd > 1 or adaptive
     hook = on_chunk if on_chunk is not None else on_round
 
-    shards = 1 if mesh is None else mesh.shape[mesh_axis]
-    if chunked:
-        from shadow_tpu.net.build import resolve_wend_fn
+    from shadow_tpu.compile import serve as _serve
 
-        # the adaptive rule needs the PLAN's record times; an opaque
-        # caller fault_fn is only acceptable when the bundle carries
-        # the plan it was derived from (resolve_wend_fn enforces)
-        wend_fn = resolve_wend_fn(bundle, end, adaptive, caller_fault_fn)
-        if mesh is not None:
-            from shadow_tpu.parallel.shard import make_sharded_chunk
-
-            chunk_fn = make_sharded_chunk(
-                mesh, mesh_axis, bundle.sim, cfg, step,
-                end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
-                exchange_capacity=exchange_capacity,
-                bulk_fn=bulk_fn, fault_fn=fault_fn)
-        else:
-            telem_fn = make_telem_fn()  # trace-time no-op, telem None
-            body = make_chunk_body(
-                step, end_time=end, wend_fn=wend_fn, chunk_windows=wpd,
-                emit_capacity=cfg.emit_capacity,
-                lane_fn=lambda s: s.net.lane_id,
-                bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
-                sparse_lanes=resolve_sparse_lanes(cfg))
-            chunk_fn = jax.jit(body)
-    elif mesh is not None:
-        from shadow_tpu.parallel.shard import make_sharded_window
-
-        one_window = make_sharded_window(
-            mesh, mesh_axis, bundle.sim, cfg, step,
-            exchange_capacity=exchange_capacity,
-            bulk_fn=bulk_fn, fault_fn=fault_fn,
-            donate=True)
-    else:
-        telem_fn = make_telem_fn()  # trace-time no-op, telem is None
-
-        @partial(jax.jit, donate_argnums=(0,))
-        def one_window(sim, wstart, wend):
-            stats = EngineStats.create()
-            return step_window(sim, stats, step, wend,
-                               emit_capacity=cfg.emit_capacity,
-                               lane_id=sim.net.lane_id,
-                               bulk_fn=bulk_fn, fault_fn=fault_fn,
-                               telem_fn=telem_fn, wstart=wstart,
-                               sparse_lanes=resolve_sparse_lanes(cfg))
+    warm = _serve.warm_enabled(default=bool(warm_start))
+    chunk_fn, one_window, _key, _raw, _ex = _make_dispatch_fns(
+        bundle, plan, sim, app_handlers, mesh=mesh, mesh_axis=mesh_axis,
+        exchange_capacity=exchange_capacity, warm=warm,
+        compile_info=compile_info)
 
     total = stats0 if stats0 is not None else EngineStats.create()
     saved = []

@@ -1,0 +1,400 @@
+"""Shape-bucketed AOT program cache (ISSUE PR 12 tentpole): capacity
+quantization must be behavior-neutral, the persistent program store
+must round-trip compiled executables and degrade to a fresh compile on
+any corruption or version skew, escalation must regrow onto the pow2
+bucket lattice, and the fleet's bucket-affinity assignment must be
+deterministic with a FIFO fallback that never starves a cold key. The
+acceptance bars live here:
+
+- a run built from a bucketed config (24 -> 32) is bit-identical, on
+  every shape-independent array, to the same run at the bespoke
+  capacity (the padding-is-free invariant from compile/buckets.py);
+- an executable stored by one ProgramStore resolve is served warm by
+  the next, and a corrupt payload / stale code version / avals drift
+  each fall back to a fresh compile, never a crash;
+- prewarm_dispatch populates the store with the EXACT program a later
+  run_windows(warm_start=True) loads.
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from shadow_tpu.apps import phold
+from shadow_tpu.compile import buckets, serve
+from shadow_tpu.compile.store import ProgramStore, default_store
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import escalate
+from shadow_tpu.fleet import affinity
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.utils import checkpoint
+
+GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H, LOAD = 8, 2
+
+
+def _build(caps=None, sim_s=1, seed=7, bucketed=False):
+    c = caps or {}
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=c.get("event_capacity", 32),
+                    outbox_capacity=c.get("outbox_capacity", 32),
+                    router_ring=c.get("router_ring", 32),
+                    in_ring=max(8, 2 * LOAD))
+    plan = None
+    if bucketed:
+        cfg, plan = buckets.bucket_config(cfg)
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, GRAPH, hosts)
+    b.sim = phold.setup(b.sim, load=LOAD)
+    if plan is not None:
+        b.bucket_plan = plan
+    return b
+
+
+# ---- the bucket planner ---------------------------------------------
+
+def test_quantize_pow2_lattice():
+    assert [buckets.quantize_pow2(n) for n in (0, 1, 2, 3, 24, 32, 33)] \
+        == [0, 1, 2, 4, 32, 32, 64]
+    with pytest.raises(ValueError):
+        buckets.quantize_pow2(-1)
+
+
+def test_bucket_config_quantizes_up_and_records_plan():
+    cfg = NetConfig(num_hosts=8, end_time=simtime.ONE_SECOND,
+                    event_capacity=24, outbox_capacity=32,
+                    router_ring=33, in_ring=5)
+    new, plan = buckets.bucket_config(cfg)
+    assert (new.event_capacity, new.router_ring, new.in_ring) \
+        == (32, 64, 8)
+    assert new.outbox_capacity == 32   # already on the lattice
+    assert plan.changed == {"event_capacity": 32, "router_ring": 64,
+                            "in_ring": 8}
+    for k, d in plan.as_dict().items():
+        assert d["bucketed"] >= d["requested"]
+        q = d["bucketed"]
+        assert q == 0 or (q & (q - 1)) == 0, f"{k} not a pow2 bucket"
+
+
+def test_bucket_config_keeps_off_knobs_off():
+    cfg = NetConfig(num_hosts=8, end_time=simtime.ONE_SECOND,
+                    sparse_lanes=0)
+    new, plan = buckets.bucket_config(cfg)
+    assert new.sparse_lanes == 0   # 0 means "feature off", not "tiny"
+    assert plan.bucketed.get("sparse_lanes") == 0
+
+
+def test_program_key_stable_and_shape_sensitive():
+    b = _build()
+    vec = buckets.shape_vector_for_sim(b.cfg, b.sim)
+    census = buckets.kind_census((phold.handler,))
+    k1 = buckets.program_key(vec, census=census)
+    k2 = buckets.program_key(dict(vec), census=census)
+    assert k1 == k2 and buckets.is_program_key(k1)
+    grown = dict(vec, event_capacity=vec["event_capacity"] * 2)
+    assert buckets.program_key(grown, census=census) != k1
+    assert buckets.program_key(vec, census=census, shards=4) != k1
+    assert not buckets.is_program_key("pkXYZ")
+    assert not buckets.is_program_key(None)
+
+
+# ---- padding is free: bucketed run == bespoke run -------------------
+
+def _shape_independent(sim, stats):
+    """Per-host arrays and conservation counters whose shapes do not
+    depend on the capacity knobs — the surface the bucketing
+    invariant promises bit-identity on."""
+    out = {"events_processed": int(stats.events_processed),
+           "windows": int(stats.windows),
+           "overflow": int(sim.events.overflow)}
+    for name in ("ctr_tx_packets", "ctr_rx_bytes", "rng_ctr"):
+        out[name] = np.asarray(jax.device_get(getattr(sim.net, name)))
+    for name, leaf in vars(sim.app).items():
+        if hasattr(leaf, "shape"):
+            out[f"app.{name}"] = np.asarray(jax.device_get(leaf))
+    return out
+
+
+def test_bucketed_run_bit_identical_to_bespoke():
+    caps = {"event_capacity": 24, "outbox_capacity": 24,
+            "router_ring": 24}
+    ba = _build(caps)                       # bespoke shapes, no overflow
+    bb = _build(caps, bucketed=True)        # quantized to 32
+    assert bb.cfg.event_capacity == 32
+    assert bb.bucket_plan.changed["event_capacity"] == 32
+    sim_a, st_a = make_runner(ba, app_handlers=(phold.handler,))(ba.sim)
+    sim_b, st_b = make_runner(bb, app_handlers=(phold.handler,))(bb.sim)
+    a, b = _shape_independent(sim_a, st_a), _shape_independent(sim_b, st_b)
+    assert a["overflow"] == 0, "undersized bespoke run voids the invariant"
+    assert a.keys() == b.keys()
+    for k in a:
+        np.testing.assert_array_equal(a[k], b[k], err_msg=f"{k} diverged")
+
+
+# ---- the program store ----------------------------------------------
+
+KEY = "pk" + "0123456789abcdef"
+
+
+def _tiny_jit():
+    return jax.jit(lambda x: x * 2 + 1), (jnp.arange(8, dtype=jnp.int32),)
+
+
+def test_store_round_trip_hit(tmp_path):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    c1, i1 = store.get_or_compile(KEY, fn, args)
+    assert (i1["hit"], i1["stored"]) == (False, True)
+    assert i1["compile_s"] > 0 and i1["lower_s"] > 0
+    c2, i2 = store.get_or_compile(KEY, fn, args)
+    assert i2["hit"] and i2["load_s"] > 0
+    np.testing.assert_array_equal(np.asarray(c1(*args)),
+                                  np.asarray(c2(*args)))
+    # sidecar carries the versions the gate checks
+    meta = store.read_meta(KEY)
+    assert meta["code"] == buckets.code_version()
+    assert meta["jax"] == jax.__version__
+
+
+def test_store_corrupt_payload_degrades_to_compile(tmp_path):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    store.get_or_compile(KEY, fn, args)
+    store.bin_path(KEY).write_bytes(b"not a pickle")
+    assert store.load(KEY, store.read_meta(KEY)["avals"]) is None
+    c, info = store.get_or_compile(KEY, fn, args)   # recompile + re-store
+    assert not info["hit"] and info["stored"]
+    np.testing.assert_array_equal(np.asarray(c(*args)),
+                                  np.asarray(fn(*args)))
+    _, again = store.get_or_compile(KEY, fn, args)
+    assert again["hit"]
+
+
+def test_store_stale_code_version_misses(tmp_path):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    store.get_or_compile(KEY, fn, args)
+    meta = json.loads(store.meta_path(KEY).read_text())
+    meta["code"] = "f" * 16
+    store.meta_path(KEY).write_text(json.dumps(meta))
+    _, info = store.get_or_compile(KEY, fn, args)
+    assert not info["hit"], "stale code version must not be served"
+
+
+def test_store_avals_mismatch_misses(tmp_path):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    store.get_or_compile(KEY, fn, args)
+    other = (jnp.arange(16, dtype=jnp.int32),)   # same key, new shape
+    _, info = store.get_or_compile(KEY, fn, other)
+    assert not info["hit"], "an under-keyed collision must miss"
+
+
+def test_store_save_failure_is_best_effort(tmp_path, monkeypatch):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    monkeypatch.setattr(ProgramStore, "save",
+                        lambda self, *a, **k: False)
+    c, info = store.get_or_compile(KEY, fn, args)
+    assert not info["stored"] and not info["hit"]
+    np.testing.assert_array_equal(np.asarray(c(*args)),
+                                  np.asarray(fn(*args)))
+    assert not store.bin_path(KEY).exists()
+
+
+def test_store_gc_evicts_stale_code_first(tmp_path):
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    store.get_or_compile(KEY, fn, args)
+    stale_key = "pk" + "f" * 16
+    store.get_or_compile(stale_key, fn, args)
+    meta = json.loads(store.meta_path(stale_key).read_text())
+    meta["code"] = "e" * 16
+    store.meta_path(stale_key).write_text(json.dumps(meta))
+    nbytes = store.bin_path(KEY).stat().st_size
+    out = store.gc(max_bytes=nbytes + 64)
+    assert out["dropped"] == [stale_key], \
+        "unservable entries must be evicted before live ones"
+    assert store.bin_path(KEY).exists()
+
+
+def test_default_store_re_roots_on_env(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHADOW_AOT_DIR", str(tmp_path / "a"))
+    assert default_store().root == tmp_path / "a"
+    monkeypatch.setenv("SHADOW_AOT_DIR", str(tmp_path / "b"))
+    assert default_store().root == tmp_path / "b"
+
+
+# ---- the serving wrapper --------------------------------------------
+
+def test_maybe_warm_disabled_is_identity():
+    fn, _ = _tiny_jit()
+    info = {}
+    out = serve.maybe_warm(fn, KEY, enabled=False, info=info)
+    assert out is fn and info == {"warm": False, "key": KEY}
+
+
+def test_warm_enabled_env_precedence(monkeypatch):
+    monkeypatch.delenv(serve.ENV_FLAG, raising=False)
+    monkeypatch.delenv("SHADOW_NO_COMPILE_CACHE", raising=False)
+    assert serve.warm_enabled(True) and not serve.warm_enabled(False)
+    monkeypatch.setenv(serve.ENV_FLAG, "0")
+    assert not serve.warm_enabled(True)
+    monkeypatch.setenv(serve.ENV_FLAG, "1")
+    assert serve.warm_enabled(False)
+    monkeypatch.setenv("SHADOW_NO_COMPILE_CACHE", "1")
+    assert not serve.warm_enabled(True)   # master opt-out beats all
+
+
+def test_warmfn_unreadable_store_falls_back(tmp_path):
+    fn, args = _tiny_jit()
+    info = {}
+
+    class Boom(ProgramStore):
+        def get_or_compile(self, *a, **k):
+            raise OSError("store root gone")
+
+    wf = serve.WarmFn(fn, KEY, store=Boom(tmp_path), info=info)
+    np.testing.assert_array_equal(np.asarray(wf(*args)),
+                                  np.asarray(fn(*args)))
+    assert info["fallback"] == "store:OSError" and not info["hit"]
+
+
+# ---- escalation regrows on the bucket lattice -----------------------
+
+def test_plan_growth_regrows_to_next_pow2_bucket():
+    caps = {"event_capacity": 24, "outbox_capacity": 32,
+            "router_ring": 16}
+    policy = escalate.EscalationPolicy(max_grow=8)
+    import types
+    health = types.SimpleNamespace(events_overflow=1, outbox_overflow=0,
+                                   rq_overflow=0)
+    grow, (ev,) = escalate.plan_growth(health, caps, policy, 0,
+                                       time_ns=0)
+    # 24*2 = 48 lands on the 64 bucket, not a bespoke 48 shape
+    assert grow == {"event_capacity": 64}
+    assert (ev.old, ev.new) == (24, 64)
+
+
+def test_escalation_regrow_lands_on_prewarmed_bucket(tmp_path):
+    """A run at the grown bucket and an escalated rebuild share one
+    program key — the regrown run resolves warm from the store entry
+    the bucket run populated."""
+    store = ProgramStore(tmp_path)
+    grown = _build({"event_capacity": 64, "outbox_capacity": 32,
+                    "router_ring": 32})
+    info1 = checkpoint.prewarm_dispatch(grown, (phold.handler,),
+                                        store=store)
+    assert not info1["hit"] and info1["stored"]
+    # escalate a bespoke 40-capacity build: 40*2=80 -> ... the lattice
+    # walk from 24 is 24 -> 64; from 33..64 the doubling lands on 128.
+    # Use 24 so the regrow target IS the prewarmed 64 bucket.
+    regrow = buckets.quantize_pow2(24 * 2)
+    assert regrow == 64
+    healed = _build({"event_capacity": regrow, "outbox_capacity": 32,
+                     "router_ring": 32})
+    info2 = checkpoint.prewarm_dispatch(healed, (phold.handler,),
+                                        store=store)
+    assert info2["key"] == info1["key"]
+    assert info2["hit"], "regrown shape must serve from the warm bucket"
+
+
+# ---- fleet bucket-affinity assignment -------------------------------
+
+def _spec(i, **kw):
+    d = {"id": f"j{i}", "num_hosts": 8, "event_capacity": 32,
+         "seed": i, "max_retries": 1}
+    d.update(kw)
+    return d
+
+
+def test_affinity_key_buckets_capacities_and_drops_runtime_fields():
+    a = affinity.affinity_key(_spec(1, event_capacity=24))
+    b = affinity.affinity_key(_spec(2, event_capacity=32))
+    assert a == b, "same bucket + same shapes must share a key"
+    assert a.startswith(affinity.AFFINITY_PREFIX) and len(a) == 18
+    c = affinity.affinity_key(_spec(3, num_hosts=16))
+    assert c != a
+
+
+def test_assign_affinity_first_then_fifo():
+    ja, jb, jc = _spec(0), _spec(1, num_hosts=16), _spec(2)
+    ka, kb = affinity.affinity_key(ja), affinity.affinity_key(jb)
+    # w1 is warm for kb, w2 warm for ka, w3 cold
+    pairs = affinity.assign([ja, jb, jc], ["w1", "w2", "w3"],
+                            {"w1": kb, "w2": ka})
+    assert pairs == [("w1", jb), ("w2", ja), ("w3", jc)]
+    # determinism: same inputs, same pairing
+    assert pairs == affinity.assign([ja, jb, jc], ["w1", "w2", "w3"],
+                                    {"w1": kb, "w2": ka})
+    # no warm workers at all -> plain FIFO, cold jobs never starved
+    assert affinity.assign([ja, jb], ["w1", "w2"], {}) \
+        == [("w1", ja), ("w2", jb)]
+    # more jobs than workers: leftovers stay queued in FIFO order
+    assert affinity.assign([ja, jb, jc], ["w1"], {}) == [("w1", ja)]
+
+
+# ---- the operator console (tools/compcache_ctl.py) ------------------
+
+def test_compcache_ctl_ls_stats_gc(tmp_path, capsys):
+    from conftest import load_tool
+
+    ctl = load_tool("compcache_ctl")
+    store = ProgramStore(tmp_path)
+    fn, args = _tiny_jit()
+    store.get_or_compile(KEY, fn, args)
+    root = ["--root", str(tmp_path)]
+    assert ctl.main(root + ["ls"]) == 0
+    out = capsys.readouterr().out
+    assert KEY in out and "servable" in out
+    assert ctl.main(root + ["stats"]) == 0
+    st = json.loads(capsys.readouterr().out)
+    assert st["entries"] == 1 and st["total_bytes"] > 0
+    assert ctl.main(root + ["gc", "--max-bytes", "1K"]) == 0
+    assert json.loads(capsys.readouterr().out)["dropped"] == [KEY]
+    assert not store.bin_path(KEY).exists()
+    assert ctl._parse_bytes("2M") == 2 << 20
+
+
+# ---- prewarm -> run_windows serves warm -----------------------------
+
+def test_prewarm_then_run_windows_hits(tmp_path, monkeypatch):
+    monkeypatch.setenv("SHADOW_AOT_DIR", str(tmp_path))
+    monkeypatch.delenv(serve.ENV_FLAG, raising=False)
+    monkeypatch.delenv("SHADOW_NO_COMPILE_CACHE", raising=False)
+    b = _build()
+    info = serve.prewarm(b, (phold.handler,))
+    assert buckets.is_program_key(info["key"])
+    assert not info["hit"] and info["stored"]
+
+    b2 = _build()
+    cinfo: dict = {}
+    sim_w, st_w, _ = checkpoint.run_windows(
+        b2, app_handlers=(phold.handler,), warm_start=True,
+        compile_info=cinfo)
+    assert cinfo["key"] == info["key"]
+    assert cinfo["hit"], "run_windows must load the prewarmed program"
+
+    # and the warm run is bit-identical to a cold one
+    b3 = _build()
+    monkeypatch.setenv("SHADOW_NO_COMPILE_CACHE", "1")
+    sim_c, st_c, _ = checkpoint.run_windows(b3, app_handlers=(phold.handler,))
+    a, c = _shape_independent(sim_w, st_w), _shape_independent(sim_c, st_c)
+    for k in a:
+        np.testing.assert_array_equal(a[k], c[k], err_msg=f"{k} diverged")

@@ -1,0 +1,160 @@
+#!/usr/bin/env python3
+"""Operator console for the persistent AOT program store
+(shadow_tpu/compile/store.py) — inspect, trim, and pre-populate the
+compiled-program cache that warm-start serving loads from.
+
+Subcommands:
+  ls                    every entry, oldest-served first (key, size,
+                        age, code/jax versions, whether THIS process
+                        could serve it)
+  stats                 one JSON summary (root, entry count, bytes,
+                        code versions present)
+  gc --max-bytes N      evict until the store fits in N bytes
+                        (suffixes K/M/G ok). Entries from other code
+                        versions go first — they can never be served
+                        again — then least-recently-served.
+  prewarm --config X    build the config's bundle (capacities
+                        bucketed, exactly like a fleet scenario) and
+                        compile-or-confirm its dispatch program, so
+                        the NEXT run of that shape starts dispatching
+                        instead of compiling. --exact skips the
+                        bucketing; --test uses the built-in example
+                        config instead of a file.
+
+The store root is $SHADOW_AOT_DIR, else the claimed compile-cache dir
+(.jax_cache/<fingerprint-namespace>/aot); --root overrides both.
+Exit 0 = ok, 1 = error (gc/prewarm failures; ls/stats of an empty or
+missing root are not errors — an empty store is a valid store).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _parse_bytes(s: str) -> int:
+    s = s.strip().upper()
+    mult = {"K": 1 << 10, "M": 1 << 20, "G": 1 << 30}.get(s[-1:], 1)
+    return int(float(s[:-1] if mult > 1 else s) * mult)
+
+
+def _age(mtime: float) -> str:
+    d = max(0.0, time.time() - mtime)
+    for unit, sec in (("d", 86400), ("h", 3600), ("m", 60)):
+        if d >= sec:
+            return f"{d / sec:.1f}{unit}"
+    return f"{d:.0f}s"
+
+
+def _store(args):
+    from shadow_tpu.compile.store import ProgramStore, default_store
+
+    return ProgramStore(args.root) if args.root else default_store()
+
+
+def cmd_ls(args) -> int:
+    import jax
+
+    from shadow_tpu.compile import buckets
+
+    store = _store(args)
+    entries = store.ls()
+    code_now, jax_now = buckets.code_version(), jax.__version__
+    print(f"# {store.root} — {len(entries)} entries")
+    for m in entries:
+        servable = (m.get("code") == code_now
+                    and m.get("jax") == jax_now)
+        print(f"{m.get('key', '?'):20s} {int(m.get('nbytes', 0)):>12d}B "
+              f"{_age(float(m.get('mtime', 0.0))):>7s} "
+              f"code={str(m.get('code'))[:8]} jax={m.get('jax')} "
+              f"{'servable' if servable else 'STALE'}")
+    return 0
+
+
+def cmd_stats(args) -> int:
+    print(json.dumps(_store(args).stats(), indent=1, sort_keys=True))
+    return 0
+
+
+def cmd_gc(args) -> int:
+    store = _store(args)
+    out = store.gc(_parse_bytes(args.max_bytes))
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+def cmd_prewarm(args) -> int:
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from shadow_tpu.compile import serve
+    from shadow_tpu.compile.buckets import CAPACITY_KEYS, quantize_pow2
+    from shadow_tpu.config.examples import example_config
+    from shadow_tpu.config.loader import load
+    from shadow_tpu.config.xmlconfig import parse_config
+
+    if args.test:
+        text, base = example_config(), None
+    elif args.config:
+        with open(args.config) as f:
+            text = f.read()
+        base = os.path.dirname(os.path.abspath(args.config))
+    else:
+        print("error: prewarm needs --config PATH or --test",
+              file=sys.stderr)
+        return 1
+
+    loaded = load(parse_config(text), seed=args.seed, base_dir=base)
+    b = loaded.bundle
+    if not args.exact:
+        # quantize AFTER the load so plugin capacity hints are already
+        # merged, then rebuild — the same bucket lattice a fleet
+        # scenario lands on (fleet/scenario.py), so this prewarms the
+        # entry those jobs will actually load
+        grown = {k: quantize_pow2(getattr(b.cfg, k))
+                 for k in CAPACITY_KEYS
+                 if quantize_pow2(getattr(b.cfg, k)) != getattr(b.cfg, k)}
+        if grown:
+            print(f"bucketing capacities: {grown}")
+            b = b.rebuild(grown)
+    store = _store(args) if args.root else None
+    info = serve.prewarm(b, loaded.handlers, store=store,
+                         log=lambda m: print(m))
+    print(json.dumps(info, indent=1, sort_keys=True, default=str))
+    return 0 if info.get("hit") or info.get("stored") else 1
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="compcache_ctl",
+        description="inspect / trim / pre-populate the AOT program store")
+    ap.add_argument("--root", help="store root (default: "
+                    "$SHADOW_AOT_DIR or the claimed .jax_cache/aot)")
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    sub.add_parser("ls", help="list entries, oldest-served first")
+    sub.add_parser("stats", help="JSON summary")
+    g = sub.add_parser("gc", help="evict down to a byte budget")
+    g.add_argument("--max-bytes", required=True,
+                   help="target size (suffixes K/M/G ok)")
+    p = sub.add_parser("prewarm",
+                       help="compile a config's program into the store")
+    p.add_argument("--config", help="shadow config XML path")
+    p.add_argument("--test", action="store_true",
+                   help="use the built-in example config")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--exact", action="store_true",
+                   help="skip capacity bucketing (bespoke shapes)")
+    args = ap.parse_args(argv)
+    return {"ls": cmd_ls, "stats": cmd_stats, "gc": cmd_gc,
+            "prewarm": cmd_prewarm}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -49,6 +49,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -62,6 +63,90 @@ KNOWN_METADATA = {
 }
 WINDOW_ARGS = ("events", "micro_steps", "routed_local", "routed_cross",
                "drops", "retx", "active_lanes", "fastpath")
+
+# canonical id formats (shadow_tpu/compile/buckets.py program_key,
+# shadow_tpu/fleet/affinity.py affinity_key) — validated by regex so
+# the lint stays importable without the engine's jax dependency
+_PROGRAM_KEY = re.compile(r"^pk[0-9a-f]{16}$")
+_AFFINITY_KEY = re.compile(r"^ak[0-9a-f]{16}$")
+
+
+def _lint_compile_block(comp, where: str) -> tuple[list, list]:
+    """(errors, warnings) for one program-store accounting block
+    (compile/serve.py WarmFn info; nested once under "warmup" for the
+    bench's fresh-vs-cached pairing)."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(comp, dict):
+        return ([f"{where} must be an object"], [])
+    key = comp.get("key")
+    if key is not None and (not isinstance(key, str)
+                            or not _PROGRAM_KEY.match(key)):
+        errors.append(f'{where}.key must match "pk" + 16 hex chars '
+                      f"(compile/buckets.py program_key), got {key!r}")
+    for k in ("warm", "hit", "stored"):
+        v = comp.get(k)
+        if v is not None and not isinstance(v, bool):
+            errors.append(f"{where}.{k} must be a bool, got {v!r}")
+    for k in ("load_s", "lower_s", "compile_s", "warm_speedup"):
+        v = comp.get(k)
+        if v is not None and (not isinstance(v, (int, float))
+                              or isinstance(v, bool) or v < 0):
+            errors.append(f"{where}.{k} must be a non-negative "
+                          f"number, got {v!r}")
+    fb = comp.get("fallback")
+    if fb is not None and (not isinstance(fb, str) or not fb):
+        errors.append(f"{where}.fallback must be a non-empty string")
+    # hit/miss consistency: a hit is a store load (load_s, no compile
+    # timings); a clean miss compiled fresh (lower_s/compile_s, no
+    # load_s); a fallback may carry neither
+    hit = comp.get("hit")
+    if hit is True:
+        if comp.get("load_s") is None:
+            errors.append(f"{where}: hit=true must record load_s "
+                          f"(the warm load IS the claimed saving)")
+        for k in ("lower_s", "compile_s"):
+            if comp.get(k) is not None:
+                errors.append(f"{where}: hit=true cannot also carry "
+                              f"{k} — a warm serve never compiled")
+    elif hit is False and comp.get("warm") and fb is None:
+        if comp.get("compile_s") is None:
+            errors.append(f"{where}: a warm-serving miss must record "
+                          f"its fresh compile_s")
+        if comp.get("load_s") is not None:
+            errors.append(f"{where}: hit=false cannot carry load_s")
+    if hit is True and key is None:
+        errors.append(f"{where}: hit=true without a program key")
+    # bucket plan: every quantized knob's bucket must be a power of
+    # two (or 0 = knob off) and must never shrink the request
+    bk = comp.get("buckets")
+    if bk is not None:
+        if not isinstance(bk, dict):
+            errors.append(f"{where}.buckets must be an object")
+            bk = {}
+        for knob, ent in sorted(bk.items()):
+            w2 = f"{where}.buckets.{knob}"
+            if not isinstance(ent, dict):
+                errors.append(f"{w2} must be an object with "
+                              f"requested/bucketed")
+                continue
+            req, got = ent.get("requested"), ent.get("bucketed")
+            for k, v in (("requested", req), ("bucketed", got)):
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errors.append(f"{w2}.{k} must be a non-negative "
+                                  f"integer, got {v!r}")
+            if isinstance(req, int) and isinstance(got, int) \
+                    and not isinstance(req, bool) \
+                    and not isinstance(got, bool):
+                if got < req:
+                    errors.append(f"{w2}: bucketed={got} < requested="
+                                  f"{req} — quantization only pads, "
+                                  f"never shrinks")
+                if got and got & (got - 1):
+                    errors.append(f"{w2}: bucketed={got} is not a "
+                                  f"power of two")
+    return errors, warnings
 
 
 def lint_trace_obj(obj) -> tuple[list, list]:
@@ -178,6 +263,19 @@ def lint_manifest_obj(man) -> tuple[list, list]:
     cf = man.get("compile_fresh")
     if cf is not None and not isinstance(cf, bool):
         errors.append(f"compile_fresh must be a bool, got {cf!r}")
+    # program-store accounting block (optional): the AOT warm-serving
+    # record (compile/serve.py), with the bench's warm-up call nested
+    # under "warmup" for one-row fresh-vs-cached scoring
+    comp = man.get("compile")
+    if comp is not None:
+        e2, w2 = _lint_compile_block(comp, "compile")
+        errors += e2
+        warnings += w2
+        if isinstance(comp, dict) and comp.get("warmup") is not None:
+            e2, w2 = _lint_compile_block(comp["warmup"],
+                                         "compile.warmup")
+            errors += e2
+            warnings += w2
     # sparse fast-path counters: non-negative, and hit+miss can never
     # exceed the windows the engine ran
     ctr = man.get("counters", {})
@@ -713,6 +811,37 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
             elif not parent.get("replicas"):
                 errors.append(f"{where}: lane_of parent {lof!r} is "
                               f"not a packed job")
+        # bucket-affinity fields (fleet/affinity.py): the scheduling
+        # key is spec-derived and always present on new manifests; the
+        # program key appears once the job's run reported one
+        ak = j.get("affinity_key")
+        if ak is not None and (not isinstance(ak, str)
+                               or not _AFFINITY_KEY.match(ak)):
+            errors.append(f'{where}: affinity_key must match "ak" + '
+                          f"16 hex chars, got {ak!r}")
+        pk = j.get("program_key")
+        if pk is not None and (not isinstance(pk, str)
+                               or not _PROGRAM_KEY.match(pk)):
+            errors.append(f'{where}: program_key must match "pk" + '
+                          f"16 hex chars, got {pk!r}")
+    # affinity consistency: two jobs the scheduler binned together
+    # (equal affinity_keys) must have realized the same compiled
+    # program — a divergence means the spec-derived key is lying
+    # about program identity
+    prog_of_aff: dict = {}
+    for jid, j in sorted(jobs.items()):
+        if not isinstance(j, dict):
+            continue
+        ak, pk = j.get("affinity_key"), j.get("program_key")
+        if not (isinstance(ak, str) and isinstance(pk, str)):
+            continue
+        seen = prog_of_aff.setdefault(ak, (jid, pk))
+        if seen[1] != pk:
+            errors.append(
+                f"jobs[{jid}] and jobs[{seen[0]}] share affinity_key "
+                f"{ak} but realized different program_keys "
+                f"({pk} vs {seen[1]}) — the affinity key must be a "
+                f"program-identity invariant")
     mc = man.get("counts")
     if isinstance(mc, dict) and mc != counts:
         errors.append(f"counts block {mc} disagrees with the jobs "
