@@ -31,6 +31,25 @@ rate measures raw engine throughput. Env knobs:
                                   ring rides the timed program, so
                                   on-vs-off is the honest overhead
                                   comparison — acceptance: <2%)
+  BENCH_FLOW_SAMPLE=N             attach the per-flow latency ring
+                                  (telemetry/flows.py) to the timed
+                                  program: deterministic 1-in-N packet
+                                  sampling at the window barrier. The
+                                  row grows a "flows" block (sampled/
+                                  harvested counts + per-lane latency)
+  BENCH_FLOW_OVERHEAD=1           A/B the flow ring's cost: rebuild
+                                  the SAME workload without the ring,
+                                  time it, and record
+                                  flow_overhead_pct = (off-on)/off —
+                                  acceptance: <=5% at the default
+                                  1-in-64 sampling (requires
+                                  BENCH_FLOW_SAMPLE)
+  BENCH_PROFILE_DIR=path          capture a jax.profiler trace of one
+                                  EXTRA (unscored) run after the timed
+                                  one — tracing costs wall time, so it
+                                  must never touch the scored number;
+                                  the row records {"profile": {"dir":
+                                  ...}} so the artifact is discoverable
   BENCH_ACTIVE=N                  sparse PHOLD shape: only the first N
                                   hosts inject load (phold.setup
                                   active_hosts) — the census/compaction
@@ -195,6 +214,23 @@ def ref_topology_text() -> str:
         return f.read()
 
 
+def _bench_flow_sample() -> int:
+    """BENCH_FLOW_SAMPLE: 1-in-N flow-latency sampling on the timed
+    program (0 = off). The ring rides the timed inputs, same honesty
+    rule as BENCH_TELEMETRY."""
+    v = os.environ.get("BENCH_FLOW_SAMPLE")
+    return int(v) if v else 0
+
+
+def _attach_flow_ring(sims: list, flow_sample: int) -> list:
+    if flow_sample <= 0:
+        return sims
+    from shadow_tpu import telemetry
+
+    return [telemetry.attach_flows(s, sample_period=flow_sample)
+            for s in sims]
+
+
 def _bench_bucketed() -> bool:
     """Quantize capacities to power-of-two buckets? Explicit
     BENCH_BUCKETED wins; unset follows warm serving (a warm store
@@ -290,7 +326,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   replica_size: int | None = None, fault_records=None,
                   active_hosts: int | None = None,
                   sparse_lanes: int | None = None,
-                  min_jump_ns: int | None = None):
+                  min_jump_ns: int | None = None,
+                  flow_sample: int | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -304,6 +341,7 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     state = {"n": 0, "cap": None, "fn": None, "sims": None,
              "bundle": None, "cinfo": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    fs = _bench_flow_sample() if flow_sample is None else flow_sample
     bucketed = _bench_bucketed()
 
     def build_at(cap):
@@ -329,6 +367,9 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
 
             sims = [telemetry.attach(s) for s in sims]
             b.sim = sims[0]
+        # flow ring on the TIMED inputs too — same honesty rule
+        sims = _attach_flow_ring(sims, fs)
+        b.sim = sims[0]
         # sparse shape: bulk would consume whole windows before the
         # fixpoint ever ran, starving the compaction fast path the
         # shape exists to exercise
@@ -377,7 +418,8 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
                              chunk_windows: int | None = None,
                              adaptive_jump: bool = False,
                              min_jump_ns: int | None = None,
-                             checkpoint_windows: int | None = None):
+                             checkpoint_windows: int | None = None,
+                             flow_sample: int | None = None):
     """PHOLD through faults.run_supervised — the host-driven window
     loop with health checks at every dispatch barrier. This is the
     dispatch-amortization A/B subject: at windows_per_dispatch=1 every
@@ -394,6 +436,7 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
     state = {"n": 0, "cap": None, "bundle": None, "sims": None,
              "mesh": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    fs = _bench_flow_sample() if flow_sample is None else flow_sample
     bucketed = _bench_bucketed()
     every = checkpoint_windows or (1 << 30)   # default: never fires
     ckdir = tempfile.mkdtemp(prefix="bench_sup_")
@@ -426,6 +469,7 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
             W = quantize_pow2(max(DEFAULT_CAPACITY,
                                   2 * (chunk_windows or 1)))
             sims = [telemetry.attach(s, capacity=W) for s in sims]
+        sims = _attach_flow_ring(sims, fs)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -508,7 +552,8 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
                    chunk_windows: int | None = None,
                    adaptive_jump: bool = False,
                    min_jump_ns: int | None = None,
-                   checkpoint_windows: int | None = None):
+                   checkpoint_windows: int | None = None,
+                   flow_sample: int | None = None):
     """Open-system injection scenario: the tgen app (every host binds
     a UDP socket; injected KIND_TGEN events fire datagrams) driven by
     a streamed trace through the supervised window loop — the feeder
@@ -536,6 +581,7 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
     state = {"n": 0, "cap": None, "bundle": None, "sims": None,
              "mesh": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
+    fs = _bench_flow_sample() if flow_sample is None else flow_sample
     bucketed = _bench_bucketed()
     every = checkpoint_windows or (1 << 30)
     ckdir = tempfile.mkdtemp(prefix="bench_inj_")
@@ -574,6 +620,7 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
             W = quantize_pow2(max(DEFAULT_CAPACITY,
                                   2 * (chunk_windows or 1)))
             sims = [telemetry.attach(s, capacity=W) for s in sims]
+        sims = _attach_flow_ring(sims, fs)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -912,6 +959,9 @@ def main(argv=None) -> None:
                 "BENCH_REPLICAS is only wired for BENCH_WORKLOAD=phold; "
                 "a pingpong run would silently measure one replica "
                 "under an unlabeled metric name")
+        if _bench_flow_sample() > 0:
+            raise SystemExit("BENCH_FLOW_SAMPLE is only wired for the "
+                             "phold/injection runners")
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
     if topo == "ref":
@@ -922,6 +972,15 @@ def main(argv=None) -> None:
         name += "_faults"
     if _SHARDS > 1:
         name += f"_{_SHARDS}shards"
+    flow_sample_n = _bench_flow_sample()
+    if flow_sample_n > 0:
+        # the flow ring shapes the program, so flow rows bank under
+        # their own metric name — bench_regress compares like with like
+        name += f"_flow{flow_sample_n}"
+    if os.environ.get("BENCH_FLOW_OVERHEAD") == "1" \
+            and flow_sample_n <= 0:
+        raise SystemExit("BENCH_FLOW_OVERHEAD=1 needs "
+                         "BENCH_FLOW_SAMPLE=N (what would it A/B?)")
 
     # compile + warm (may escalate capacity). Timed + cache-diffed:
     # compile_s is the wall cost of the first device call, and the
@@ -952,6 +1011,47 @@ def main(argv=None) -> None:
     # the aggregate under the per-chip name would inflate vs_baseline
     # by the shard count)
     value = total_rate / _SHARDS if _SHARDS > 1 else total_rate
+
+    # BENCH_FLOW_OVERHEAD=1: rebuild the SAME workload with the flow
+    # ring off, time it the same way, and score the ring's cost as
+    # (off - on) / off. Positive = the ring costs throughput;
+    # acceptance is <=5% at the default 1-in-64 sampling.
+    flow_overhead_pct = None
+    value_flow_off = None
+    if os.environ.get("BENCH_FLOW_OVERHEAD") == "1" \
+            and flow_sample_n > 0:
+        if inject_on:
+            base = _inject_runner(
+                H, sim_s, shards=_SHARDS, graph=graph,
+                trace_path=inj_trace, rate=inj_rate,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, flow_sample=0)
+        elif supervise:
+            base = _phold_supervised_runner(
+                H, load, sim_s, shards=_SHARDS, graph=graph,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, flow_sample=0)
+        else:
+            base = _phold_runner(
+                H * replicas, load, sim_s, shards=_SHARDS, graph=graph,
+                replica_size=H if replicas > 1 else None,
+                fault_records=fault_records,
+                active_hosts=active, sparse_lanes=sparse,
+                min_jump_ns=min_jump_ns, flow_sample=0)
+        base()                     # warm-up (compile, maybe escalate)
+        while True:
+            t0 = time.perf_counter()
+            ev_off = base()
+            wall_off = time.perf_counter() - t0
+            if not getattr(base, "escalated", False):
+                break
+        rate_off = ev_off / wall_off
+        value_flow_off = (rate_off / _SHARDS if _SHARDS > 1
+                          else rate_off)
+        flow_overhead_pct = round(
+            (value_flow_off - value) / value_flow_off * 100.0, 2)
 
     # compare against the measured baseline AT THE SAME SCALE (the
     # C pthread heap-skeleton upper bound, BASELINE.md): the published
@@ -1063,6 +1163,55 @@ def main(argv=None) -> None:
             fault_plan=getattr(b, "fault_plan", None),
             dispatch=disp, injection=inj_blk,
             compile_info=cinfo or None)
+    if flow_sample_n > 0 and getattr(runner, "last_sim", None) is not None \
+            and getattr(runner.last_sim, "flows", None) is not None:
+        # flow-latency accounting of the TIMED run: counters + per-lane
+        # summary on the row, the full histogram block in the manifest
+        from shadow_tpu import telemetry
+        from shadow_tpu.telemetry.flows import flows_manifest_block
+
+        fh = getattr(runner, "harvester", None)
+        if fh is None:
+            fh = telemetry.Harvester()
+        fh.drain(runner.last_sim)
+        fb = flows_manifest_block(
+            fh, num_hosts=runner.state["bundle"].cfg.num_hosts,
+            shards=max(_SHARDS, 1), sample_period=flow_sample_n)
+        if fb is not None:
+            out["flows"] = {k: fb[k] for k in
+                            ("sample_period", "sampled", "recorded",
+                             "harvested", "lost_ring",
+                             "lost_window_clamp", "per_lane")}
+            if "manifest" in out:
+                out["manifest"]["flows"] = fb
+    if flow_overhead_pct is not None:
+        out["flow_overhead_pct"] = flow_overhead_pct
+        out["events_per_sec_flow_off"] = round(value_flow_off, 1)
+    # BENCH_PROFILE_DIR: capture ONE extra, unscored run, after every
+    # export has read the timed run's state. Tracing costs wall time
+    # (observed: an order of magnitude on small CPU shapes), so it
+    # must never bracket the run whose events/s banks.
+    prof_dir = os.environ.get("BENCH_PROFILE_DIR")
+    if prof_dir:
+        prof_on = False
+        try:
+            os.makedirs(prof_dir, exist_ok=True)
+            jax.profiler.start_trace(prof_dir)
+            prof_on = True
+            runner()
+        except Exception as e:
+            import sys
+
+            print(f"WARNING: BENCH_PROFILE_DIR: profiler unavailable "
+                  f"({e}); continuing without capture", file=sys.stderr)
+        finally:
+            if prof_on:
+                try:
+                    jax.profiler.stop_trace()
+                except Exception:
+                    pass
+                out["profile"] = {"dir": os.path.abspath(prof_dir),
+                                  "tool": "jax.profiler"}
     print(json.dumps(out))
 
 

@@ -146,6 +146,23 @@ def make_parser() -> argparse.ArgumentParser:
                    help="telemetry ring capacity in window records "
                         "(default 4096); overruns are latched as a "
                         "health warning, never silently")
+    p.add_argument("--flow-sample", type=int, default=0, metavar="N",
+                   help="sample 1-in-N cross-host packets into the "
+                        "per-flow latency flight recorder "
+                        "(telemetry/flows.py): deterministic "
+                        "(time,dst,src,seq)-hash sampling, per-lane "
+                        "latency histograms and a cross-shard traffic "
+                        "matrix in the manifest. 0 (default) = off, "
+                        "byte-identical to builds without the recorder")
+    p.add_argument("--flow-capacity", type=int, default=None,
+                   help="flow ring capacity in sampled records "
+                        "(default 4096); window-clamp and overrun "
+                        "losses are accounted, never silent")
+    p.add_argument("--profile-dir", default=None, metavar="DIR",
+                   help="capture a jax.profiler trace of the window "
+                        "loop into DIR (view with TensorBoard / "
+                        "Perfetto); the artifact path is recorded in "
+                        "run_manifest.json")
     # --- run supervisor (faults/supervisor.py) -----------------------
     p.add_argument("--host-kernel", choices=("run", "diff"), default=None,
                    help="execute the config's .py-plugin processes on "
@@ -392,6 +409,17 @@ def main(argv=None) -> int:
         return 1
 
     logger = SimLogger(level=level_from_name(args.log_level))
+    # jax.profiler capture state (--profile-dir): started just before
+    # the run branch, stopped at convergence and again (idempotently)
+    # in the finally so an abort never leaves the tracer running
+    _prof = {"on": False}
+
+    def _stop_profile():
+        if _prof["on"]:
+            _prof["on"] = False
+            with contextlib.suppress(Exception):
+                jax.profiler.stop_trace()
+
     # flush on every exit path so a mid-run failure still
     # surfaces the buffered sim log (the reference flushes
     # each round, slave.c:446-450)
@@ -533,15 +561,17 @@ def main(argv=None) -> int:
         # without these flags are untouched.
         telem_on = bool(args.trace_out or args.metrics_out
                         or args.telemetry_capacity)
+        flows_on = bool(args.flow_sample and args.flow_sample > 0)
         harvester = None
         timers = None
-        if telem_on and loaded.vprocs:
+        if (telem_on or flows_on) and loaded.vprocs:
             logger.warning(0, "shadow-tpu",
                            "window telemetry is unavailable with .py "
                            "plugins (ProcessRuntime drives its own "
-                           "window loop); --trace-out/--metrics-out "
-                           "ignored")
+                           "window loop); --trace-out/--metrics-out/"
+                           "--flow-sample ignored")
             telem_on = False
+            flows_on = False
         if telem_on:
             from shadow_tpu import telemetry
 
@@ -549,6 +579,30 @@ def main(argv=None) -> int:
                 b.sim,
                 capacity=args.telemetry_capacity
                 or telemetry.DEFAULT_CAPACITY)
+        if flows_on:
+            # flow flight-recorder (telemetry/flows.py): deterministic
+            # 1-in-N packet sampling at the window barrier; drained by
+            # the same harvester as the window ring
+            from shadow_tpu import telemetry
+            from shadow_tpu.telemetry import flows as flows_mod
+
+            try:
+                b.sim = telemetry.attach_flows(
+                    b.sim, sample_period=args.flow_sample,
+                    capacity=args.flow_capacity
+                    or flows_mod.DEFAULT_CAPACITY)
+            except ValueError as e:
+                print(f"error: --flow-sample: {e}", file=sys.stderr)
+                logger.flush()
+                return 1
+            logger.message(
+                0, "shadow-tpu",
+                f"flow tracing: 1-in-{args.flow_sample} packet "
+                f"sampling, ring capacity "
+                f"{args.flow_capacity or flows_mod.DEFAULT_CAPACITY}")
+        if telem_on or flows_on:
+            from shadow_tpu import telemetry
+
             harvester = telemetry.Harvester()
             timers = telemetry.PhaseTimers()
 
@@ -566,6 +620,21 @@ def main(argv=None) -> int:
         # realized {key, hit, load_s|compile_s} block from it (the
         # supervised path uses the supervisor's own copy instead)
         cinfo: dict = {}
+        # --profile-dir: bracket the device work with a jax.profiler
+        # trace; the manifest's "profile" block records where the
+        # artifact landed so tooling can find it without guessing
+        profile_info = None
+        if args.profile_dir:
+            try:
+                os.makedirs(args.profile_dir, exist_ok=True)
+                jax.profiler.start_trace(args.profile_dir)
+                _prof["on"] = True
+                profile_info = {"dir": os.path.abspath(args.profile_dir),
+                                "tool": "jax.profiler"}
+            except Exception as e:  # profiler backend is optional
+                logger.warning(0, "shadow-tpu",
+                               f"--profile-dir: capture unavailable "
+                               f"({e}); continuing without profile")
         # track_paths no longer forces serial: shard-local [V,V]
         # partials are psummed at the window barrier
         # (parallel/shard.py _replicate_scalars)
@@ -717,6 +786,8 @@ def main(argv=None) -> int:
                     inj_blk = inject_mod.manifest_block(sim_, feeder)
                 from shadow_tpu.telemetry.export import \
                     lanes_manifest_block
+                from shadow_tpu.telemetry.flows import \
+                    flows_manifest_block
 
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards,
@@ -729,15 +800,21 @@ def main(argv=None) -> int:
                     dispatch=disp, injection=inj_blk,
                     compile_info=result.compile_info,
                     lanes=lanes_manifest_block(
-                        health_, result.lane_incidents))
+                        health_, result.lane_incidents),
+                    flows=flows_manifest_block(
+                        harvester, num_hosts=b.cfg.num_hosts,
+                        shards=nshards,
+                        sample_period=args.flow_sample or None),
+                    profile=profile_info)
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
                                  "run_manifest.json"), man)
                 if args.trace_out:
-                    telemetry.write_trace(args.trace_out,
-                                          harvester.records, timers,
-                                          nshards)
+                    telemetry.write_trace(
+                        args.trace_out, harvester.records, timers,
+                        nshards,
+                        flow_records=harvester.flow_records)
                 if args.metrics_out:
                     telemetry.write_metrics(args.metrics_out, man)
                 return man
@@ -753,7 +830,7 @@ def main(argv=None) -> int:
                     "escalations": len(result.escalations),
                     "resume": f"--resume {args.data_directory}",
                 }
-                if telem_on and result.sim is not None:
+                if (telem_on or flows_on) and result.sim is not None:
                     report["manifest"] = _sup_manifest(
                         result.sim, None, result.stats)
                 logger.message(0, "shadow-tpu", "run preempted "
@@ -786,7 +863,7 @@ def main(argv=None) -> int:
                     oc = objcount.gather(result.sim)
                     logger.message(0, "shadow-tpu", oc.format())
                     logger.message(0, "shadow-tpu", oc.format_diff())
-                    if telem_on:
+                    if telem_on or flows_on:
                         report["manifest"] = _sup_manifest(
                             result.sim, result.health)
                 logger.flush()
@@ -852,6 +929,7 @@ def main(argv=None) -> int:
 
                 sim, stats = run(b, app_handlers=loaded.handlers,
                                  app_bulk=b.app_bulk)
+        _stop_profile()
         if cap is not None:
             cap.drain(sim)
             cap.close()
@@ -908,6 +986,7 @@ def main(argv=None) -> int:
         run_health = health_mod.gather(
             sim,
             telemetry_lost=(harvester.records_lost
+                            + getattr(harvester, "flow_lost", 0)
                             if harvester is not None else 0))
         # critical, not error: SimLogger.error raises, and the fatal
         # path below must still print the structured report + exit 3.
@@ -949,7 +1028,7 @@ def main(argv=None) -> int:
                     e.as_dict() for e in sup_result.escalations]
             if sup_result.resume_of:
                 report["resume_of"] = sup_result.resume_of
-        if telem_on:
+        if telem_on or flows_on:
             from shadow_tpu import telemetry
 
             nshards = mesh.shape["hosts"] if mesh is not None else 1
@@ -975,6 +1054,8 @@ def main(argv=None) -> int:
                             disp["adaptive_jump_mean_ns"] = m
                 from shadow_tpu.telemetry.export import \
                     lanes_manifest_block
+                from shadow_tpu.telemetry.flows import \
+                    flows_manifest_block
 
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
@@ -989,6 +1070,11 @@ def main(argv=None) -> int:
                         run_health,
                         sup_result.lane_incidents
                         if sup_result is not None else ()),
+                    flows=flows_manifest_block(
+                        harvester, num_hosts=b.cfg.num_hosts,
+                        shards=nshards,
+                        sample_period=args.flow_sample or None),
+                    profile=profile_info,
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,
@@ -1001,9 +1087,10 @@ def main(argv=None) -> int:
                 logger.message(b.cfg.end_time, "shadow-tpu",
                                f"run manifest -> {mpath}")
                 if args.trace_out:
-                    telemetry.write_trace(args.trace_out,
-                                          harvester.records, timers,
-                                          nshards)
+                    telemetry.write_trace(
+                        args.trace_out, harvester.records, timers,
+                        nshards,
+                        flow_records=harvester.flow_records)
                     logger.message(b.cfg.end_time, "shadow-tpu",
                                    f"trace -> {args.trace_out} (load in "
                                    f"chrome://tracing or ui.perfetto.dev)")
@@ -1023,6 +1110,7 @@ def main(argv=None) -> int:
         print(json.dumps(report))
         return 0
     finally:
+        _stop_profile()
         logger.flush()
 
 

@@ -226,7 +226,7 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 emit_capacity: int = 4, lane_id=None,
                 route_fn=_default_route, min_fn=_identity,
                 bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None,
-                sparse_lanes: int = 0, census_fn=None):
+                sparse_lanes: int = 0, census_fn=None, flow_fn=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -335,6 +335,10 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                        stats.events_processed - ev0,
                        stats.micro_steps - ms0,
                        n_active, fastpath, **kw)
+    if flow_fn is not None:
+        # flow flight-recorder (telemetry/flows.py): samples the
+        # staged outbox, so it must also run BEFORE route_fn clears it
+        sim = flow_fn(sim, wend if wstart is None else wstart, wend)
     sim = route_fn(sim)
     if getattr(sim, "lanes", None) is not None:
         # lane-isolated health (core/lanes.py): reduce the per-host
@@ -449,7 +453,7 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
                     lane_fn=None, route_fn=_default_route,
                     min_fn=_identity, bulk_fn=None, fault_fn=None,
                     telem_fn=None, sparse_lanes: int = 0,
-                    census_fn=None):
+                    census_fn=None, flow_fn=None):
     """Build ``chunk(sim, stats, wstart) -> (sim, stats, wstart')``:
     up to `chunk_windows` full window rounds as ONE device program (a
     lax.fori_loop over step_window), so host-driven loops pay one
@@ -511,7 +515,8 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
                 emit_capacity=emit_capacity, lane_id=lane,
                 route_fn=route_fn, min_fn=min_fn, bulk_fn=bulk_fn,
                 fault_fn=fault_fn, telem_fn=telem_fn, wstart=ws,
-                sparse_lanes=sparse_lanes, census_fn=census_fn)
+                sparse_lanes=sparse_lanes, census_fn=census_fn,
+                flow_fn=flow_fn)
             return i + 1, sim, stats, next_min
 
         _, sim, stats, wstart = jax.lax.while_loop(
@@ -538,6 +543,7 @@ def run(
     sparse_lanes: int = 0,
     census_fn=None,
     fault_times=None,
+    flow_fn=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -579,7 +585,7 @@ def run(
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
             route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,
-            sparse_lanes, census_fn,
+            sparse_lanes, census_fn, flow_fn,
         )
         return sim, stats, next_min
 

@@ -272,3 +272,38 @@ def lane_report(sim) -> list:
             d["trip"] = trip_names(int(bits[r]))
         out.append(d)
     return out
+
+
+# manifest per-lane key -> Prometheus family name. One row per latch
+# the lane report carries, so a new latch added to lane_report shows
+# up on dashboards by adding one line here.
+LANE_METRIC_KEYS = (
+    ("quarantined", "lane_quarantined"),
+    ("flushed", "lane_flushed"),
+    ("events_exec", "lane_events_exec"),
+    ("events_overflow", "lane_events_overflow"),
+    ("outbox_overflow", "lane_outbox_overflow"),
+    ("rq_overflow", "lane_rq_overflow"),
+    ("inj_dropped", "lane_inj_dropped"),
+    ("stall_streak", "lane_stall_streak"),
+    ("time_regression", "lane_time_regression"),
+)
+
+
+def lane_metric_families(per_lane) -> dict:
+    """Per-lane gauge families from the manifest's lanes.per_lane list
+    (lane_report dicts), in the nested-dict shape
+    telemetry.export.prometheus_text renders as
+    family{key="<lane>"} value. The quarantine mask exports as 0/1 per
+    lane — the tenant dashboard's liveness bit — alongside the flush
+    counter, overflow shares and per-lane executed-event totals that
+    previously only reached Prometheus as scalar roll-ups."""
+    out: dict = {}
+    for src_key, family in LANE_METRIC_KEYS:
+        fam = {}
+        for d in per_lane or []:
+            if src_key in d:
+                fam[str(d["lane"])] = int(d[src_key])
+        if fam:
+            out[family] = fam
+    return out

@@ -436,7 +436,11 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 stalled_windows=tele["worst_streak"],
                 stall_limit=stall_windows,
                 time_regression=tele["regressed"],
+                # flow-ring overruns ride the same observability-
+                # degraded warning: results stay exact, the flight
+                # recorder has gaps (telemetry/flows.py)
                 telemetry_lost=(harvester.records_lost
+                                + getattr(harvester, "flow_lost", 0)
                                 if harvester is not None else 0),
                 trace_warnings=tuple(
                     getattr(feeder, "warnings", ()) or ()),

@@ -61,6 +61,10 @@ def _job_entry(queue, j) -> dict:
             entry["lanes"] = j.result["lanes"]
     if getattr(j.spec, "lane_of", None):
         entry["lane_of"] = j.spec.lane_of
+    if j.result and j.result.get("flows"):
+        # per-flow latency summary (telemetry/flows.py): the job-level
+        # copy is the roll-up input for the fleet "flows" block
+        entry["flows"] = j.result["flows"]
     run_man = os.path.join(queue.job_dir(jid), "run_manifest.json")
     if os.path.isfile(run_man):
         entry["run_manifest"] = os.path.join(rel, "run_manifest.json")
@@ -89,6 +93,26 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         j = queue.jobs[jid]
         counts[j.status] = counts.get(j.status, 0) + 1
         jobs[jid] = _job_entry(queue, j)
+    # flows roll-up: sum every flow-traced job's counters, and fold
+    # the per-lane (per-tenant) sample counts into one table — the
+    # lint checks these totals against the per-job entries
+    flows_tot = None
+    for jid, entry in jobs.items():
+        fl = entry.get("flows")
+        if not fl:
+            continue
+        if flows_tot is None:
+            flows_tot = {"jobs": 0, "sampled": 0, "recorded": 0,
+                         "harvested": 0, "lost_ring": 0,
+                         "lost_window_clamp": 0, "lane_samples": {}}
+        flows_tot["jobs"] += 1
+        for k in ("sampled", "recorded", "harvested", "lost_ring",
+                  "lost_window_clamp"):
+            flows_tot[k] += int(fl.get(k, 0) or 0)
+        for lane, summ in (fl.get("per_lane") or {}).items():
+            flows_tot["lane_samples"][lane] = (
+                flows_tot["lane_samples"].get(lane, 0)
+                + int(summ.get("count", 0) or 0))
     return {
         "schema": SCHEMA,
         "schema_version": SCHEMA_VERSION,
@@ -99,6 +123,7 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         "workers_alive": workers_alive,
         "journal_events": queue.events,
         "counts": counts,
+        **({"flows": flows_tot} if flows_tot else {}),
         "jobs": jobs,
     }
 

@@ -217,7 +217,11 @@ class FleetRunner:
             return
         if result.get("ok"):
             self.queue.complete(job, result)
-            self._emit("done", job=job)
+            fl = result.get("flows") or {}
+            self._emit("done", job=job,
+                       **({"flows_sampled": fl.get("sampled"),
+                           "flows_harvested": fl.get("harvested")}
+                          if fl else {}))
             self._backfill_lanes(job, result)
         elif result.get("preempted") and not result.get("deadline"):
             # graceful drain: the run snapshotted and yielded — park it

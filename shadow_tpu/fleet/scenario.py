@@ -126,6 +126,14 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
         faults.install(b, records_from_json({"faults":
                                              list(spec.faults)}))
+    if int(getattr(spec, "flow_sample", 0) or 0) > 0:
+        # per-flow latency tracing: the flow ring rides the sim pytree,
+        # so rebuilds/escalations re-attach it the same way the app
+        # state is re-set-up
+        from shadow_tpu import telemetry
+
+        b.sim = telemetry.attach_flows(
+            b.sim, sample_period=int(spec.flow_sample))
     return b
 
 
@@ -178,6 +186,13 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
 
         feeder = Feeder(spec.inject_trace)
 
+    # flow tracing needs a harvester so checkpoint-time drains keep
+    # ring loss bounded (telemetry/harvest.py drains flows + windows
+    # through the same choke point)
+    harvester = (telemetry.Harvester()
+                 if int(getattr(spec, "flow_sample", 0) or 0) > 0
+                 else None)
+
     res = faults.run_supervised(
         make_bundle(), app_handlers=(phold.handler,),
         checkpoint_path=prefix,
@@ -188,7 +203,7 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         rebuild=rebuild, stop=stop, resume_from=resume_from,
         max_run_wallclock=spec.max_wallclock_s,
         on_round=on_round, log=log, sleep=lambda s: None,
-        feeder=feeder,
+        feeder=feeder, harvester=harvester,
         # fleets live on repeated shapes: serve dispatch programs from
         # the persistent AOT store by default (compile/serve.py;
         # SHADOW_WARM_PROGRAMS=0 / SHADOW_NO_COMPILE_CACHE opt out)
@@ -228,12 +243,19 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         bundle = built["b"]
         from shadow_tpu import inject as inject_mod
         from shadow_tpu.telemetry.export import lanes_manifest_block
+        from shadow_tpu.telemetry.flows import flows_manifest_block
 
         cinfo = dict(res.compile_info or {})
         plan = getattr(bundle, "bucket_plan", None)
         if plan is not None:
             cinfo["buckets"] = plan.as_dict()
         result["program_key"] = cinfo.get("key")
+        flows_blk = None
+        if harvester is not None:
+            harvester.drain(res.sim)
+            flows_blk = flows_manifest_block(
+                harvester, num_hosts=bundle.cfg.num_hosts, shards=1,
+                sample_period=int(spec.flow_sample))
         man = telemetry.run_manifest(
             cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
             stats=res.stats, health=res.health,
@@ -243,10 +265,19 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             preempted=res.preempted or None,
             injection=inject_mod.manifest_block(res.sim, feeder),
             lanes=lanes_manifest_block(res.health, incidents),
+            flows=flows_blk,
             compile_info=cinfo or None)
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
         result["counters"] = man["counters"]
+        if flows_blk is not None:
+            # the roll-up copy: histogram keys stay in the job
+            # manifest; the fleet manifest only needs the summaries
+            result["flows"] = {
+                k: flows_blk[k] for k in
+                ("sample_period", "sampled", "recorded", "harvested",
+                 "lost_ring", "lost_window_clamp", "per_lane")
+                if k in flows_blk}
         if res.ok:
             result["digest"] = sim_digest(res.sim)
     if not res.ok and not res.preempted:

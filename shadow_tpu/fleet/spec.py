@@ -121,6 +121,11 @@ class JobSpec:
     # capacities; `lane_of` records that provenance on the child.
     replicas: int = 1
     lane_of: Optional[str] = None  # parent packed-job id (requeues)
+    # per-flow latency tracing (telemetry/flows.py): sample 1-in-N
+    # cross-host packets into the device flow ring; 0 = off. The job
+    # manifest grows a "flows" block and the fleet manifest rolls the
+    # per-lane latency summaries up per tenant.
+    flow_sample: int = 0
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -156,6 +161,9 @@ class JobSpec:
             if n <= 0 or n & (n - 1):
                 raise ValueError(f"job {self.id}: inject_lanes must "
                                  f"be a positive power of two")
+        if int(self.flow_sample) < 0:
+            raise ValueError(f"job {self.id}: flow_sample must be "
+                             f">= 0 (0 disables flow tracing)")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)

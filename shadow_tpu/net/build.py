@@ -27,6 +27,7 @@ from shadow_tpu.core.engine import (
 )
 from shadow_tpu.core.engine import run as engine_run
 from shadow_tpu.core.events import EventKind, emit_words, push_rows
+from shadow_tpu.telemetry.flows import make_flow_fn
 from shadow_tpu.telemetry.ring import make_telem_fn
 from shadow_tpu.net.state import (
     NetConfig,
@@ -370,8 +371,10 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             q, out = route_outbox(sim.events, sim.outbox, impl=route_impl)
             return sim.replace(events=q, outbox=out)
 
-    # trace-time no-op unless telemetry.attach()ed to the input sim
+    # trace-time no-ops unless telemetry.attach()ed /
+    # telemetry.attach_flows()ed to the input sim
     telem_fn = make_telem_fn()
+    flow_fn = make_flow_fn()
 
     def _go(sim):
         return engine_run(
@@ -382,6 +385,7 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             bulk_fn=bulk_fn,
             fault_fn=fault_fn,
             telem_fn=telem_fn,
+            flow_fn=flow_fn,
             sparse_lanes=resolve_sparse_lanes(bundle.cfg),
             fault_times=plan_times(bundle),
         )
@@ -455,7 +459,8 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
         emit_capacity=bundle.cfg.emit_capacity,
         lane_fn=lambda s: s.net.lane_id,
         bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
-        sparse_lanes=resolve_sparse_lanes(bundle.cfg))
+        sparse_lanes=resolve_sparse_lanes(bundle.cfg),
+        flow_fn=make_flow_fn())
     from shadow_tpu.compile import serve
 
     k_windows = serve.maybe_warm(

@@ -432,6 +432,10 @@ class Sim:
     # on for packed ensemble runs — same None-contributes-no-leaves
     # contract; core.lanes.attach() is the opt-in.
     lanes: Any = None
+    # FlowRing (telemetry/flows.py) when per-flow latency sampling is
+    # on — same None-contributes-no-leaves contract;
+    # telemetry.attach_flows() is the opt-in.
+    flows: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

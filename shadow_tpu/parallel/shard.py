@@ -59,6 +59,7 @@ from shadow_tpu.core.events import (
     segment_ranks,
 )
 from shadow_tpu.net.state import NetState, REPLICATED_FIELDS
+from shadow_tpu.telemetry.flows import make_flow_fn
 from shadow_tpu.telemetry.ring import make_telem_fn
 
 I32 = jnp.int32
@@ -84,7 +85,10 @@ def sim_specs(sim, axis: str):
         # also not host rows — but their window_update reduces
         # shard-LOCAL host planes, so lane isolation is a
         # single-shard feature today (enforced by the attach sites).
-        if names and names[0] in ("telem", "inject", "lanes"):
+        # The flow ring (telemetry/flows.py) is replicated like telem:
+        # its [F] planes are ring slots holding globally-merged
+        # records, identical on every shard after the barrier psum.
+        if names and names[0] in ("telem", "inject", "lanes", "flows"):
             return P()
         # Replicated lookup tables are identified by NetState field
         # name, scoped to the NetState subtree ("net" in a Sim, or a
@@ -262,6 +266,9 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     # prev_*) and planes already hold globally-reduced values — the
     # delta-psum below would multiply them by the shard count.
     telem = getattr(sim, "telem", None)
+    # The flow ring's planes and scalars are likewise already
+    # globally merged at the barrier (telemetry/flows.py) — pin.
+    flows = getattr(sim, "flows", None)
     # Injection staging: seq_floor and horizon are REPLICATED values
     # (the floor advance is the same pure function of the replicated
     # planes on every shard) — the delta-psum would multiply the
@@ -290,6 +297,8 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
         max_occupied=narrow_pinned[2], route_elided=narrow_pinned[3]))
     if telem is not None:
         sim = sim.replace(telem=telem)
+    if flows is not None:
+        sim = sim.replace(flows=flows)
     if inject is not None:
         sim = sim.replace(inject=sim.inject.replace(
             seq_floor=inject.seq_floor, horizon=inject.horizon))
@@ -377,6 +386,8 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             fault_fn=fault_fn,
             # trace-time no-op when sim.telem is None (telemetry off)
             telem_fn=make_telem_fn(axis),
+            # likewise a no-op when sim.flows is None (flow tracing off)
+            flow_fn=make_flow_fn(axis),
             sparse_lanes=sparse_lanes,
             # the active-lane census is a GLOBAL count so every shard
             # takes the same compact/full branch
@@ -477,6 +488,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             telem_fn=make_telem_fn(axis), wstart=wstart,
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
+            flow_fn=make_flow_fn(axis),
         )
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
         return out_sim, stats, next_min
@@ -528,6 +540,7 @@ def make_sharded_chunk(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             telem_fn=make_telem_fn(axis),
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
+            flow_fn=make_flow_fn(axis),
         )
         out_sim, stats, next_min = chunk(local_sim, stats, wstart)
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)

@@ -1,14 +1,26 @@
 """Window telemetry: device-resident per-window ring + host exports.
 
-See ring.py (the on-device ring and the engine hook), harvest.py (the
-between-calls drain + wall-clock phase timers), export.py (Chrome
-trace / Prometheus text / run manifest)."""
+See ring.py (the on-device ring and the engine hook), flows.py (the
+per-flow latency flight-recorder and its histogram/traffic-matrix
+fan-out), harvest.py (the between-calls drain + wall-clock phase
+timers), export.py (Chrome trace / Prometheus text / run manifest)."""
 
 from shadow_tpu.telemetry.ring import (  # noqa: F401
     DEFAULT_CAPACITY,
     TelemetryRing,
     attach,
     make_telem_fn,
+)
+from shadow_tpu.telemetry.flows import (  # noqa: F401
+    DEFAULT_SAMPLE_PERIOD,
+    FlowRecord,
+    FlowRing,
+    attach_flows,
+    flows_manifest_block,
+    latency_histograms,
+    make_flow_fn,
+    per_lane_latency,
+    traffic_matrix,
 )
 from shadow_tpu.telemetry.harvest import (  # noqa: F401
     Harvester,
@@ -17,6 +29,7 @@ from shadow_tpu.telemetry.harvest import (  # noqa: F401
 )
 from shadow_tpu.telemetry.export import (  # noqa: F401
     chrome_trace,
+    metrics_from_manifest,
     prometheus_text,
     run_manifest,
     write_manifest,

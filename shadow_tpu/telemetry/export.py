@@ -26,14 +26,22 @@ def _us(ns: int) -> float:
     return ns / 1000.0
 
 
-def chrome_trace(records, timers=None, num_shards: int = 1) -> dict:
+def chrome_trace(records, timers=None, num_shards: int = 1,
+                 flow_records=None) -> dict:
     """Build a Trace Event Format object (dict; json.dump it).
 
     Sim-time track: pid 0, one "X" event per window record, ts/dur in
     simulated µs (the format's native unit), counters in args.
     Wall-time tracks: pid 1, tid = shard id, phase spans in wall µs
     from the timer origin. Both Chrome and Perfetto accept mixed
-    timelines as separate process groups."""
+    timelines as separate process groups.
+
+    `flow_records` (harvested telemetry/flows.FlowRecord list) adds a
+    third process group, pid 2: per-LANE flow tracks on the sim-time
+    axis — one thread per isolation lane, one "X" span per sampled
+    packet from its staging window to its delivery timestamp, so a
+    packed multi-tenant run reads as side-by-side per-tenant latency
+    timelines in Perfetto."""
     events = []
     events.append({"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
                    "args": {"name": "sim-time (simulated µs)"}})
@@ -77,6 +85,27 @@ def chrome_trace(records, timers=None, num_shards: int = 1) -> dict:
                     "ts": p.start_s * 1e6, "dur": p.dur_s * 1e6,
                     "args": {},
                 })
+    if flow_records:
+        events.append({"ph": "M", "name": "process_name", "pid": 2,
+                       "tid": 0,
+                       "args": {"name": "flows per-lane (simulated µs)"}})
+        for lane in sorted({r.lane for r in flow_records}):
+            events.append({"ph": "M", "name": "thread_name", "pid": 2,
+                           "tid": lane,
+                           "args": {"name": f"lane {lane}"}})
+        for r in flow_records:
+            events.append({
+                "ph": "X", "pid": 2, "tid": r.lane,
+                "name": f"{r.src}->{r.dst} k{r.kind}",
+                "ts": _us(r.t_enq),
+                "dur": max(_us(r.t_deliver - r.t_enq), 0.001),
+                "args": {
+                    "src": r.src, "dst": r.dst, "kind": r.kind,
+                    "flags": r.flags,
+                    "latency_ns": r.t_deliver - r.t_enq,
+                    "t_route": r.t_route,
+                },
+            })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -200,7 +229,9 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  dispatch: dict | None = None,
                  injection: dict | None = None,
                  lanes: dict | None = None,
-                 compile_info: dict | None = None) -> dict:
+                 compile_info: dict | None = None,
+                 flows: dict | None = None,
+                 profile: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -270,6 +301,19 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # checks key format, hit/timing consistency, and that every
         # bucketed capacity >= its requested value
         man["compile"] = dict(compile_info)
+    if flows is not None:
+        # per-flow latency tracing (telemetry/flows.py
+        # flows_manifest_block): sampling accounting, per-(lane, path,
+        # kind) latency histograms, per-lane percentiles, and the
+        # cross-shard traffic matrix the placement pass consumes.
+        # tools/telemetry_lint.py reconciles recorded + lost ==
+        # sampled and the bucket sums
+        man["flows"] = flows
+    if profile is not None:
+        # jax.profiler capture (--profile-dir / BENCH_PROFILE_DIR):
+        # where the TPU trace artifact landed, so the manifest is the
+        # one pointer from a run to every artifact it produced
+        man["profile"] = dict(profile)
     return man
 
 
@@ -324,19 +368,43 @@ def metrics_from_manifest(man: dict) -> dict:
             if inj.get(k) is not None:
                 out[f"inject_{k}"] = inj[k]
     if "lanes" in man:
+        from shadow_tpu.core.lanes import lane_metric_families
+
         ln = man["lanes"]
         out["lanes_replicas"] = ln.get("replicas", 0)
         out["lanes_quarantined_total"] = len(ln.get("quarantined", []))
         out["lanes_contained"] = bool(ln.get("contained", False))
-        out["lane_events_exec"] = {
-            str(d["lane"]): d.get("events_exec", 0)
-            for d in ln.get("per_lane", [])}
+        # per-lane gauge families for every latch the lane report
+        # carries (quarantine mask, flush counter, overflow shares,
+        # per-lane events) — the scalar roll-ups above say "something
+        # tripped", these say WHICH tenant
+        out.update(lane_metric_families(ln.get("per_lane", [])))
+    if "flows" in man:
+        fl = man["flows"]
+        for k in ("sampled", "recorded", "harvested", "lost_ring",
+                  "lost_window_clamp"):
+            if fl.get(k) is not None:
+                out[f"flow_{k}"] = fl[k]
+        if fl.get("sample_period"):
+            out["flow_sample_period"] = fl["sample_period"]
+        per_lane = fl.get("per_lane") or {}
+        for stat in ("p50_ns", "p95_ns", "p99_ns"):
+            fam = {lane: v[stat] for lane, v in sorted(per_lane.items())
+                   if stat in v}
+            if fam:
+                out[f"flow_latency_{stat}"] = fam
+        fam = {lane: v["count"] for lane, v in sorted(per_lane.items())
+               if "count" in v}
+        if fam:
+            out["flow_lane_samples"] = fam
     return out
 
 
-def write_trace(path: str, records, timers=None, num_shards: int = 1):
+def write_trace(path: str, records, timers=None, num_shards: int = 1,
+                flow_records=None):
     with open(path, "w") as f:
-        json.dump(chrome_trace(records, timers, num_shards), f)
+        json.dump(chrome_trace(records, timers, num_shards,
+                               flow_records=flow_records), f)
     return path
 
 

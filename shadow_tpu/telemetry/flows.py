@@ -1,0 +1,436 @@
+"""Device-resident flow flight-recorder: per-packet latency sampling.
+
+The telemetry ring (telemetry/ring.py) answers "how did the *window*
+go"; this module answers "where did the *packets* go and how long did
+they take" — the per-flow attribution ROADMAP items 1-3 need (placement
+wants a cross-shard traffic matrix, the gateway arc wants per-flow
+latency back out, packed multi-tenant runs want per-lane fan-out).
+
+A FlowRing is a fixed-capacity ring of per-packet records appended at
+the window barrier from the staged outbox (every cross-host send passes
+through the outbox exactly once, core/events.apply_emissions; same-host
+loopback deliveries never cross the fabric and are not sampled).
+
+Record fields (one [F] plane each):
+
+- src / dst        global host ids of the sampled send
+- lane             isolation lane of the src host (0 when lane
+                   isolation is off, core/lanes.lane_of_host)
+- kind             event kind of the staged delivery
+- flags            shard-invariant topology bits: FLAG_LOOPBACK
+                   (src == dst), FLAG_CROSS_VERTEX (src and dst attach
+                   to different topology vertices), FLAG_CROSS_LANE
+                   (src and dst in different isolation lanes).
+                   Physical cross-*shard* classification is host-side
+                   (path_of_host) because it depends on the mesh, like
+                   the routed local/cross split.
+- t_enq            window start — the packet was staged inside
+                   [wstart, wend), so wstart bounds its enqueue time
+- t_route          window end: the barrier where the send crossed (or
+                   would cross) the shard exchange
+- t_deliver        the delivery timestamp carried by the event
+
+Determinism / shard invariance (the non-negotiable): sampling is a
+pure hash of (time, dst, src, seq) — splitmix64 finalizer, keep when
+hash % sample_period == 0 — never host randomness. Append order is the
+global (source host, outbox slot) order: rows are contiguous ascending
+global host ids per shard, so each shard's sampled entries form a
+contiguous block of the global order; the cross-shard prefix offset is
+an all_gather of per-shard sampled counts. Each ring slot therefore
+has exactly one writer, and the cross-shard merge is ONE psum of the
+stacked plane deltas (each shard contributes its own writes, zeros
+elsewhere) — records are bit-identical for {1..S} shards and any
+windows-per-dispatch chunking, because the ring state threads through
+the window loop unchanged.
+
+Overflow: per-window appends are clamped to capacity. `count` is the
+monotonic stored-record counter (slot = count % F, the telemetry-ring
+pattern — host overruns are detected from count jumps); `sampled` and
+`lost` are cumulative device scalars with the exact invariant
+count + lost == sampled, which tools/telemetry_lint.py checks.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+from jax import lax
+
+from shadow_tpu.core import simtime
+
+I32 = jnp.int32
+I64 = jnp.int64
+U64 = jnp.uint64
+
+# plane name -> dtype, in record order (harvest.py drains in this
+# order; FlowRecord fields are (index,) + FLOW_PLANES)
+FLOW_PLANES = (
+    ("src", I32),
+    ("dst", I32),
+    ("lane", I32),
+    ("kind", I32),
+    ("flags", I32),
+    ("t_enq", I64),
+    ("t_route", I64),
+    ("t_deliver", I64),
+)
+_I32_PLANES = tuple(n for n, dt in FLOW_PLANES if dt == I32)
+_I64_PLANES = tuple(n for n, dt in FLOW_PLANES if dt == I64)
+
+DEFAULT_CAPACITY = 4096
+DEFAULT_SAMPLE_PERIOD = 64
+
+FLAG_LOOPBACK = 1       # src == dst (defensive: outbox is cross-host)
+FLAG_CROSS_VERTEX = 2   # src/dst attach to different topology vertices
+FLAG_CROSS_LANE = 4     # src/dst in different isolation lanes
+
+
+@struct.dataclass
+class FlowRing:
+    """Fixed-capacity ring of sampled per-packet records."""
+
+    src: jax.Array        # [F] i32
+    dst: jax.Array        # [F] i32
+    lane: jax.Array       # [F] i32
+    kind: jax.Array       # [F] i32
+    flags: jax.Array      # [F] i32
+    t_enq: jax.Array      # [F] i64
+    t_route: jax.Array    # [F] i64
+    t_deliver: jax.Array  # [F] i64
+    # monotonic stored-record counter; slot = count % F
+    count: jax.Array      # [] i64
+    # cumulative sampled (stored + clamped); count + lost == sampled
+    sampled: jax.Array    # [] i64
+    lost: jax.Array       # [] i64
+    # keep 1-in-N when hash(time,dst,src,seq) % N == 0; static so the
+    # sampling constant folds into the compiled program
+    sample_period: int = struct.field(pytree_node=False, default=64)
+
+    @property
+    def capacity(self) -> int:
+        return self.src.shape[0]
+
+    @staticmethod
+    def create(capacity: int = DEFAULT_CAPACITY,
+               sample_period: int = DEFAULT_SAMPLE_PERIOD) -> "FlowRing":
+        if capacity < 1:
+            raise ValueError(
+                f"flow ring capacity must be >= 1, got {capacity}")
+        if sample_period < 1:
+            raise ValueError(
+                f"flow sample period must be >= 1, got {sample_period}")
+        planes = {n: jnp.zeros((capacity,), dt) for n, dt in FLOW_PLANES}
+        z = jnp.zeros((), I64)
+        return FlowRing(count=z, sampled=z, lost=z,
+                        sample_period=int(sample_period), **planes)
+
+
+def attach_flows(sim, sample_period: int = DEFAULT_SAMPLE_PERIOD,
+                 capacity: int = DEFAULT_CAPACITY):
+    """Return `sim` with a flow ring attached (no-op if one already
+    is). Sim.flows defaults to None — the same opt-in contract as
+    sim.telem: a None field contributes no pytree leaves, so programs,
+    checkpoints and results built without flow tracing are byte-for-
+    byte untouched; attaching changes the pytree and retraces."""
+    if getattr(sim, "flows", None) is not None:
+        return sim
+    return sim.replace(flows=FlowRing.create(capacity, sample_period))
+
+
+def _mix64(x: jax.Array) -> jax.Array:
+    """splitmix64 finalizer (u64 wrap-around arithmetic)."""
+    x = (x ^ (x >> jnp.uint64(30))) * jnp.uint64(0xBF58476D1CE4E5B9)
+    x = (x ^ (x >> jnp.uint64(27))) * jnp.uint64(0x94D049BB133111EB)
+    return x ^ (x >> jnp.uint64(31))
+
+
+def sample_hash(time, dst, src, seq) -> jax.Array:
+    """Deterministic u64 sampling key over the flow identity. Pure
+    function of simulated state — the same packet hashes the same on
+    any mesh, which is what makes sampling shard-invariant."""
+    k = time.astype(U64)
+    k = k ^ dst.astype(U64) * jnp.uint64(0x9E3779B97F4A7C15)
+    k = k ^ src.astype(U64) * jnp.uint64(0xC2B2AE3D27D4EB4F)
+    k = k ^ seq.astype(U64) * jnp.uint64(0x165667B19E3779F9)
+    return _mix64(k)
+
+
+def make_flow_fn(axis: str | None = None):
+    """Build the engine's flow_fn(sim, wstart, wend) -> sim hook. Runs
+    inside step_window right after telem_fn — after the window fixpoint
+    and BEFORE route_fn, so the outbox still holds the window's staged
+    sends (route clears it).
+
+    `axis` names the shard_map mesh axis; None compiles single-shard
+    identity reductions (no collectives at all). Sharded, the hook adds
+    three collectives per window at the barrier the route all-to-all
+    already synchronizes: one all_gather of the per-shard sampled
+    counts (the append-prefix offsets), and one psum each for the
+    stacked i32 / i64 plane deltas.
+
+    When sim.flows is None the hook is a trace-time no-op: zero ops in
+    the compiled program."""
+
+    def flow_fn(sim, wstart, wend):
+        ring = getattr(sim, "flows", None)
+        if ring is None:
+            return sim
+
+        out = sim.outbox
+        Hl, M = out.dst.shape
+        F = ring.capacity
+        P = ring.sample_period
+
+        occupied = out.occupied()
+        keep = occupied & (sample_hash(out.time, out.dst, out.src,
+                                       out.seq) % jnp.uint64(P)
+                           == jnp.uint64(0))
+        # flatten in (row, slot) order: rows are ascending global host
+        # ids, so the local order is a contiguous block of the global
+        # (source host, outbox slot) append order
+        keep_f = keep.reshape(-1)
+        csum = jnp.cumsum(keep_f.astype(I64))
+        cnt = csum[-1]
+
+        if axis is None:
+            offset = jnp.zeros((), I64)
+            total = cnt
+        else:
+            counts = lax.all_gather(cnt, axis)        # [S], shard order
+            sidx = lax.axis_index(axis)
+            S = counts.shape[0]
+            offset = jnp.sum(
+                jnp.where(jnp.arange(S) < sidx, counts, 0), dtype=I64)
+            total = jnp.sum(counts, dtype=I64)
+
+        # Scatter-free append: invert the slot map. The local rank-r
+        # kept entry lands in ring slot (count + offset + r) % F when
+        # offset + r < F (the capacity clamp; the excess is counted,
+        # never silently dropped). So for each ring slot s there is at
+        # most one writing rank r = (s - count - offset) mod F, and the
+        # flattened outbox index of rank r is the first position whose
+        # keep-cumsum reaches r+1 — a searchsorted. Everything below is
+        # gathers over [F] + elementwise selects: no scatter at all,
+        # which is the whole point (XLA lowers a [Hl*M]-update scatter
+        # to a serial per-update loop on CPU and a slow path on TPU —
+        # the scatter form cost ~46% of end-to-end throughput at 256
+        # hosts; this form is in the noise).
+        s = jnp.arange(F, dtype=I64)
+        r = jnp.mod(s - ring.count - offset, jnp.asarray(F, I64))
+        valid = (r < cnt) & ((offset + r) < F)
+        i = jnp.clip(jnp.searchsorted(csum, r + 1), 0, Hl * M - 1)
+
+        # gather the F candidate records, then derive lane/flags on the
+        # compacted [F] width (not the full outbox width)
+        src = out.src.reshape(-1)[i]
+        dst = out.dst.reshape(-1)[i]
+        kind = out.kind.reshape(-1)[i]
+        t_del = out.time.reshape(-1)[i]
+        GH = sim.net.vertex_of_host.shape[0]
+        lanes_st = getattr(sim, "lanes", None)
+        if lanes_st is not None:
+            from shadow_tpu.core.lanes import lane_of_host
+
+            R = lanes_st.replicas
+            lane_src = lane_of_host(src, GH, R).astype(I32)
+            lane_dst = lane_of_host(dst, GH, R).astype(I32)
+        else:
+            lane_src = jnp.zeros_like(src)
+            lane_dst = lane_src
+        # gather against replicated topology tables (clamped indexing
+        # tolerates the dst == -1 empties; those rows are never valid)
+        vsrc = sim.net.vertex_of_host[jnp.clip(src, 0, GH - 1)]
+        vdst = sim.net.vertex_of_host[jnp.clip(dst, 0, GH - 1)]
+        flags = ((src == dst).astype(I32) * FLAG_LOOPBACK
+                 + (vsrc != vdst).astype(I32) * FLAG_CROSS_VERTEX
+                 + (lane_src != lane_dst).astype(I32) * FLAG_CROSS_LANE)
+
+        vals = {
+            "src": src, "dst": dst, "lane": lane_src, "kind": kind,
+            "flags": flags,
+            "t_enq": jnp.broadcast_to(
+                jnp.asarray(wstart, simtime.DTYPE), (F,)),
+            "t_route": jnp.broadcast_to(
+                jnp.asarray(wend, simtime.DTYPE), (F,)),
+            "t_deliver": t_del,
+        }
+        new = {
+            n: jnp.where(valid, v.astype(getattr(ring, n).dtype),
+                         getattr(ring, n))
+            for n, v in vals.items()
+        }
+        if axis is not None:
+            # each slot has exactly one writing shard; merge by summing
+            # the plane deltas (zeros where this shard did not write)
+            d32 = jnp.stack([new[n] - getattr(ring, n)
+                             for n in _I32_PLANES])
+            d64 = jnp.stack([new[n] - getattr(ring, n)
+                             for n in _I64_PLANES])
+            d32 = lax.psum(d32, axis)
+            d64 = lax.psum(d64, axis)
+            new = {n: getattr(ring, n) + d32[i]
+                   for i, n in enumerate(_I32_PLANES)}
+            new.update({n: getattr(ring, n) + d64[i]
+                        for i, n in enumerate(_I64_PLANES)})
+
+        appended = jnp.minimum(total, jnp.asarray(F, I64))
+        ring = ring.replace(
+            count=ring.count + appended,
+            sampled=ring.sampled + total,
+            lost=ring.lost + (total - appended),
+            **new)
+        return sim.replace(flows=ring)
+
+    return flow_fn
+
+
+# --- host side: records -> histograms / percentiles / traffic matrix --
+
+@dataclass
+class FlowRecord:
+    """One harvested flow sample (host-side ints). Field order is
+    (index,) + FLOW_PLANES — the harvester constructs positionally."""
+
+    index: int      # monotonic append position (ring count at write)
+    src: int
+    dst: int
+    lane: int
+    kind: int
+    flags: int
+    t_enq: int
+    t_route: int
+    t_deliver: int
+
+    @property
+    def latency_ns(self) -> int:
+        """Staging-to-delivery latency: the observable the histograms
+        bucket. t_enq is the window start, so this over-approximates
+        the true enqueue->deliver span by < one window."""
+        return self.t_deliver - self.t_enq
+
+
+def path_of_host(h: int, num_hosts: int, path_shards: int) -> int:
+    """Contiguous-block shard of a host — the same decomposition the
+    mesh uses (parallel/shard.py: shard s owns [s*Hl, (s+1)*Hl)).
+    `path_shards` is a host-side choice: pass the run's physical shard
+    count for "where did traffic cross THIS mesh", or a candidate count
+    to evaluate a placement before running it."""
+    if path_shards <= 1 or num_hosts <= 0:
+        return 0
+    block = max(1, num_hosts // path_shards)
+    return min(h // block, path_shards - 1)
+
+
+def _pct_sorted(vals: list, q: float) -> int:
+    """Nearest-rank percentile over a pre-sorted int list — pure
+    integer selection, bit-reproducible across platforms (no float
+    interpolation)."""
+    if not vals:
+        return 0
+    i = min(len(vals) - 1, max(0, round(q / 100 * (len(vals) - 1))))
+    return vals[i]
+
+
+def _log2_bucket_lo(lat: int) -> int:
+    """Lower bound of the log2 latency bucket holding `lat` ns: bucket
+    [2^b, 2^(b+1)) for lat >= 1; the degenerate lat <= 0 lands in
+    bucket 0."""
+    if lat < 1:
+        return 0
+    return 1 << (int(lat).bit_length() - 1)
+
+
+def latency_histograms(records, *, num_hosts: int, path_shards: int = 1
+                       ) -> dict:
+    """Log-bucketed latency histograms keyed by
+    "lane<r>/<srcshard>-><dstshard>/k<kind>". Each value carries the
+    sample count, nearest-rank p50/p95/p99 latency, and the sparse
+    bucket map {bucket_lo_ns: count} with keys ascending. Keyed by the
+    *host-side* path decomposition (path_of_host) so histograms are
+    identical for any physical mesh that harvested the same records."""
+    lats: dict[str, list] = {}
+    for r in records:
+        key = (f"lane{r.lane}/"
+               f"{path_of_host(r.src, num_hosts, path_shards)}->"
+               f"{path_of_host(r.dst, num_hosts, path_shards)}/"
+               f"k{r.kind}")
+        lats.setdefault(key, []).append(r.latency_ns)
+    out = {}
+    for key in sorted(lats):
+        vs = sorted(lats[key])
+        buckets: dict[str, int] = {}
+        for v in vs:
+            lo = str(_log2_bucket_lo(v))
+            buckets[lo] = buckets.get(lo, 0) + 1
+        out[key] = {
+            "count": len(vs),
+            "p50_ns": _pct_sorted(vs, 50),
+            "p95_ns": _pct_sorted(vs, 95),
+            "p99_ns": _pct_sorted(vs, 99),
+            "buckets": {k: buckets[k]
+                        for k in sorted(buckets, key=int)},
+        }
+    return out
+
+
+def per_lane_latency(records) -> dict:
+    """{lane: {count, p50_ns, p95_ns, p99_ns}} — the per-lane metric
+    families and Perfetto track summaries."""
+    lats: dict[int, list] = {}
+    for r in records:
+        lats.setdefault(int(r.lane), []).append(r.latency_ns)
+    out = {}
+    for lane in sorted(lats):
+        vs = sorted(lats[lane])
+        out[str(lane)] = {
+            "count": len(vs),
+            "p50_ns": _pct_sorted(vs, 50),
+            "p95_ns": _pct_sorted(vs, 95),
+            "p99_ns": _pct_sorted(vs, 99),
+        }
+    return out
+
+
+def traffic_matrix(records, *, num_hosts: int, path_shards: int) -> list:
+    """[S][S] sampled-send counts between contiguous host blocks — the
+    placement pass's objective input (minimize off-diagonal mass).
+    Multiply by the sample period for an unbiased traffic estimate."""
+    S = max(1, path_shards)
+    mat = [[0] * S for _ in range(S)]
+    for r in records:
+        mat[path_of_host(r.src, num_hosts, S)][
+            path_of_host(r.dst, num_hosts, S)] += 1
+    return mat
+
+
+def flows_manifest_block(harvester, *, num_hosts: int, shards: int = 1,
+                         sample_period: int | None = None) -> dict | None:
+    """Build the manifest's top-level "flows" block from a harvester
+    that drained a flow ring. None when no flow tracing ran.
+    tools/telemetry_lint.py checks: recorded + lost_window_clamp ==
+    sampled, harvested + lost_ring <= recorded, histogram bucket sums
+    == harvested, traffic-matrix total == harvested."""
+    if harvester is None or not getattr(harvester, "flow_enabled", False):
+        return None
+    recs = harvester.flow_records
+    S = max(1, int(shards))
+    block = {
+        "sample_period": (int(sample_period)
+                          if sample_period is not None else None),
+        "sampled": int(harvester.flow_sampled),
+        "recorded": int(harvester.flow_seen),
+        "harvested": len(recs),
+        "lost_ring": int(harvester.flow_lost),
+        "lost_window_clamp": int(harvester.flow_lost_clamp),
+        "path_shards": S,
+        "histograms": latency_histograms(
+            recs, num_hosts=num_hosts, path_shards=S),
+        "per_lane": per_lane_latency(recs),
+        "traffic_matrix": traffic_matrix(
+            recs, num_hosts=num_hosts, path_shards=S),
+    }
+    return block

@@ -24,6 +24,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from shadow_tpu.telemetry.flows import FLOW_PLANES, FlowRecord
 from shadow_tpu.telemetry.ring import PLANES
 
 
@@ -66,6 +67,17 @@ class Harvester:
     # sees — record it here so the manifest's telemetry aggregates
     # carry it next to the windows it interrupted
     escalation_marks: list = field(default_factory=list)
+    # --- flow flight-recorder (telemetry/flows.py), drained in the
+    # same pass as the window ring so every host loop that already
+    # calls drain() (supervisor checkpoints, pcap hook, final harvest)
+    # gets flow records for free. flow_enabled latches True the first
+    # time a sim with a flow ring passes through drain().
+    flow_enabled: bool = False
+    flow_seen: int = 0            # flow ring count at the last drain
+    flow_records: list = field(default_factory=list)
+    flow_lost: int = 0            # ring overrun (host drained too late)
+    flow_lost_clamp: int = 0      # device window-clamp loss (cumulative)
+    flow_sampled: int = 0         # device cumulative sampled count
 
     def mark_escalation(self, esc) -> None:
         self.escalation_marks.append(
@@ -77,6 +89,7 @@ class Harvester:
         from an older checkpoint): already-harvested records past the
         restored count are discarded so replayed windows are not
         double-counted."""
+        self._drain_flows(sim)
         ring = getattr(sim, "telem", None)
         if ring is None:
             return 0
@@ -108,6 +121,38 @@ class Harvester:
             WindowRecord(*row)
             for row in zip(idx.tolist(), *cols, *extras))
         self.seen = c
+        return take
+
+    def _drain_flows(self, sim) -> int:
+        """Flow-ring sibling of the window drain: same monotonic-count
+        overrun accounting, same rewind tolerance. The device's own
+        cumulative sampled/lost scalars are snapshotted as-is (they
+        rewind with the checkpoint on a supervisor resume)."""
+        ring = getattr(sim, "flows", None)
+        if ring is None:
+            return 0
+        self.flow_enabled = True
+        self.flow_sampled = int(np.asarray(ring.sampled))
+        self.flow_lost_clamp = int(np.asarray(ring.lost))
+        c = int(np.asarray(ring.count))
+        if c < self.flow_seen:
+            self.flow_records = [r for r in self.flow_records
+                                 if r.index < c]
+            self.flow_seen = c
+        new = c - self.flow_seen
+        if new <= 0:
+            return 0
+        F = ring.capacity
+        lost = max(0, new - F)
+        self.flow_lost += lost
+        take = min(new, F)
+        idx = np.arange(c - take, c)
+        slots = idx % F
+        cols = [np.asarray(getattr(ring, name))[slots].tolist()
+                for name, _ in FLOW_PLANES]
+        self.flow_records.extend(
+            FlowRecord(*row) for row in zip(idx.tolist(), *cols))
+        self.flow_seen = c
         return take
 
     def mean_window_ns(self) -> float | None:
@@ -162,6 +207,14 @@ class Harvester:
                             if r.lane_events)) for i in range(R)]
         if self.escalation_marks:
             out["escalations"] = len(self.escalation_marks)
+        if self.flow_enabled:
+            # headline flow accounting only — the full histogram /
+            # traffic-matrix fan-out is the manifest's top-level
+            # "flows" block (telemetry/flows.flows_manifest_block)
+            out["flows_sampled"] = int(self.flow_sampled)
+            out["flows_harvested"] = len(self.flow_records)
+            out["flows_lost_ring"] = int(self.flow_lost)
+            out["flows_lost_window_clamp"] = int(self.flow_lost_clamp)
         return out
 
 

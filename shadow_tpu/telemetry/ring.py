@@ -9,6 +9,13 @@ masked one-hot stores (the same no-scatter idiom as events._put and
 the pcap capture ring) — that the host drains between device calls
 (telemetry/harvest.py).
 
+This ring answers "how did each WINDOW go"; its per-packet sibling is
+telemetry/flows.py (the flow flight-recorder), which reuses the same
+count-monotonic ring/overrun contract but samples individual
+cross-host sends into latency records. Both drain through one
+Harvester and surface through the same manifest/metrics/trace fan-out
+(telemetry/export.py).
+
 Record fields (one [W] plane each):
 
 - wstart / wend      window bounds in sim-ns

@@ -390,6 +390,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
         step_window,
     )
     from shadow_tpu.compile import serve
+    from shadow_tpu.telemetry.flows import make_flow_fn
     from shadow_tpu.telemetry.ring import make_telem_fn
 
     cfg = bundle.cfg
@@ -423,7 +424,8 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
                 emit_capacity=cfg.emit_capacity,
                 lane_fn=lambda s: s.net.lane_id,
                 bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
-                sparse_lanes=resolve_sparse_lanes(cfg))
+                sparse_lanes=resolve_sparse_lanes(cfg),
+                flow_fn=make_flow_fn())
             raw = jax.jit(body)
         example = (sim, EngineStats.create(),
                    jnp.asarray(0, simtime.DTYPE))
@@ -440,6 +442,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
             donate=True)
     else:
         telem_fn = make_telem_fn()  # trace-time no-op, telem is None
+        flow_fn = make_flow_fn()    # likewise when flows is None
 
         @partial(jax.jit, donate_argnums=(0,))
         def raw(sim, wstart, wend):
@@ -449,7 +452,8 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
                                lane_id=sim.net.lane_id,
                                bulk_fn=bulk_fn, fault_fn=fault_fn,
                                telem_fn=telem_fn, wstart=wstart,
-                               sparse_lanes=resolve_sparse_lanes(cfg))
+                               sparse_lanes=resolve_sparse_lanes(cfg),
+                               flow_fn=flow_fn)
     example = (sim, 0, plan.min_jump)
     one_window = serve.maybe_warm(raw, key, enabled=warm, store=store,
                                   info=compile_info)

@@ -1,0 +1,76 @@
+"""tools/bench_regress.py: the trajectory gate. It must pass the
+repo's own banked rounds (the checked-in history is the fixture), fail
+loudly on a synthetic >threshold drop, tolerate new metrics and the
+fresh-then-warm same-round repeat, and never compare a CPU number
+against a TPU number under one metric name."""
+
+import json
+import pathlib
+
+from conftest import load_tool
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+
+bench_regress = load_tool("bench_regress")
+
+
+def _write_round(d, n, rows):
+    (d / f"BENCH_r{n:02d}.json").write_text(json.dumps(
+        {"n": n, "cmd": "bench", "rc": 0, "tail": "",
+         "parsed": rows if isinstance(rows, dict) else rows}))
+
+
+def test_banked_history_passes_gate():
+    """The repo's own BENCH_r*.json trajectory is within the gate —
+    the invariant every future round must keep."""
+    assert bench_regress.main(["--dir", str(REPO)]) == 0
+
+
+def test_regression_detected(tmp_path):
+    _write_round(tmp_path, 1, {"metric": "events_per_sec", "value": 1000.0,
+                               "backend": "cpu"})
+    _write_round(tmp_path, 2, {"metric": "events_per_sec", "value": 850.0,
+                               "backend": "cpu"})
+    assert bench_regress.main(["--dir", str(tmp_path)]) == 1
+    # a looser threshold lets the same drop through
+    assert bench_regress.main(["--dir", str(tmp_path),
+                               "--threshold", "0.2"]) == 0
+
+
+def test_new_metric_and_backend_split_pass(tmp_path):
+    # round 1 banks a cpu number; round 2 banks the SAME metric from
+    # tpu (not comparable -> no prior) plus a brand-new metric
+    _write_round(tmp_path, 1, {"metric": "events_per_sec", "value": 1000.0,
+                               "backend": "cpu"})
+    _write_round(tmp_path, 2, {
+        "tpu": {"metric": "events_per_sec", "value": 5.0,
+                "backend": "tpu"},
+        "new": {"metric": "events_per_sec@new_shape", "value": 1.0,
+                "backend": "tpu"}})
+    assert bench_regress.main(["--dir", str(tmp_path)]) == 0
+
+
+def test_same_round_repeat_is_compared(tmp_path):
+    """A fresh-then-warm pair banks one metric twice in one round; the
+    warm row compares against the fresh row, so a warm-path collapse
+    fails the gate even with no prior round."""
+    _write_round(tmp_path, 1, {
+        "fresh": {"metric": "events_per_sec", "value": 1000.0,
+                  "backend": "cpu"},
+        "warm": {"metric": "events_per_sec", "value": 400.0,
+                 "backend": "cpu"}})
+    assert bench_regress.main(["--dir", str(tmp_path)]) == 1
+
+
+def test_empty_dir_and_bad_threshold(tmp_path):
+    assert bench_regress.main(["--dir", str(tmp_path)]) == 0
+    assert bench_regress.main(["--dir", str(tmp_path),
+                               "--threshold", "0"]) == 2
+    assert bench_regress.main(["--dir", str(tmp_path),
+                               "--threshold", "1.5"]) == 2
+
+
+def test_unreadable_round_skipped(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text("{not json")
+    _write_round(tmp_path, 2, {"metric": "m", "value": 10.0})
+    assert bench_regress.main(["--dir", str(tmp_path)]) == 0

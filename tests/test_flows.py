@@ -1,0 +1,422 @@
+"""Per-flow latency flight-recorder (telemetry/flows.py): sampling is
+a pure hash of simulated state, so the harvested record stream must be
+bit-identical across shard counts and dispatch chunking; attaching the
+ring must never perturb the simulation; overflow is counted on device
+(count + lost == sampled) and at harvest (harvested + lost_ring <=
+recorded), never silent; and every export surface (manifest flows
+block, per-lane metric families, Perfetto flow tracks) round-trips
+through the same lint the CI gate runs."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import load_tool
+from jax.sharding import Mesh
+
+from shadow_tpu import telemetry
+from shadow_tpu.apps import phold, pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import health as health_mod
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel import run_sharded
+from shadow_tpu.telemetry import flows as flows_mod
+from shadow_tpu.utils import checkpoint
+
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 8
+PORT = 7000
+
+
+def _build(seed=1):
+    """TCP-relay shape: 4 pingpong client/server pairs (the same
+    fixture as test_telemetry, so regressions triangulate)."""
+    cfg = NetConfig(num_hosts=H, end_time=5 * simtime.ONE_SECOND, seed=seed)
+    hosts = [HostSpec(name=f"client{i}", proc_start_time=simtime.ONE_SECOND)
+             for i in range(H // 2)]
+    hosts += [HostSpec(name=f"server{i}") for i in range(H // 2)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    client = jnp.asarray(np.arange(H) < H // 2)
+    server = jnp.asarray(np.arange(H) >= H // 2)
+    server_ip = np.zeros(H, np.int64)
+    for i in range(H // 2):
+        server_ip[i] = b.ip_of(f"server{i}")
+    b.sim = pingpong.setup(b.sim, client_mask=client, server_mask=server,
+                           server_ip=jnp.asarray(server_ip),
+                           server_port=PORT, count=5, size=128)
+    return b
+
+
+def _phold_bundle(H8=8, load=2, sim_s=1, seed=7):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H8, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H8)]
+    b = build(cfg, ONE_VERTEX.replace("10240", "102400"), hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+@pytest.fixture(scope="module")
+def serial():
+    """Serial pingpong run with every cross-host send sampled."""
+    b = _build()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    b.sim = telemetry.attach_flows(b.sim, sample_period=1)
+    sim, stats = jax.device_get(run(b, app_handlers=(pingpong.handler,)))
+    h = telemetry.Harvester()
+    h.drain(sim)
+    return b, sim, stats, h
+
+
+def test_flow_records_sane(serial):
+    _, sim, stats, h = serial
+    assert h.flow_enabled
+    recs = h.flow_records
+    assert recs, "pingpong run sampled no flows at period 1"
+    # device invariant: stored + clamped == sampled
+    assert (int(np.asarray(sim.flows.count))
+            + int(np.asarray(sim.flows.lost))
+            == int(np.asarray(sim.flows.sampled)))
+    # host invariant: what we drained never exceeds what was stored
+    assert len(recs) + h.flow_lost <= h.flow_seen
+    # at period 1 every sampled send is an emitted event
+    assert h.flow_sampled <= int(stats.events_processed)
+    for r in recs:
+        assert 0 <= r.src < H and 0 <= r.dst < H
+        assert r.src != r.dst          # the outbox is cross-host only
+        assert r.lane == 0             # lane isolation off
+        assert not r.flags & flows_mod.FLAG_LOOPBACK
+        assert not r.flags & flows_mod.FLAG_CROSS_VERTEX  # one vertex
+        assert not r.flags & flows_mod.FLAG_CROSS_LANE
+        assert r.t_enq <= r.t_route    # window start <= window end
+        assert r.latency_ns > 0        # delivery is after staging
+    # append order is monotone in ring position
+    assert [r.index for r in recs] == sorted(r.index for r in recs)
+
+
+def test_flow_records_bit_identical_across_shard_counts(serial):
+    """The tentpole contract: sampling hashes simulated state, never
+    mesh state, so 1-shard and 8-shard runs harvest THE SAME records
+    (dataclass equality: every field, in order)."""
+    _, _, _, h1 = serial
+    b = _build()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    b.sim = telemetry.attach_flows(b.sim, sample_period=1)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sim2, _ = run_sharded(b, mesh, "hosts",
+                          app_handlers=(pingpong.handler,))
+    h2 = telemetry.Harvester()
+    h2.drain(jax.device_get(sim2))
+    assert len(h1.flow_records) == len(h2.flow_records)
+    assert h1.flow_records == h2.flow_records
+    assert h1.flow_sampled == h2.flow_sampled
+    assert h1.flow_lost_clamp == h2.flow_lost_clamp
+
+
+def test_phold_flow_identity_shards_and_chunking():
+    """PHOLD shape, sampled 1-in-2: serial K=1, serial K=64 and
+    8-shard runs all store bit-identical ring planes — partitioning
+    (mesh or dispatch chunking) is a performance knob, not a sampling
+    knob."""
+    def flows_of(sim):
+        sim = jax.device_get(sim)
+        return {n: np.asarray(getattr(sim.flows, n))
+                for n, _ in flows_mod.FLOW_PLANES} | {
+                    "count": int(np.asarray(sim.flows.count)),
+                    "sampled": int(np.asarray(sim.flows.sampled)),
+                    "lost": int(np.asarray(sim.flows.lost))}
+
+    def bundle():
+        b = _phold_bundle()
+        b.sim = telemetry.attach_flows(b.sim, sample_period=2)
+        return b
+
+    sim_k1, _, _ = checkpoint.run_windows(
+        bundle(), app_handlers=(phold.handler,))
+    sim_k64, _, _ = checkpoint.run_windows(
+        bundle(), app_handlers=(phold.handler,), windows_per_dispatch=64)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sim_sh, _ = run_sharded(bundle(), mesh, "hosts",
+                            app_handlers=(phold.handler,))
+
+    ref = flows_of(sim_k1)
+    assert ref["sampled"] > 0, "period-2 phold sampled nothing"
+    assert 0 < ref["count"] <= ref["sampled"]  # the hash filters some
+    for name, got in (("K=64", flows_of(sim_k64)),
+                      ("8-shard", flows_of(sim_sh))):
+        for k, v in ref.items():
+            np.testing.assert_array_equal(
+                v, got[k], err_msg=f"{name}: flow plane {k} diverged")
+
+
+def test_flow_tracing_off_is_byte_identical(serial):
+    """sim.flows is None by default and contributes no pytree leaves;
+    attaching the ring observes the run without perturbing it — every
+    non-flow leaf of the traced run equals the untraced run's."""
+    _, sim_f, stats_f, _ = serial
+    b = _build()
+    assert b.sim.flows is None
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    sim0, stats0 = jax.device_get(run(b, app_handlers=(pingpong.handler,)))
+    assert int(stats0.events_processed) == int(stats_f.events_processed)
+    assert int(stats0.windows) == int(stats_f.windows)
+    flat_f = {jax.tree_util.keystr(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(sim_f)[0]}
+    flat_0 = {jax.tree_util.keystr(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(sim0)[0]}
+    flow_keys = {k for k in flat_f if ".flows" in k}
+    assert flow_keys and set(flat_f) - flow_keys == set(flat_0)
+    for k in flat_0:
+        np.testing.assert_array_equal(np.asarray(flat_0[k]),
+                                      np.asarray(flat_f[k]),
+                                      err_msg=f"{k} perturbed by tracing")
+
+
+def test_attach_flows_idempotent_and_validates():
+    b = _build()
+    s1 = telemetry.attach_flows(b.sim, sample_period=4, capacity=32)
+    assert s1.flows.capacity == 32
+    assert s1.flows.sample_period == 4
+    assert telemetry.attach_flows(s1, sample_period=8) is s1
+    with pytest.raises(ValueError):
+        flows_mod.FlowRing.create(capacity=0)
+    with pytest.raises(ValueError):
+        flows_mod.FlowRing.create(sample_period=0)
+
+
+def test_overflow_accounting_saturated_ring():
+    """A ring far smaller than the traffic must clamp loudly: the
+    device invariant count + lost == sampled holds, the harvester
+    reports the ring overrun, and the manifest lint warns (never
+    errors) about both loss modes."""
+    b = _build()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    b.sim = telemetry.attach_flows(b.sim, sample_period=1, capacity=8)
+    sim, stats = jax.device_get(run(b, app_handlers=(pingpong.handler,)))
+    sampled = int(np.asarray(sim.flows.sampled))
+    count = int(np.asarray(sim.flows.count))
+    lost = int(np.asarray(sim.flows.lost))
+    assert sampled > 8          # the ring actually saturated
+    assert count + lost == sampled
+    h = telemetry.Harvester()
+    h.drain(sim)
+    assert len(h.flow_records) <= 8
+    assert len(h.flow_records) + h.flow_lost <= h.flow_seen
+    assert h.flow_lost > 0 or h.flow_lost_clamp > 0
+    blk = telemetry.flows_manifest_block(h, num_hosts=H, shards=1,
+                                         sample_period=1)
+    assert blk["recorded"] + blk["lost_window_clamp"] == blk["sampled"]
+    assert blk["harvested"] + blk["lost_ring"] <= blk["recorded"]
+    man = telemetry.run_manifest(cfg=b.cfg, seed=1, shards=1, sim=sim,
+                                 stats=stats,
+                                 health=health_mod.gather(sim),
+                                 flows=blk)
+    lint = load_tool("telemetry_lint")
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert any("flow" in w for w in warns)
+
+
+def test_histograms_deterministic_pure_integer():
+    """Histogram construction is integer-only (nearest-rank
+    percentiles, log2 buckets): the same records give the same block,
+    and hand-checkable values come out exactly."""
+    R = flows_mod.FlowRecord
+    recs = [R(index=i, src=0, dst=4, lane=0, kind=1, flags=0,
+              t_enq=0, t_route=50, t_deliver=lat)
+            for i, lat in enumerate([1, 2, 3, 4, 100])]
+    h1 = flows_mod.latency_histograms(recs, num_hosts=8, path_shards=2)
+    h2 = flows_mod.latency_histograms(list(recs), num_hosts=8,
+                                      path_shards=2)
+    assert h1 == h2
+    assert list(h1) == ["lane0/0->1/k1"]
+    blk = h1["lane0/0->1/k1"]
+    assert blk["count"] == 5
+    assert blk["p50_ns"] == 3
+    assert blk["p99_ns"] == 100
+    # log2 buckets: 1, [2,4) x2, [4,8), [64,128)
+    assert blk["buckets"] == {"1": 1, "2": 2, "4": 1, "64": 1}
+    assert sum(blk["buckets"].values()) == blk["count"]
+    per_lane = flows_mod.per_lane_latency(recs)
+    assert per_lane == {"0": {"count": 5, "p50_ns": 3, "p95_ns": 100,
+                              "p99_ns": 100}}
+    mat = flows_mod.traffic_matrix(recs, num_hosts=8, path_shards=2)
+    assert mat == [[0, 5], [0, 0]]
+
+
+def test_path_of_host_blocks():
+    # contiguous blocks, the same carve-up the mesh uses
+    assert [flows_mod.path_of_host(h, 8, 2) for h in range(8)] \
+        == [0, 0, 0, 0, 1, 1, 1, 1]
+    # degenerate cases collapse to path 0
+    assert flows_mod.path_of_host(5, 8, 1) == 0
+    # remainder hosts fold into the last block
+    assert flows_mod.path_of_host(7, 8, 3) == 2
+
+
+def test_manifest_metrics_trace_roundtrip(serial, tmp_path):
+    """The full export fan-out from one harvest: manifest flows block,
+    per-lane metric families, pid-2 Perfetto track — all pass the CI
+    lint through the same entrypoints the CLI uses."""
+    b, sim, stats, h = serial
+    blk = telemetry.flows_manifest_block(h, num_hosts=H, shards=1,
+                                         sample_period=1)
+    assert blk["sampled"] == h.flow_sampled
+    assert blk["harvested"] == len(h.flow_records)
+    assert sum(v["count"] for v in blk["histograms"].values()) \
+        == blk["harvested"]
+    assert sum(sum(row) for row in blk["traffic_matrix"]) \
+        == blk["harvested"]
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, wall_seconds=1.0,
+                                 flows=blk)
+    trace = telemetry.chrome_trace(h.records, num_shards=1,
+                                   flow_records=h.flow_records)
+    pids = {e.get("pid") for e in trace["traceEvents"]
+            if e.get("ph") == "X"}
+    assert 2 in pids            # the flows track exists
+    lint = load_tool("telemetry_lint")
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert warns == []
+    errs, _ = lint.lint_trace_obj(trace)
+    assert errs == []
+    # per-lane families surface in the metrics export
+    metrics = telemetry.metrics_from_manifest(man)
+    assert metrics["flow_sampled"] == blk["sampled"]
+    assert metrics["flow_sample_period"] == 1
+    assert metrics["flow_lane_samples"]["0"] == blk["harvested"]
+    assert metrics["flow_latency_p50_ns"]["0"] \
+        == blk["per_lane"]["0"]["p50_ns"]
+    # and the files the CLI writes lint clean end to end
+    tp, mp = str(tmp_path / "t.json"), str(tmp_path / "m.json")
+    telemetry.write_trace(tp, h.records, None, 1,
+                          flow_records=h.flow_records)
+    telemetry.write_manifest(mp, man)
+    assert lint.main(["--trace", tp, "--manifest", mp, "-q"]) == 0
+
+
+def test_lint_rejects_corrupt_flows_block(serial):
+    """The lint actually bites: breaking each flows invariant turns a
+    clean manifest into an error."""
+    b, sim, stats, h = serial
+    lint = load_tool("telemetry_lint")
+
+    def man_with(mut):
+        blk = telemetry.flows_manifest_block(h, num_hosts=H, shards=1,
+                                             sample_period=1)
+        mut(blk)
+        return telemetry.run_manifest(cfg=b.cfg, seed=1, shards=1,
+                                      sim=sim, stats=stats,
+                                      health=health_mod.gather(sim),
+                                      harvester=h, flows=blk)
+
+    def bump_sampled(blk):
+        blk["sampled"] += 1          # breaks recorded+clamp == sampled
+
+    def shrink_bucket(blk):
+        k = next(iter(blk["histograms"]))
+        bk = blk["histograms"][k]["buckets"]
+        bk[next(iter(bk))] += 1      # bucket sum != count
+
+    def scramble_pct(blk):
+        k = next(iter(blk["histograms"]))
+        blk["histograms"][k]["p50_ns"] = 10**12   # p50 > p99
+
+    def bad_matrix(blk):
+        blk["traffic_matrix"][0][0] += 1          # total != harvested
+
+    for mut in (bump_sampled, shrink_bucket, scramble_pct, bad_matrix):
+        errs, _ = lint.lint_manifest_obj(man_with(mut))
+        assert errs, f"lint passed a manifest corrupted by {mut.__name__}"
+
+
+def test_lane_latch_gauge_families():
+    """The PR 9 lane latches reach Prometheus as per-lane families,
+    not just scalar roll-ups: one gauge per (family, lane), rendered
+    with the lane as the label key."""
+    from shadow_tpu.core.lanes import lane_metric_families
+
+    per_lane = [
+        {"lane": 0, "quarantined": 0, "flushed": 0, "events_exec": 10,
+         "events_overflow": 0, "outbox_overflow": 0, "rq_overflow": 0,
+         "stall_streak": 0},
+        {"lane": 1, "quarantined": 1, "flushed": 2, "events_exec": 4,
+         "events_overflow": 3, "outbox_overflow": 0, "rq_overflow": 0,
+         "stall_streak": 5},
+    ]
+    fams = lane_metric_families(per_lane)
+    assert fams["lane_quarantined"] == {"0": 0, "1": 1}
+    assert fams["lane_flushed"] == {"0": 0, "1": 2}
+    assert fams["lane_events_exec"] == {"0": 10, "1": 4}
+    assert fams["lane_stall_streak"] == {"0": 0, "1": 5}
+    prom = telemetry.prometheus_text(fams)
+    assert 'shadow_tpu_lane_quarantined{key="1"} 1' in prom
+    assert 'shadow_tpu_lane_events_exec{key="0"} 10' in prom
+
+
+def test_fleet_flows_rollup_and_lint(tmp_path):
+    """Jobs that sampled flows surface per-job summaries plus a
+    derived fleet-level totals block; the lint re-derives the totals
+    so a mismatch is an error, not a dashboard surprise."""
+    import json
+
+    from shadow_tpu.fleet import manifest as manifest_mod
+    from shadow_tpu.fleet import spec as spec_mod
+    from shadow_tpu.fleet import state as state_mod
+
+    def flows_summary(n, lane):
+        return {"sample_period": 4, "sampled": n, "recorded": n,
+                "harvested": n, "lost_ring": 0, "lost_window_clamp": 0,
+                "per_lane": {str(lane): {"count": n, "p50_ns": 7,
+                                         "p95_ns": 9, "p99_ns": 9}}}
+
+    pol = spec_mod.FleetPolicy(max_attempts=2, backoff_base_s=0.0,
+                               backoff_cap_s=0.0)
+    q = state_mod.FleetQueue(
+        str(tmp_path), pol,
+        [spec_mod.JobSpec(id=j, seed=i, flow_sample=4)
+         for i, j in enumerate(("fa", "fb"))],
+        fsync=False, now=lambda: 100.0)
+    q.lease("fa", "w0")
+    q.complete("fa", {"ok": True, "flows": flows_summary(10, 0)})
+    q.lease("fb", "w0")
+    q.complete("fb", {"ok": True, "flows": flows_summary(6, 1)})
+    man = manifest_mod.fleet_manifest(q, complete=True)
+    q.close()
+    assert man["jobs"]["fa"]["flows"]["sampled"] == 10
+    assert man["flows"]["jobs"] == 2
+    assert man["flows"]["sampled"] == 16
+    assert man["flows"]["lane_samples"] == {"0": 10, "1": 6}
+    lint = load_tool("telemetry_lint")
+    errs, _ = lint.lint_fleet_manifest_obj(man)
+    assert errs == []
+    # totals that disagree with the per-job entries are an error
+    bad = json.loads(json.dumps(man))
+    bad["flows"]["sampled"] = 999
+    errs, _ = lint.lint_fleet_manifest_obj(bad)
+    assert errs
+    # ...and so is dropping the roll-up while jobs carry flows
+    bad = json.loads(json.dumps(man))
+    del bad["flows"]
+    errs, _ = lint.lint_fleet_manifest_obj(bad)
+    assert errs
+    # spec knob validation: negative sampling is rejected up front
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        spec_mod.JobSpec(id="x", flow_sample=-1)

@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""Bench regression gate: newest BENCH_r*.json vs the banked trajectory.
+
+The driver banks one BENCH_rNN.json per round (schema: {n, cmd, rc,
+tail, parsed}; `parsed` is either one bench row or a {label: row} dict
+of rows, each row carrying "metric"/"value" in events/s). This tool
+compares every row of the NEWEST round against the most recent prior
+occurrence of the same metric — matched by (metric, backend), because
+a CPU-fallback number and a TPU number under one metric name are not
+comparable — and exits nonzero when any metric dropped by more than
+the threshold (default 10%).
+
+Metrics with no prior occurrence (new scenario names) pass: a gate
+that fails on first appearance would punish adding coverage.
+
+    python tools/bench_regress.py                 # repo root, 10%
+    python tools/bench_regress.py --dir D --threshold 0.15
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+
+def _rows(parsed) -> list:
+    """Normalize a round's `parsed` field to a list of row dicts, in
+    file order. Rows without a numeric value under a metric name are
+    dropped (derived stats like adaptive_window_reduction bank as
+    bare numbers)."""
+    if isinstance(parsed, dict) and "metric" in parsed:
+        cands = [parsed]
+    elif isinstance(parsed, dict):
+        cands = [v for v in parsed.values() if isinstance(v, dict)]
+    elif isinstance(parsed, list):
+        cands = [v for v in parsed if isinstance(v, dict)]
+    else:
+        cands = []
+    out = []
+    for r in cands:
+        m, v = r.get("metric"), r.get("value")
+        if isinstance(m, str) and isinstance(v, (int, float)):
+            out.append(r)
+    return out
+
+
+def load_rounds(bench_dir: str) -> list:
+    """[(round_n, path, [row, ...])] sorted by round number. The `n`
+    field orders rounds; the filename is the fallback for hand-rolled
+    files that omit it."""
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(bench_dir,
+                                              "BENCH_r*.json"))):
+        try:
+            with open(path) as f:
+                d = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"bench_regress: skipping unreadable {path}: {e}",
+                  file=sys.stderr)
+            continue
+        n = d.get("n")
+        if not isinstance(n, int):
+            stem = os.path.basename(path)
+            digits = "".join(c for c in stem if c.isdigit())
+            n = int(digits) if digits else 0
+        rounds.append((n, path, _rows(d.get("parsed"))))
+    rounds.sort(key=lambda t: (t[0], t[1]))
+    return rounds
+
+
+def check(rounds: list, threshold: float) -> tuple:
+    """-> (regressions, comparisons). A regression is a dict naming
+    the metric, both values, and both rounds. Comparison key is
+    (metric, backend); the newest round's rows compare against the
+    most recent PRIOR occurrence — including an earlier row of the
+    same round (a fresh-then-warm pair banks twice under one name)."""
+    if not rounds:
+        return [], []
+    *history, (new_n, new_path, new_rows) = rounds
+    last_seen: dict = {}
+    for n, path, rows in history:
+        for r in rows:
+            last_seen[(r["metric"], r.get("backend"))] = (n, r["value"])
+    regressions, comparisons = [], []
+    for r in new_rows:
+        key = (r["metric"], r.get("backend"))
+        prior = last_seen.get(key)
+        if prior is not None:
+            prior_n, prior_v = prior
+            drop = ((prior_v - r["value"]) / prior_v if prior_v > 0
+                    else 0.0)
+            comparisons.append({
+                "metric": r["metric"], "backend": r.get("backend"),
+                "value": r["value"], "prior_value": prior_v,
+                "round": new_n, "prior_round": prior_n,
+                "drop_pct": round(drop * 100.0, 2),
+            })
+            if drop > threshold:
+                regressions.append(comparisons[-1])
+        # this row becomes the prior for a same-round repeat
+        last_seen[key] = (new_n, r["value"])
+    return regressions, comparisons
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when the newest banked bench round regressed "
+                    ">threshold vs the trajectory")
+    ap.add_argument("--dir", default=os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))),
+        help="directory holding BENCH_r*.json (default: repo root)")
+    ap.add_argument("--threshold", type=float, default=0.10,
+                    help="fractional events/s drop that fails the gate "
+                         "(default 0.10 = 10%%)")
+    args = ap.parse_args(argv)
+    if not 0 < args.threshold < 1:
+        print("bench_regress: --threshold must be in (0, 1)",
+              file=sys.stderr)
+        return 2
+    rounds = load_rounds(args.dir)
+    if not rounds:
+        print(f"bench_regress: no BENCH_r*.json under {args.dir}; "
+              f"nothing to gate")
+        return 0
+    regressions, comparisons = check(rounds, args.threshold)
+    new_n = rounds[-1][0]
+    for c in comparisons:
+        tag = "REGRESSION" if c in regressions else "ok"
+        print(f"{tag}: {c['metric']} [{c['backend']}] "
+              f"r{c['prior_round']:02d} {c['prior_value']} -> "
+              f"r{c['round']:02d} {c['value']} "
+              f"({c['drop_pct']:+.2f}% drop)")
+    if not comparisons:
+        print(f"bench_regress: round {new_n} has no metrics with a "
+              f"banked prior; pass")
+    if regressions:
+        print(f"bench_regress: {len(regressions)} metric(s) regressed "
+              f">{args.threshold:.0%} in round {new_n}",
+              file=sys.stderr)
+        return 1
+    print(f"bench_regress: round {new_n} within {args.threshold:.0%} "
+          f"of the trajectory ({len(comparisons)} compared)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -149,6 +149,206 @@ def _lint_compile_block(comp, where: str) -> tuple[list, list]:
     return errors, warnings
 
 
+_FLOW_HIST_KEY = re.compile(r"^lane\d+/\d+->\d+/k-?\d+$")
+
+
+def _lint_flows(fl, ctr, tel) -> tuple[list, list]:
+    """(errors, warnings) for a manifest's "flows" block
+    (telemetry/flows.py flows_manifest_block). The invariants are the
+    flow ring's accounting identities: the device splits every sampled
+    packet into appended-or-clamped (recorded + lost_window_clamp ==
+    sampled), the harvester splits every recorded slot into
+    pulled-or-overrun (harvested + lost_ring <= recorded; < only
+    after a checkpoint rewind discarded replayed records), and every
+    harvested record lands in exactly one histogram key, one lane,
+    and one traffic-matrix cell."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(fl, dict):
+        return (["flows must be an object"], [])
+    for k in ("sample_period", "path_shards"):
+        v = fl.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"flows.{k} must be an integer >= 1, "
+                          f"got {v!r}")
+    counts = {}
+    for k in ("sampled", "recorded", "harvested", "lost_ring",
+              "lost_window_clamp"):
+        v = fl.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"flows.{k} must be a non-negative integer, "
+                          f"got {v!r}")
+        else:
+            counts[k] = v
+    if len(counts) == 5:
+        if counts["recorded"] + counts["lost_window_clamp"] \
+                != counts["sampled"]:
+            errors.append(
+                f"flows accounting broken: recorded="
+                f"{counts['recorded']} + lost_window_clamp="
+                f"{counts['lost_window_clamp']} != sampled="
+                f"{counts['sampled']} — the device splits every "
+                f"sampled packet into appended or clamped, never "
+                f"drops one silently")
+        if counts["harvested"] + counts["lost_ring"] \
+                > counts["recorded"]:
+            errors.append(
+                f"flows: harvested={counts['harvested']} + lost_ring="
+                f"{counts['lost_ring']} exceeds recorded="
+                f"{counts['recorded']}")
+        if counts["lost_ring"]:
+            warnings.append(
+                f"{counts['lost_ring']} flow record(s) lost to ring "
+                f"overrun (raise --flow-capacity or drain more often)")
+        if counts["lost_window_clamp"]:
+            warnings.append(
+                f"{counts['lost_window_clamp']} sampled flow(s) "
+                f"clamped on device (one window sampled more than the "
+                f"ring holds; raise --flow-capacity or the sample "
+                f"period)")
+    ev = (ctr or {}).get("events_processed")
+    if isinstance(ev, int) and not isinstance(ev, bool) \
+            and isinstance(fl.get("sampled"), int) \
+            and fl.get("sample_period") == 1 and fl["sampled"] > ev:
+        # at 1-in-1 sampling every cross-host send is sampled, and a
+        # send needs an executed event behind it; coarser periods make
+        # the bound vacuous, so only the exhaustive case is checked
+        errors.append(
+            f"flows.sampled={fl['sampled']} exceeds "
+            f"counters.events_processed={ev} at sample_period=1 — "
+            f"more packets sampled than events executed")
+    if isinstance(tel, dict) and tel.get("flows_sampled") is not None:
+        for mk, fk in (("flows_sampled", "sampled"),
+                       ("flows_harvested", "harvested"),
+                       ("flows_lost_ring", "lost_ring"),
+                       ("flows_lost_window_clamp", "lost_window_clamp")):
+            if (isinstance(tel.get(mk), int)
+                    and isinstance(fl.get(fk), int)
+                    and tel[mk] != fl[fk]):
+                errors.append(
+                    f"telemetry.{mk}={tel[mk]} disagrees with "
+                    f"flows.{fk}={fl[fk]} — one harvester fills both "
+                    f"blocks, they cannot diverge")
+    harvested = fl.get("harvested")
+    hist = fl.get("histograms")
+    hist_total = 0
+    if hist is not None:
+        if not isinstance(hist, dict):
+            errors.append("flows.histograms must be an object")
+            hist = {}
+        for key in sorted(hist):
+            where = f"flows.histograms[{key}]"
+            if not _FLOW_HIST_KEY.match(key):
+                errors.append(
+                    f'{where}: key must look like '
+                    f'"lane<r>/<src_shard>-><dst_shard>/k<kind>"')
+            h = hist[key]
+            if not isinstance(h, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            c = h.get("count")
+            if not isinstance(c, int) or isinstance(c, bool) or c < 1:
+                errors.append(f"{where}: count must be an integer "
+                              f">= 1 (empty keys are omitted)")
+                c = 0
+            hist_total += c
+            pcts = [h.get(k) for k in ("p50_ns", "p95_ns", "p99_ns")]
+            for k, v in zip(("p50_ns", "p95_ns", "p99_ns"), pcts):
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errors.append(f"{where}: {k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            if all(isinstance(v, int) and not isinstance(v, bool)
+                   for v in pcts) and not (pcts[0] <= pcts[1]
+                                           <= pcts[2]):
+                errors.append(f"{where}: percentiles must be "
+                              f"monotone (p50 <= p95 <= p99), "
+                              f"got {pcts}")
+            bk = h.get("buckets")
+            if not isinstance(bk, dict) or not bk:
+                errors.append(f"{where}: buckets must be a non-empty "
+                              f"object")
+                continue
+            los, bsum, ok = [], 0, True
+            for lo, n in bk.items():
+                try:
+                    lov = int(lo)
+                except (TypeError, ValueError):
+                    errors.append(f"{where}: bucket key {lo!r} is not "
+                                  f"an integer lower bound")
+                    ok = False
+                    continue
+                if lov != 0 and (lov < 0 or lov & (lov - 1)):
+                    errors.append(f"{where}: bucket lower bound {lov} "
+                                  f"is neither 0 nor a power of two "
+                                  f"(log2 latency buckets)")
+                if (not isinstance(n, int) or isinstance(n, bool)
+                        or n < 1):
+                    errors.append(f"{where}: bucket[{lo}] count must "
+                                  f"be an integer >= 1")
+                    ok = False
+                else:
+                    los.append(lov)
+                    bsum += n
+            if los != sorted(los):
+                errors.append(f"{where}: bucket bounds must be "
+                              f"ascending, got {los}")
+            if ok and isinstance(c, int) and c and bsum != c:
+                errors.append(f"{where}: buckets sum to {bsum} but "
+                              f"count={c}")
+        if isinstance(harvested, int) and hist \
+                and hist_total != harvested:
+            errors.append(
+                f"flows.histograms cover {hist_total} record(s) but "
+                f"harvested={harvested} — every harvested record "
+                f"lands in exactly one (lane, path, kind) key")
+    per_lane = fl.get("per_lane")
+    if per_lane is not None:
+        if not isinstance(per_lane, dict):
+            errors.append("flows.per_lane must be an object")
+            per_lane = {}
+        lane_total = 0
+        for lane in sorted(per_lane):
+            where = f"flows.per_lane[{lane}]"
+            try:
+                int(lane)
+            except (TypeError, ValueError):
+                errors.append(f"{where}: lane key must be an integer")
+            d = per_lane[lane]
+            if not isinstance(d, dict) or not isinstance(
+                    d.get("count"), int):
+                errors.append(f"{where}: must carry an integer count")
+                continue
+            lane_total += d["count"]
+        if isinstance(harvested, int) and per_lane \
+                and lane_total != harvested:
+            errors.append(
+                f"flows.per_lane counts sum to {lane_total} but "
+                f"harvested={harvested} — every record has exactly "
+                f"one lane")
+    tm = fl.get("traffic_matrix")
+    if tm is not None:
+        S = fl.get("path_shards")
+        if not isinstance(tm, list) or (
+                isinstance(S, int) and len(tm) != S) or not all(
+                isinstance(row, list)
+                and (not isinstance(S, int) or len(row) == S)
+                and all(isinstance(c, int) and not isinstance(c, bool)
+                        and c >= 0 for c in row)
+                for row in tm):
+            errors.append(f"flows.traffic_matrix must be a "
+                          f"path_shards x path_shards grid of "
+                          f"non-negative integers")
+        elif isinstance(harvested, int) and sum(
+                c for row in tm for c in row) != harvested:
+            errors.append(
+                f"flows.traffic_matrix sums to "
+                f"{sum(c for row in tm for c in row)} but harvested="
+                f"{harvested} — every record crosses exactly one "
+                f"(src_shard, dst_shard) cell")
+    return errors, warnings
+
+
 def lint_trace_obj(obj) -> tuple[list, list]:
     """(errors, warnings) for a parsed Chrome-trace object."""
     errors: list = []
@@ -663,6 +863,19 @@ def lint_manifest_obj(man) -> tuple[list, list]:
                     f"events_exec={got} on a lossless run — the "
                     f"per-window fan-out should cover every executed "
                     f"event")
+    # flows block (optional): per-flow latency tracing accounting
+    fl = man.get("flows")
+    if fl is not None:
+        e2, w2 = _lint_flows(fl, man.get("counters"), tel)
+        errors += e2
+        warnings += w2
+    # profile block (optional): a pointer to a jax.profiler artifact
+    prof = man.get("profile")
+    if prof is not None:
+        if not isinstance(prof, dict) or not prof.get("dir"):
+            errors.append('profile must be an object naming its '
+                          '"dir" — a capture nobody can find is no '
+                          'capture')
     return errors, warnings
 
 
@@ -842,6 +1055,64 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
                 f"{ak} but realized different program_keys "
                 f"({pk} vs {seen[1]}) — the affinity key must be a "
                 f"program-identity invariant")
+    # flows roll-up (optional): the fleet-level totals must equal the
+    # sums over the per-job flow summaries — the roll-up is derived,
+    # so a divergence means the manifest writer and the job results
+    # went out of sync
+    ft = man.get("flows")
+    job_fl = {jid: j["flows"] for jid, j in sorted(jobs.items())
+              if isinstance(j, dict) and isinstance(j.get("flows"),
+                                                    dict)}
+    for jid, fl in job_fl.items():
+        where = f"jobs[{jid}].flows"
+        cnt = {}
+        for k in ("sampled", "recorded", "harvested", "lost_ring",
+                  "lost_window_clamp"):
+            v = fl.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}.{k} must be a non-negative "
+                              f"integer, got {v!r}")
+            else:
+                cnt[k] = v
+        if len(cnt) == 5 and cnt["recorded"] + cnt["lost_window_clamp"] \
+                != cnt["sampled"]:
+            errors.append(
+                f"{where}: recorded={cnt['recorded']} + "
+                f"lost_window_clamp={cnt['lost_window_clamp']} != "
+                f"sampled={cnt['sampled']}")
+    if ft is not None:
+        if not isinstance(ft, dict):
+            errors.append('"flows" must be an object')
+        elif not job_fl:
+            errors.append('fleet "flows" roll-up with no flow-traced '
+                          'job entries')
+        else:
+            if ft.get("jobs") != len(job_fl):
+                errors.append(f"flows.jobs={ft.get('jobs')!r} but "
+                              f"{len(job_fl)} job(s) carry a flows "
+                              f"summary")
+            for k in ("sampled", "recorded", "harvested", "lost_ring",
+                      "lost_window_clamp"):
+                want = sum(int(fl.get(k, 0) or 0)
+                           for fl in job_fl.values())
+                if ft.get(k) != want:
+                    errors.append(f"flows.{k}={ft.get(k)!r} but the "
+                                  f"job summaries sum to {want}")
+            want_lanes: dict = {}
+            for fl in job_fl.values():
+                for lane, summ in (fl.get("per_lane") or {}).items():
+                    if isinstance(summ, dict):
+                        want_lanes[lane] = (want_lanes.get(lane, 0)
+                                            + int(summ.get("count", 0)
+                                                  or 0))
+            if ft.get("lane_samples") != want_lanes:
+                errors.append(f"flows.lane_samples="
+                              f"{ft.get('lane_samples')!r} but the "
+                              f"job per-lane counts sum to "
+                              f"{want_lanes}")
+    elif job_fl:
+        errors.append(f'{len(job_fl)} job(s) carry flow summaries but '
+                      f'the fleet manifest has no "flows" roll-up')
     mc = man.get("counts")
     if isinstance(mc, dict) and mc != counts:
         errors.append(f"counts block {mc} disagrees with the jobs "

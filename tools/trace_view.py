@@ -8,7 +8,9 @@ local/cross split, drops, retransmits, and a coarse events-per-window
 sparkline; from the wall-time tracks: total seconds per phase
 (trace/compile vs device execute vs harvest/export). With a manifest,
 adds the run identity line (config hash, seed, shards, health
-verdict).
+verdict) and — when the run sampled flows (--flow-sample) — the flow
+summary: sampling accounting, per-lane latency percentiles, and the
+hottest (lane, path, kind) latency histogram keys.
 
 Usage: trace_view.py trace.json [--manifest run_manifest.json]
        [--top N]
@@ -110,6 +112,33 @@ def summarize(trace: dict, manifest: dict | None = None,
             lines.append(f"WARNING: {tel['records_lost']} window "
                          f"record(s) lost to ring overrun — trace has "
                          f"gaps")
+        fl = manifest.get("flows")
+        if fl:
+            per = f"1-in-{fl['sample_period']}" \
+                if fl.get("sample_period") else "?"
+            lines.append(
+                f"flows: {fl.get('harvested', 0)} harvested of "
+                f"{fl.get('sampled', 0)} sampled ({per} packets), "
+                f"lost ring={fl.get('lost_ring', 0)} "
+                f"clamp={fl.get('lost_window_clamp', 0)}")
+            for lane, s in sorted((fl.get("per_lane") or {}).items(),
+                                  key=lambda kv: int(kv[0])):
+                lines.append(
+                    f"  lane {lane}: {s.get('count', 0)} samples  "
+                    f"latency p50={s.get('p50_ns', 0)}ns "
+                    f"p95={s.get('p95_ns', 0)}ns "
+                    f"p99={s.get('p99_ns', 0)}ns")
+            hot = sorted((fl.get("histograms") or {}).items(),
+                         key=lambda kv: -kv[1].get("count", 0))[:top]
+            for key, s in hot:
+                lines.append(
+                    f"  hot path {key}: {s.get('count', 0)} samples  "
+                    f"p50={s.get('p50_ns', 0)}ns "
+                    f"p99={s.get('p99_ns', 0)}ns")
+            if fl.get("lost_ring"):
+                lines.append(
+                    f"WARNING: {fl['lost_ring']} flow record(s) lost "
+                    f"to ring overrun — histograms undercount")
     return "\n".join(lines)
 
 
