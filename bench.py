@@ -118,6 +118,21 @@ rate measures raw engine throughput. Env knobs:
                                   warm serving — bucketing is what
                                   makes nearby configs share one
                                   stored program
+  BENCH_RESIDENT=R                resident-program mode
+                                  (fleet/admission.py): R heterogeneous
+                                  PHOLD tenants lease lanes of ONE warm
+                                  packed program, with one mid-run
+                                  operator eviction so the scored wall
+                                  includes admission-barrier churn. The
+                                  row banks under its own metric name
+                                  and carries the lease-table roll-up
+                                  ("resident" block: program_key_stable,
+                                  retraces, admission_events) so the
+                                  regression gate tracks continuous-
+                                  admission throughput, not just static
+                                  ensembles. Exclusive with the other
+                                  workload shapes; BENCH_HOSTS is the
+                                  per-tenant host count
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline",
 "backend", ...}. `backend` records where the run actually executed —
@@ -789,6 +804,87 @@ def _probe_backend(tries: int = 3, timeout_s: int = 0) -> int:
     return 0
 
 
+def _resident_row(H: int, load: int, sim_s: int, lanes: int) -> dict:
+    """BENCH_RESIDENT=R: throughput of one warm packed program whose
+    lane population churns at window barriers (fleet/admission.py).
+    R heterogeneous PHOLD tenants are admitted at t=0, one is evicted
+    and re-admitted mid-run — two extra admission barriers inside the
+    scored wall — and the program drains. The warm-up trial pays the
+    compile; the timed trial re-resolves the same program. The row
+    carries the lease-table roll-up so the regression gate also sees a
+    broken zero-retrace contract (program_key_stable=false or
+    retraces>0) on the banked line, not only a throughput drop."""
+    import shutil
+    import tempfile
+
+    from shadow_tpu.fleet import admission as adm_mod
+    from shadow_tpu.fleet.spec import JobSpec
+
+    specs = [JobSpec(id=f"tenant-{k}", kind="scenario", seed=1000 + k,
+                     hosts=H, load=max(1, load - (k % 2)), sim_s=sim_s)
+             for k in range(lanes)]
+
+    def trial(workdir):
+        rp = adm_mod.ResidentProgram(
+            specs, workdir=workdir, lanes=lanes,
+            horizon_s=2 * sim_s + 1, checkpoint_every_events=0,
+            fsync=False)
+        try:
+            for s in specs:
+                rp.admit(s.id)
+            rp.advance(until_ns=(sim_s * 1_000_000_000) // 2)
+            rp.evict(specs[-1].id, reason="bench churn")
+            rp.admit(specs[-1].id)
+            rp.drain()
+        finally:
+            rp.close()
+        return rp
+
+    root = tempfile.mkdtemp(prefix="bench_resident_")
+    try:
+        cache_before = _cache_files()
+        t0 = time.perf_counter()
+        trial(os.path.join(root, "warm"))      # pays the compile
+        compile_s = time.perf_counter() - t0
+        cache_after = _cache_files()
+        compile_fresh = (cache_before is None
+                         or bool((cache_after or set()) - cache_before))
+        t0 = time.perf_counter()
+        rp = trial(os.path.join(root, "timed"))
+        wall = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    baseline = 0.0
+    try:
+        with open(os.path.join(os.path.dirname(__file__),
+                               "BASELINE.json")) as f:
+            baseline = float(json.load(f)["published"]
+                             .get("events_per_sec", 0.0))
+    except Exception:
+        pass
+    value = rp.events / wall
+    blk = rp.manifest_block()
+    return {
+        "metric": (f"events_per_sec_per_chip@{H}hosts_resident"
+                   f"_x{lanes}lanes_churn"),
+        "value": round(value, 1),
+        "unit": "events/s",
+        "vs_baseline": round(value / baseline, 3) if baseline else 0.0,
+        "backend": jax.default_backend(),
+        "compile_s": round(compile_s, 3),
+        "compile_cache": "fresh" if compile_fresh else "cached",
+        "wall_seconds": round(wall, 3),
+        "windows": rp.windows,
+        "dispatches": rp.dispatches,
+        "resident": {k: blk.get(k) for k in
+                     ("lanes", "admitted", "completed", "evicted",
+                      "quarantined", "resident", "deferred",
+                      "program_key", "program_key_stable",
+                      "admission_events", "retraces", "lane_width",
+                      "degrade_level")},
+    }
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -847,6 +943,31 @@ def main(argv=None) -> None:
     load = int(os.environ.get("BENCH_LOAD", "8"))
     graph = (ref_topology_text() if topo == "ref"
              else MIX_VERTICES if topo == "mix" else None)
+
+    # BENCH_RESIDENT=R: the continuous-admission scenario is its own
+    # workload — a resident packed program with churn — and banks its
+    # own row, so the regression gate tracks it independently of the
+    # static-ensemble numbers
+    resident = int(os.environ.get("BENCH_RESIDENT", "0") or "0")
+    if resident:
+        if (any(os.environ.get(k) for k in
+                ("BENCH_REPLICAS", "BENCH_SUPERVISE", "BENCH_ACTIVE",
+                 "BENCH_SPARSE_LANES", "BENCH_INJECT_TRACE",
+                 "BENCH_INJECT_RATE", "BENCH_CHUNK_WINDOWS",
+                 "BENCH_SHARDS", "BENCH_FLOW_OVERHEAD",
+                 "BENCH_FLOW_SAMPLE"))
+                or workload != "phold" or topo != "one"
+                or fault_records):
+            raise SystemExit(
+                "BENCH_RESIDENT is its own scenario (one warm packed "
+                "program, tenant leases, mid-run churn); it does not "
+                "combine with the other workload/loop shapes")
+        if resident < 2:
+            raise SystemExit("BENCH_RESIDENT needs >= 2 lanes (churn "
+                             "on a 1-lane program has no undisturbed "
+                             "tenant to protect)")
+        print(json.dumps(_resident_row(H, load, sim_s, resident)))
+        return
 
     # BENCH_REPLICAS=R: run R independent replicas of the H-host sim
     # in one device program (ensemble mode) — small configs alone

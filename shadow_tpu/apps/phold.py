@@ -68,35 +68,32 @@ def setup(sim, *, load: int, port: int = 9000,
     """All hosts run PHOLD: bind a UDP socket, seed `load` messages.
     `replica_size` partitions the hosts into independent replicas of
     that many hosts each (peer draws stay in-replica). `active_hosts`
-    is the sparse-workload shape: only the first N hosts inject load
-    and peers draw from that prefix, so the other H-N rows stay idle
-    forever — the census/compaction benchmark geometry (a handful of
-    live lanes in a sea of allocated capacity). Mutually exclusive
-    with replica_size."""
+    is the sparse-workload shape: only the first N hosts *of each
+    replica* inject load and peers draw from that prefix, so the
+    other rows stay idle forever — alone it is the census/compaction
+    benchmark geometry (a handful of live rows in a sea of allocated
+    capacity); combined with replica_size it is the heterogeneous-
+    tenant padding shape (fleet/admission.py): a tenant smaller than
+    the shared pow2 lane bucket occupies the active prefix of its
+    lane and the padding rows never send, so the padded build is
+    behavior-identical to an exact-size build of the same tenant."""
     H = sim.net.host_ip.shape[0]
     if H < 2:
         raise ValueError("PHOLD needs at least 2 hosts")
-    if active_hosts is not None and replica_size is not None:
-        raise ValueError("active_hosts and replica_size are mutually "
-                         "exclusive PHOLD shapes")
     rs = H if replica_size is None else replica_size
     if rs < 2 or H % rs != 0:
         raise ValueError(f"replica_size={rs} must divide H={H}, be >= 2")
-    active = H if active_hosts is None else active_hosts
-    if active < 2 or active > H:
-        raise ValueError(f"active_hosts={active} must be in [2, H={H}]")
+    active = rs if active_hosts is None else active_hosts
+    if active < 2 or active > rs:
+        raise ValueError(
+            f"active_hosts={active} must be in [2, replica_size={rs}]")
     every = jnp.ones((H,), bool)
     net, sock = sk_create(sim.net, every, SocketType.UDP)
     net, _ = sk_bind(net, every, sock, 0, port)
     lane = jnp.arange(H, dtype=I32)
-    if active_hosts is None:
-        peer_base = (lane // rs) * rs
-        peer_span = jnp.full((H,), rs, I32)
-        remaining = jnp.full((H,), load, I32)
-    else:
-        peer_base = jnp.zeros((H,), I32)
-        peer_span = jnp.full((H,), active, I32)
-        remaining = jnp.where(lane < active, load, 0).astype(I32)
+    peer_base = (lane // rs) * rs
+    peer_span = jnp.full((H,), active, I32)
+    remaining = jnp.where(lane % rs < active, load, 0).astype(I32)
     app = PholdApp(
         sock=sock,
         peer_base=peer_base,

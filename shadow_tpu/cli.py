@@ -230,6 +230,16 @@ def make_parser() -> argparse.ArgumentParser:
                         "checkpoint). Lanes must not exchange traffic "
                         "for healthy-lane bit-exactness; single-shard "
                         "only (docs/6-robustness.md)")
+    p.add_argument("--resident", action="store_true",
+                   help="attach resident-admission lease planes to a "
+                        "lane-isolated run (requires --lane-isolation; "
+                        "core/lanes.py LaneAdmission): every lane "
+                        "boots with an open lease, barriers enforce "
+                        "free-lane flush + completion latching, and "
+                        "the manifest gains an 'admission' block. "
+                        "This is the static-population twin of "
+                        "`fleet run --resident`, whose lease table "
+                        "churns lanes at barriers (docs/8-fleet.md)")
     p.add_argument("--auto-grow", action="store_true",
                    help="supervisor escalation: a fatal capacity "
                         "overflow (event queue / outbox / router ring) "
@@ -552,6 +562,24 @@ def main(argv=None) -> int:
                     0, "shadow-tpu",
                     f"lane isolation: {args.lane_isolation} lanes x "
                     f"{b.cfg.num_hosts // args.lane_isolation} hosts")
+                if args.resident:
+                    # static-population resident planes: all lanes
+                    # admitted at t=0 with open leases; the window
+                    # barrier now also enforces the admission rules
+                    # (free-lane flush, completion latch) and the
+                    # manifest carries the lease-conservation block
+                    b.sim = lanes_mod.admit_all(
+                        lanes_mod.attach_admission(b.sim))
+                    logger.message(
+                        0, "shadow-tpu",
+                        f"resident admission: "
+                        f"{args.lane_isolation} lanes admitted with "
+                        f"open leases")
+        if args.resident and getattr(b.sim, "admission", None) is None:
+            logger.warning(0, "shadow-tpu",
+                           "--resident requires --lane-isolation "
+                           "(admission is lease bookkeeping over "
+                           "lanes); ignored")
 
         # window telemetry (shadow_tpu/telemetry): attach the on-device
         # ring BEFORE any run path branches so checkpoint templates,
@@ -784,8 +812,10 @@ def main(argv=None) -> int:
                     from shadow_tpu import inject as inject_mod
 
                     inj_blk = inject_mod.manifest_block(sim_, feeder)
-                from shadow_tpu.telemetry.export import \
-                    lanes_manifest_block
+                from shadow_tpu.telemetry.export import (
+                    admission_manifest_block,
+                    lanes_manifest_block,
+                )
                 from shadow_tpu.telemetry.flows import \
                     flows_manifest_block
 
@@ -805,6 +835,7 @@ def main(argv=None) -> int:
                         harvester, num_hosts=b.cfg.num_hosts,
                         shards=nshards,
                         sample_period=args.flow_sample or None),
+                    admission=admission_manifest_block(health_),
                     profile=profile_info)
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
@@ -1052,8 +1083,10 @@ def main(argv=None) -> int:
                         m = harvester.mean_window_ns()
                         if m is not None:
                             disp["adaptive_jump_mean_ns"] = m
-                from shadow_tpu.telemetry.export import \
-                    lanes_manifest_block
+                from shadow_tpu.telemetry.export import (
+                    admission_manifest_block,
+                    lanes_manifest_block,
+                )
                 from shadow_tpu.telemetry.flows import \
                     flows_manifest_block
 
@@ -1074,6 +1107,7 @@ def main(argv=None) -> int:
                         harvester, num_hosts=b.cfg.num_hosts,
                         shards=nshards,
                         sample_period=args.flow_sample or None),
+                    admission=admission_manifest_block(run_health),
                     profile=profile_info,
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,

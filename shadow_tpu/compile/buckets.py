@@ -149,11 +149,42 @@ def shape_vector_for_sim(cfg, sim) -> dict:
     telem = getattr(sim, "telem", None)
     lanes = getattr(sim, "lanes", None)
     inject = getattr(sim, "inject", None)
-    return shape_vector(
+    flows = getattr(sim, "flows", None)
+    vec = shape_vector(
         cfg,
         telem_capacity=int(telem.capacity) if telem is not None else None,
         lane_replicas=int(lanes.replicas) if lanes is not None else None,
         inject_lanes=int(inject.lanes) if inject is not None else None)
+    if flows is not None:
+        vec["flow_capacity"] = int(flows.capacity)
+        vec["flow_sample_period"] = int(flows.sample_period)
+    if getattr(sim, "admission", None) is not None:
+        # resident program (core/lanes.LaneAdmission): the lease
+        # planes add pytree leaves, so a resident program is a
+        # different executable from a lanes-only program of the same
+        # shapes — key it as such. The flag is the ONLY admission
+        # contribution: lease values are runtime data, which is
+        # exactly why joins/leaves never change the program key.
+        vec["resident"] = True
+    return vec
+
+
+def lane_bucket(host_counts) -> int:
+    """Shared power-of-two lane width for a set of heterogeneous
+    tenants: every tenant's per-lane topology pads UP to this bucket
+    (apps/phold.py active_hosts occupies the prefix; padding rows are
+    idle forever, so padding is behavior-neutral the same way
+    capacity padding is). One width for all lanes keeps the resident
+    program's host partition uniform — lane of host h stays
+    h // width — which is what lets the lane population change
+    without changing any shape."""
+    counts = [int(h) for h in host_counts]
+    if not counts:
+        raise ValueError("lane_bucket needs at least one tenant")
+    if min(counts) < 2:
+        raise ValueError(
+            f"every tenant needs >= 2 hosts, got {sorted(counts)}")
+    return max(2, quantize_pow2(max(counts)))
 
 
 def kind_census(app_handlers=(), app_bulk=None, *, fault_plan_digest=None,

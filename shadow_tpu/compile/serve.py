@@ -152,6 +152,21 @@ def maybe_warm(jitted, key: str | None, *, enabled: bool,
     return WarmFn(jitted, key, store=store, meta=meta, info=info)
 
 
+def live_cache_size(fn):
+    """Trace count of the live jitted callable behind `fn` (a WarmFn
+    or a bare jax.jit function) — the resident program's zero-retrace
+    proof (fleet/admission.py): after any number of admission events
+    the dispatch function's trace cache must still hold exactly one
+    entry, because joins/leaves mutate runtime data, never shapes.
+    Returns None when the callable exposes no cache (a loaded AOT
+    executable cannot retrace by construction)."""
+    j = getattr(fn, "_jitted", fn)
+    try:
+        return int(j._cache_size())
+    except Exception:
+        return None
+
+
 def prewarm(bundle, app_handlers=(), *, end_time=None,
             mesh=None, mesh_axis: str = "hosts",
             exchange_capacity=None, windows_per_dispatch=None,

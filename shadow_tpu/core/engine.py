@@ -341,11 +341,14 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         sim = flow_fn(sim, wend if wstart is None else wstart, wend)
     sim = route_fn(sim)
     if getattr(sim, "lanes", None) is not None:
-        # lane-isolated health (core/lanes.py): reduce the per-host
-        # latch planes per lane, trip + freeze sick lanes at this
-        # barrier — after the route so this window's deliveries are
-        # attributed, before the min so frozen lanes stop holding the
-        # global advance back
+        # lane barrier (core/lanes.py): reduce the per-host latch
+        # planes per lane, trip + freeze sick lanes, and — when the
+        # program is resident (Sim.admission, fleet/admission.py) —
+        # enforce lease horizons and keep FREE lanes empty, all at
+        # this barrier. After the route so this window's deliveries
+        # are attributed (and a delivery past a lease edge is flushed
+        # the window it arrives), before the min so frozen/expired
+        # lanes stop holding the global advance back.
         from shadow_tpu.core.lanes import window_update
         sim = window_update(sim, wend)
     stats = stats.replace(windows=stats.windows + 1)

@@ -58,6 +58,10 @@ TRIP_OUTBOX = 2    # Outbox overflow from one of the lane's hosts
 TRIP_RQ = 4        # router-ring overflow inside the lane
 TRIP_STALL = 8     # lane min-time pinned for >= stall_limit windows
 TRIP_REGRESS = 16  # lane pending time behind the window barrier
+TRIP_SLO = 32      # admission gate exhausted the degradation ladder
+# (fleet/admission.py): quarantine by POLICY, set host-side at a
+# barrier — the device freeze machinery is identical to a capacity
+# trip, but the cause is an SLO breach, not corruption.
 
 TRIP_NAMES = {
     TRIP_EVENTS: "events_overflow",
@@ -65,6 +69,7 @@ TRIP_NAMES = {
     TRIP_RQ: "rq_overflow",
     TRIP_STALL: "stall",
     TRIP_REGRESS: "time_regression",
+    TRIP_SLO: "slo_breach",
 }
 
 
@@ -118,6 +123,83 @@ class LaneHealth:
             flushed=jnp.zeros((R,), I64),
             stall_limit=int(stall_limit),
         )
+
+
+@struct.dataclass
+class LaneAdmission:
+    """[R]-shaped lease planes for a RESIDENT program (PR 16): the
+    lane population changes at window barriers without retracing.
+
+    The host-side lease state machine (fleet/admission.py LaneLease)
+    owns the transitions; these planes are the device-visible shadow
+    the jitted window body enforces at every barrier:
+
+    - a FREE lane (active=False) is kept empty — any event routed or
+      resurrected into it is flushed at the next barrier (counted in
+      `flushed`, never silently),
+    - an active lane's events at/after its `lease_end` horizon are
+      flushed, so the tenant drains within one barrier of its lease
+      expiring instead of holding the global min-time advance back,
+    - an active lane that ran dry latches `completed` (+ the barrier
+      time), which is what the host polls to fold the lease to
+      COMPLETED and return the lane to the free pool.
+
+    Same opt-in contract as LaneHealth: Sim.admission defaults to
+    None and contributes no pytree leaves; attach_admission() is the
+    explicit opt-in (and requires LaneHealth attached first — the
+    quarantine machinery is the degradation ladder's last step)."""
+
+    active: jax.Array        # [R] bool lane holds a live lease
+    epoch: jax.Array         # [R] i32 admissions into this lane so far
+    lease_end: jax.Array     # [R] i64 lease horizon (INVALID = open)
+    admitted_at: jax.Array   # [R] i64 barrier time of the live join
+    completed: jax.Array     # [R] bool active lane ran dry (latched)
+    completed_at: jax.Array  # [R] i64 barrier time the lane ran dry
+    flushed: jax.Array      # [R] i64 events flushed by admission rules
+
+    @property
+    def replicas(self) -> int:
+        return self.active.shape[0]
+
+    @staticmethod
+    def create(replicas: int) -> "LaneAdmission":
+        R = int(replicas)
+        return LaneAdmission(
+            active=jnp.zeros((R,), bool),
+            epoch=jnp.zeros((R,), I32),
+            lease_end=jnp.full((R,), simtime.INVALID, simtime.DTYPE),
+            admitted_at=jnp.full((R,), simtime.INVALID, simtime.DTYPE),
+            completed=jnp.zeros((R,), bool),
+            completed_at=jnp.full((R,), simtime.INVALID, simtime.DTYPE),
+            flushed=jnp.zeros((R,), I64),
+        )
+
+
+def attach_admission(sim):
+    """Opt a lane-isolated sim into resident admission: every lane
+    starts FREE (the host-side lease table admits tenants by implant,
+    fleet/admission.py). Requires core.lanes.attach() first."""
+    if getattr(sim, "lanes", None) is None:
+        raise ValueError(
+            "attach_admission requires lane isolation (core.lanes."
+            "attach) — admission is lease bookkeeping over lanes")
+    return sim.replace(admission=LaneAdmission.create(sim.lanes.replicas))
+
+
+def admit_all(sim, at_ns: int = 0):
+    """Standalone resident mode (`shadow-tpu --resident`): mark every
+    lane as holding an OPEN lease from t=at_ns. No host-side lease
+    table drives churn here — the planes exist so the barrier rules,
+    completion latches, and the manifest "admission" block behave
+    identically to a fleet-managed resident program with a static
+    population."""
+    adm = sim.admission
+    if adm is None:
+        raise ValueError("admit_all requires attach_admission() first")
+    return sim.replace(admission=adm.replace(
+        active=jnp.ones_like(adm.active),
+        epoch=jnp.ones_like(adm.epoch),
+        admitted_at=jnp.full_like(adm.admitted_at, int(at_ns))))
 
 
 def lane_sum(x: jax.Array, replicas: int) -> jax.Array:
@@ -225,7 +307,35 @@ def window_update(sim, wend):
         prev_min=jnp.where(quarantined, simtime.INVALID, lmin),
         quarantined=quarantined, quarantined_at=quarantined_at,
         trip_bits=trip_bits, flushed=flushed)
-    return sim.replace(events=q, lanes=lanes)
+    sim = sim.replace(events=q, lanes=lanes)
+
+    adm = getattr(sim, "admission", None)
+    if adm is not None:
+        # resident admission (fleet/admission.py): keep FREE lanes
+        # empty and enforce each active lane's lease horizon, both at
+        # this barrier — route_fn already ran, so a delivery landing
+        # at/after the horizon is flushed the same window it arrives
+        # (the lease edge is exact at barriers, like fault times)
+        free_h = host_mask(~adm.active, H)                  # [H] bool
+        lease_h = jnp.repeat(adm.lease_end, H // R)         # [H] i64
+        over = q.valid() & (free_h[:, None]
+                            | (q.time >= lease_h[:, None]))
+        adm_flushed = adm.flushed + lane_sum(
+            jnp.sum(over, axis=1, dtype=I64), R)
+        q = q.replace(time=jnp.where(over, simtime.INVALID, q.time))
+        # completion latch: an active, un-quarantined lane with no
+        # pending events ran its lease dry — record the barrier time
+        # once; the host folds the lease to COMPLETED and frees the
+        # lane (a quarantined lane is the supervisor's problem, not a
+        # completion)
+        quiet = lane_min(q.min_time(), R) == simtime.INVALID
+        newly_done = adm.active & quiet & ~adm.completed & ~quarantined
+        adm = adm.replace(
+            flushed=adm_flushed,
+            completed=adm.completed | newly_done,
+            completed_at=jnp.where(newly_done, wend, adm.completed_at))
+        sim = sim.replace(events=q, admission=adm)
+    return sim
 
 
 def lane_events_exec(sim) -> jax.Array:
@@ -270,6 +380,39 @@ def lane_report(sim) -> list:
             d["quarantined_at_ns"] = int(qat[r])
             d["trip_bits"] = int(bits[r])
             d["trip"] = trip_names(int(bits[r]))
+        out.append(d)
+    return out
+
+
+def admission_report(sim) -> list:
+    """Host-side: one dict per lane of the LaneAdmission planes —
+    the device-truth half of the manifest "admission" block (the
+    lease-table half comes from fleet/admission.py). Pull once per
+    call, between device steps."""
+    import numpy as np
+
+    adm = sim.admission
+    active = np.asarray(adm.active)
+    epoch = np.asarray(adm.epoch)
+    lease = np.asarray(adm.lease_end)
+    at = np.asarray(adm.admitted_at)
+    done = np.asarray(adm.completed)
+    done_at = np.asarray(adm.completed_at)
+    flushed = np.asarray(adm.flushed)
+    out = []
+    for r in range(adm.replicas):
+        d = {
+            "lane": r,
+            "active": bool(active[r]),
+            "epoch": int(epoch[r]),
+            "completed": bool(done[r]),
+            "flushed": int(flushed[r]),
+        }
+        if bool(active[r]):
+            d["lease_end_ns"] = int(lease[r])
+            d["admitted_at_ns"] = int(at[r])
+        if bool(done[r]):
+            d["completed_at_ns"] = int(done_at[r])
         out.append(d)
     return out
 

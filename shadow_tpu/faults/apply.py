@@ -204,6 +204,20 @@ def make_fault_fn(plan: FaultPlan, boot_sim):
     def _crash_reset(sim, down):
         lane = sim.net.lane_id
         q = sim.events
+        adm = getattr(sim, "admission", None)
+        if adm is not None:
+            # resident program (core/lanes.LaneAdmission): a crash or
+            # restart landing in a FREE lane must be a no-op — sparing
+            # its PROC_START and restoring boot rows would resurrect a
+            # lane the lease table already returned to the pool (the
+            # boot image carries live app state). Only hosts in leased
+            # lanes reset; free-lane rows stay flushed/stale until the
+            # next implant overwrites them. The admission planes
+            # themselves ride untouched, like rq_overflow_h: they are
+            # lease bookkeeping, not per-host state.
+            from shadow_tpu.core.lanes import host_mask
+
+            down = down & host_mask(adm.active, q.time.shape[0])
         spare = ((q.kind == EventKind.PROC_START)
                  | (q.kind == EventKind.FAULT_WAKEUP))
         keep = ~down[:, None] | spare

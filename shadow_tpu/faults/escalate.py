@@ -189,9 +189,12 @@ def extract_lane(leaves: dict, meta: dict, lane: int,
     out = {}
     for key, arr in leaves.items():
         a = np.asarray(arr)
-        if key.startswith((".telem", ".inject")):
+        if key.startswith((".telem", ".inject", ".flows")):
+            # whole-sim rings (flow ring rows are samples, not hosts —
+            # its capacity could collide with H, so never host-slice)
             out[key] = a
-        elif key.startswith(".lanes"):
+        elif key.startswith((".lanes", ".admission")):
+            # [R]-shaped lane-health / lease planes: the lane's entry
             out[key] = a[lane:lane + 1] if a.ndim else a
         elif a.ndim and a.shape[0] == H:
             out[key] = a[lo:hi]

@@ -81,6 +81,13 @@ class RunHealth:
     lanes: tuple = ()
     lanes_quarantined: tuple = ()
     lane_contained: bool = False
+    # --- resident programs (core/lanes.py LaneAdmission) -------------
+    # resident=True means the sim carried lease planes: `admission` is
+    # the per-lane device report (core.lanes.admission_report dicts).
+    # A FREE lane is EXPECTED to be empty/idle — supervision must not
+    # read an inactive lane's silence as a stall or incident.
+    resident: bool = False
+    admission: tuple = ()
 
     @property
     def fatal(self) -> bool:
@@ -215,6 +222,9 @@ class RunHealth:
                 "contained": bool(self.lane_contained),
                 "per_lane": [dict(d) for d in self.lanes],
             }} if self.lanes_total else {}),
+            **({"admission": {
+                "per_lane": [dict(d) for d in self.admission],
+            }} if self.resident else {}),
         }
 
 
@@ -246,11 +256,19 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
             d["events_overflow"] or d["outbox_overflow"]
             or d["rq_overflow"] or d["time_regression"]
             for d in lane_rep if not d["quarantined"])
+    resident, adm_rep = False, ()
+    if getattr(sim, "admission", None) is not None:
+        from shadow_tpu.core.lanes import admission_report
+
+        resident = True
+        adm_rep = tuple(admission_report(sim))
     return RunHealth(
         lanes_total=lanes_total,
         lanes=lane_rep,
         lanes_quarantined=quar,
         lane_contained=contained,
+        resident=resident,
+        admission=adm_rep,
         events_overflow=ev,
         outbox_overflow=int(np.asarray(sim.outbox.overflow)),
         rq_overflow=int(np.asarray(sim.net.rq_overflow)),

@@ -304,8 +304,18 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
         if not h.lanes_total:
             return
         caps = ckpt.capacities_of_sim(bundle.sim)
+        # resident programs (core/lanes.LaneAdmission): a lane with no
+        # live lease holds no tenant — there is nothing to salvage or
+        # requeue, and the lease table (fleet/admission.py) owns the
+        # lane's lifecycle; raising an incident for it would fabricate
+        # a tenant failure out of an empty vessel
+        inactive = {d["lane"] for d in getattr(h, "admission", ())
+                    if not d.get("active")}
         for d in h.lanes:
             if not d.get("quarantined") or d["lane"] in lanes_seen:
+                continue
+            if d["lane"] in inactive:
+                lanes_seen.add(d["lane"])
                 continue
             lanes_seen.add(d["lane"])
             bits = int(d.get("trip_bits", 0))

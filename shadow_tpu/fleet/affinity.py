@@ -34,11 +34,16 @@ AFFINITY_PREFIX = "ak"
 
 # JobSpec fields that do NOT shape the compiled program: identity,
 # runtime data (seed — the RNG counter rides in arrays), retry/budget
-# policy, and host-side pacing. Everything else is program-shaping.
+# policy, host-side pacing, and lease terms (tenant class / SLO are
+# admission-gate inputs evaluated on the host — the resident
+# program's shape must NOT change when a tenant's SLO does, or every
+# lease renegotiation would retrace). Everything else is
+# program-shaping.
 _NON_PROGRAM_FIELDS = frozenset({
     "id", "seed", "max_retries", "max_attempts", "max_wallclock_s",
     "checkpoint_every_windows", "lane_of", "kills", "verify",
-    "round_sleep_s", "auto_grow", "max_grow",
+    "round_sleep_s", "auto_grow", "max_grow", "tenant_class",
+    "slo_p99_ms",
 })
 
 

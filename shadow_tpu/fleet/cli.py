@@ -55,6 +55,28 @@ def make_parser() -> argparse.ArgumentParser:
     r.add_argument("--no-fsync", action="store_true",
                    help="skip journal fsyncs (tests only; forfeits "
                         "power-loss durability)")
+    r.add_argument("--resident", action="store_true",
+                   help="continuous lane admission: run every job as "
+                        "a tenant lease of ONE resident packed "
+                        "program (fleet/admission.py) instead of one "
+                        "worker process per job; joins/leaves happen "
+                        "at window barriers with zero retraces")
+    r.add_argument("--resident-lanes", type=int, default=None,
+                   help="lane count of the resident program "
+                        "(default: max(2, number of jobs))")
+    r.add_argument("--resident-horizon-s", type=int, default=None,
+                   help="simulated horizon of the resident program "
+                        "in seconds (default: sized from the jobs)")
+    r.add_argument("--slo-sustained", type=int, default=2,
+                   help="consecutive breached SLO evaluations before "
+                        "the admission gate acts")
+    r.add_argument("--slo-stride", type=int, default=1,
+                   help="evaluate per-lane flow p99s every Nth "
+                        "barrier (the degradation ladder raises this "
+                        "host-side stride as its first relief step)")
+    r.add_argument("--flow-sample", type=int, default=1,
+                   help="resident flow-sampling period feeding the "
+                        "SLO gate (0 disables the gate's p99 input)")
 
     s = sub.add_parser("status", help="summarize a fleet dir "
                                       "(read-only)")
@@ -84,6 +106,34 @@ def _cmd_run(args) -> int:
         json.dump(policy.as_dict(), f, indent=1, sort_keys=True)
     os.replace(tmp, policy_path)
 
+    if args.resident:
+        from shadow_tpu.fleet.admission import (
+            AdmissionGate,
+            run_resident_fleet,
+        )
+
+        man = run_resident_fleet(
+            args.fleet_dir, policy, specs,
+            lanes=args.resident_lanes,
+            horizon_s=args.resident_horizon_s,
+            resume=args.resume, fsync=not args.no_fsync,
+            gate=AdmissionGate(sustained=args.slo_sustained,
+                               eval_stride=args.slo_stride),
+            flow_sample=args.flow_sample,
+            log=lambda m: print(m, file=sys.stderr))
+        counts = man["counts"]
+        bad = counts.get("failed", 0) + (
+            counts.get("quarantined", 0) if args.no_salvage else 0)
+        rc = 1 if bad else (0 if man["complete"] else 6)
+        print(json.dumps({"exit": rc, "counts": counts,
+                          "admission": {
+                              k: man["admission"][k] for k in
+                              ("admitted", "completed", "evicted",
+                               "quarantined", "resident", "deferred",
+                               "program_key_stable")},
+                          "manifest": os.path.join(
+                              args.fleet_dir, "fleet_manifest.json")}))
+        return rc
     runner = FleetRunner(
         args.fleet_dir, policy, specs, workers=args.workers,
         resume=args.resume, fsync=not args.no_fsync,
@@ -134,6 +184,24 @@ def _cmd_status(args) -> int:
     out = {"journal_events": len(records), "journal_bytes": good,
            "counts": counts, "jobs": status,
            "checkpoints": checkpoints}
+    lease_log = os.path.join(args.fleet_dir, "resident", "leases.log")
+    if os.path.isfile(lease_log):
+        # resident fleet: fold the lease journal read-only
+        # (fleet/admission.py LeaseTable shares this replay)
+        lrecs, _ = journal_mod.replay(lease_log)
+        pop: dict = {}
+        for rec in lrecs:
+            if rec.get("ev") != "lease":
+                continue
+            lane, st = rec.get("lane"), rec.get("state")
+            if st in ("admitted", "running"):
+                pop[lane] = {"job": rec.get("job"), "state": st,
+                             "epoch": rec.get("epoch")}
+            else:
+                pop.pop(lane, None)
+        out["resident"] = {"lease_frames": len(lrecs),
+                           "population": {str(k): v for k, v
+                                          in sorted(pop.items())}}
     man_path = os.path.join(args.fleet_dir, "fleet_manifest.json")
     if os.path.isfile(man_path):
         out["manifest"] = man_path

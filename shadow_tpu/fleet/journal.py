@@ -28,6 +28,17 @@ Single writer by design: only the fleet supervisor process appends.
 Workers report through their pipes and their per-job dirs; the
 supervisor serializes everything into this one ordered record, which
 is what makes `fleet run --resume` a pure replay.
+
+Idempotent-fold contract: replay() returns frames verbatim — it is
+the FOLDS over them that must be idempotent against duplicates. A
+crash between an effect landing and its ack can journal the same
+terminal transition twice (a second `done`/`failed`/`quarantined`
+for a settled job, a second terminal lease frame for a settled
+lane); both consumers keep the FIRST terminal state and warn instead
+of crashing or flipping the verdict (fleet/state.py FleetQueue._apply
+for job frames, fleet/admission.py LeaseTable._apply for lane-lease
+frames). tests/test_fleet.py and tests/test_admission.py cover the
+duplicate-terminal and torn-tail cases for both frame families.
 """
 
 from __future__ import annotations

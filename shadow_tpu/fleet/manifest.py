@@ -86,7 +86,14 @@ def _job_entry(queue, j) -> dict:
 
 def fleet_manifest(queue, *, workers_alive: int = 0,
                    preempted: bool = False, stalled: bool = False,
-                   complete: bool = False) -> dict:
+                   complete: bool = False,
+                   admission: dict | None = None) -> dict:
+    """`admission` is the resident-program block
+    (fleet/admission.py ResidentProgram.manifest_block): lease-count
+    conservation, program-key stability, the degradation ladder's
+    history and the per-lane device planes. tools/telemetry_lint.py
+    validates it (admitted == completed + evicted + quarantined +
+    resident; SLO verdicts consistent with flow percentiles)."""
     counts: dict[str, int] = {}
     jobs = {}
     for jid in sorted(queue.jobs):
@@ -122,8 +129,13 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         "complete": bool(complete),
         "workers_alive": workers_alive,
         "journal_events": queue.events,
+        # idempotent-fold refusals (fleet/state.py): duplicate
+        # terminal frames a crashed writer left behind — surfaced, not
+        # swallowed, so an operator can audit what replay ignored
+        "journal_warnings": list(queue.fold_warnings),
         "counts": counts,
         **({"flows": flows_tot} if flows_tot else {}),
+        **({"admission": admission} if admission else {}),
         "jobs": jobs,
     }
 

@@ -54,6 +54,22 @@ def _write_json(path: str, obj) -> str:
     return path
 
 
+# the PHOLD-on-one-vertex soak topology every scenario job runs on
+# (shared with tools/chaos_soak.py and the resident-program builders
+# below — one graph, so heterogeneous tenants differ only in their
+# per-lane host count, load, seed and lease terms)
+SOAK_GRAPH = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
+    </node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+
 def _build_scenario(spec: JobSpec, caps: dict):
     """chaos_soak's PHOLD-on-one-vertex scenario surface, sized by
     the spec (undersized caps + auto_grow exercises escalation;
@@ -66,16 +82,7 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
     from shadow_tpu import faults
 
-    graph = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
-  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
-  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
-  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
-  <graph edgedefault="undirected">
-    <node id="v0"><data key="up">102400</data><data key="dn">102400</data>
-    </node>
-    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
-  </graph>
-</graphml>"""
+    graph = SOAK_GRAPH
     lanes = 0
     if spec.inject_trace:
         # lane count must be stable across rebuilds/requeues — the
@@ -135,6 +142,135 @@ def _build_scenario(spec: JobSpec, caps: dict):
         b.sim = telemetry.attach_flows(
             b.sim, sample_period=int(spec.flow_sample))
     return b
+
+
+def resident_caps(specs) -> dict:
+    """Shared capacity envelope for a heterogeneous tenant set: every
+    shape-bearing knob takes the max any tenant asked for (then the
+    shell build quantizes to pow2 buckets). Padding is behavior-
+    neutral until the first overflow (compile/buckets.py), so the
+    small tenant runs bit-identically at the big tenant's caps — the
+    price of sharing one resident program."""
+    specs = list(specs)
+    if not specs:
+        raise ValueError("resident_caps needs at least one tenant")
+    return {
+        "event_capacity": max(int(s.event_capacity) for s in specs),
+        "outbox_capacity": max(int(s.outbox_capacity) for s in specs),
+        "router_ring": max(int(s.router_ring) for s in specs),
+        "in_ring": max(8, 2 * max(int(s.load) for s in specs)),
+    }
+
+
+def _resident_cfg(*, width: int, lanes: int, caps: dict,
+                  horizon_ns: int, seed: int):
+    """One NetConfig rule for the shell AND every tenant donor — the
+    donor must build at bit-identical shapes/dtypes or the implant
+    (fleet/admission.py) would be transplanting across programs."""
+    from shadow_tpu.compile.buckets import bucket_config
+    from shadow_tpu.net.state import NetConfig
+
+    cfg = NetConfig(num_hosts=int(width) * int(lanes), tcp=False,
+                    end_time=int(horizon_ns), seed=int(seed),
+                    event_capacity=caps["event_capacity"],
+                    outbox_capacity=caps["outbox_capacity"],
+                    router_ring=caps["router_ring"],
+                    in_ring=caps["in_ring"])
+    return bucket_config(cfg)
+
+
+def build_resident_shell(*, width: int, lanes: int, caps: dict,
+                         horizon_ns: int, seed: int = 0,
+                         flow_sample: int = 1):
+    """The resident program's bundle: R FREE lanes of `width` hosts,
+    lane health + admission + (optionally) flow tracing attached, and
+    NO pending events — build() seeds every host's PROC_START, but a
+    FREE lane must be empty BEFORE the first window or the boot
+    events would execute ahead of the device-side free-lane flush.
+    Tenants enter by implant (fleet/admission.py), never by running
+    the shell's own boot."""
+    import jax.numpy as jnp
+
+    from shadow_tpu.apps import phold
+    from shadow_tpu.core import lanes as lanes_mod
+    from shadow_tpu.core import simtime
+    from shadow_tpu.net.build import HostSpec, build
+
+    cfg, bucket_plan = _resident_cfg(width=width, lanes=lanes,
+                                     caps=caps, horizon_ns=horizon_ns,
+                                     seed=seed)
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
+             for i in range(cfg.num_hosts)]
+    b = build(cfg, SOAK_GRAPH, hosts)
+    b.bucket_plan = bucket_plan
+    # load=0: the injector arms nobody (remaining == 0 everywhere) —
+    # the shell is an inert vessel with the full PHOLD handler set
+    # traced in, so any tenant's implanted chains execute
+    b.sim = phold.setup(b.sim, load=0, replica_size=int(width))
+    b.sim = lanes_mod.attach(b.sim, int(lanes))
+    b.sim = lanes_mod.attach_admission(b.sim)
+    if int(flow_sample) > 0:
+        from shadow_tpu import telemetry
+
+        b.sim = telemetry.attach_flows(
+            b.sim, sample_period=int(flow_sample))
+    # flush the boot PROC_STARTs explicitly (host-side, before any
+    # dispatch): every lane starts FREE and empty
+    b.sim = b.sim.replace(events=b.sim.events.replace(
+        time=jnp.full_like(b.sim.events.time, simtime.INVALID)))
+    return b
+
+
+def build_tenant_donor(spec: JobSpec, *, width: int, lanes: int,
+                       caps: dict, horizon_ns: int):
+    """A tenant's donor build: the SAME shapes as the resident shell
+    (same cfg rule, same pow2 buckets) but seeded and loaded as the
+    tenant's scenario — `spec.hosts` active hosts occupy each lane's
+    prefix (apps/phold.py active_hosts), padding rows idle forever.
+
+    The donor is never dispatched: fleet/admission.py slices ONE lane
+    block out of its leaves and implants it into the warm program at
+    the join barrier. Building at full H keeps every per-host identity
+    plane (rng keys, IPs, lane ids) correct for whichever lane the
+    tenant lands in — the donor's lane-r rows ARE lane-r rows."""
+    from shadow_tpu.apps import phold
+    from shadow_tpu.net.build import HostSpec, build
+
+    active = int(spec.hosts)
+    if active > int(width):
+        raise ValueError(
+            f"tenant {spec.id}: hosts={active} exceeds the resident "
+            f"lane width {width}")
+    cfg, _ = _resident_cfg(width=width, lanes=lanes, caps=caps,
+                           horizon_ns=horizon_ns, seed=spec.seed)
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0)
+             for i in range(cfg.num_hosts)]
+    b = build(cfg, SOAK_GRAPH, hosts)
+    b.sim = phold.setup(
+        b.sim, load=int(spec.load), replica_size=int(width),
+        active_hosts=active if active < int(width) else None)
+    return b
+
+
+def slo_verdict(spec: JobSpec, flows_blk) -> dict | None:
+    """The per-job "slo" result block: compare the run's worst
+    per-lane flow p99 against the spec's objective. None when the
+    spec carries no SLO or no flow data exists — the lint
+    (tools/telemetry_lint.py) cross-checks the verdict against the
+    manifest's flow percentiles."""
+    if spec.slo_p99_ms is None or not flows_blk:
+        return None
+    per_lane = flows_blk.get("per_lane") or {}
+    p99s = [int(v.get("p99_ns", 0)) for v in per_lane.values()
+            if v.get("count")]
+    if not p99s:
+        return None
+    worst = max(p99s)
+    objective_ns = int(float(spec.slo_p99_ms) * 1e6)
+    return {"objective_p99_ms": float(spec.slo_p99_ms),
+            "p99_ns": worst,
+            "met": worst <= objective_ns,
+            "tenant_class": spec.tenant_class}
 
 
 def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
@@ -278,6 +414,12 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                 ("sample_period", "sampled", "recorded", "harvested",
                  "lost_ring", "lost_window_clamp", "per_lane")
                 if k in flows_blk}
+        # the same spec file serves resident and per-process execution:
+        # a standalone run of a tenant spec still records its SLO
+        # verdict (the admission gate is the resident-path consumer)
+        verdict = slo_verdict(spec, flows_blk)
+        if verdict is not None:
+            result["slo"] = verdict
         if res.ok:
             result["digest"] = sim_digest(res.sim)
     if not res.ok and not res.preempted:

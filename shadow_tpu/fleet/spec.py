@@ -126,6 +126,17 @@ class JobSpec:
     # manifest grows a "flows" block and the fleet manifest rolls the
     # per-lane latency summaries up per tenant.
     flow_sample: int = 0
+    # Tenant lease terms (fleet/admission.py, resident programs):
+    # `tenant_class` ranks the job for SLO-aware shedding —
+    # "protected" tenants are never evicted by the admission gate and
+    # their SLO breaches drive the degradation ladder; "best_effort"
+    # tenants are the shedding pool. `slo_p99_ms` is the per-flow p99
+    # latency objective (telemetry/flows.py per-lane percentiles feed
+    # the gate); None = no SLO. Both also annotate standalone runs'
+    # results (scenario.py records an "slo" verdict), so the same
+    # spec file serves resident and per-process execution.
+    tenant_class: str = "best_effort"
+    slo_p99_ms: Optional[float] = None
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -164,6 +175,13 @@ class JobSpec:
         if int(self.flow_sample) < 0:
             raise ValueError(f"job {self.id}: flow_sample must be "
                              f">= 0 (0 disables flow tracing)")
+        if self.tenant_class not in ("protected", "best_effort"):
+            raise ValueError(
+                f"job {self.id}: tenant_class must be 'protected' or "
+                f"'best_effort', got {self.tenant_class!r}")
+        if self.slo_p99_ms is not None and float(self.slo_p99_ms) <= 0:
+            raise ValueError(f"job {self.id}: slo_p99_ms must be > 0 "
+                             f"(None disables the SLO)")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)

@@ -118,6 +118,13 @@ class FleetQueue:
         self.now = now
         self.jobs: dict[str, JobState] = {}
         self.events = 0
+        # duplicate/conflicting frames the fold refused (idempotent
+        # replay hardening): a journal whose tail carries a second
+        # terminal transition for a settled job — a crash between a
+        # worker's result landing and the supervisor's ack can write
+        # one — must replay to the FIRST verdict, warn, and not crash.
+        # The fleet manifest surfaces these (journal_warnings).
+        self.fold_warnings: list = []
         jpath = os.path.join(fleet_dir, "journal.log")
         if resume:
             old, _ = journal_mod.replay(jpath)
@@ -194,10 +201,25 @@ class FleetQueue:
         self._apply(rec)
         return rec
 
+    # events that (re)write a job's status — once a job is terminal,
+    # folding another of these would overwrite its verdict, so the
+    # fold keeps the FIRST terminal state and warns instead (the
+    # journal is append-only; a dead writer's retry or a result that
+    # raced a worker_lost can legitimately leave such frames).
+    # worker_lost/heartbeat stay foldable: they only touch counters.
+    _STATUS_EVENTS = ("leased", "running", "done", "failed",
+                      "requeued", "quarantined")
+
     def _apply(self, rec: dict) -> None:
         self.events += 1
         ev = rec.get("ev")
         j = self.jobs.get(rec.get("job", ""))
+        if j is not None and j.terminal and ev in self._STATUS_EVENTS:
+            self.fold_warnings.append(
+                f"journal: '{ev}' frame for job {j.spec.id} ignored — "
+                f"job already terminal ({j.status}); keeping the "
+                f"first verdict")
+            return
         if ev == "leased" and j is not None:
             j.status = LEASED
             j.worker = rec.get("worker")

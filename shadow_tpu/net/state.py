@@ -436,6 +436,11 @@ class Sim:
     # on — same None-contributes-no-leaves contract;
     # telemetry.attach_flows() is the opt-in.
     flows: Any = None
+    # LaneAdmission (core/lanes.py) when the program is RESIDENT — its
+    # lane population changes at window barriers under tenant leases
+    # (fleet/admission.py) — same None-contributes-no-leaves contract;
+    # core.lanes.attach_admission() is the opt-in (requires lanes).
+    admission: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

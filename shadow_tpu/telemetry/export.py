@@ -216,6 +216,34 @@ def lanes_manifest_block(health, incidents=()) -> dict | None:
     return out
 
 
+def admission_manifest_block(health) -> dict | None:
+    """Build the manifest's top-level "admission" block for a
+    STANDALONE resident run (`shadow-tpu --resident`): every lane is
+    admitted at boot and holds an open lease, so the lease-count
+    conservation the lint checks (admitted == completed + evicted +
+    quarantined + resident) folds directly from the device planes —
+    there is no host-side lease table in this mode. Fleet-managed
+    resident programs build their block from fleet/admission.py's
+    LeaseTable instead. None when the run carried no admission
+    planes."""
+    if health is None or not getattr(health, "resident", False):
+        return None
+    per = [dict(d) for d in health.admission]
+    quarantined = {int(r) for r in
+                   getattr(health, "lanes_quarantined", ())}
+    completed = sum(1 for d in per
+                    if d.get("completed") and d["lane"] not in quarantined)
+    return {
+        "admitted": len(per),
+        "completed": completed,
+        "evicted": 0,
+        "quarantined": len(quarantined),
+        "resident": len(per) - completed - len(quarantined),
+        "deferred": 0,
+        "per_lane": per,
+    }
+
+
 def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  health=None, fault_plan=None, harvester=None,
                  timers=None, wall_seconds: float | None = None,
@@ -231,6 +259,7 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  lanes: dict | None = None,
                  compile_info: dict | None = None,
                  flows: dict | None = None,
+                 admission: dict | None = None,
                  profile: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
@@ -309,6 +338,14 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # tools/telemetry_lint.py reconciles recorded + lost ==
         # sampled and the bucket sums
         man["flows"] = flows
+    if admission is not None:
+        # resident program (fleet/admission.py manifest_block or the
+        # CLI's standalone block): lease-count conservation, program-
+        # key stability across admission events, degradation-ladder
+        # history, per-lane lease planes. tools/telemetry_lint.py
+        # checks admitted == completed + evicted + quarantined +
+        # resident and the SLO verdicts against the flow percentiles
+        man["admission"] = admission
     if profile is not None:
         # jax.profiler capture (--profile-dir / BENCH_PROFILE_DIR):
         # where the TPU trace artifact landed, so the manifest is the
@@ -397,6 +434,32 @@ def metrics_from_manifest(man: dict) -> dict:
                if "count" in v}
         if fam:
             out["flow_lane_samples"] = fam
+    if "admission" in man:
+        adm = man["admission"]
+        for k in ("admitted", "completed", "evicted", "quarantined",
+                  "resident", "deferred"):
+            if adm.get(k) is not None:
+                out[f"admission_{k}"] = adm[k]
+        if "program_key_stable" in adm:
+            out["admission_program_key_stable"] = bool(
+                adm["program_key_stable"])
+        if adm.get("admission_events") is not None:
+            out["admission_events"] = adm["admission_events"]
+        if adm.get("retraces") is not None:
+            out["admission_retraces"] = adm["retraces"]
+        if adm.get("degrade_level") is not None:
+            out["admission_degrade_level"] = adm["degrade_level"]
+        # per-lane lease planes: which tenant occupies which lane, and
+        # whether its lease is live — churn debugging needs the lane
+        # attribution, not just the scalar counts above
+        per = adm.get("per_lane") or []
+        for stat, key in (("active", "active"),
+                          ("epoch", "epoch"),
+                          ("completed", "completed")):
+            fam = {str(d["lane"]): int(d[key]) for d in per
+                   if key in d}
+            if fam:
+                out[f"admission_lane_{stat}"] = fam
     return out
 
 

@@ -115,6 +115,14 @@ def main(argv=None) -> int:
     ap.add_argument("--threshold", type=float, default=0.10,
                     help="fractional events/s drop that fails the gate "
                          "(default 0.10 = 10%%)")
+    ap.add_argument("--require", action="append", default=[],
+                    metavar="SUBSTR",
+                    help="fail unless the newest round banks a metric "
+                         "containing SUBSTR (repeatable). E.g. "
+                         "--require _resident_ gates the resident-"
+                         "program row into every round — a dropped "
+                         "row would otherwise pass silently, since "
+                         "absent metrics are never compared")
     args = ap.parse_args(argv)
     if not 0 < args.threshold < 1:
         print("bench_regress: --threshold must be in (0, 1)",
@@ -126,7 +134,30 @@ def main(argv=None) -> int:
               f"nothing to gate")
         return 0
     regressions, comparisons = check(rounds, args.threshold)
-    new_n = rounds[-1][0]
+    new_n, _, new_rows = rounds[-1]
+    failed = 0
+    for sub in args.require:
+        if not any(sub in r["metric"] for r in new_rows):
+            print(f"bench_regress: round {new_n} banks no metric "
+                  f"containing {sub!r} (--require)", file=sys.stderr)
+            failed = 1
+    # resident-program rows (bench.py BENCH_RESIDENT) carry their
+    # zero-retrace contract on the row; a broken contract fails the
+    # gate even when the throughput number held up
+    for r in new_rows:
+        res = r.get("resident")
+        if not isinstance(res, dict):
+            continue
+        if res.get("program_key_stable") is False:
+            print(f"bench_regress: {r['metric']}: program key moved "
+                  f"across an admission event (program_key_stable="
+                  f"false)", file=sys.stderr)
+            failed = 1
+        if (res.get("retraces") or 0) > 0:
+            print(f"bench_regress: {r['metric']}: resident program "
+                  f"retraced {res['retraces']} time(s)",
+                  file=sys.stderr)
+            failed = 1
     for c in comparisons:
         tag = "REGRESSION" if c in regressions else "ok"
         print(f"{tag}: {c['metric']} [{c['backend']}] "
@@ -140,6 +171,8 @@ def main(argv=None) -> int:
         print(f"bench_regress: {len(regressions)} metric(s) regressed "
               f">{args.threshold:.0%} in round {new_n}",
               file=sys.stderr)
+        return 1
+    if failed:
         return 1
     print(f"bench_regress: round {new_n} within {args.threshold:.0%} "
           f"of the trajectory ({len(comparisons)} compared)")

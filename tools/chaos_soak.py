@@ -294,10 +294,10 @@ def _build_packed(replicas, hosts, load, sim_s, seed, caps):
 
 def _lane_digests(sim, replicas: int) -> list:
     """sha256 per lane over every [H]-leading leaf's lane slice. The
-    lane-latch planes and the telemetry ring are excluded (they are
-    the containment mechanism under test, not lane state), as are
-    global scalars (the run-total overflow latch legitimately differs
-    once the victim lane trips)."""
+    lane-latch planes, the lease planes, and the telemetry/flow rings
+    are excluded (they are the containment mechanism under test, not
+    lane state), as are global scalars (the run-total overflow latch
+    legitimately differs once the victim lane trips)."""
     import hashlib
 
     import jax
@@ -307,7 +307,8 @@ def _lane_digests(sim, replicas: int) -> list:
     hs = [hashlib.sha256() for _ in range(replicas)]
     for path, leaf in jax.tree_util.tree_flatten_with_path(sim)[0]:
         key = jax.tree_util.keystr(path)
-        if ".lanes" in key or ".telem" in key:
+        if (".lanes" in key or ".telem" in key or ".admission" in key
+                or ".flows" in key or ".inject" in key):
             continue
         a = np.asarray(jax.device_get(leaf))
         if a.ndim == 0 or a.shape[0] != H:
@@ -406,6 +407,228 @@ def run_replica_trial(seed: int, *, replicas: int = 4, hosts: int = 4,
     }
 
 
+def _load_lint():
+    """Import tools/telemetry_lint.py by path (tools/ is not a
+    package; the soak and the lint ship side by side)."""
+    import importlib.util
+
+    p = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     "telemetry_lint.py")
+    spec = importlib.util.spec_from_file_location("telemetry_lint", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def _churn_specs(seed: int):
+    """>=4 heterogeneous tenants for one resident program: different
+    host counts and loads (all pad to the shared pow2 lane bucket),
+    mixed tenant classes. `t-slo` carries an impossible p99 objective
+    so the admission gate MUST shed it; the two `t-und*` tenants are
+    the undisturbed control group (admitted identically in the
+    baseline and churn runs, byte-identity asserted on their terminal
+    digests)."""
+    from shadow_tpu.fleet.spec import JobSpec
+
+    return [
+        JobSpec(id="t-und-prot", kind="scenario", seed=seed + 1,
+                hosts=4, load=2, sim_s=1, tenant_class="protected",
+                slo_p99_ms=1e9),
+        JobSpec(id="t-und-be", kind="scenario", seed=seed + 2,
+                hosts=3, load=2, sim_s=1),
+        JobSpec(id="t-churn-a", kind="scenario", seed=seed + 3,
+                hosts=2, load=1, sim_s=1),
+        JobSpec(id="t-slo", kind="scenario", seed=seed + 4,
+                hosts=4, load=3, sim_s=1,
+                tenant_class="best_effort", slo_p99_ms=1e-6),
+        JobSpec(id="t-churn-b", kind="scenario", seed=seed + 5,
+                hosts=2, load=2, sim_s=1),
+    ]
+
+
+def run_churn_trial(seed: int, *, lanes: int = 6, horizon_s: int = 4,
+                    workdir: str | None = None, log=None) -> dict:
+    """Continuous-admission churn oracle (fleet/admission.py).
+
+    One resident program, >=4 heterogeneous tenants, >=8 join/leave/
+    evict events, one simulated SIGKILL. Asserts, in order:
+
+    1. zero retraces: the program key is identical before and after
+       every admission event and the live trace cache never grows;
+    2. SLO shedding: the best-effort tenant breaching its own p99
+       objective is evicted within one window barrier of the
+       sustained breach, with a lint-clean salvage artifact;
+    3. kill/resume: after a SIGKILL (journal abandoned mid-stream
+       with a torn tail frame), ResidentProgram.resume reconstructs
+       the EXACT resident lease population from replay;
+    4. byte-identity: the undisturbed tenants' terminal lane digests
+       are identical to a no-churn baseline run's, despite joins,
+       leaves, evictions, and the kill in other lanes."""
+    from shadow_tpu.core import simtime
+    from shadow_tpu.fleet import admission as adm_mod
+    from shadow_tpu.fleet import journal as journal_mod
+
+    SEC = simtime.ONE_SECOND
+    say = log or (lambda m: None)
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_churn.")
+    specs = _churn_specs(seed)
+    errors: list = []
+
+    def _gate():
+        return adm_mod.AdmissionGate(sustained=1)
+
+    # --- baseline: the two undisturbed tenants alone, no churn ------
+    base = adm_mod.ResidentProgram(
+        specs, workdir=os.path.join(workdir, "base"), lanes=lanes,
+        horizon_s=horizon_s, gate=_gate(), flow_sample=1, seed=seed,
+        fsync=False, log=say)
+    for jid in ("t-und-prot", "t-und-be"):
+        if base.admit(jid) is None:
+            errors.append(f"baseline: {jid} was not admitted")
+    base.drain()
+    base_digest = {h["job"]: h["digest"] for h in base.table.history
+                   if h["state"] == adm_mod.COMPLETED}
+    base_key = base.program_key
+    base.close()
+    for jid in ("t-und-prot", "t-und-be"):
+        if jid not in base_digest:
+            errors.append(f"baseline: {jid} did not complete: "
+                          f"{[h['state'] for h in base.table.history]}")
+    if base_key is None:
+        errors.append("baseline: program key unavailable (opaque "
+                      "loop?) — zero-retrace proof impossible")
+    if not base.program_key_stable:
+        errors.append("baseline: program key moved without churn")
+
+    # --- churn run: same undisturbed admissions + lane churn --------
+    churn_dir = os.path.join(workdir, "churn")
+    rp = adm_mod.ResidentProgram(
+        specs, workdir=churn_dir, lanes=lanes, horizon_s=horizon_s,
+        gate=_gate(), flow_sample=1, seed=seed, fsync=False, log=say)
+    for jid in ("t-und-prot", "t-und-be", "t-churn-a", "t-slo"):
+        if rp.admit(jid) is None:
+            errors.append(f"churn: {jid} was not admitted at t=0")
+    rp.advance(until_ns=SEC // 4)
+    # the gate must have shed t-slo by now (sustained=1, folds every
+    # barrier — "within one window barrier" by construction)
+    slo_lease = next((h for h in rp.table.history
+                      if h["job"] == "t-slo"), None)
+    if slo_lease is None:
+        errors.append("churn: t-slo still resident after "
+                      f"{rp.dispatches} barriers — the gate never "
+                      "shed the SLO-breaching best-effort lane")
+    elif slo_lease["state"] != adm_mod.EVICTED:
+        errors.append(f"churn: t-slo ended {slo_lease['state']}, "
+                      f"expected evicted (reason: "
+                      f"{slo_lease.get('reason')})")
+    elif "slo breach" not in (slo_lease.get("reason") or ""):
+        errors.append(f"churn: t-slo evicted for "
+                      f"{slo_lease.get('reason')!r}, not an SLO "
+                      f"breach")
+    salvage_path = (slo_lease or {}).get("salvage")
+    if not salvage_path or not os.path.isfile(salvage_path):
+        errors.append(f"churn: t-slo eviction left no salvage "
+                      f"artifact ({salvage_path})")
+    else:
+        lint = _load_lint().lint_salvage(salvage_path)
+        if lint:
+            errors.append(f"churn: t-slo salvage artifact is not "
+                          f"lint-clean: {lint}")
+    # operator churn: evict one tenant, admit the other mid-run, then
+    # re-admit the evicted one into the shed lane
+    if not rp.evict("t-churn-a", reason="operator churn"):
+        errors.append("churn: operator evict of t-churn-a failed")
+    rp.advance(until_ns=SEC // 2)
+    for jid in ("t-churn-b", "t-churn-a"):
+        if rp.admit(jid) is None:
+            errors.append(f"churn: mid-run admission of {jid} failed")
+    if not rp.program_key_stable:
+        errors.append(
+            f"churn: program retraced before the kill — keys "
+            f"{sorted(map(str, rp.program_keys))}, retraces "
+            f"{rp.retraces_seen}")
+
+    # --- SIGKILL: abandon the journal mid-stream, torn tail and all -
+    expected_pop = {int(k): tuple(v)
+                    for k, v in rp.table.population().items()}
+    rp.table.journal.close()       # fd gone, no terminal frames: the
+    # on-disk journal is exactly what a SIGKILL leaves behind
+    lease_log = os.path.join(churn_dir, "leases.log")
+    with open(lease_log, "ab") as f:
+        # half a frame header: the torn tail a dying writer leaves
+        f.write(journal_mod.encode_frame(
+            {"ev": "lease", "lane": 0, "state": "free"})[:7])
+    del rp
+
+    rp2 = adm_mod.ResidentProgram.resume(
+        specs, workdir=churn_dir, lanes=lanes, horizon_s=horizon_s,
+        gate=_gate(), flow_sample=1, seed=seed, fsync=False, log=say)
+    got_pop = {int(k): tuple(v)
+               for k, v in rp2.table.population().items()}
+    if got_pop != expected_pop:
+        errors.append(f"resume: lease population diverged — expected "
+                      f"{expected_pop}, replay gave {got_pop}")
+    rp2.drain()
+    rp2.close()
+    if not rp2.program_key_stable:
+        errors.append(
+            f"resume: program retraced after the kill — keys "
+            f"{sorted(map(str, rp2.program_keys))}, retraces "
+            f"{rp2.retraces_seen}")
+    keys = {base_key, rp2.program_key}
+    if len(keys) != 1:
+        errors.append(f"program key differs across runs: {keys}")
+
+    # --- byte-identity of the undisturbed lanes ---------------------
+    churn_digest = {h["job"]: h["digest"] for h in rp2.table.history
+                    if h["state"] == adm_mod.COMPLETED}
+    for jid in ("t-und-prot", "t-und-be"):
+        if jid not in churn_digest:
+            errors.append(f"churn: undisturbed tenant {jid} did not "
+                          f"complete")
+        elif churn_digest[jid] != base_digest.get(jid):
+            errors.append(
+                f"undisturbed tenant {jid} diverged from the "
+                f"no-churn baseline ({churn_digest[jid][:12]} != "
+                f"{(base_digest.get(jid) or '?')[:12]}) — churn in "
+                f"other lanes perturbed a healthy lane")
+
+    # --- event census over the journal ------------------------------
+    frames = [r for r in journal_mod.replay(lease_log)[0]
+              if r.get("ev") == "lease"]
+    joins = sum(1 for r in frames if r["state"] == adm_mod.ADMITTED)
+    leaves = sum(1 for r in frames
+                 if r["state"] in (adm_mod.COMPLETED,
+                                   adm_mod.QUARANTINED))
+    evictions = sum(1 for r in frames
+                    if r["state"] == adm_mod.EVICTED)
+    tenants = {r.get("job") for r in frames if r.get("job")}
+    if joins + leaves + evictions < 8:
+        errors.append(f"churn schedule too thin: {joins} joins + "
+                      f"{leaves} leaves + {evictions} evictions < 8")
+    if len(tenants) < 4:
+        errors.append(f"churn covered only {len(tenants)} tenants "
+                      f"(need >= 4): {sorted(tenants)}")
+    lease_warnings = list(rp2.table.fold_warnings)
+    return {
+        "seed": int(seed),
+        "ok": not errors,
+        "tenants": len(tenants),
+        "joins": joins,
+        "leaves": leaves,
+        "evictions": evictions,
+        "program_key": base_key,
+        "program_key_stable": bool(rp2.program_key_stable),
+        "population_resumed": {str(k): list(v)
+                               for k, v in sorted(got_pop.items())},
+        "slo_evicted": (slo_lease or {}).get("job"),
+        "salvage": salvage_path,
+        "lease_warnings": lease_warnings,
+        "churn_errors": errors,
+    }
+
+
 def _main_fleet(args) -> int:
     """--jobs K: dogfood the fleet runner. Each trial becomes a
     `chaos_trial` job; K worker processes execute them with the full
@@ -477,10 +700,35 @@ def main(argv=None) -> int:
                          "lanes' final state is byte-identical to a "
                          "clean packed run (core/lanes.py "
                          "containment)")
+    ap.add_argument("--churn", action="store_true",
+                    help="continuous-admission mode: random-free "
+                         "join/leave/evict schedule over one resident "
+                         "program (fleet/admission.py) with a "
+                         "simulated SIGKILL — asserts undisturbed-"
+                         "lane byte-identity vs a no-churn run, zero "
+                         "retraces across every admission event, SLO "
+                         "shedding with a lint-clean salvage, and "
+                         "exact lease-population reconstruction on "
+                         "resume")
+    ap.add_argument("--lanes", type=int, default=6,
+                    help="resident lane count for --churn")
     args = ap.parse_args(argv)
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.churn:
+        if args.jobs > 0 or args.replicas > 1:
+            ap.error("--churn is a standalone resident-program soak; "
+                     "it does not combine with --jobs or --replicas")
+        failed = 0
+        for k in range(args.trials):
+            rep = run_churn_trial(args.seed + k, lanes=args.lanes)
+            print(json.dumps(rep), flush=True)
+            if not rep["ok"]:
+                failed += 1
+        print(f"churn soak: {args.trials - failed}/{args.trials} "
+              f"trials ok", file=sys.stderr)
+        return 1 if failed else 0
     if args.replicas > 1:
         if args.jobs > 0:
             ap.error("--replicas is a standalone containment soak; "

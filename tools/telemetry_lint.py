@@ -70,6 +70,11 @@ WINDOW_ARGS = ("events", "micro_steps", "routed_local", "routed_cross",
 _PROGRAM_KEY = re.compile(r"^pk[0-9a-f]{16}$")
 _AFFINITY_KEY = re.compile(r"^ak[0-9a-f]{16}$")
 
+# the resident-admission degradation ladder, in order
+# (fleet/admission.py LADDER) — duplicated literally so the lint stays
+# importable without the engine
+_LEASE_LADDER = ("nominal", "stride", "defer", "evict", "quarantine")
+
 
 def _lint_compile_block(comp, where: str) -> tuple[list, list]:
     """(errors, warnings) for one program-store accounting block
@@ -347,6 +352,274 @@ def _lint_flows(fl, ctr, tel) -> tuple[list, list]:
                 f"{harvested} — every record crosses exactly one "
                 f"(src_shard, dst_shard) cell")
     return errors, warnings
+
+
+def _lint_admission(adm) -> tuple[list, list]:
+    """(errors, warnings) for an "admission" block — either a resident
+    program's lease-table block (fleet/admission.py manifest_block,
+    rides the fleet manifest) or the standalone resident run's
+    device-plane fold (telemetry/export.py admission_manifest_block,
+    rides the run manifest). The core invariant is lease-count
+    conservation: every admitted lease is exactly one of completed,
+    evicted, quarantined, or still resident — a lease can never
+    vanish or be double-counted."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(adm, dict):
+        return (["admission must be an object"], [])
+    counts = {}
+    for k in ("admitted", "completed", "evicted", "quarantined",
+              "resident"):
+        v = adm.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"admission.{k} must be a non-negative "
+                          f"integer, got {v!r}")
+        else:
+            counts[k] = v
+    for k in ("deferred", "lanes", "lane_width", "admission_events",
+              "retraces"):
+        v = adm.get(k)
+        if v is not None and (not isinstance(v, int)
+                              or isinstance(v, bool) or v < 0):
+            errors.append(f"admission.{k} must be a non-negative "
+                          f"integer, got {v!r}")
+    if len(counts) == 5 and counts["admitted"] != (
+            counts["completed"] + counts["evicted"]
+            + counts["quarantined"] + counts["resident"]):
+        errors.append(
+            f"lease counts not conserved: admitted="
+            f"{counts['admitted']} != completed={counts['completed']} "
+            f"+ evicted={counts['evicted']} + quarantined="
+            f"{counts['quarantined']} + resident={counts['resident']} "
+            f"— every admitted lease must end in exactly one terminal "
+            f"state or still hold its lane")
+    # zero-retrace contract: a resident program that retraced (or
+    # whose program key moved) broke the whole design — admission
+    # events must be pure runtime-data mutation
+    pk = adm.get("program_key")
+    if pk is not None and (not isinstance(pk, str)
+                           or not _PROGRAM_KEY.match(pk)):
+        errors.append(f'admission.program_key must match "pk" + 16 '
+                      f"hex chars, got {pk!r}")
+    stable = adm.get("program_key_stable")
+    if stable is not None and not isinstance(stable, bool):
+        errors.append(f"admission.program_key_stable must be a bool, "
+                      f"got {stable!r}")
+    elif stable is False:
+        errors.append(
+            "admission.program_key_stable=false — the program key "
+            "moved across an admission event (a join/leave must "
+            "never change compiled shapes)")
+    rt = adm.get("retraces")
+    if isinstance(rt, int) and not isinstance(rt, bool) and rt > 0:
+        errors.append(f"admission.retraces={rt} — a resident program "
+                      f"must serve every admission event from the one "
+                      f"warm trace")
+    # degradation ladder: the recorded step must be a real rung and
+    # agree with the level index
+    lvl = adm.get("degrade_level")
+    step = adm.get("degrade_step")
+    if lvl is not None and (not isinstance(lvl, int)
+                            or isinstance(lvl, bool)
+                            or not 0 <= lvl < len(_LEASE_LADDER)):
+        errors.append(f"admission.degrade_level must be an integer in "
+                      f"[0, {len(_LEASE_LADDER)}), got {lvl!r}")
+    if step is not None and step not in _LEASE_LADDER:
+        errors.append(f"admission.degrade_step {step!r} is not a "
+                      f"ladder rung {_LEASE_LADDER}")
+    if (isinstance(lvl, int) and not isinstance(lvl, bool)
+            and 0 <= lvl < len(_LEASE_LADDER)
+            and step is not None and step != _LEASE_LADDER[lvl]):
+        errors.append(f"admission.degrade_step={step!r} disagrees "
+                      f"with degrade_level={lvl} "
+                      f"({_LEASE_LADDER[lvl]!r})")
+    if isinstance(lvl, int) and not isinstance(lvl, bool) and lvl > 0:
+        warnings.append(f"admission gate degraded to "
+                        f"{_LEASE_LADDER[lvl]!r} (protected-tenant "
+                        f"SLO pressure)")
+    hist = adm.get("degrade_history")
+    if hist is not None:
+        if not isinstance(hist, list):
+            errors.append("admission.degrade_history must be an array")
+        else:
+            for i, h in enumerate(hist):
+                if not isinstance(h, dict) \
+                        or h.get("step") not in _LEASE_LADDER:
+                    errors.append(f"admission.degrade_history[{i}] "
+                                  f"must name a ladder rung")
+    # per-lane lease planes (core/lanes.py admission_report)
+    per = adm.get("per_lane")
+    active = 0
+    if per is not None:
+        if not isinstance(per, list):
+            errors.append("admission.per_lane must be an array")
+            per = []
+        nlanes = adm.get("lanes")
+        if (isinstance(nlanes, int) and not isinstance(nlanes, bool)
+                and per and len(per) != nlanes):
+            errors.append(f"admission.per_lane has {len(per)} entries "
+                          f"but lanes={nlanes}")
+        for i, d in enumerate(per):
+            where = f"admission.per_lane[{i}]"
+            if not isinstance(d, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            if d.get("lane") != i:
+                errors.append(f"{where}: lane={d.get('lane')!r} out "
+                              f"of order (expected {i})")
+            for k in ("active", "completed"):
+                if not isinstance(d.get(k), bool):
+                    errors.append(f"{where}: {k} must be a bool")
+            for k in ("epoch", "flushed"):
+                v = d.get(k)
+                if (not isinstance(v, int) or isinstance(v, bool)
+                        or v < 0):
+                    errors.append(f"{where}: {k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            if d.get("active") is True:
+                active += 1
+        if per and "resident" in counts and active < counts["resident"]:
+            errors.append(
+                f"admission: {counts['resident']} resident lease(s) "
+                f"but only {active} active device lane plane(s) — a "
+                f"live lease must hold an active lane")
+    # SLO gate snapshot
+    slo = adm.get("slo")
+    if slo is not None:
+        if not isinstance(slo, dict):
+            errors.append("admission.slo must be an object")
+            slo = {}
+        for k in ("eval_stride", "sustained"):
+            v = slo.get(k)
+            if v is not None and (not isinstance(v, int)
+                                  or isinstance(v, bool) or v < 1):
+                errors.append(f"admission.slo.{k} must be an integer "
+                              f">= 1, got {v!r}")
+        for lane, v in sorted((slo.get("last_p99_ns") or {}).items()):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"admission.slo.last_p99_ns[{lane}] "
+                              f"must be a non-negative integer")
+        for job, ratio in sorted((slo.get("breached_jobs")
+                                  or {}).items()):
+            if (not isinstance(ratio, (int, float))
+                    or isinstance(ratio, bool) or ratio <= 1.0):
+                errors.append(
+                    f"admission.slo.breached_jobs[{job}]={ratio!r} — "
+                    f"a recorded breach ratio must exceed 1.0 (p99 "
+                    f"over objective), anything else is not a breach")
+    if counts.get("evicted"):
+        warnings.append(f"{counts['evicted']} lease(s) evicted "
+                        f"(SLO shedding or operator churn; salvage "
+                        f"artifacts in the lease history)")
+    if counts.get("quarantined"):
+        warnings.append(f"{counts['quarantined']} lane lease(s) "
+                        f"quarantined (lanes stay parked until the "
+                        f"program restarts)")
+    lw = adm.get("lease_warnings")
+    if lw:
+        for w in lw:
+            warnings.append(f"lease journal: {w}")
+    return errors, warnings
+
+
+def _lint_slo_verdict(slo, flows, where: str) -> list:
+    """Errors for one scenario result's "slo" verdict
+    (fleet/scenario.py slo_verdict): the verdict must be arithmetic
+    over the flow percentiles it claims to summarize."""
+    errors: list = []
+    if not isinstance(slo, dict):
+        return [f"{where} must be an object"]
+    obj_ms = slo.get("objective_p99_ms")
+    p99 = slo.get("p99_ns")
+    met = slo.get("met")
+    if (not isinstance(obj_ms, (int, float)) or isinstance(obj_ms, bool)
+            or obj_ms <= 0):
+        errors.append(f"{where}.objective_p99_ms must be a positive "
+                      f"number, got {obj_ms!r}")
+    if not isinstance(p99, int) or isinstance(p99, bool) or p99 < 0:
+        errors.append(f"{where}.p99_ns must be a non-negative "
+                      f"integer, got {p99!r}")
+    if not isinstance(met, bool):
+        errors.append(f"{where}.met must be a bool, got {met!r}")
+    tc = slo.get("tenant_class")
+    if tc is not None and tc not in ("protected", "best_effort"):
+        errors.append(f"{where}.tenant_class must be 'protected' or "
+                      f"'best_effort', got {tc!r}")
+    if not errors and met != (p99 <= obj_ms * 1e6):
+        errors.append(
+            f"{where}: met={met} contradicts p99_ns={p99} vs "
+            f"objective {obj_ms}ms ({int(obj_ms * 1e6)}ns) — the "
+            f"verdict must be arithmetic over its own numbers")
+    # the claimed p99 must be the worst per-lane flow p99 it
+    # summarizes (slo_verdict takes the max across lanes)
+    per_lane = (flows or {}).get("per_lane")
+    if isinstance(per_lane, dict) and per_lane \
+            and isinstance(p99, int) and not isinstance(p99, bool):
+        worst = max((int(d.get("p99_ns", 0) or 0)
+                     for d in per_lane.values()
+                     if isinstance(d, dict) and d.get("count")),
+                    default=None)
+        if worst is not None and p99 != worst:
+            errors.append(
+                f"{where}.p99_ns={p99} but the flow per-lane "
+                f"percentiles peak at {worst} — the verdict must "
+                f"summarize the flow block it rides with")
+    return errors
+
+
+def lint_salvage(path: str) -> list:
+    """Errors for a lane-salvage artifact (utils/checkpoint.py
+    save_salvage; faults/escalate.py extract_lane output). Pure
+    numpy — no engine import — so the soak and CI can lint salvage
+    evidence anywhere. Returns [] when clean."""
+    import zlib
+
+    import numpy as np
+
+    errors: list = []
+    try:
+        z = np.load(path, allow_pickle=False)
+    except (OSError, ValueError) as e:
+        return [f"{path}: unreadable npz: {e}"]
+    with z:
+        if "__meta__" not in z.files:
+            return [f"{path}: missing __meta__ — not a salvage "
+                    f"artifact"]
+        try:
+            meta = json.loads(str(z["__meta__"]))
+        except ValueError as e:
+            return [f"{path}: __meta__ is not JSON: {e}"]
+        if meta.get("kind") != "lane_salvage":
+            errors.append(f"{path}: kind={meta.get('kind')!r}, "
+                          f"expected 'lane_salvage' (a resumable "
+                          f"snapshot is not salvage evidence)")
+        leaves = sorted(k for k in z.files if k != "__meta__")
+        if not leaves:
+            errors.append(f"{path}: artifact holds zero state leaves")
+        keys = meta.get("keys")
+        if isinstance(keys, list) and sorted(keys) != leaves:
+            errors.append(f"{path}: __meta__.keys disagrees with the "
+                          f"stored leaves")
+        crcs = meta.get("crc32")
+        if not isinstance(crcs, dict):
+            errors.append(f"{path}: missing per-leaf crc32 map")
+            crcs = {}
+        for k in leaves:
+            arr = z[k]
+            if k in crcs and (zlib.crc32(
+                    np.ascontiguousarray(arr).tobytes())
+                    & 0xFFFFFFFF) != crcs[k]:
+                errors.append(f"{path}: leaf {k} fails its CRC32 — "
+                              f"salvage evidence is corrupt")
+        t = meta.get("time_ns")
+        if not isinstance(t, int) or isinstance(t, bool) or t < 0:
+            errors.append(f"{path}: __meta__.time_ns must be a "
+                          f"non-negative integer, got {t!r}")
+        caps = meta.get("capacities")
+        if not isinstance(caps, dict) or not caps.get("num_hosts"):
+            errors.append(f"{path}: __meta__.capacities must name the "
+                          f"slice's shapes (at least num_hosts)")
+    return errors
 
 
 def lint_trace_obj(obj) -> tuple[list, list]:
@@ -869,6 +1142,12 @@ def lint_manifest_obj(man) -> tuple[list, list]:
         e2, w2 = _lint_flows(fl, man.get("counters"), tel)
         errors += e2
         warnings += w2
+    # admission block (optional): standalone resident-run lease fold
+    adm = man.get("admission")
+    if adm is not None:
+        e2, w2 = _lint_admission(adm)
+        errors += e2
+        warnings += w2
     # profile block (optional): a pointer to a jax.profiler artifact
     prof = man.get("profile")
     if prof is not None:
@@ -956,6 +1235,12 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
                           f"verdict ({verdict!r})")
         if st == "done" and not isinstance(j.get("result"), dict):
             errors.append(f"{where}: done job must carry its result")
+        # SLO verdict (optional, tenant jobs): the verdict must be
+        # arithmetic over the flow percentiles it rides with
+        res = j.get("result")
+        if isinstance(res, dict) and res.get("slo") is not None:
+            errors += _lint_slo_verdict(res["slo"], j.get("flows"),
+                                        f"{where}.result.slo")
         if st == "failed" and not isinstance(j.get("failure"), dict):
             errors.append(f"{where}: failed job must carry its "
                           f"failure report")
@@ -1113,6 +1398,13 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
     elif job_fl:
         errors.append(f'{len(job_fl)} job(s) carry flow summaries but '
                       f'the fleet manifest has no "flows" roll-up')
+    # admission block (optional): a resident program's lease-table
+    # roll-up (fleet/admission.py manifest_block)
+    adm = man.get("admission")
+    if adm is not None:
+        e2, w2 = _lint_admission(adm)
+        errors += e2
+        warnings += w2
     mc = man.get("counts")
     if isinstance(mc, dict) and mc != counts:
         errors.append(f"counts block {mc} disagrees with the jobs "
@@ -1140,11 +1432,16 @@ def main(argv=None) -> int:
                     help="run_manifest.json path")
     ap.add_argument("--fleet-manifest", default=None,
                     help="fleet_manifest.json path (shadow_tpu.fleet)")
+    ap.add_argument("--salvage", default=None,
+                    help="lane-salvage .npz path (lease eviction / "
+                         "quarantine artifact)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings, print errors only")
     args = ap.parse_args(argv)
-    if not args.trace and not args.manifest and not args.fleet_manifest:
-        ap.error("give --trace, --manifest and/or --fleet-manifest")
+    if not (args.trace or args.manifest or args.fleet_manifest
+            or args.salvage):
+        ap.error("give --trace, --manifest, --fleet-manifest and/or "
+                 "--salvage")
 
     errors: list = []
     warnings: list = []
@@ -1162,6 +1459,8 @@ def main(argv=None) -> int:
         e2, w2 = lint(obj)
         errors += [f"{path}: {m}" for m in e2]
         warnings += [f"{path}: {m}" for m in w2]
+    if args.salvage:
+        errors += lint_salvage(args.salvage)
 
     for e in errors:
         print(f"ERROR: {e}", file=sys.stderr)
