@@ -44,6 +44,23 @@ rate measures raw engine throughput. Env knobs:
                                   acceptance: <=5% at the default
                                   1-in-64 sampling (requires
                                   BENCH_FLOW_SAMPLE)
+  BENCH_CAUSALITY=N               attach the causal lineage recorder
+                                  (telemetry/causality.py) to the
+                                  timed program: deterministic 1-in-N
+                                  event sampling plus per-window
+                                  advance attribution. The row grows a
+                                  "causality" block (sampled/harvested
+                                  counts + binding-cause histogram)
+                                  and the embedded manifest carries
+                                  the full block for tools/critpath.py
+  BENCH_CAUSALITY_OVERHEAD=1      A/B the lineage recorder's cost:
+                                  rebuild the SAME workload without
+                                  the causality planes, time it, and
+                                  record causality_overhead_pct =
+                                  (off-on)/off — acceptance: <=5% at
+                                  the default 1-in-64 sampling
+                                  (requires BENCH_CAUSALITY; gated by
+                                  tools/bench_regress.py)
   BENCH_PROFILE_DIR=path          capture a jax.profiler trace of one
                                   EXTRA (unscored) run after the timed
                                   one — tracing costs wall time, so it
@@ -246,6 +263,24 @@ def _attach_flow_ring(sims: list, flow_sample: int) -> list:
             for s in sims]
 
 
+def _bench_causality_sample() -> int:
+    """BENCH_CAUSALITY: 1-in-N event-lineage sampling + window-advance
+    attribution on the timed program (0 = off). Same honesty rule as
+    the other rings: the planes ride the timed inputs."""
+    v = os.environ.get("BENCH_CAUSALITY")
+    return int(v) if v else 0
+
+
+def _attach_causality_ring(sims: list, causality_sample: int) -> list:
+    if causality_sample <= 0:
+        return sims
+    from shadow_tpu import telemetry
+
+    return [telemetry.attach_causality(s,
+                                       sample_period=causality_sample)
+            for s in sims]
+
+
 def _bench_bucketed() -> bool:
     """Quantize capacities to power-of-two buckets? Explicit
     BENCH_BUCKETED wins; unset follows warm serving (a warm store
@@ -342,7 +377,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   active_hosts: int | None = None,
                   sparse_lanes: int | None = None,
                   min_jump_ns: int | None = None,
-                  flow_sample: int | None = None):
+                  flow_sample: int | None = None,
+                  causality_sample: int | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -357,6 +393,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
              "bundle": None, "cinfo": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
     fs = _bench_flow_sample() if flow_sample is None else flow_sample
+    cs = (_bench_causality_sample() if causality_sample is None
+          else causality_sample)
     bucketed = _bench_bucketed()
 
     def build_at(cap):
@@ -382,8 +420,10 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
 
             sims = [telemetry.attach(s) for s in sims]
             b.sim = sims[0]
-        # flow ring on the TIMED inputs too — same honesty rule
+        # flow + causality rings on the TIMED inputs too — same
+        # honesty rule
         sims = _attach_flow_ring(sims, fs)
+        sims = _attach_causality_ring(sims, cs)
         b.sim = sims[0]
         # sparse shape: bulk would consume whole windows before the
         # fixpoint ever ran, starving the compaction fast path the
@@ -434,7 +474,8 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
                              adaptive_jump: bool = False,
                              min_jump_ns: int | None = None,
                              checkpoint_windows: int | None = None,
-                             flow_sample: int | None = None):
+                             flow_sample: int | None = None,
+                             causality_sample: int | None = None):
     """PHOLD through faults.run_supervised — the host-driven window
     loop with health checks at every dispatch barrier. This is the
     dispatch-amortization A/B subject: at windows_per_dispatch=1 every
@@ -452,6 +493,8 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
              "mesh": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
     fs = _bench_flow_sample() if flow_sample is None else flow_sample
+    cs = (_bench_causality_sample() if causality_sample is None
+          else causality_sample)
     bucketed = _bench_bucketed()
     every = checkpoint_windows or (1 << 30)   # default: never fires
     ckdir = tempfile.mkdtemp(prefix="bench_sup_")
@@ -485,6 +528,7 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
                                   2 * (chunk_windows or 1)))
             sims = [telemetry.attach(s, capacity=W) for s in sims]
         sims = _attach_flow_ring(sims, fs)
+        sims = _attach_causality_ring(sims, cs)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -568,7 +612,8 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
                    adaptive_jump: bool = False,
                    min_jump_ns: int | None = None,
                    checkpoint_windows: int | None = None,
-                   flow_sample: int | None = None):
+                   flow_sample: int | None = None,
+                   causality_sample: int | None = None):
     """Open-system injection scenario: the tgen app (every host binds
     a UDP socket; injected KIND_TGEN events fire datagrams) driven by
     a streamed trace through the supervised window loop — the feeder
@@ -597,6 +642,8 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
              "mesh": None}
     telem_on = os.environ.get("BENCH_TELEMETRY", "1") != "0"
     fs = _bench_flow_sample() if flow_sample is None else flow_sample
+    cs = (_bench_causality_sample() if causality_sample is None
+          else causality_sample)
     bucketed = _bench_bucketed()
     every = checkpoint_windows or (1 << 30)
     ckdir = tempfile.mkdtemp(prefix="bench_inj_")
@@ -636,6 +683,7 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
                                   2 * (chunk_windows or 1)))
             sims = [telemetry.attach(s, capacity=W) for s in sims]
         sims = _attach_flow_ring(sims, fs)
+        sims = _attach_causality_ring(sims, cs)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -955,7 +1003,8 @@ def main(argv=None) -> None:
                  "BENCH_SPARSE_LANES", "BENCH_INJECT_TRACE",
                  "BENCH_INJECT_RATE", "BENCH_CHUNK_WINDOWS",
                  "BENCH_SHARDS", "BENCH_FLOW_OVERHEAD",
-                 "BENCH_FLOW_SAMPLE"))
+                 "BENCH_FLOW_SAMPLE", "BENCH_CAUSALITY",
+                 "BENCH_CAUSALITY_OVERHEAD"))
                 or workload != "phold" or topo != "one"
                 or fault_records):
             raise SystemExit(
@@ -1083,6 +1132,9 @@ def main(argv=None) -> None:
         if _bench_flow_sample() > 0:
             raise SystemExit("BENCH_FLOW_SAMPLE is only wired for the "
                              "phold/injection runners")
+        if _bench_causality_sample() > 0:
+            raise SystemExit("BENCH_CAUSALITY is only wired for the "
+                             "phold/injection runners")
         runner = _pingpong_runner(H, sim_s)
         name = f"events_per_sec_per_chip@{H}hosts_udp_pingpong"
     if topo == "ref":
@@ -1102,6 +1154,14 @@ def main(argv=None) -> None:
             and flow_sample_n <= 0:
         raise SystemExit("BENCH_FLOW_OVERHEAD=1 needs "
                          "BENCH_FLOW_SAMPLE=N (what would it A/B?)")
+    caus_sample_n = _bench_causality_sample()
+    if caus_sample_n > 0:
+        # the causality planes shape the program too — own metric name
+        name += f"_caus{caus_sample_n}"
+    if os.environ.get("BENCH_CAUSALITY_OVERHEAD") == "1" \
+            and caus_sample_n <= 0:
+        raise SystemExit("BENCH_CAUSALITY_OVERHEAD=1 needs "
+                         "BENCH_CAUSALITY=N (what would it A/B?)")
 
     # compile + warm (may escalate capacity). Timed + cache-diffed:
     # compile_s is the wall cost of the first device call, and the
@@ -1173,6 +1233,48 @@ def main(argv=None) -> None:
                           else rate_off)
         flow_overhead_pct = round(
             (value_flow_off - value) / value_flow_off * 100.0, 2)
+
+    # BENCH_CAUSALITY_OVERHEAD=1: same A/B for the lineage recorder —
+    # rebuild with the causality planes off (every other knob
+    # unchanged, so the delta IS the recorder), time it, score the
+    # cost as (off - on) / off. Acceptance: <=5% at the default
+    # 1-in-64 sampling; tools/bench_regress.py gates the bound.
+    causality_overhead_pct = None
+    value_caus_off = None
+    if os.environ.get("BENCH_CAUSALITY_OVERHEAD") == "1" \
+            and caus_sample_n > 0:
+        if inject_on:
+            base = _inject_runner(
+                H, sim_s, shards=_SHARDS, graph=graph,
+                trace_path=inj_trace, rate=inj_rate,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, causality_sample=0)
+        elif supervise:
+            base = _phold_supervised_runner(
+                H, load, sim_s, shards=_SHARDS, graph=graph,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, causality_sample=0)
+        else:
+            base = _phold_runner(
+                H * replicas, load, sim_s, shards=_SHARDS, graph=graph,
+                replica_size=H if replicas > 1 else None,
+                fault_records=fault_records,
+                active_hosts=active, sparse_lanes=sparse,
+                min_jump_ns=min_jump_ns, causality_sample=0)
+        base()                     # warm-up (compile, maybe escalate)
+        while True:
+            t0 = time.perf_counter()
+            ev_off = base()
+            wall_off = time.perf_counter() - t0
+            if not getattr(base, "escalated", False):
+                break
+        rate_off = ev_off / wall_off
+        value_caus_off = (rate_off / _SHARDS if _SHARDS > 1
+                          else rate_off)
+        causality_overhead_pct = round(
+            (value_caus_off - value) / value_caus_off * 100.0, 2)
 
     # compare against the measured baseline AT THE SAME SCALE (the
     # C pthread heap-skeleton upper bound, BASELINE.md): the published
@@ -1305,9 +1407,38 @@ def main(argv=None) -> None:
                              "lost_window_clamp", "per_lane")}
             if "manifest" in out:
                 out["manifest"]["flows"] = fb
+    if caus_sample_n > 0 \
+            and getattr(runner, "last_sim", None) is not None \
+            and getattr(runner.last_sim, "causality", None) is not None:
+        # causal-attribution accounting of the TIMED run: counters +
+        # binding-cause histogram on the row, the full block (chains,
+        # advances, utilization percentiles) in the manifest — the
+        # input tools/critpath.py reads
+        from shadow_tpu import telemetry
+        from shadow_tpu.telemetry.causality import (
+            causality_manifest_block)
+
+        ch = getattr(runner, "harvester", None)
+        if ch is None:
+            ch = telemetry.Harvester()
+        ch.drain(runner.last_sim)
+        cb = causality_manifest_block(
+            ch, num_hosts=runner.state["bundle"].cfg.num_hosts,
+            shards=max(_SHARDS, 1), sample_period=caus_sample_n)
+        if cb is not None:
+            out["causality"] = {
+                k: cb[k] for k in
+                ("sample_period", "sampled", "harvested", "lost_ring",
+                 "windows_attributed", "windows_lost", "causes")
+                if k in cb}
+            if "manifest" in out:
+                out["manifest"]["causality"] = cb
     if flow_overhead_pct is not None:
         out["flow_overhead_pct"] = flow_overhead_pct
         out["events_per_sec_flow_off"] = round(value_flow_off, 1)
+    if causality_overhead_pct is not None:
+        out["causality_overhead_pct"] = causality_overhead_pct
+        out["events_per_sec_causality_off"] = round(value_caus_off, 1)
     # BENCH_PROFILE_DIR: capture ONE extra, unscored run, after every
     # export has read the timed run's state. Tracing costs wall time
     # (observed: an order of magnitude on small CPU shapes), so it

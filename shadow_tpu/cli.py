@@ -158,6 +158,21 @@ def make_parser() -> argparse.ArgumentParser:
                    help="flow ring capacity in sampled records "
                         "(default 4096); window-clamp and overrun "
                         "losses are accounted, never silent")
+    p.add_argument("--causality-sample", type=int, default=0, metavar="N",
+                   help="sample 1-in-N emitted events into the causal "
+                        "lineage recorder (telemetry/causality.py): "
+                        "parent/child event keys, window-advance "
+                        "attribution (which clamp decided every window "
+                        "end), top-K critical chains and a binding-"
+                        "cause histogram in the manifest, a critical-"
+                        "path track in --trace-out, and the input "
+                        "tools/critpath.py turns into a speed-of-light "
+                        "report. 0 (default) = off, byte-identical to "
+                        "builds without the recorder")
+    p.add_argument("--causality-capacity", type=int, default=None,
+                   help="per-host lineage sub-ring capacity in sampled "
+                        "events (default 64); overruns are accounted "
+                        "in the manifest, never silently")
     p.add_argument("--profile-dir", default=None, metavar="DIR",
                    help="capture a jax.profiler trace of the window "
                         "loop into DIR (view with TensorBoard / "
@@ -590,16 +605,19 @@ def main(argv=None) -> int:
         telem_on = bool(args.trace_out or args.metrics_out
                         or args.telemetry_capacity)
         flows_on = bool(args.flow_sample and args.flow_sample > 0)
+        caus_on = bool(args.causality_sample
+                       and args.causality_sample > 0)
         harvester = None
         timers = None
-        if (telem_on or flows_on) and loaded.vprocs:
+        if (telem_on or flows_on or caus_on) and loaded.vprocs:
             logger.warning(0, "shadow-tpu",
                            "window telemetry is unavailable with .py "
                            "plugins (ProcessRuntime drives its own "
                            "window loop); --trace-out/--metrics-out/"
-                           "--flow-sample ignored")
+                           "--flow-sample/--causality-sample ignored")
             telem_on = False
             flows_on = False
+            caus_on = False
         if telem_on:
             from shadow_tpu import telemetry
 
@@ -628,7 +646,30 @@ def main(argv=None) -> int:
                 f"flow tracing: 1-in-{args.flow_sample} packet "
                 f"sampling, ring capacity "
                 f"{args.flow_capacity or flows_mod.DEFAULT_CAPACITY}")
-        if telem_on or flows_on:
+        if caus_on:
+            # causal lineage recorder (telemetry/causality.py): the
+            # same deterministic hash sampling discipline as the flow
+            # recorder, plus per-window advance attribution at the
+            # barrier; drained by the same harvester
+            from shadow_tpu import telemetry
+            from shadow_tpu.telemetry import causality as caus_mod
+
+            try:
+                b.sim = telemetry.attach_causality(
+                    b.sim, sample_period=args.causality_sample,
+                    capacity=args.causality_capacity
+                    or caus_mod.DEFAULT_CAPACITY)
+            except ValueError as e:
+                print(f"error: --causality-sample: {e}",
+                      file=sys.stderr)
+                logger.flush()
+                return 1
+            logger.message(
+                0, "shadow-tpu",
+                f"causality tracing: 1-in-{args.causality_sample} "
+                f"event sampling, per-host lineage capacity "
+                f"{args.causality_capacity or caus_mod.DEFAULT_CAPACITY}")
+        if telem_on or flows_on or caus_on:
             from shadow_tpu import telemetry
 
             harvester = telemetry.Harvester()
@@ -818,7 +859,13 @@ def main(argv=None) -> int:
                 )
                 from shadow_tpu.telemetry.flows import \
                     flows_manifest_block
+                from shadow_tpu.telemetry.causality import \
+                    causality_manifest_block
 
+                caus_blk = causality_manifest_block(
+                    harvester, num_hosts=b.cfg.num_hosts,
+                    shards=nshards,
+                    sample_period=args.causality_sample or None)
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards,
                     sim=sim_, stats=stats_, health=health_,
@@ -836,7 +883,8 @@ def main(argv=None) -> int:
                         shards=nshards,
                         sample_period=args.flow_sample or None),
                     admission=admission_manifest_block(health_),
-                    profile=profile_info)
+                    profile=profile_info,
+                    causality=caus_blk)
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
@@ -845,7 +893,9 @@ def main(argv=None) -> int:
                     telemetry.write_trace(
                         args.trace_out, harvester.records, timers,
                         nshards,
-                        flow_records=harvester.flow_records)
+                        flow_records=harvester.flow_records,
+                        adv_records=harvester.adv_records or None,
+                        chains=(caus_blk or {}).get("chains"))
                 if args.metrics_out:
                     telemetry.write_metrics(args.metrics_out, man)
                 return man
@@ -861,7 +911,8 @@ def main(argv=None) -> int:
                     "escalations": len(result.escalations),
                     "resume": f"--resume {args.data_directory}",
                 }
-                if (telem_on or flows_on) and result.sim is not None:
+                if (telem_on or flows_on or caus_on) \
+                        and result.sim is not None:
                     report["manifest"] = _sup_manifest(
                         result.sim, None, result.stats)
                 logger.message(0, "shadow-tpu", "run preempted "
@@ -894,7 +945,7 @@ def main(argv=None) -> int:
                     oc = objcount.gather(result.sim)
                     logger.message(0, "shadow-tpu", oc.format())
                     logger.message(0, "shadow-tpu", oc.format_diff())
-                    if telem_on or flows_on:
+                    if telem_on or flows_on or caus_on:
                         report["manifest"] = _sup_manifest(
                             result.sim, result.health)
                 logger.flush()
@@ -1059,7 +1110,7 @@ def main(argv=None) -> int:
                     e.as_dict() for e in sup_result.escalations]
             if sup_result.resume_of:
                 report["resume_of"] = sup_result.resume_of
-        if telem_on or flows_on:
+        if telem_on or flows_on or caus_on:
             from shadow_tpu import telemetry
 
             nshards = mesh.shape["hosts"] if mesh is not None else 1
@@ -1089,7 +1140,13 @@ def main(argv=None) -> int:
                 )
                 from shadow_tpu.telemetry.flows import \
                     flows_manifest_block
+                from shadow_tpu.telemetry.causality import \
+                    causality_manifest_block
 
+                caus_blk = causality_manifest_block(
+                    harvester, num_hosts=b.cfg.num_hosts,
+                    shards=nshards,
+                    sample_period=args.causality_sample or None)
                 man = telemetry.run_manifest(
                     cfg=b.cfg, seed=args.seed, shards=nshards, sim=sim,
                     stats=stats, health=run_health,
@@ -1109,6 +1166,7 @@ def main(argv=None) -> int:
                         sample_period=args.flow_sample or None),
                     admission=admission_manifest_block(run_health),
                     profile=profile_info,
+                    causality=caus_blk,
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,
@@ -1124,7 +1182,9 @@ def main(argv=None) -> int:
                     telemetry.write_trace(
                         args.trace_out, harvester.records, timers,
                         nshards,
-                        flow_records=harvester.flow_records)
+                        flow_records=harvester.flow_records,
+                        adv_records=harvester.adv_records or None,
+                        chains=(caus_blk or {}).get("chains"))
                     logger.message(b.cfg.end_time, "shadow-tpu",
                                    f"trace -> {args.trace_out} (load in "
                                    f"chrome://tracing or ui.perfetto.dev)")

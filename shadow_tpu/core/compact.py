@@ -39,6 +39,13 @@ def _replicated(path, sim) -> bool:
     # events/outbox/net and DO gather, keeping row attribution exact.)
     if names and names[0] in ("telem", "inject", "lanes"):
         return True
+    # Causality (telemetry/causality.py): the advance-attribution
+    # plane's [W] leaves are window slots, not host rows — pass
+    # through. The [H, F] lineage sub-rings and their [H] counters ARE
+    # host rows mutated inside the fixpoint: they gather/scatter by
+    # the default leading-dim rule, keeping row attribution exact.
+    if names and names[0] == "causality" and names[-1].startswith("adv_"):
+        return True
     if names and names[-1] in REPLICATED_FIELDS and (
         names[-2] == "net" if len(names) > 1
         else isinstance(sim, NetState)

@@ -203,6 +203,15 @@ def window_fixpoint(sim, stats: EngineStats, step_fn: StepFn, wend,
             sim, buf = step_fn(sim, popped, buf0)
         blocked1 = (jnp.sum(sim.net.ctr_cpu_blocked)
                     if hasattr(sim, "net") else jnp.zeros((), I64))
+        if getattr(sim, "causality", None) is not None:
+            # event-lineage recorder (telemetry/causality.py): must see
+            # the PRE-apply next_seq so each emission's identity hash
+            # matches the seq apply_emissions is about to assign. Lazy
+            # import like the injection merge below — core must not
+            # depend on telemetry at module load. Trace-time no-op
+            # (zero compiled ops) when Sim.causality is None.
+            from shadow_tpu.telemetry.causality import lineage_update
+            sim = lineage_update(sim, popped, buf, lane_id)
         q, out = apply_emissions(sim.events, sim.outbox, buf, lane_id)
         sim = sim.replace(events=q, outbox=out)
         stats = stats.replace(
@@ -226,7 +235,8 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 emit_capacity: int = 4, lane_id=None,
                 route_fn=_default_route, min_fn=_identity,
                 bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None,
-                sparse_lanes: int = 0, census_fn=None, flow_fn=None):
+                sparse_lanes: int = 0, census_fn=None, flow_fn=None,
+                adv_attr=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -257,7 +267,13 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
     fixpoint runs over a compacted [S]-lane Sim (core/compact.py) and
     scatters back — bit-identical by construction. fault_fn, bulk_fn,
     telemetry and route all run at full width on both branches, so
-    fault/checkpoint boundaries are unchanged."""
+    fault/checkpoint boundaries are unchanged.
+
+    `adv_attr` — a (cause, edge_a, edge_b, raw_jump) tuple from a
+    window-end rule's `.explain` companion (make_wend_fn) — latches
+    this window's advance attribution into Sim.causality
+    (telemetry/causality.py advance_latch) after the drain. None (the
+    default, and always when causality is off) latches nothing."""
     if telem_fn is not None:
         ev0 = stats.events_processed
         ms0 = stats.micro_steps
@@ -279,9 +295,11 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         stats = stats.replace(
             events_processed=stats.events_processed + n_bulk)
 
+    if adv_attr is not None and getattr(sim, "causality", None) is None:
+        adv_attr = None
     S = int(sparse_lanes) if sparse_lanes else 0
     n_active = None
-    if S > 0 or telem_fn is not None:
+    if S > 0 or telem_fn is not None or adv_attr is not None:
         active = sim.events.min_time() < jnp.asarray(wend, simtime.DTYPE)
         n_active = jnp.sum(active, dtype=I32)  # shard-LOCAL lane count
     fastpath = jnp.zeros((), jnp.bool_)
@@ -339,6 +357,16 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         # flow flight-recorder (telemetry/flows.py): samples the
         # staged outbox, so it must also run BEFORE route_fn clears it
         sim = flow_fn(sim, wend if wstart is None else wstart, wend)
+    if adv_attr is not None:
+        # window-advance attribution (telemetry/causality.py): the
+        # census reduction makes the latched active count GLOBAL, so
+        # the replicated [W] plane stays shard-identical
+        from shadow_tpu.telemetry.causality import advance_latch
+        cause, edge_a, edge_b, raw_jump = adv_attr
+        sim = advance_latch(
+            sim, wend if wstart is None else wstart, wend,
+            cause, edge_a, edge_b, raw_jump,
+            (census_fn or _identity)(n_active))
     sim = route_fn(sim)
     if getattr(sim, "lanes", None) is not None:
         # lane barrier (core/lanes.py): reduce the per-host latch
@@ -401,7 +429,23 @@ def make_wend_fn(*, min_jump: int, end_time: int,
       the stale (still-spiked) table: packets flying at the restored
       short latency then land inside the over-long window, out of
       conservative order.
+
+    The returned rule carries an ``explain`` companion —
+    ``wend_fn.explain(sim, wstart) -> (wend, cause, edge_a, edge_b,
+    raw_jump)`` — computing the SAME wend plus its advance attribution
+    (telemetry/causality.py CAUSE_* codes): which constraint bound the
+    window, the binding latency-table vertex pair under adaptive jump
+    (-1 otherwise), and the available lookahead before the record/end
+    clamps. Clamps are attributed in a fixed priority order (floor ->
+    record -> end) and only a clamp that STRICTLY lowers wend takes
+    the cause, so ties are deterministic on every path.
     """
+    from shadow_tpu.telemetry.causality import (
+        CAUSE_ADAPTIVE_EDGE,
+        CAUSE_END_TIME,
+        CAUSE_FAULT_RECORD,
+        CAUSE_MIN_JUMP,
+    )
     if isinstance(min_jump, int) and min_jump <= 0:
         raise ValueError(f"min_jump must be positive, got {min_jump}")
     end = jnp.asarray(int(end_time), simtime.DTYPE)
@@ -409,6 +453,7 @@ def make_wend_fn(*, min_jump: int, end_time: int,
     ft_c = None
     if fault_times is not None and len(fault_times):
         ft_c = jnp.asarray(fault_times, simtime.DTYPE)
+    neg1 = jnp.asarray(-1, I32)
     if pair_mask is None:
         def wend_fn(sim, wstart):
             wend = jnp.minimum(wstart + jump0, end + 1)
@@ -424,17 +469,35 @@ def make_wend_fn(*, min_jump: int, end_time: int,
                                         simtime.INVALID))
                 wend = jnp.minimum(wend, nxt)
             return wend
+
+        def explain(sim, wstart):
+            wend = wstart + jump0
+            cause = jnp.asarray(CAUSE_MIN_JUMP, I32)
+            if ft_c is not None:
+                nxt = jnp.min(jnp.where(ft_c > wstart, ft_c,
+                                        simtime.INVALID))
+                cause = jnp.where(nxt < wend, CAUSE_FAULT_RECORD, cause)
+                wend = jnp.minimum(wend, nxt)
+            cause = jnp.where(end + 1 < wend, CAUSE_END_TIME, cause)
+            wend = jnp.minimum(wend, end + 1)
+            return wend, cause, neg1, neg1, jump0
+
+        wend_fn.explain = explain
         return wend_fn
     mask_c = jnp.asarray(pair_mask, bool)
+    V = int(mask_c.shape[0])
 
-    def wend_fn(sim, wstart):
+    def _adaptive_jump(sim, wstart):
         if table_fn is not None:
             lat, rel = table_fn(wstart + 1)
         else:
             lat, rel = sim.net.latency_ns, sim.net.reliability
         lat = jnp.asarray(lat, simtime.DTYPE)
         live = mask_c & (rel > 0)
-        jump = jnp.min(jnp.where(live, lat, simtime.INVALID))
+        return jnp.where(live, lat, simtime.INVALID)
+
+    def wend_fn(sim, wstart):
+        jump = jnp.min(_adaptive_jump(sim, wstart))
         # Tables are replicated across shards (REPLICATED_FIELDS), so
         # this min is shard-invariant without a collective. The upper
         # clip keeps wstart + jump from overflowing i64 when no pair
@@ -448,6 +511,28 @@ def make_wend_fn(*, min_jump: int, end_time: int,
             wend = jnp.minimum(wend, nxt)
         return jnp.minimum(wend, end + 1)
 
+    def explain(sim, wstart):
+        masked = _adaptive_jump(sim, wstart)
+        flat = masked.reshape(-1)
+        k = jnp.argmin(flat)            # first min: deterministic edge
+        jump_u = flat[k]
+        jump = jnp.clip(jump_u, jump0, end + 1)
+        # at (or below) the floor the EDGE is not the constraint
+        adaptive = jump_u > jump0
+        cause = jnp.where(adaptive, CAUSE_ADAPTIVE_EDGE,
+                          CAUSE_MIN_JUMP).astype(I32)
+        edge_a = jnp.where(adaptive, (k // V).astype(I32), neg1)
+        edge_b = jnp.where(adaptive, (k % V).astype(I32), neg1)
+        wend = wstart + jump
+        if ft_c is not None:
+            nxt = jnp.min(jnp.where(ft_c > wstart, ft_c, simtime.INVALID))
+            cause = jnp.where(nxt < wend, CAUSE_FAULT_RECORD, cause)
+            wend = jnp.minimum(wend, nxt)
+        cause = jnp.where(end + 1 < wend, CAUSE_END_TIME, cause)
+        wend = jnp.minimum(wend, end + 1)
+        return wend, cause, edge_a, edge_b, jump
+
+    wend_fn.explain = explain
     return wend_fn
 
 
@@ -508,18 +593,34 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
                 ok = ok & (ws < _sim.inject.horizon)
             return ok
 
+        explain = getattr(wend_fn, "explain", None)
+        tracing = (getattr(sim, "causality", None) is not None
+                   and explain is not None)
+
         def body(carry):
             i, sim, stats, ws = carry
-            wend = wend_fn(sim, ws)
-            if streamed:
-                wend = jnp.minimum(wend, sim.inject.horizon)
+            adv = None
+            if tracing:
+                from shadow_tpu.telemetry.causality import (
+                    CAUSE_INJECT_HORIZON,
+                )
+                wend, cause, edge_a, edge_b, raw = explain(sim, ws)
+                if streamed:
+                    cause = jnp.where(sim.inject.horizon < wend,
+                                      CAUSE_INJECT_HORIZON, cause)
+                    wend = jnp.minimum(wend, sim.inject.horizon)
+                adv = (cause, edge_a, edge_b, raw)
+            else:
+                wend = wend_fn(sim, ws)
+                if streamed:
+                    wend = jnp.minimum(wend, sim.inject.horizon)
             sim, stats, next_min = step_window(
                 sim, stats, step_fn, wend,
                 emit_capacity=emit_capacity, lane_id=lane,
                 route_fn=route_fn, min_fn=min_fn, bulk_fn=bulk_fn,
                 fault_fn=fault_fn, telem_fn=telem_fn, wstart=ws,
                 sparse_lanes=sparse_lanes, census_fn=census_fn,
-                flow_fn=flow_fn)
+                flow_fn=flow_fn, adv_attr=adv)
             return i + 1, sim, stats, next_min
 
         _, sim, stats, wstart = jax.lax.while_loop(
@@ -579,16 +680,43 @@ def run(
         sim, stats, wstart = carry
         return wstart <= end_time
 
+    tracing = getattr(sim, "causality", None) is not None
+
     def body(carry):
         sim, stats, wstart = carry
-        wend = jnp.minimum(wstart + min_jump, end_time + 1)
-        if ft_c is not None:
-            nxt = jnp.min(jnp.where(ft_c > wstart, ft_c, simtime.INVALID))
-            wend = jnp.minimum(wend, nxt)
+        adv = None
+        if tracing:
+            # same attribution rule (and clamp-priority order) as the
+            # static make_wend_fn explain — the whole-run program's
+            # advance plane must be bit-identical to the chunked
+            # drivers' (telemetry/causality.py)
+            from shadow_tpu.telemetry.causality import (
+                CAUSE_END_TIME,
+                CAUSE_FAULT_RECORD,
+                CAUSE_MIN_JUMP,
+            )
+            wend = wstart + min_jump
+            cause = jnp.asarray(CAUSE_MIN_JUMP, I32)
+            if ft_c is not None:
+                nxt = jnp.min(jnp.where(ft_c > wstart, ft_c,
+                                        simtime.INVALID))
+                cause = jnp.where(nxt < wend, CAUSE_FAULT_RECORD, cause)
+                wend = jnp.minimum(wend, nxt)
+            cause = jnp.where(end_time + 1 < wend, CAUSE_END_TIME,
+                              cause)
+            wend = jnp.minimum(wend, end_time + 1)
+            neg1 = jnp.asarray(-1, I32)
+            adv = (cause, neg1, neg1, min_jump)
+        else:
+            wend = jnp.minimum(wstart + min_jump, end_time + 1)
+            if ft_c is not None:
+                nxt = jnp.min(jnp.where(ft_c > wstart, ft_c,
+                                        simtime.INVALID))
+                wend = jnp.minimum(wend, nxt)
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
             route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,
-            sparse_lanes, census_fn, flow_fn,
+            sparse_lanes, census_fn, flow_fn, adv,
         )
         return sim, stats, next_min
 

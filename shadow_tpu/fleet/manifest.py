@@ -65,6 +65,10 @@ def _job_entry(queue, j) -> dict:
         # per-flow latency summary (telemetry/flows.py): the job-level
         # copy is the roll-up input for the fleet "flows" block
         entry["flows"] = j.result["flows"]
+    if j.result and j.result.get("causality"):
+        # causality accounting (telemetry/causality.py): the job-level
+        # copy is the roll-up input for the fleet "causality" block
+        entry["causality"] = j.result["causality"]
     run_man = os.path.join(queue.job_dir(jid), "run_manifest.json")
     if os.path.isfile(run_man):
         entry["run_manifest"] = os.path.join(rel, "run_manifest.json")
@@ -120,6 +124,26 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
             flows_tot["lane_samples"][lane] = (
                 flows_tot["lane_samples"].get(lane, 0)
                 + int(summ.get("count", 0) or 0))
+    # causality roll-up: sum every causality-traced job's lineage
+    # accounting and fold the binding-cause histograms fleet-wide —
+    # "what is the FLEET waiting on" (the lint checks these totals
+    # against the per-job entries)
+    caus_tot = None
+    for jid, entry in jobs.items():
+        cz = entry.get("causality")
+        if not cz:
+            continue
+        if caus_tot is None:
+            caus_tot = {"jobs": 0, "sampled": 0, "harvested": 0,
+                        "lost_ring": 0, "windows_attributed": 0,
+                        "windows_lost": 0, "causes": {}}
+        caus_tot["jobs"] += 1
+        for k in ("sampled", "harvested", "lost_ring",
+                  "windows_attributed", "windows_lost"):
+            caus_tot[k] += int(cz.get(k, 0) or 0)
+        for cause, n in (cz.get("causes") or {}).items():
+            caus_tot["causes"][cause] = (
+                caus_tot["causes"].get(cause, 0) + int(n or 0))
     return {
         "schema": SCHEMA,
         "schema_version": SCHEMA_VERSION,
@@ -135,6 +159,7 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         "journal_warnings": list(queue.fold_warnings),
         "counts": counts,
         **({"flows": flows_tot} if flows_tot else {}),
+        **({"causality": caus_tot} if caus_tot else {}),
         **({"admission": admission} if admission else {}),
         "jobs": jobs,
     }

@@ -218,10 +218,15 @@ class FleetRunner:
         if result.get("ok"):
             self.queue.complete(job, result)
             fl = result.get("flows") or {}
+            cz = result.get("causality") or {}
             self._emit("done", job=job,
                        **({"flows_sampled": fl.get("sampled"),
                            "flows_harvested": fl.get("harvested")}
-                          if fl else {}))
+                          if fl else {}),
+                       **({"causality_sampled": cz.get("sampled"),
+                           "causality_windows":
+                           cz.get("windows_attributed")}
+                          if cz else {}))
             self._backfill_lanes(job, result)
         elif result.get("preempted") and not result.get("deadline"):
             # graceful drain: the run snapshotted and yielded — park it

@@ -141,6 +141,13 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
         b.sim = telemetry.attach_flows(
             b.sim, sample_period=int(spec.flow_sample))
+    if int(getattr(spec, "causality_sample", 0) or 0) > 0:
+        # causal lineage recorder + window-advance attribution
+        # (telemetry/causality.py): rides the sim pytree the same way
+        from shadow_tpu import telemetry
+
+        b.sim = telemetry.attach_causality(
+            b.sim, sample_period=int(spec.causality_sample))
     return b
 
 
@@ -322,11 +329,12 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
 
         feeder = Feeder(spec.inject_trace)
 
-    # flow tracing needs a harvester so checkpoint-time drains keep
-    # ring loss bounded (telemetry/harvest.py drains flows + windows
-    # through the same choke point)
+    # flow/causality tracing needs a harvester so checkpoint-time
+    # drains keep ring loss bounded (telemetry/harvest.py drains
+    # flows + lineage + windows through the same choke point)
     harvester = (telemetry.Harvester()
                  if int(getattr(spec, "flow_sample", 0) or 0) > 0
+                 or int(getattr(spec, "causality_sample", 0) or 0) > 0
                  else None)
 
     res = faults.run_supervised(
@@ -387,11 +395,19 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             cinfo["buckets"] = plan.as_dict()
         result["program_key"] = cinfo.get("key")
         flows_blk = None
+        caus_blk = None
         if harvester is not None:
             harvester.drain(res.sim)
             flows_blk = flows_manifest_block(
                 harvester, num_hosts=bundle.cfg.num_hosts, shards=1,
                 sample_period=int(spec.flow_sample))
+            from shadow_tpu.telemetry.causality import \
+                causality_manifest_block
+
+            caus_blk = causality_manifest_block(
+                harvester, num_hosts=bundle.cfg.num_hosts, shards=1,
+                sample_period=int(getattr(spec, "causality_sample", 0)
+                                 or 0) or None)
         man = telemetry.run_manifest(
             cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
             stats=res.stats, health=res.health,
@@ -402,6 +418,7 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             injection=inject_mod.manifest_block(res.sim, feeder),
             lanes=lanes_manifest_block(res.health, incidents),
             flows=flows_blk,
+            causality=caus_blk,
             compile_info=cinfo or None)
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
@@ -414,6 +431,15 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                 ("sample_period", "sampled", "recorded", "harvested",
                  "lost_ring", "lost_window_clamp", "per_lane")
                 if k in flows_blk}
+        if caus_blk is not None:
+            # roll-up copy: the chains and traffic matrix stay in the
+            # job manifest; the fleet manifest folds the accounting
+            # and the binding-cause histogram fleet-wide
+            result["causality"] = {
+                k: caus_blk[k] for k in
+                ("sample_period", "sampled", "harvested", "lost_ring",
+                 "windows_attributed", "windows_lost", "causes")
+                if k in caus_blk}
         # the same spec file serves resident and per-process execution:
         # a standalone run of a tenant spec still records its SLO
         # verdict (the admission gate is the resident-path consumer)

@@ -126,6 +126,12 @@ class JobSpec:
     # manifest grows a "flows" block and the fleet manifest rolls the
     # per-lane latency summaries up per tenant.
     flow_sample: int = 0
+    # causal critical-path profiling (telemetry/causality.py): sample
+    # 1-in-N emitted events into the lineage recorder and latch which
+    # clamp decided every window end; 0 = off. The job manifest grows
+    # a "causality" block (critical chains, binding-cause histogram)
+    # and the fleet manifest rolls the cause counts up fleet-wide.
+    causality_sample: int = 0
     # Tenant lease terms (fleet/admission.py, resident programs):
     # `tenant_class` ranks the job for SLO-aware shedding —
     # "protected" tenants are never evicted by the admission gate and
@@ -175,6 +181,9 @@ class JobSpec:
         if int(self.flow_sample) < 0:
             raise ValueError(f"job {self.id}: flow_sample must be "
                              f">= 0 (0 disables flow tracing)")
+        if int(self.causality_sample) < 0:
+            raise ValueError(f"job {self.id}: causality_sample must "
+                             f"be >= 0 (0 disables causality tracing)")
         if self.tenant_class not in ("protected", "best_effort"):
             raise ValueError(
                 f"job {self.id}: tenant_class must be 'protected' or "

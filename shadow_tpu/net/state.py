@@ -441,6 +441,11 @@ class Sim:
     # (fleet/admission.py) — same None-contributes-no-leaves contract;
     # core.lanes.attach_admission() is the opt-in (requires lanes).
     admission: Any = None
+    # CausalityState (telemetry/causality.py) when event-lineage /
+    # window-advance attribution tracing is on — same
+    # None-contributes-no-leaves contract;
+    # telemetry.attach_causality() is the opt-in.
+    causality: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

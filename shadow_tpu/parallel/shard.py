@@ -90,6 +90,16 @@ def sim_specs(sim, axis: str):
         # records, identical on every shard after the barrier psum.
         if names and names[0] in ("telem", "inject", "lanes", "flows"):
             return P()
+        # Causality state (telemetry/causality.py) is mixed: the
+        # lineage sub-rings are per-HOST rows ([H, F] planes and [H]
+        # counters — appends are row-local, so they shard like event
+        # queues), while the advance-attribution plane (adv_* leaves)
+        # is latched from replicated window values on every shard and
+        # replicates like the telemetry ring.
+        if names and names[0] == "causality":
+            if names[-1].startswith("adv_") or jnp.ndim(leaf) == 0:
+                return P()
+            return P(axis)
         # Replicated lookup tables are identified by NetState field
         # name, scoped to the NetState subtree ("net" in a Sim, or a
         # bare NetState) so an app field that happens to share a name
@@ -276,6 +286,11 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     # (injected/dropped/late) are per-shard partials and take the
     # generic delta-psum below like every other counter.
     inject = getattr(sim, "inject", None)
+    # Causality's only scalar, adv_count, is REPLICATED (every shard
+    # latches the same windows into the same slots) — the delta-psum
+    # would multiply it by the shard count. The [H]/[H,F] lineage
+    # leaves and [W] adv planes are non-scalar and untouched below.
+    caus = getattr(sim, "causality", None)
     # The per-path matrix is declared replicated (REPLICATED_FIELDS)
     # but each shard scatter-adds only its own hosts' sends into its
     # replica — psum the [V,V] delta so the reassembled matrix equals
@@ -302,6 +317,9 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     if inject is not None:
         sim = sim.replace(inject=sim.inject.replace(
             seq_floor=inject.seq_floor, horizon=inject.horizon))
+    if caus is not None:
+        sim = sim.replace(causality=sim.causality.replace(
+            adv_count=caus.adv_count))
     if path_pinned is not None:
         sim = sim.replace(net=sim.net.replace(
             ctr_path_packets=path_pinned))

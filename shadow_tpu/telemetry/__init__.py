@@ -2,14 +2,27 @@
 
 See ring.py (the on-device ring and the engine hook), flows.py (the
 per-flow latency flight-recorder and its histogram/traffic-matrix
-fan-out), harvest.py (the between-calls drain + wall-clock phase
-timers), export.py (Chrome trace / Prometheus text / run manifest)."""
+fan-out), causality.py (the event-lineage recorder, window-advance
+attribution, and critical-chain reconstruction), harvest.py (the
+between-calls drain + wall-clock phase timers), export.py (Chrome
+trace / Prometheus text / run manifest)."""
 
 from shadow_tpu.telemetry.ring import (  # noqa: F401
     DEFAULT_CAPACITY,
     TelemetryRing,
     attach,
     make_telem_fn,
+)
+from shadow_tpu.telemetry.causality import (  # noqa: F401
+    CAUSE_NAMES,
+    AdvanceRecord,
+    CausalityRecord,
+    CausalityState,
+    attach_causality,
+    binding_histogram,
+    causality_manifest_block,
+    cause_name,
+    critical_chains,
 )
 from shadow_tpu.telemetry.flows import (  # noqa: F401
     DEFAULT_SAMPLE_PERIOD,

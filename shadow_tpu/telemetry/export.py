@@ -27,7 +27,8 @@ def _us(ns: int) -> float:
 
 
 def chrome_trace(records, timers=None, num_shards: int = 1,
-                 flow_records=None) -> dict:
+                 flow_records=None, adv_records=None,
+                 chains=None) -> dict:
     """Build a Trace Event Format object (dict; json.dump it).
 
     Sim-time track: pid 0, one "X" event per window record, ts/dur in
@@ -41,7 +42,14 @@ def chrome_trace(records, timers=None, num_shards: int = 1,
     axis — one thread per isolation lane, one "X" span per sampled
     packet from its staging window to its delivery timestamp, so a
     packed multi-tenant run reads as side-by-side per-tenant latency
-    timelines in Perfetto."""
+    timelines in Perfetto.
+
+    `adv_records` / `chains` (harvested telemetry/causality.py
+    AdvanceRecord list and critical_chains() dicts) add pid 3, the
+    critical-path group: one thread per top-K causal chain drawing its
+    events as spans on the sim-time axis, plus "C" counter tracks for
+    jump-utilization and the window binding cause — so "why can't this
+    run go faster" reads directly off the trace."""
     events = []
     events.append({"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
                    "args": {"name": "sim-time (simulated µs)"}})
@@ -105,6 +113,35 @@ def chrome_trace(records, timers=None, num_shards: int = 1,
                     "latency_ns": r.t_deliver - r.t_enq,
                     "t_route": r.t_route,
                 },
+            })
+    if adv_records or chains:
+        events.append({"ph": "M", "name": "process_name", "pid": 3,
+                       "tid": 0,
+                       "args": {"name":
+                                "critical path (simulated µs)"}})
+        for rank, ch in enumerate(chains or ()):
+            events.append({"ph": "M", "name": "thread_name", "pid": 3,
+                           "tid": rank,
+                           "args": {"name": f"chain {rank} "
+                                            f"(len {ch['length']})"}})
+            for ev in ch.get("events", ()):
+                events.append({
+                    "ph": "X", "pid": 3, "tid": rank,
+                    "name": f"h{ev['host']}->h{ev['dst']} k{ev['kind']}",
+                    "ts": _us(ev["t_emit"]),
+                    "dur": max(_us(ev["t_due"] - ev["t_emit"]), 0.001),
+                    "args": {"depth": ev["depth"], "key": ev["key"]},
+                })
+        for r in (adv_records or ()):
+            util = r.utilization_pct
+            args = {"cause": r.cause}
+            if util is not None:
+                args["jump_utilization_pct"] = util
+            events.append({
+                "ph": "C", "pid": 3, "tid": 0,
+                "name": "window_advance",
+                "ts": _us(r.wstart),
+                "args": args,
             })
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
@@ -260,7 +297,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  compile_info: dict | None = None,
                  flows: dict | None = None,
                  admission: dict | None = None,
-                 profile: dict | None = None) -> dict:
+                 profile: dict | None = None,
+                 causality: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -351,6 +389,16 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # where the TPU trace artifact landed, so the manifest is the
         # one pointer from a run to every artifact it produced
         man["profile"] = dict(profile)
+    if causality is not None:
+        # causal critical-path profiling (telemetry/causality.py
+        # causality_manifest_block): lineage sampling accounting,
+        # top-K critical chains, binding-cause histogram, per-edge
+        # binding counts, jump-utilization percentiles.
+        # tools/telemetry_lint.py reconciles harvested + lost against
+        # sampled, the cause counts against the attributed windows,
+        # and the traffic matrix against the flows block;
+        # tools/critpath.py derives the speed-of-light report from it
+        man["causality"] = causality
     return man
 
 
@@ -460,14 +508,46 @@ def metrics_from_manifest(man: dict) -> dict:
                    if key in d}
             if fam:
                 out[f"admission_lane_{stat}"] = fam
+    if "causality" in man:
+        cz = man["causality"]
+        for k in ("sampled", "emitted", "harvested", "lost_ring",
+                  "cross_host_harvested", "windows_attributed",
+                  "windows_lost"):
+            if cz.get(k) is not None:
+                out[f"causality_{k}"] = cz[k]
+        if cz.get("sample_period"):
+            out["causality_sample_period"] = cz["sample_period"]
+        # binding-cause histogram: one counter per clamp that decided
+        # a window end (min_jump_floor / adaptive_edge / fault_record
+        # / inject_horizon / end_time) — the dashboard's "what is the
+        # simulator waiting on" breakdown
+        if cz.get("causes"):
+            out["window_binding_cause"] = dict(cz["causes"])
+        if cz.get("edges"):
+            out["window_binding_edge"] = dict(cz["edges"])
+        for key, name in (("jump_utilization_pct",
+                           "window_jump_utilization_pct"),
+                          ("idle_lane_pct",
+                           "causality_idle_lane_pct")):
+            fam = cz.get(key) or {}
+            if fam:
+                out[name] = {k: v for k, v in sorted(fam.items())}
+        chains = cz.get("chains") or []
+        if chains:
+            out["critical_chain_count"] = len(chains)
+            out["critical_chain_len_max"] = max(
+                c.get("length", 0) for c in chains)
+            out["critical_chain_span_ns_max"] = max(
+                c.get("span_ns", 0) for c in chains)
     return out
 
 
 def write_trace(path: str, records, timers=None, num_shards: int = 1,
-                flow_records=None):
+                flow_records=None, adv_records=None, chains=None):
     with open(path, "w") as f:
         json.dump(chrome_trace(records, timers, num_shards,
-                               flow_records=flow_records), f)
+                               flow_records=flow_records,
+                               adv_records=adv_records, chains=chains), f)
     return path
 
 

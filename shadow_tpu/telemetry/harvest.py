@@ -24,6 +24,12 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from shadow_tpu.telemetry.causality import (
+    ADVANCE_PLANES,
+    LINEAGE_PLANES,
+    AdvanceRecord,
+    CausalityRecord,
+)
 from shadow_tpu.telemetry.flows import FLOW_PLANES, FlowRecord
 from shadow_tpu.telemetry.ring import PLANES
 
@@ -78,6 +84,19 @@ class Harvester:
     flow_lost: int = 0            # ring overrun (host drained too late)
     flow_lost_clamp: int = 0      # device window-clamp loss (cumulative)
     flow_sampled: int = 0         # device cumulative sampled count
+    # --- causality planes (telemetry/causality.py), drained in the
+    # same pass: per-host lineage sub-rings (caus_seen is a per-host
+    # count list) plus the replicated window-advance plane. Enabled
+    # latches True the first time a sim with causality passes through.
+    caus_enabled: bool = False
+    caus_seen: list = field(default_factory=list)   # [H] per-host counts
+    caus_records: list = field(default_factory=list)
+    caus_lost: int = 0            # per-host ring overrun total
+    caus_sampled: int = 0         # device cumulative kept (sum of counts)
+    caus_emitted: int = 0         # device cumulative ALL emissions seen
+    adv_seen: int = 0             # advance-plane count at the last drain
+    adv_records: list = field(default_factory=list)
+    adv_lost: int = 0
 
     def mark_escalation(self, esc) -> None:
         self.escalation_marks.append(
@@ -90,6 +109,7 @@ class Harvester:
         restored count are discarded so replayed windows are not
         double-counted."""
         self._drain_flows(sim)
+        self._drain_causality(sim)
         ring = getattr(sim, "telem", None)
         if ring is None:
             return 0
@@ -155,6 +175,74 @@ class Harvester:
         self.flow_seen = c
         return take
 
+    def _drain_causality(self, sim) -> int:
+        """Causality drain: the per-host lineage sub-rings (each host
+        row is its own monotonic ring — overrun and rewind accounting
+        run PER HOST) plus the replicated advance plane (a plain
+        flows-style scalar-count ring). Returns total records taken."""
+        ring = getattr(sim, "causality", None)
+        if ring is None:
+            return 0
+        self.caus_enabled = True
+        counts = np.asarray(ring.count)
+        H = counts.shape[0]
+        F = ring.capacity
+        if len(self.caus_seen) != H:
+            self.caus_seen = [0] * H
+        self.caus_sampled = int(counts.sum())
+        self.caus_emitted = int(np.asarray(ring.seen).sum())
+        taken = 0
+        planes = None
+        for h in range(H):
+            c = int(counts[h])
+            if c < self.caus_seen[h]:
+                self.caus_records = [
+                    r for r in self.caus_records
+                    if not (r.host == h and r.index >= c)]
+                self.caus_seen[h] = c
+            new = c - self.caus_seen[h]
+            if new <= 0:
+                continue
+            if planes is None:
+                # one device_get per plane, shared by every host row
+                planes = [np.asarray(getattr(ring, name))
+                          for name, _ in LINEAGE_PLANES]
+            lost = max(0, new - F)
+            self.caus_lost += lost
+            take = min(new, F)
+            idx = np.arange(c - take, c)
+            slots = idx % F
+            cols = [p[h][slots].tolist() for p in planes]
+            self.caus_records.extend(
+                CausalityRecord(h, *row)
+                for row in zip(idx.tolist(), *cols))
+            self.caus_seen[h] = c
+            taken += take
+        taken += self._drain_advance(ring)
+        return taken
+
+    def _drain_advance(self, ring) -> int:
+        c = int(np.asarray(ring.adv_count))
+        if c < self.adv_seen:
+            self.adv_records = [r for r in self.adv_records
+                                if r.index < c]
+            self.adv_seen = c
+        new = c - self.adv_seen
+        if new <= 0:
+            return 0
+        W = ring.adv_capacity
+        lost = max(0, new - W)
+        self.adv_lost += lost
+        take = min(new, W)
+        idx = np.arange(c - take, c)
+        slots = idx % W
+        cols = [np.asarray(getattr(ring, name))[slots].tolist()
+                for name, _ in ADVANCE_PLANES]
+        self.adv_records.extend(
+            AdvanceRecord(*row) for row in zip(idx.tolist(), *cols))
+        self.adv_seen = c
+        return take
+
     def mean_window_ns(self) -> float | None:
         """Mean harvested window span (wend - wstart) in ns, or None
         when nothing was harvested. Under adaptive_jump this is the
@@ -215,6 +303,14 @@ class Harvester:
             out["flows_harvested"] = len(self.flow_records)
             out["flows_lost_ring"] = int(self.flow_lost)
             out["flows_lost_window_clamp"] = int(self.flow_lost_clamp)
+        if self.caus_enabled:
+            # headline causality accounting — the chains / binding
+            # fan-out is the manifest's top-level "causality" block
+            # (telemetry/causality.causality_manifest_block)
+            out["causality_sampled"] = int(self.caus_sampled)
+            out["causality_harvested"] = len(self.caus_records)
+            out["causality_lost_ring"] = int(self.caus_lost)
+            out["causality_windows_attributed"] = len(self.adv_records)
         return out
 
 

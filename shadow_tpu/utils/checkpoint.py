@@ -305,7 +305,8 @@ class _LoopPlan:
 
 
 def _resolve_loop(bundle, app_handlers, *, end_time, fault_fn, mesh,
-                  mesh_axis, windows_per_dispatch, adaptive_jump):
+                  mesh_axis, windows_per_dispatch, adaptive_jump,
+                  sim=None):
     from shadow_tpu.net.build import _resolve_bulk_fn, _resolve_fault_fn
     from shadow_tpu.net.step import make_step_fn
 
@@ -332,7 +333,15 @@ def _resolve_loop(bundle, app_handlers, *, end_time, fault_fn, mesh,
     p.wpd = wpd
     p.adaptive = (bool(adaptive_jump) if adaptive_jump is not None
                   else bool(getattr(cfg, "adaptive_jump", False)))
-    p.chunked = wpd > 1 or p.adaptive
+    # Causality tracing rides the chunked body even at K=1: the
+    # advance-attribution latch lives in the wend_fn.explain path
+    # (engine.make_chunk_body), not the host-clamped per-window body —
+    # forcing the chunk driver keeps the attribution plane bit-
+    # identical across every windows_per_dispatch, which the K1-vs-K64
+    # identity contract requires (telemetry/causality.py).
+    tracing = (getattr(sim if sim is not None else bundle.sim,
+                       "causality", None) is not None)
+    p.chunked = wpd > 1 or p.adaptive or tracing
     p.shards = 1 if mesh is None else mesh.shape[mesh_axis]
     return p
 
@@ -471,11 +480,11 @@ def prewarm_dispatch(bundle, app_handlers=(), *, end_time=None, sim=None,
     block ({key, hit, compile_s|load_s})."""
     from shadow_tpu.compile.store import default_store
 
+    sim = sim if sim is not None else bundle.sim
     plan = _resolve_loop(bundle, app_handlers, end_time=end_time,
                          fault_fn=None, mesh=mesh, mesh_axis=mesh_axis,
                          windows_per_dispatch=windows_per_dispatch,
-                         adaptive_jump=adaptive_jump)
-    sim = sim if sim is not None else bundle.sim
+                         adaptive_jump=adaptive_jump, sim=sim)
     _, _, key, raw, example = _make_dispatch_fns(
         bundle, plan, sim, app_handlers, mesh=mesh, mesh_axis=mesh_axis,
         exchange_capacity=exchange_capacity, warm=False, store=store,
@@ -583,7 +592,7 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                          fault_fn=fault_fn,
                          mesh=mesh, mesh_axis=mesh_axis,
                          windows_per_dispatch=windows_per_dispatch,
-                         adaptive_jump=adaptive_jump)
+                         adaptive_jump=adaptive_jump, sim=sim)
     cfg, end, min_jump = plan.cfg, plan.end, plan.min_jump
     chunked, wpd, adaptive = plan.chunked, plan.wpd, plan.adaptive
     shards = plan.shards

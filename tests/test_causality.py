@@ -1,0 +1,511 @@
+"""Causal critical-path profiler (telemetry/causality.py): lineage
+sampling is a pure hash of simulated state and appends are row-local,
+so the harvested planes must be bit-identical across shard counts AND
+dispatch chunking with zero collectives; every window latches exactly
+one binding cause; attaching the recorder must never perturb the
+simulation; overflow is counted per host sub-ring, never silent; and
+the full export fan-out (manifest causality block, metric families,
+pid-3 Perfetto tracks, fleet roll-up, critpath report) round-trips
+through the same lint the CI gate runs."""
+
+import jax
+import numpy as np
+import pytest
+from conftest import load_tool
+from jax.sharding import Mesh
+
+from shadow_tpu import telemetry
+from shadow_tpu.apps import phold
+from shadow_tpu.core import simtime
+from shadow_tpu.faults import health as health_mod
+from shadow_tpu.net.build import HostSpec, build, run
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel import run_sharded
+from shadow_tpu.telemetry import causality as caus_mod
+from shadow_tpu.utils import checkpoint
+
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">102400</data><data key="dn">102400</data></node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 8
+
+
+def _phold_bundle(load=2, sim_s=1, seed=7):
+    """Banked PHOLD shape (no bulk pass, so every event runs through
+    the window fixpoint the lineage recorder instruments)."""
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=H, tcp=False,
+                    end_time=sim_s * simtime.ONE_SECOND, seed=seed,
+                    event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    hosts = [HostSpec(name=f"p{i}", proc_start_time=0) for i in range(H)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+@pytest.fixture(scope="module")
+def serial():
+    """Serial PHOLD run through engine.run with every emission
+    sampled, ring sized to hold them all."""
+    b = _phold_bundle()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    b.sim = telemetry.attach_causality(b.sim, sample_period=1,
+                                       capacity=256)
+    sim, stats = jax.device_get(run(b, app_handlers=(phold.handler,)))
+    h = telemetry.Harvester()
+    h.drain(sim)
+    return b, sim, stats, h
+
+
+def test_lineage_records_sane(serial):
+    _, sim, stats, h = serial
+    assert h.caus_enabled
+    recs = h.caus_records
+    assert recs, "period-1 phold sampled no lineage"
+    cz = sim.causality
+    counts = np.asarray(cz.count)
+    seen = np.asarray(cz.seen)
+    # device invariant: kept never exceeds observed, per host
+    assert (counts <= seen).all()
+    # at period 1 every observed emission is kept
+    assert int(counts.sum()) == int(seen.sum()) == h.caus_sampled
+    # host invariant: drained + overrun never exceeds stored
+    assert len(recs) + h.caus_lost <= h.caus_sampled
+    by_host: dict = {}
+    for r in recs:
+        assert 0 <= r.host < H and 0 <= r.dst < H
+        # hops have positive latency; the load injector chains
+        # same-time self events, so equality is legal
+        assert r.t_due >= r.t_emit
+        assert r.depth >= 1           # the parent itself executed
+        by_host.setdefault(r.host, []).append(r.index)
+    # per-host append order is monotone in ring position
+    for idxs in by_host.values():
+        assert idxs == sorted(idxs)
+    # execs is the depth source: per-host events executed on device
+    assert int(np.asarray(cz.execs).sum()) == int(stats.events_processed)
+
+
+def test_causality_bit_identical_shards_and_chunking():
+    """The tentpole contract: sampling hashes simulated state and
+    appends are row-local, so the whole-run megakernel, the K=1 and
+    K=64 chunked drivers, and an 8-shard mesh all store bit-identical
+    causality planes — partitioning is a performance knob, not an
+    attribution knob."""
+    def planes_of(sim):
+        sim = jax.device_get(sim)
+        cz = sim.causality
+        out = {n: np.asarray(getattr(cz, n))
+               for n, _ in caus_mod.LINEAGE_PLANES}
+        out |= {n: np.asarray(getattr(cz, n))
+                for n, _ in caus_mod.ADVANCE_PLANES}
+        out |= {"count": np.asarray(cz.count),
+                "seen": np.asarray(cz.seen),
+                "execs": np.asarray(cz.execs),
+                "adv_count": int(np.asarray(cz.adv_count))}
+        return out
+
+    def bundle():
+        b = _phold_bundle()
+        b.sim = telemetry.attach_causality(b.sim, sample_period=2,
+                                           capacity=128)
+        return b
+
+    sim_run, _ = run(bundle(), app_handlers=(phold.handler,))
+    sim_k1, _, _ = checkpoint.run_windows(
+        bundle(), app_handlers=(phold.handler,))
+    sim_k64, _, _ = checkpoint.run_windows(
+        bundle(), app_handlers=(phold.handler,), windows_per_dispatch=64)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("hosts",))
+    sim_sh, _ = run_sharded(bundle(), mesh, "hosts",
+                            app_handlers=(phold.handler,))
+
+    ref = planes_of(sim_run)
+    assert int(ref["count"].sum()) > 0, "period-2 phold kept nothing"
+    assert ref["adv_count"] > 0
+    # the hash filters some emissions at period 2
+    assert int(ref["count"].sum()) < int(ref["seen"].sum())
+    for name, got in (("K=1", planes_of(sim_k1)),
+                      ("K=64", planes_of(sim_k64)),
+                      ("8-shard", planes_of(sim_sh))):
+        for k, v in ref.items():
+            np.testing.assert_array_equal(
+                v, got[k],
+                err_msg=f"{name}: causality plane {k} diverged")
+
+
+def test_causality_off_is_byte_identical(serial):
+    """sim.causality is None by default and contributes no pytree
+    leaves; attaching the recorder observes the run without perturbing
+    it — every non-causality leaf of the traced run equals the
+    untraced run's."""
+    _, sim_c, stats_c, _ = serial
+    b = _phold_bundle()
+    assert b.sim.causality is None
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    sim0, stats0 = jax.device_get(run(b, app_handlers=(phold.handler,)))
+    assert int(stats0.events_processed) == int(stats_c.events_processed)
+    assert int(stats0.windows) == int(stats_c.windows)
+    flat_c = {jax.tree_util.keystr(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(sim_c)[0]}
+    flat_0 = {jax.tree_util.keystr(p): l for p, l in
+              jax.tree_util.tree_flatten_with_path(sim0)[0]}
+    caus_keys = {k for k in flat_c if ".causality" in k}
+    assert caus_keys and set(flat_c) - caus_keys == set(flat_0)
+    for k in flat_0:
+        np.testing.assert_array_equal(
+            np.asarray(flat_0[k]), np.asarray(flat_c[k]),
+            err_msg=f"{k} perturbed by causality tracing")
+
+
+def test_attach_idempotent_and_validates():
+    b = _phold_bundle()
+    s1 = telemetry.attach_causality(b.sim, sample_period=4, capacity=32)
+    assert s1.causality.capacity == 32
+    assert s1.causality.sample_period == 4
+    assert s1.causality.num_hosts == H
+    assert telemetry.attach_causality(s1, sample_period=8) is s1
+    with pytest.raises(ValueError):
+        caus_mod.CausalityState.create(H, capacity=0)
+    with pytest.raises(ValueError):
+        caus_mod.CausalityState.create(H, sample_period=0)
+    with pytest.raises(ValueError):
+        caus_mod.CausalityState.create(H, adv_capacity=0)
+
+
+def test_overflow_accounting_saturated_ring():
+    """Sub-rings far smaller than the emission volume must overrun
+    loudly: per-host kept counts keep growing past capacity, the
+    harvester reports the loss, and the manifest lint warns (never
+    errors) about it."""
+    b = _phold_bundle()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    b.sim = telemetry.attach_causality(b.sim, sample_period=1,
+                                       capacity=2)
+    sim, stats = jax.device_get(run(b, app_handlers=(phold.handler,)))
+    counts = np.asarray(sim.causality.count)
+    assert int(counts.max()) > 2       # some row actually saturated
+    h = telemetry.Harvester()
+    h.drain(sim)
+    assert len(h.caus_records) <= H * 2
+    assert h.caus_lost > 0
+    assert len(h.caus_records) + h.caus_lost == h.caus_sampled
+    blk = caus_mod.causality_manifest_block(
+        h, num_hosts=H, shards=1, sample_period=1)
+    assert blk["harvested"] + blk["lost_ring"] == blk["sampled"]
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, causality=blk)
+    lint = load_tool("telemetry_lint")
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert any("lineage" in w for w in warns)
+
+
+def test_binding_cause_attribution(serial):
+    """On the static single-vertex shape every window is sized by the
+    min-jump floor (bar a terminal end-time clamp): the advance plane
+    attributes every window, exactly once, to a known cause."""
+    _, _, stats, h = serial
+    advs = h.adv_records
+    assert len(advs) == int(stats.windows)
+    causes = caus_mod.binding_histogram(advs)
+    assert set(causes) <= set(caus_mod.CAUSE_NAMES)
+    assert sum(causes.values()) == len(advs)
+    assert causes.get("min_jump_floor", 0) > 0
+    # no adaptive jump -> no binding edges
+    assert caus_mod.binding_edges(advs) == {}
+    for r in advs:
+        assert r.jump > 0              # windows always advance
+        assert 0 <= r.cause < len(caus_mod.CAUSE_NAMES)
+        if r.raw > 0:
+            assert r.jump <= r.raw     # clamps only lower
+            assert 0 <= r.utilization_pct <= 100
+        assert 0 <= r.active <= H      # the global census, not local
+
+
+def test_critical_chains_reconstruction():
+    """Hand-built lineage: parent->key joins chain only where the
+    times agree, chains come out longest-first and root-first, and
+    composition tables sum to the length."""
+    R = caus_mod.CausalityRecord
+
+    def rec(host, idx, key, parent, t_emit, t_due, depth=1):
+        return R(host=host, index=idx, key=key, parent=parent, dst=0,
+                 kind=3, depth=depth, t_emit=t_emit, t_due=t_due)
+
+    chain = [rec(0, 0, key=11, parent=99, t_emit=0, t_due=10, depth=1),
+             rec(1, 0, key=22, parent=11, t_emit=10, t_due=20, depth=1),
+             rec(0, 1, key=33, parent=22, t_emit=20, t_due=30, depth=2)]
+    # same keys, but the time join is broken: NOT part of the chain
+    stray = rec(2, 0, key=44, parent=11, t_emit=11, t_due=21)
+    orphan = rec(3, 0, key=55, parent=77, t_emit=5, t_due=6)
+    chains = caus_mod.critical_chains(
+        [stray, orphan] + chain, top_k=5)
+    assert [c["length"] for c in chains] == [3, 1, 1]
+    top = chains[0]
+    assert top["span_ns"] == 30
+    assert top["hosts"] == 2
+    assert top["per_host"] == {"0": 2, "1": 1}
+    assert top["per_kind"] == {"3": 3}
+    assert [e["key"] for e in top["events"]] == [11, 22, 33]  # root first
+    # consecutive join invariant the lint enforces
+    for a, b in zip(top["events"], top["events"][1:]):
+        assert b["t_emit"] == a["t_due"]
+    # max_events truncates towards the head (latest events kept)
+    short = caus_mod.critical_chains(chain, top_k=1, max_events=2)[0]
+    assert short["length"] == 3
+    assert [e["key"] for e in short["events"]] == [22, 33]
+
+
+def test_manifest_metrics_trace_roundtrip(serial, tmp_path):
+    """The full export fan-out from one harvest: manifest causality
+    block, causality metric families, pid-3 Perfetto tracks — all pass
+    the CI lint through the same entrypoints the CLI uses."""
+    b, sim, stats, h = serial
+    blk = caus_mod.causality_manifest_block(
+        h, num_hosts=H, shards=1, sample_period=1)
+    assert blk["sampled"] == h.caus_sampled
+    assert blk["harvested"] == len(h.caus_records)
+    assert blk["windows_attributed"] == int(stats.windows)
+    assert len(blk["advances"]) == blk["windows_attributed"]
+    assert blk["chains"], "period-1 phold reconstructed no chains"
+    assert blk["chains"][0]["length"] > 1, (
+        "full sampling must join at least one parent->child edge")
+    assert sum(sum(row) for row in blk["traffic_matrix"]) \
+        == blk["cross_host_harvested"]
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, wall_seconds=1.0,
+                                 causality=blk)
+    trace = telemetry.chrome_trace(h.records, num_shards=1,
+                                   adv_records=h.adv_records,
+                                   chains=blk["chains"])
+    evs = trace["traceEvents"]
+    assert {e.get("pid") for e in evs if e.get("ph") == "X"} >= {0, 3}
+    counters = [e for e in evs if e.get("ph") == "C"]
+    assert len(counters) == len(h.adv_records)
+    lint = load_tool("telemetry_lint")
+    errs, warns = lint.lint_manifest_obj(man)
+    assert errs == []
+    assert warns == []
+    errs, _ = lint.lint_trace_obj(trace)
+    assert errs == []
+    metrics = telemetry.metrics_from_manifest(man)
+    assert metrics["causality_sampled"] == blk["sampled"]
+    assert metrics["causality_harvested"] == blk["harvested"]
+    assert metrics["window_binding_cause"] == blk["causes"]
+    assert metrics["critical_chain_len_max"] \
+        == max(c["length"] for c in blk["chains"])
+    prom = telemetry.prometheus_text(metrics)
+    assert "shadow_tpu_causality_sampled" in prom
+    assert 'shadow_tpu_window_binding_cause{key="min_jump_floor"}' \
+        in prom
+    # and the files the CLI writes lint clean end to end
+    tp, mp = str(tmp_path / "t.json"), str(tmp_path / "m.json")
+    telemetry.write_trace(tp, h.records, None, 1,
+                          adv_records=h.adv_records,
+                          chains=blk["chains"])
+    telemetry.write_manifest(mp, man)
+    assert lint.main(["--trace", tp, "--manifest", mp, "-q"]) == 0
+
+
+def test_lint_rejects_corrupt_causality_block(serial):
+    """The lint actually bites: breaking each causality invariant
+    turns a clean manifest into an error."""
+    b, sim, stats, h = serial
+    lint = load_tool("telemetry_lint")
+
+    def man_with(mut):
+        blk = caus_mod.causality_manifest_block(
+            h, num_hosts=H, shards=1, sample_period=1)
+        mut(blk)
+        return telemetry.run_manifest(
+            cfg=b.cfg, seed=1, shards=1, sim=sim, stats=stats,
+            health=health_mod.gather(sim), causality=blk)
+
+    def bump_cause(blk):
+        k = next(iter(blk["causes"]))
+        blk["causes"][k] += 1        # sum != windows_attributed
+
+    def unknown_cause(blk):
+        blk["causes"]["gremlins"] = blk["causes"].pop(
+            next(iter(blk["causes"])))
+
+    def jump_past_raw(blk):
+        a = blk["advances"][0]
+        a["raw"] = max(1, a["jump"] - 1)   # jump exceeds the lookahead
+
+    def break_chain_depth(blk):
+        ch = blk["chains"][0]
+        # two same-host events with non-increasing depth
+        ev = ch["events"][0]
+        same = dict(ev, t_emit=ev["t_due"], t_due=ev["t_due"] + 1,
+                    key=ev["key"] ^ 1)
+        ch["events"] = [ev, same]
+        ch["length"] = 2
+        ch["per_host"] = {str(ev["host"]): 2}
+        ch["per_kind"] = {str(ev["kind"]): 2}
+        ch["hosts"] = 1
+
+    def bad_matrix(blk):
+        blk["traffic_matrix"][0][0] += 1
+
+    for mut in (bump_cause, unknown_cause, jump_past_raw,
+                break_chain_depth, bad_matrix):
+        errs, _ = lint.lint_manifest_obj(man_with(mut))
+        assert errs, \
+            f"lint passed a manifest corrupted by {mut.__name__}"
+
+
+def test_critpath_speed_of_light_report(serial, tmp_path):
+    """tools/critpath.py on the banked PHOLD shape: floors from the
+    run's own unit costs, window cohorts naming the binding constraint,
+    ranked reasons — and a hard exit on an untraced manifest."""
+    import json
+
+    b, sim, stats, h = serial
+    blk = caus_mod.causality_manifest_block(
+        h, num_hosts=H, shards=1, sample_period=1)
+    timers = telemetry.PhaseTimers()
+    with timers.phase("device-execute"):
+        pass
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, wall_seconds=0.5,
+                                 timers=timers, causality=blk)
+    crit = load_tool("critpath")
+    report = crit.analyze(man)
+    assert report["windows"] == int(stats.windows)
+    cohorts = report["window_cohorts"]
+    assert cohorts, "no window cohorts on an attributed run"
+    assert {c["cause"] for c in cohorts} <= set(caus_mod.CAUSE_NAMES)
+    assert sum(c["windows"] for c in cohorts) == len(h.adv_records)
+    # the dominant cohort leads and names its lever
+    assert cohorts[0]["windows"] == max(c["windows"] for c in cohorts)
+    assert cohorts[0]["lever"]
+    assert report["reasons"]
+    assert report["critical_chain_len"] \
+        == max(c["length"] for c in blk["chains"])
+    text = crit.render(report)
+    assert "window cohorts" in text and cohorts[0]["cause"] in text
+    # CLI: traced manifest -> 0, untraced -> 1
+    mp = str(tmp_path / "man.json")
+    with open(mp, "w") as f:
+        json.dump(man, f)
+    assert crit.main([mp]) == 0
+    assert crit.main([mp, "--json"]) == 0
+    bare = dict(man)
+    bare.pop("causality")
+    mp2 = str(tmp_path / "bare.json")
+    with open(mp2, "w") as f:
+        json.dump(bare, f)
+    assert crit.main([mp2]) == 1
+
+
+def test_trace_view_window_advance_section(serial):
+    """tools/trace_view.py prints the window-advance story from the
+    manifest: accounting, binding-cause table, utilization line."""
+    b, sim, stats, h = serial
+    blk = caus_mod.causality_manifest_block(
+        h, num_hosts=H, shards=1, sample_period=1)
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 harvester=h, causality=blk)
+    trace = telemetry.chrome_trace(h.records, num_shards=1)
+    tv = load_tool("trace_view")
+    out = tv.summarize(trace, man)
+    assert "windows attributed" in out
+    assert "binding cause:" in out
+    assert "min_jump_floor" in out
+    assert "lookahead utilization" in out
+
+
+def test_wall_phase_seconds_metric():
+    """Satellite: wall-clock phase totals surface as the
+    wall_phase_seconds metric family, one keyed entry per phase."""
+    b = _phold_bundle()
+    b.sim = telemetry.attach(b.sim, capacity=256)
+    sim, stats = jax.device_get(run(b, app_handlers=(phold.handler,)))
+    timers = telemetry.PhaseTimers()
+    with timers.phase("device-execute"):
+        pass
+    with timers.phase("harvest"):
+        pass
+    man = telemetry.run_manifest(cfg=b.cfg, seed=b.cfg.seed, shards=1,
+                                 sim=sim, stats=stats,
+                                 health=health_mod.gather(sim),
+                                 timers=timers)
+    assert set(man["wall_phases_s"]) == {"device-execute", "harvest"}
+    metrics = telemetry.metrics_from_manifest(man)
+    assert metrics["wall_phase_seconds"] == man["wall_phases_s"]
+    prom = telemetry.prometheus_text(metrics)
+    assert 'shadow_tpu_wall_phase_seconds{key="device-execute"}' in prom
+    assert 'shadow_tpu_wall_phase_seconds{key="harvest"}' in prom
+
+
+def test_fleet_causality_rollup_and_lint(tmp_path):
+    """Jobs that sampled causality surface per-job summaries plus a
+    derived fleet-level totals block; the lint re-derives the totals
+    so a mismatch is an error, not a dashboard surprise."""
+    import json
+
+    from shadow_tpu.fleet import manifest as manifest_mod
+    from shadow_tpu.fleet import spec as spec_mod
+    from shadow_tpu.fleet import state as state_mod
+
+    def caus_summary(n, w, cause):
+        return {"sample_period": 4, "sampled": n, "harvested": n,
+                "lost_ring": 0, "windows_attributed": w,
+                "windows_lost": 0, "causes": {cause: w}}
+
+    pol = spec_mod.FleetPolicy(max_attempts=2, backoff_base_s=0.0,
+                               backoff_cap_s=0.0)
+    q = state_mod.FleetQueue(
+        str(tmp_path), pol,
+        [spec_mod.JobSpec(id=j, seed=i, causality_sample=4)
+         for i, j in enumerate(("ca", "cb"))],
+        fsync=False, now=lambda: 100.0)
+    q.lease("ca", "w0")
+    q.complete("ca", {"ok": True,
+                      "causality": caus_summary(10, 4,
+                                                "min_jump_floor")})
+    q.lease("cb", "w0")
+    q.complete("cb", {"ok": True,
+                      "causality": caus_summary(6, 3, "end_time")})
+    man = manifest_mod.fleet_manifest(q, complete=True)
+    q.close()
+    assert man["jobs"]["ca"]["causality"]["sampled"] == 10
+    assert man["causality"]["jobs"] == 2
+    assert man["causality"]["sampled"] == 16
+    assert man["causality"]["windows_attributed"] == 7
+    assert man["causality"]["causes"] == {"min_jump_floor": 4,
+                                          "end_time": 3}
+    lint = load_tool("telemetry_lint")
+    errs, _ = lint.lint_fleet_manifest_obj(man)
+    assert errs == []
+    # totals that disagree with the per-job entries are an error
+    bad = json.loads(json.dumps(man))
+    bad["causality"]["sampled"] = 999
+    errs, _ = lint.lint_fleet_manifest_obj(bad)
+    assert errs
+    # ...and so is dropping the roll-up while jobs carry causality
+    bad = json.loads(json.dumps(man))
+    del bad["causality"]
+    errs, _ = lint.lint_fleet_manifest_obj(bad)
+    assert errs
+    # spec knob validation: negative sampling is rejected up front
+    with pytest.raises(ValueError):
+        spec_mod.JobSpec(id="x", causality_sample=-1)

@@ -158,6 +158,19 @@ def main(argv=None) -> int:
                   f"retraced {res['retraces']} time(s)",
                   file=sys.stderr)
             failed = 1
+    # causality-overhead rows (bench.py BENCH_CAUSALITY_OVERHEAD)
+    # carry the A/B cost of the lineage recorder; tolerate absence
+    # (rounds without the knob bank no such field) but gate the bound:
+    # the profiler must stay under 5% of events/s at its default
+    # sampling or it is not an always-on-able instrument
+    for r in new_rows:
+        ov = r.get("causality_overhead_pct")
+        if isinstance(ov, (int, float)) and not isinstance(ov, bool) \
+                and ov > 5.0:
+            print(f"bench_regress: {r['metric']}: causality tracing "
+                  f"costs {ov}% events/s (>5% bound)",
+                  file=sys.stderr)
+            failed = 1
     for c in comparisons:
         tag = "REGRESSION" if c in regressions else "ok"
         print(f"{tag}: {c['metric']} [{c['backend']}] "

@@ -1,0 +1,275 @@
+#!/usr/bin/env python3
+"""Speed-of-light analysis of a run manifest — "how fast COULD this
+run have gone, and what is in the way".
+
+Reads a run_manifest.json whose run sampled causality
+(--causality-sample; telemetry/causality.py) and derives three lower
+bounds on wallclock from measured per-unit costs:
+
+- **dispatch floor**: dispatches x measured per-dispatch wall cost.
+  The windowed-PDES tax — every barrier costs one host round trip, so
+  fewer/larger windows (chunking, adaptive jump) shrink this floor.
+- **window floor**: windows x the best-case per-window device cost
+  (derived from the device-execute phase over the windows that ran).
+  This is the conservative-synchronization cost of the window count
+  the binding constraints produced.
+- **chain floor**: longest critical chain length x measured per-event
+  cost. Causally-serialized events cannot be batched into one window
+  pass no matter how windows are sized — the hard serial residue.
+
+The report names the binding constraint per window cohort (windows
+grouped by their latched binding cause), the top reasons the run sits
+above its speed-of-light, and the levers that attack each one. Exits
+non-zero when the manifest is unusable, zero otherwise (the report is
+an analysis, not a gate).
+
+Usage: critpath.py run_manifest.json [--json] [--top N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# lever text per binding cause: what an operator does about a cohort
+# of windows bound by this constraint (telemetry/causality.py
+# CAUSE_NAMES order)
+_LEVERS = {
+    "min_jump_floor": "raise the topology's minimum latency edge or "
+                      "--runahead (the static floor IS the window "
+                      "size); --adaptive-jump lets fault plans that "
+                      "raise latencies grow windows past it",
+    "adaptive_edge": "the live latency table's minimum edge binds — "
+                     "co-locate or slow the named vertex pair, or "
+                     "shard so the binding edge stays shard-local",
+    "fault_record": "windows clamp to fault-plan record times — "
+                    "coalesce fault records or batch them away from "
+                    "the hot window range",
+    "inject_horizon": "windows clamp to the injection staging "
+                      "horizon — raise --inject-lanes (deeper "
+                      "staging) or pre-sort the trace so refills "
+                      "cover longer spans",
+    "end_time": "windows clamp to end_time (run tail) — benign",
+}
+
+
+def _get(d: dict, *keys, default=None):
+    cur = d
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return default
+        cur = cur[k]
+    return cur
+
+
+def analyze(man: dict, top: int = 3) -> dict:
+    """The speed-of-light report object for one run manifest."""
+    cz = man.get("causality")
+    if not isinstance(cz, dict):
+        raise ValueError(
+            'manifest has no "causality" block — run with '
+            "--causality-sample N (tools/critpath.py reads the "
+            "lineage/attribution planes it produces)")
+    ctr = man.get("counters") or {}
+    windows = int(ctr.get("windows", 0) or 0)
+    events = int(ctr.get("events_processed", 0) or 0)
+    wall = man.get("wall_seconds")
+    phases = man.get("wall_phases_s") or {}
+    # device time: prefer the execute phase (excludes trace/compile);
+    # fall back to total wall minus compile-ish phases, then to wall
+    device_s = None
+    for k in ("device-execute", "supervised-run", "window-loop"):
+        if isinstance(phases.get(k), (int, float)):
+            device_s = float(phases[k])
+            break
+    if device_s is None and isinstance(wall, (int, float)):
+        device_s = float(wall)
+
+    disp = man.get("dispatch") or {}
+    dispatches = int(disp.get("dispatches", 0) or 0)
+    if not dispatches and windows:
+        wpd = max(1, int(disp.get("windows_per_dispatch", 1) or 1))
+        dispatches = (windows + wpd - 1) // wpd
+
+    report: dict = {
+        "windows": windows,
+        "events": events,
+        "wall_seconds": wall,
+        "device_seconds": device_s,
+    }
+
+    # measured unit costs — these make the floors empirical, not
+    # guesses: the run's own realized cost per dispatch / window /
+    # event is the best available "speed of light" for THIS program
+    # on THIS backend
+    per_dispatch_s = (device_s / dispatches
+                      if device_s and dispatches else None)
+    per_window_s = device_s / windows if device_s and windows else None
+    per_event_s = device_s / events if device_s and events else None
+    chains = cz.get("chains") or []
+    chain_len = max((int(c.get("length", 0) or 0) for c in chains),
+                    default=0)
+
+    floors: dict = {}
+    if per_dispatch_s is not None:
+        floors["dispatch_floor_s"] = round(
+            dispatches * per_dispatch_s, 6)
+    if per_window_s is not None:
+        floors["window_floor_s"] = round(windows * per_window_s, 6)
+    if per_event_s is not None and chain_len:
+        # the chain is sampled at 1-in-P: a sampled chain of length L
+        # witnesses >= L causally-serialized executions
+        floors["chain_floor_s"] = round(chain_len * per_event_s, 9)
+    report["unit_costs"] = {
+        k: v for k, v in (("per_dispatch_s", per_dispatch_s),
+                          ("per_window_s", per_window_s),
+                          ("per_event_s", per_event_s))
+        if v is not None}
+    report["floors"] = floors
+    report["critical_chain_len"] = chain_len
+
+    # window cohorts by binding cause: each cohort's share of the
+    # window count is its share of the window floor — the attribution
+    # that turns "too many windows" into "THESE constraints made them"
+    causes = cz.get("causes") or {}
+    attributed = int(cz.get("windows_attributed", 0) or 0)
+    cohorts = []
+    for name, n in sorted(causes.items(), key=lambda kv: -kv[1]):
+        c: dict = {"cause": name, "windows": int(n)}
+        if attributed:
+            c["share_pct"] = int(n) * 100 // attributed
+        if per_window_s is not None:
+            c["floor_s"] = round(int(n) * per_window_s, 6)
+        if name in _LEVERS:
+            c["lever"] = _LEVERS[name]
+        cohorts.append(c)
+    report["window_cohorts"] = cohorts
+
+    # top reasons the run sits above its floors, ranked: dominant
+    # binding cause first, then low lookahead utilization, then idle
+    # lanes — each names its evidence and its lever
+    reasons = []
+    if cohorts:
+        lead = cohorts[0]
+        reasons.append({
+            "reason": f"windows bound by {lead['cause']}",
+            "evidence": f"{lead['windows']} of {attributed} "
+                        f"attributed window(s) "
+                        f"({lead.get('share_pct', 0)}%)",
+            "lever": lead.get("lever", ""),
+        })
+    ju = cz.get("jump_utilization_pct") or {}
+    if isinstance(ju.get("p50"), int) and ju["p50"] < 100:
+        reasons.append({
+            "reason": "realized jumps below the available lookahead",
+            "evidence": f"jump utilization p50={ju['p50']}% "
+                        f"p95={ju.get('p95')}% — clamps (fault "
+                        f"records, injection horizon, end time) "
+                        f"shrink windows the latency tables would "
+                        f"allow",
+            "lever": "remove or batch the clamping constraint named "
+                     "by the cohort table",
+        })
+    il = cz.get("idle_lane_pct") or {}
+    if isinstance(il.get("p50"), int) and il["p50"] > 0:
+        reasons.append({
+            "reason": "idle lanes at the window barrier",
+            "evidence": f"idle-lane fraction p50={il['p50']}% "
+                        f"p95={il.get('p95')}% — the global window "
+                        f"waits on its busiest host while these sit "
+                        f"idle",
+            "lever": "rebalance load across hosts, or pack more "
+                     "tenants per program (fleet packed jobs) so "
+                     "idle rows do someone's work",
+        })
+    edges = cz.get("edges") or {}
+    if edges:
+        (ek, en), = sorted(edges.items(), key=lambda kv: -kv[1])[:1]
+        reasons.append({
+            "reason": f"latency edge {ek} repeatedly binds the "
+                      f"adaptive window",
+            "evidence": f"{en} window(s) sized by {ek}",
+            "lever": _LEVERS["adaptive_edge"],
+        })
+    if chain_len and windows and chain_len >= windows:
+        reasons.append({
+            "reason": "causally-serialized event chain spans the run",
+            "evidence": f"critical chain of {chain_len} event(s) vs "
+                        f"{windows} window(s) — at least one event "
+                        f"per window is forced serial",
+            "lever": "this is the hard serial residue — only a "
+                     "faster per-event step (kernel work) attacks it",
+        })
+    report["reasons"] = reasons[:top]
+
+    # headroom: measured device time over the tightest floor
+    best = max(floors.values(), default=None)
+    if best and device_s:
+        report["headroom_pct"] = max(
+            0, round((device_s - best) * 100.0 / device_s, 1))
+    return report
+
+
+def render(report: dict) -> str:
+    lines = []
+    w = report.get("windows")
+    lines.append(
+        f"run: {w} window(s), {report.get('events')} event(s), "
+        f"device {report.get('device_seconds')}s "
+        f"(wall {report.get('wall_seconds')}s)")
+    fl = report.get("floors") or {}
+    if fl:
+        lines.append("speed-of-light floors: " + "  ".join(
+            f"{k}={v}s" for k, v in sorted(fl.items())))
+    if report.get("headroom_pct") is not None:
+        lines.append(f"headroom above tightest floor: "
+                     f"{report['headroom_pct']}%")
+    coh = report.get("window_cohorts") or []
+    if coh:
+        lines.append("window cohorts (binding constraint -> windows):")
+        for c in coh:
+            lines.append(
+                f"  {c['cause']:<16} {c['windows']:>8} window(s) "
+                f"({c.get('share_pct', 0)}%)"
+                + (f"  floor {c['floor_s']}s" if "floor_s" in c
+                   else ""))
+    if report.get("critical_chain_len"):
+        lines.append(f"longest sampled critical chain: "
+                     f"{report['critical_chain_len']} event(s)")
+    for i, r in enumerate(report.get("reasons") or [], 1):
+        lines.append(f"reason {i}: {r['reason']}")
+        lines.append(f"  evidence: {r['evidence']}")
+        if r.get("lever"):
+            lines.append(f"  lever: {r['lever']}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="speed-of-light analysis of a causality-traced "
+                    "run manifest")
+    ap.add_argument("manifest", help="run_manifest.json path")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--top", type=int, default=3,
+                    help="reasons to rank (default 3)")
+    args = ap.parse_args(argv)
+    try:
+        with open(args.manifest) as f:
+            man = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: {args.manifest}: {e}", file=sys.stderr)
+        return 1
+    try:
+        report = analyze(man, top=args.top)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(report, indent=1, sort_keys=True)
+          if args.json else render(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -7,12 +7,13 @@ Checks:
 
 - Trace JSON (--trace): Chrome Trace Event Format schema — top-level
   {"traceEvents": [...]}; every event carries "ph"; "X" (complete)
-  events carry numeric ts/dur with dur > 0 and int pid/tid; "M"
-  (metadata) events carry the known metadata names; window events'
-  args hold the per-window counters with sane values (events >= 0,
-  qocc_min <= qocc_max); sim-time windows are sorted by ts and
-  non-overlapping (warns otherwise — a ring overrun leaves gaps,
-  which are legal).
+  events carry numeric ts/dur with dur > 0 and int pid/tid; "C"
+  (counter) events carry a name, numeric ts and a non-empty args
+  series; "M" (metadata) events carry the known metadata names;
+  window events' args hold the per-window counters with sane values
+  (events >= 0, qocc_min <= qocc_max); sim-time windows are sorted by
+  ts and non-overlapping (warns otherwise — a ring overrun leaves
+  gaps, which are legal).
 - Manifest JSON (--manifest): required identity keys present
   (config_hash, seed, shards, counters); the telemetry block's
   records_lost is SURFACED — a nonzero loss count without a matching
@@ -30,6 +31,12 @@ Checks:
   run-total latch counters exactly, and every quarantined lane must
   name its trips and (when the supervisor's lane surgery ran) its
   salvage pointer + requeue context.
+  The optional "causality" block (causal critical-path profiling)
+  must conserve its sampling accounting (harvested + lost_ring <=
+  sampled <= emitted), its binding-cause counts must cover the
+  attributed windows exactly, its chains must be time-contiguous with
+  same-host depth strictly increasing, and its traffic matrix must
+  agree with the flow recorder's on a lossless equal-period run.
 
 - Fleet manifest JSON (--fleet-manifest): shadow_tpu/fleet schema —
   attempt histories monotone non-decreasing with attempts at the
@@ -354,6 +361,281 @@ def _lint_flows(fl, ctr, tel) -> tuple[list, list]:
     return errors, warnings
 
 
+# binding-cause names (telemetry/causality.py CAUSE_NAMES) —
+# duplicated literally so the lint stays importable without jax
+_CAUSE_NAMES = {"min_jump_floor", "adaptive_edge", "fault_record",
+                "inject_horizon", "end_time"}
+_BINDING_EDGE_KEY = re.compile(r"^v\d+->v\d+$")
+
+
+def _lint_causality(cz, tel, flows) -> tuple[list, list]:
+    """(errors, warnings) for a manifest's "causality" block
+    (telemetry/causality.py causality_manifest_block). The invariants:
+    every sampled emission was appended to its per-host sub-ring, so
+    the harvester splits sampled into pulled-or-overrun (harvested +
+    lost_ring <= sampled; < only after a checkpoint rewind discarded
+    replayed records); the device kept at most what it saw (sampled <=
+    emitted); every attributed window carries exactly one binding
+    cause (cause counts sum to windows_attributed); chains are
+    time-ordered with same-host depth strictly increasing; and the
+    lineage traffic matrix agrees with the flow recorder's when both
+    ran lossless at the same sampling period."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(cz, dict):
+        return (["causality must be an object"], [])
+    for k in ("sample_period", "path_shards"):
+        v = cz.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 1:
+            errors.append(f"causality.{k} must be an integer >= 1, "
+                          f"got {v!r}")
+    counts = {}
+    for k in ("sampled", "emitted", "harvested", "lost_ring",
+              "cross_host_harvested", "windows_attributed",
+              "windows_lost"):
+        v = cz.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"causality.{k} must be a non-negative "
+                          f"integer, got {v!r}")
+        else:
+            counts[k] = v
+    if len(counts) == 7:
+        if counts["sampled"] > counts["emitted"]:
+            errors.append(
+                f"causality: sampled={counts['sampled']} exceeds "
+                f"emitted={counts['emitted']} — the recorder cannot "
+                f"keep more emissions than it observed")
+        if counts["harvested"] + counts["lost_ring"] \
+                > counts["sampled"]:
+            errors.append(
+                f"causality: harvested={counts['harvested']} + "
+                f"lost_ring={counts['lost_ring']} exceeds sampled="
+                f"{counts['sampled']} — every sampled emission is "
+                f"appended exactly once")
+        if counts["cross_host_harvested"] > counts["harvested"]:
+            errors.append(
+                f"causality: cross_host_harvested="
+                f"{counts['cross_host_harvested']} exceeds harvested="
+                f"{counts['harvested']}")
+        if counts["lost_ring"]:
+            warnings.append(
+                f"{counts['lost_ring']} lineage record(s) lost to "
+                f"ring overrun (raise --causality-capacity or the "
+                f"sample period) — chains may be truncated")
+        if counts["windows_lost"]:
+            warnings.append(
+                f"{counts['windows_lost']} window attribution(s) "
+                f"lost to advance-ring overrun")
+    if isinstance(tel, dict) \
+            and tel.get("causality_sampled") is not None:
+        for mk, ck in (("causality_sampled", "sampled"),
+                       ("causality_harvested", "harvested"),
+                       ("causality_lost_ring", "lost_ring"),
+                       ("causality_windows_attributed",
+                        "windows_attributed")):
+            if (isinstance(tel.get(mk), int)
+                    and isinstance(cz.get(ck), int)
+                    and tel[mk] != cz[ck]):
+                errors.append(
+                    f"telemetry.{mk}={tel[mk]} disagrees with "
+                    f"causality.{ck}={cz[ck]} — one harvester fills "
+                    f"both blocks, they cannot diverge")
+    # binding-cause histogram: every attributed window has exactly one
+    # cause, so the counts must cover windows_attributed exactly
+    causes = cz.get("causes")
+    cause_total = 0
+    if causes is not None:
+        if not isinstance(causes, dict):
+            errors.append("causality.causes must be an object")
+            causes = {}
+        for name, n in sorted(causes.items()):
+            if name not in _CAUSE_NAMES:
+                errors.append(f"causality.causes[{name!r}]: unknown "
+                              f"binding cause (expected one of "
+                              f"{sorted(_CAUSE_NAMES)})")
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                errors.append(f"causality.causes[{name!r}] must be an "
+                              f"integer >= 1 (empty causes are "
+                              f"omitted)")
+            else:
+                cause_total += n
+        if isinstance(cz.get("windows_attributed"), int) \
+                and cause_total != cz["windows_attributed"]:
+            errors.append(
+                f"causality.causes cover {cause_total} window(s) but "
+                f"windows_attributed={cz['windows_attributed']} — "
+                f"every attributed window has exactly one binding "
+                f"cause")
+    edges = cz.get("edges")
+    edge_total = 0
+    if edges is not None:
+        if not isinstance(edges, dict):
+            errors.append("causality.edges must be an object")
+            edges = {}
+        for key, n in sorted(edges.items()):
+            if not _BINDING_EDGE_KEY.match(key):
+                errors.append(f'causality.edges key {key!r} must look '
+                              f'like "v<a>->v<b>"')
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                errors.append(f"causality.edges[{key!r}] must be an "
+                              f"integer >= 1")
+            else:
+                edge_total += n
+        adaptive = (causes or {}).get("adaptive_edge", 0)
+        if isinstance(adaptive, int) and edge_total > adaptive:
+            errors.append(
+                f"causality.edges cover {edge_total} window(s) but "
+                f"only {adaptive} window(s) were adaptive-edge bound "
+                f"— a binding edge exists only where the live table "
+                f"was the constraint")
+    # per-window advance records: one per attributed window, each
+    # jump within its unclamped lookahead
+    advances = cz.get("advances")
+    if advances is not None:
+        if not isinstance(advances, list):
+            errors.append("causality.advances must be an array")
+            advances = []
+        if isinstance(cz.get("windows_attributed"), int) \
+                and len(advances) != cz["windows_attributed"]:
+            errors.append(
+                f"causality.advances holds {len(advances)} record(s) "
+                f"but windows_attributed={cz['windows_attributed']}")
+        for i, a in enumerate(advances):
+            where = f"causality.advances[{i}]"
+            if not isinstance(a, dict):
+                errors.append(f"{where}: must be an object")
+                continue
+            if a.get("cause") not in _CAUSE_NAMES:
+                errors.append(f"{where}: unknown cause "
+                              f"{a.get('cause')!r}")
+            for k in ("jump", "raw"):
+                v = a.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append(f"{where}: {k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            if isinstance(a.get("jump"), int) \
+                    and isinstance(a.get("raw"), int) \
+                    and a["raw"] > 0 and a["jump"] > a["raw"]:
+                errors.append(
+                    f"{where}: jump={a['jump']} exceeds the unclamped "
+                    f"lookahead raw={a['raw']} — clamps only shrink "
+                    f"windows")
+            u = a.get("utilization_pct")
+            if u is not None and (not isinstance(u, int)
+                                  or isinstance(u, bool)
+                                  or not 0 <= u <= 100):
+                errors.append(f"{where}: utilization_pct must be an "
+                              f"integer in [0, 100], got {u!r}")
+    # critical chains: root-first, time-contiguous joins (child t_emit
+    # == parent t_due), same-host depth strictly increasing
+    for ci, ch in enumerate(cz.get("chains") or []):
+        where = f"causality.chains[{ci}]"
+        if not isinstance(ch, dict):
+            errors.append(f"{where}: must be an object")
+            continue
+        ln = ch.get("length")
+        if not isinstance(ln, int) or isinstance(ln, bool) or ln < 1:
+            errors.append(f"{where}: length must be an integer >= 1")
+            continue
+        span = ch.get("span_ns")
+        if not isinstance(span, int) or isinstance(span, bool) \
+                or span < 0:
+            errors.append(f"{where}: span_ns must be a non-negative "
+                          f"integer, got {span!r}")
+        ph = ch.get("per_host") or {}
+        if isinstance(ph, dict) and ph \
+                and sum(ph.values()) != ln:
+            errors.append(f"{where}: per_host counts sum to "
+                          f"{sum(ph.values())} but length={ln}")
+        pk = ch.get("per_kind") or {}
+        if isinstance(pk, dict) and pk \
+                and sum(pk.values()) != ln:
+            errors.append(f"{where}: per_kind counts sum to "
+                          f"{sum(pk.values())} but length={ln}")
+        evs = ch.get("events") or []
+        if not isinstance(evs, list) or len(evs) > ln:
+            errors.append(f"{where}: events must be an array of at "
+                          f"most length={ln} records (tail-truncated)")
+            continue
+        depth_of: dict = {}
+        for ei, ev in enumerate(evs):
+            w2 = f"{where}.events[{ei}]"
+            if not isinstance(ev, dict):
+                errors.append(f"{w2}: must be an object")
+                continue
+            if isinstance(ev.get("t_emit"), int) \
+                    and isinstance(ev.get("t_due"), int) \
+                    and ev["t_due"] < ev["t_emit"]:
+                errors.append(f"{w2}: t_due={ev['t_due']} precedes "
+                              f"t_emit={ev['t_emit']} — an event "
+                              f"cannot be due before it was emitted")
+            if ei > 0 and isinstance(evs[ei - 1], dict):
+                prev = evs[ei - 1]
+                if isinstance(prev.get("t_due"), int) \
+                        and isinstance(ev.get("t_emit"), int) \
+                        and ev["t_emit"] != prev["t_due"]:
+                    errors.append(
+                        f"{w2}: t_emit={ev['t_emit']} breaks the join "
+                        f"(parent t_due={prev['t_due']}) — a chain "
+                        f"edge requires the child to be emitted at "
+                        f"its parent's execution time")
+            h = ev.get("host")
+            d = ev.get("depth")
+            if isinstance(h, int) and isinstance(d, int):
+                if h in depth_of and d <= depth_of[h]:
+                    errors.append(
+                        f"{w2}: depth={d} not strictly greater than "
+                        f"the previous depth {depth_of[h]} on host "
+                        f"{h} — per-host execution order is total, "
+                        f"so same-host chain depth must increase")
+                depth_of[h] = d
+    # lineage traffic matrix: the cross-host cell sums must cover the
+    # cross-host harvested records exactly
+    tm = cz.get("traffic_matrix")
+    if tm is not None:
+        S = cz.get("path_shards")
+        if not isinstance(tm, list) or (
+                isinstance(S, int) and len(tm) != S) or not all(
+                isinstance(row, list)
+                and (not isinstance(S, int) or len(row) == S)
+                and all(isinstance(c, int) and not isinstance(c, bool)
+                        and c >= 0 for c in row)
+                for row in tm):
+            errors.append("causality.traffic_matrix must be a "
+                          "path_shards x path_shards grid of "
+                          "non-negative integers")
+        elif isinstance(counts.get("cross_host_harvested"), int) \
+                and sum(c for row in tm for c in row) \
+                != counts["cross_host_harvested"]:
+            errors.append(
+                f"causality.traffic_matrix sums to "
+                f"{sum(c for row in tm for c in row)} but "
+                f"cross_host_harvested="
+                f"{counts['cross_host_harvested']}")
+        # cross-check against the flow recorder (PR 15): both samplers
+        # hash the same (time, dst, src, seq) identity, so two
+        # LOSSLESS recorders at the SAME period must agree on the
+        # cross-shard traffic matrix (warning: bulk-pass emissions
+        # bypass the lineage hook, so a bulk-heavy run can diverge
+        # legitimately)
+        if (isinstance(flows, dict)
+                and flows.get("sample_period") == cz.get("sample_period")
+                and flows.get("path_shards") == cz.get("path_shards")
+                and flows.get("lost_ring") == 0
+                and flows.get("lost_window_clamp") == 0
+                and cz.get("lost_ring") == 0
+                and isinstance(flows.get("traffic_matrix"), list)
+                and flows["traffic_matrix"] != tm):
+            warnings.append(
+                "causality.traffic_matrix disagrees with "
+                "flows.traffic_matrix on a lossless run at equal "
+                "sample periods — expected only when bulk-pass "
+                "events (which bypass the lineage hook) carried "
+                "cross-host traffic")
+    return errors, warnings
+
+
 def _lint_admission(adm) -> tuple[list, list]:
     """(errors, warnings) for an "admission" block — either a resident
     program's lease-table block (fleet/admission.py manifest_block,
@@ -646,9 +928,22 @@ def lint_trace_obj(obj) -> tuple[list, list]:
                     f'{where}: metadata name {e.get("name")!r} is not '
                     f'one the viewers understand ({sorted(KNOWN_METADATA)})')
             continue
+        if ph == "C":
+            # counter events (the critical-path track's per-window
+            # jump-utilization series, export.py pid 3): need a name,
+            # a numeric ts, and a numeric-valued args series
+            if not e.get("name"):
+                errors.append(f'{where}: "C" event needs a name')
+            if not isinstance(e.get("ts"), (int, float)):
+                errors.append(f'{where}: "C" event needs numeric ts')
+            a = e.get("args")
+            if not isinstance(a, dict) or not a:
+                errors.append(f'{where}: "C" event needs a non-empty '
+                              f'args object (the counter series)')
+            continue
         if ph != "X":
             warnings.append(f'{where}: unexpected phase {ph!r} (the '
-                            f'exporter only emits "X" and "M")')
+                            f'exporter only emits "X", "C" and "M")')
             continue
         for k in ("ts", "dur"):
             if not isinstance(e.get(k), (int, float)):
@@ -1142,6 +1437,12 @@ def lint_manifest_obj(man) -> tuple[list, list]:
         e2, w2 = _lint_flows(fl, man.get("counters"), tel)
         errors += e2
         warnings += w2
+    # causality block (optional): causal critical-path accounting
+    cz = man.get("causality")
+    if cz is not None:
+        e2, w2 = _lint_causality(cz, tel, fl)
+        errors += e2
+        warnings += w2
     # admission block (optional): standalone resident-run lease fold
     adm = man.get("admission")
     if adm is not None:
@@ -1398,6 +1699,63 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
     elif job_fl:
         errors.append(f'{len(job_fl)} job(s) carry flow summaries but '
                       f'the fleet manifest has no "flows" roll-up')
+    # causality roll-up (optional): same derived-totals rule — the
+    # fleet block must be the exact fold of the per-job causality
+    # summaries, including the binding-cause histogram
+    ct = man.get("causality")
+    job_cz = {jid: j["causality"] for jid, j in sorted(jobs.items())
+              if isinstance(j, dict)
+              and isinstance(j.get("causality"), dict)}
+    for jid, cz in job_cz.items():
+        where = f"jobs[{jid}].causality"
+        for k in ("sampled", "harvested", "lost_ring",
+                  "windows_attributed", "windows_lost"):
+            v = cz.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}.{k} must be a non-negative "
+                              f"integer, got {v!r}")
+        if isinstance(cz.get("harvested"), int) \
+                and isinstance(cz.get("lost_ring"), int) \
+                and isinstance(cz.get("sampled"), int) \
+                and cz["harvested"] + cz["lost_ring"] > cz["sampled"]:
+            errors.append(
+                f"{where}: harvested={cz['harvested']} + lost_ring="
+                f"{cz['lost_ring']} exceeds sampled={cz['sampled']}")
+        for name in (cz.get("causes") or {}):
+            if name not in _CAUSE_NAMES:
+                errors.append(f"{where}.causes[{name!r}]: unknown "
+                              f"binding cause")
+    if ct is not None:
+        if not isinstance(ct, dict):
+            errors.append('"causality" must be an object')
+        elif not job_cz:
+            errors.append('fleet "causality" roll-up with no '
+                          'causality-traced job entries')
+        else:
+            if ct.get("jobs") != len(job_cz):
+                errors.append(f"causality.jobs={ct.get('jobs')!r} but "
+                              f"{len(job_cz)} job(s) carry a "
+                              f"causality summary")
+            for k in ("sampled", "harvested", "lost_ring",
+                      "windows_attributed", "windows_lost"):
+                want = sum(int(cz.get(k, 0) or 0)
+                           for cz in job_cz.values())
+                if ct.get(k) != want:
+                    errors.append(f"causality.{k}={ct.get(k)!r} but "
+                                  f"the job summaries sum to {want}")
+            want_causes: dict = {}
+            for cz in job_cz.values():
+                for name, n in (cz.get("causes") or {}).items():
+                    want_causes[name] = (want_causes.get(name, 0)
+                                         + int(n or 0))
+            if ct.get("causes") != want_causes:
+                errors.append(f"causality.causes="
+                              f"{ct.get('causes')!r} but the job "
+                              f"histograms fold to {want_causes}")
+    elif job_cz:
+        errors.append(f'{len(job_cz)} job(s) carry causality '
+                      f'summaries but the fleet manifest has no '
+                      f'"causality" roll-up')
     # admission block (optional): a resident program's lease-table
     # roll-up (fleet/admission.py manifest_block)
     adm = man.get("admission")

@@ -139,6 +139,62 @@ def summarize(trace: dict, manifest: dict | None = None,
                 lines.append(
                     f"WARNING: {fl['lost_ring']} flow record(s) lost "
                     f"to ring overrun — histograms undercount")
+        cz = manifest.get("causality")
+        if cz:
+            lines.append(_window_advance_section(cz, top=top))
+    return "\n".join(lines)
+
+
+def _window_advance_section(cz: dict, top: int = 5) -> str:
+    """The window-advance view of a manifest causality block: how far
+    every window jumped (sparkline over the attributed windows, in
+    attribution order), WHY each stopped where it did (binding-cause
+    table), and how much of the unclamped lookahead the realized jumps
+    kept (utilization summary) — the one-screen answer to "is the
+    simulator window-bound, and on what"."""
+    lines = []
+    per = (f"1-in-{cz['sample_period']}"
+           if cz.get("sample_period") else "?")
+    lines.append(
+        f"causality: {cz.get('harvested', 0)} lineage records "
+        f"harvested of {cz.get('sampled', 0)} sampled ({per} events), "
+        f"lost ring={cz.get('lost_ring', 0)}; "
+        f"{cz.get('windows_attributed', 0)} windows attributed "
+        f"(lost={cz.get('windows_lost', 0)})")
+    jumps = [int(a.get("jump", 0)) for a in (cz.get("advances") or [])]
+    if jumps:
+        lines.append("window jump ns " + sparkline(jumps))
+    causes = cz.get("causes") or {}
+    if causes:
+        total = sum(causes.values()) or 1
+        lines.append("binding cause:")
+        for name, n in sorted(causes.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {name:<16} {n:>8}  "
+                         f"({n * 100 // total}%)")
+    edges = cz.get("edges") or {}
+    for key, n in sorted(edges.items(), key=lambda kv: -kv[1])[:top]:
+        lines.append(f"  binding edge {key}: {n} windows")
+    ju = cz.get("jump_utilization_pct") or {}
+    if ju:
+        lines.append(
+            f"lookahead utilization p50={ju.get('p50', 0)}% "
+            f"p95={ju.get('p95', 0)}% p99={ju.get('p99', 0)}% "
+            f"mean={ju.get('mean', 0)}% (realized jump / unclamped "
+            f"lookahead)")
+    il = cz.get("idle_lane_pct") or {}
+    if il:
+        lines.append(
+            f"idle lanes at barrier p50={il.get('p50', 0)}% "
+            f"p95={il.get('p95', 0)}% p99={il.get('p99', 0)}%")
+    for i, ch in enumerate((cz.get("chains") or [])[:top]):
+        lines.append(
+            f"  chain {i}: {ch.get('length', 0)} events over "
+            f"{ch.get('span_ns', 0)}ns across "
+            f"{ch.get('hosts', 0)} host(s)")
+    if cz.get("lost_ring"):
+        lines.append(
+            f"WARNING: {cz['lost_ring']} lineage record(s) lost to "
+            f"ring overrun — chains may be truncated")
     return "\n".join(lines)
 
 
