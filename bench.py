@@ -78,6 +78,16 @@ rate measures raw engine throughput. Env knobs:
                                   unset = engine default 256, 0 =
                                   fast path off — the A/B lever for
                                   the sparse-window speedup claim)
+  BENCH_SPECIALIZE=1              compile-time specialization A/B
+                                  (compile/specialize.py): the timed
+                                  program is the capability-trimmed
+                                  variant (the metric name gains
+                                  _spec so the row banks separately)
+                                  and an unspecialized twin of the
+                                  same workload is timed for the
+                                  specialize_speedup field =
+                                  rate_trimmed / rate_full. Plain
+                                  PHOLD runner only.
   BENCH_SUPERVISE=1               route PHOLD through the supervised
                                   host-driven window loop
                                   (faults.run_supervised) instead of
@@ -293,6 +303,21 @@ def _bench_bucketed() -> bool:
     return v != "0"
 
 
+def _bench_specialize() -> bool:
+    """BENCH_SPECIALIZE=1: time the capability-trimmed program
+    (compile/specialize.py) and an unspecialized twin of the same
+    workload for the specialize_speedup A/B field."""
+    return os.environ.get("BENCH_SPECIALIZE", "0") == "1"
+
+
+def _spec_block(caps, sim):
+    """Manifest specialization block of the timed run (None when the
+    program was not specialized) — telemetry_lint validates it."""
+    from shadow_tpu.compile import specialize
+
+    return specialize.specialization_block(caps, sim)
+
+
 def _build_phold(H: int, load: int, sim_s: int, seed: int = 1,
                  cap: int | None = None, graph: str | None = None,
                  replica_size: int | None = None, fault_records=None,
@@ -378,7 +403,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   sparse_lanes: int | None = None,
                   min_jump_ns: int | None = None,
                   flow_sample: int | None = None,
-                  causality_sample: int | None = None):
+                  causality_sample: int | None = None,
+                  specialize: bool | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -396,6 +422,7 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
     cs = (_bench_causality_sample() if causality_sample is None
           else causality_sample)
     bucketed = _bench_bucketed()
+    sp = _bench_specialize() if specialize is None else specialize
 
     def build_at(cap):
         b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
@@ -425,6 +452,20 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
         sims = _attach_flow_ring(sims, fs)
         sims = _attach_causality_ring(sims, cs)
         b.sim = sims[0]
+        if sp:
+            # specialize AFTER every attachment (the analysis reads
+            # the final sim composition); the specialized program
+            # expects the guard leaves in its input pytree, so every
+            # timed input gets them
+            from shadow_tpu.apps import phold
+            from shadow_tpu.compile import specialize as spec_mod
+
+            b = spec_mod.apply(b, (phold.handler,),
+                               app_bulk=phold.BULK
+                               if active_hosts is None else None)
+            if getattr(b.sim, "guard", None) is not None:
+                sims = [b.sim] + [s.replace(guard=b.sim.guard)
+                                  for s in sims[1:]]
         # sparse shape: bulk would consume whole windows before the
         # fixpoint ever ran, starving the compaction fast path the
         # shape exists to exercise
@@ -1154,6 +1195,17 @@ def main(argv=None) -> None:
             and flow_sample_n <= 0:
         raise SystemExit("BENCH_FLOW_OVERHEAD=1 needs "
                          "BENCH_FLOW_SAMPLE=N (what would it A/B?)")
+    spec_on = _bench_specialize()
+    if spec_on and (workload != "phold" or supervise or inject_on):
+        raise SystemExit(
+            "BENCH_SPECIALIZE=1 is only wired for the plain PHOLD "
+            "runner (the supervised/injection loops build their own "
+            "bundles)")
+    if spec_on:
+        # the trimmed variant is a DIFFERENT compiled program under
+        # its own store key — bank it under its own metric name so
+        # bench_regress compares like with like
+        name += "_spec"
     caus_sample_n = _bench_causality_sample()
     if caus_sample_n > 0:
         # the causality planes shape the program too — own metric name
@@ -1276,6 +1328,32 @@ def main(argv=None) -> None:
         causality_overhead_pct = round(
             (value_caus_off - value) / value_caus_off * 100.0, 2)
 
+    # BENCH_SPECIALIZE=1: time the unspecialized twin of the SAME
+    # workload (every other knob unchanged, so the delta IS the
+    # trimmed subgraphs) and score specialize_speedup =
+    # rate_trimmed / rate_full. >1.0 means the trim pays; the
+    # regression gate tracks the trajectory once banked.
+    specialize_speedup = None
+    value_spec_off = None
+    if spec_on:
+        base = _phold_runner(
+            H * replicas, load, sim_s, shards=_SHARDS, graph=graph,
+            replica_size=H if replicas > 1 else None,
+            fault_records=fault_records,
+            active_hosts=active, sparse_lanes=sparse,
+            min_jump_ns=min_jump_ns, specialize=False)
+        base()                     # warm-up (compile, maybe escalate)
+        while True:
+            t0 = time.perf_counter()
+            ev_off = base()
+            wall_off = time.perf_counter() - t0
+            if not getattr(base, "escalated", False):
+                break
+        rate_off = ev_off / wall_off
+        value_spec_off = (rate_off / _SHARDS if _SHARDS > 1
+                          else rate_off)
+        specialize_speedup = round(value / value_spec_off, 4)
+
     # compare against the measured baseline AT THE SAME SCALE (the
     # C pthread heap-skeleton upper bound, BASELINE.md): the published
     # block carries per-scale numbers because the heap baseline slows
@@ -1385,7 +1463,9 @@ def main(argv=None) -> None:
             compile_s=compile_s, compile_fresh=compile_fresh,
             fault_plan=getattr(b, "fault_plan", None),
             dispatch=disp, injection=inj_blk,
-            compile_info=cinfo or None)
+            compile_info=cinfo or None,
+            specialization=_spec_block(
+                getattr(b, "caps", None), runner.last_sim))
     if flow_sample_n > 0 and getattr(runner, "last_sim", None) is not None \
             and getattr(runner.last_sim, "flows", None) is not None:
         # flow-latency accounting of the TIMED run: counters + per-lane
@@ -1439,6 +1519,14 @@ def main(argv=None) -> None:
     if causality_overhead_pct is not None:
         out["causality_overhead_pct"] = causality_overhead_pct
         out["events_per_sec_causality_off"] = round(value_caus_off, 1)
+    if specialize_speedup is not None:
+        out["specialize_speedup"] = specialize_speedup
+        out["events_per_sec_full_program"] = round(value_spec_off, 1)
+        caps = getattr(runner.state["bundle"], "caps", None) \
+            if getattr(runner, "state", None) is not None else None
+        if caps is not None:
+            out["specialization"] = {"dropped": list(caps.dropped()),
+                                     "key_extra": caps.key_extra()}
     # BENCH_PROFILE_DIR: capture ONE extra, unscored run, after every
     # export has read the timed run's state. Tracing costs wall time
     # (observed: an order of magnitude on small CPU shapes), so it

@@ -205,3 +205,12 @@ def handler(cfg: NetConfig, sim, popped, buf):
                       app=sim.app.replace(rcvd=sim.app.rcvd + got.astype(I64)))
     sim, buf = _send_one(cfg, sim, buf, got, now)
     return sim, buf
+
+
+# Complete set of event kinds this handler can emit (its UDP sends go
+# through the netstack's own NIC_SEND/PACKET machinery, which is always
+# live) — the static capability analysis (compile/specialize.py) reads
+# this declaration to prove the timer handler family dead: PHOLD never
+# arms a host timer, so TIMER events cannot exist and the family can be
+# omitted from the trace.
+handler.specialize_kinds = frozenset({int(KIND_INJECT)})

@@ -265,6 +265,19 @@ def make_parser() -> argparse.ArgumentParser:
     p.add_argument("--max-grow", type=int, default=8,
                    help="escalation budget: total capacity doublings "
                         "allowed across the run (chain-wide)")
+    p.add_argument("--specialize", choices=("auto", "off"),
+                   default="auto",
+                   help="compile-time program specialization "
+                        "(compile/specialize.py): auto (default) "
+                        "proves capabilities statically dead for this "
+                        "build (all-ones reliability table with no "
+                        "fault plan touching it; no handler that can "
+                        "arm a host timer) and trims their subgraphs "
+                        "out of the traced program, keying the "
+                        "variant separately in the warm program "
+                        "store; a device guard latch turns any "
+                        "violated assumption into a fatal health "
+                        "fault. off always runs the full program")
     p.add_argument("--resume", default=None, metavar="PATH",
                    help="continue a previous run from its checkpoint: "
                         "a snapshot file, a checkpoint path prefix, or "
@@ -675,6 +688,30 @@ def main(argv=None) -> int:
             harvester = telemetry.Harvester()
             timers = telemetry.PhaseTimers()
 
+        # compile-time program specialization (compile/specialize.py):
+        # derive the capability vector from the CONCRETE build — after
+        # every optional attachment, so the analysis sees the final
+        # sim composition — and trim statically-dead subgraphs from
+        # the trace. The guard latch attached here turns a violated
+        # assumption into a fatal health fault (exit 3), never silent
+        # drift. .py-plugin runtimes arm host timers outside the
+        # handler declaration surface, so they run the full program.
+        from shadow_tpu.compile import specialize
+
+        if loaded.vprocs or args.host_kernel:
+            b = specialize.apply(b, mode="off")
+        else:
+            b = specialize.apply(b, loaded.handlers,
+                                 app_bulk=b.app_bulk,
+                                 mode=args.specialize)
+        if b.caps is not None and b.caps.dropped():
+            logger.message(
+                0, "shadow-tpu",
+                "specialization: trimmed "
+                + ",".join(b.caps.dropped())
+                + f" (program-key extra {b.caps.key_extra()!r}; "
+                  f"guard latch armed)")
+
         cap = None
         if b.cfg.pcap:
             # pcap capture needs a host-driven window loop to drain
@@ -884,7 +921,10 @@ def main(argv=None) -> int:
                         sample_period=args.flow_sample or None),
                     admission=admission_manifest_block(health_),
                     profile=profile_info,
-                    causality=caus_blk)
+                    causality=caus_blk,
+                    specialization=specialize.specialization_block(
+                        getattr(b, "caps", None), sim_,
+                        mode=args.specialize))
                 os.makedirs(args.data_directory, exist_ok=True)
                 telemetry.write_manifest(
                     os.path.join(args.data_directory,
@@ -1167,6 +1207,8 @@ def main(argv=None) -> int:
                     admission=admission_manifest_block(run_health),
                     profile=profile_info,
                     causality=caus_blk,
+                    specialization=specialize.specialization_block(
+                        b.caps, sim, mode=args.specialize),
                     **({} if sup_result is None else {
                         "run_id": sup_result.run_id,
                         "resume_of": sup_result.resume_of,

@@ -1,0 +1,274 @@
+"""Compile-time program specialization: capability-trimmed variants.
+
+The step/bulk passes are traced for the *general* network — every
+window pays for a Bernoulli loss draw per send and a timer-handler
+family gate even when the concrete build can prove neither can ever
+fire (reliability table all-ones and no fault plan touching it; no
+handler that can arm a host timer). ROADMAP item 4(a) measured that
+generality at ~11% on lossless topologies.
+
+This module closes the gap statically:
+
+- `derive(bundle, ...)` computes a `Capabilities` vector from the
+  CONCRETE build inputs (the boot reliability table, the installed
+  fault plan's record kinds, the app handlers' declared emit-kind
+  sets, the attached optional subsystems).
+- `apply(bundle, ...)` attaches the vector to the bundle; the runner
+  factories (net/build.py) thread it into make_step_fn /
+  make_bulk_fn / make_tcp_bulk_fn, which then *omit* the dead
+  subgraphs from the trace instead of lax.cond-gating them.
+- The vector folds into the program key (compile/buckets.py `extra`)
+  ONLY when something was actually dropped, so a scenario with
+  nothing trimmable produces a byte-identical program under the SAME
+  key as an unspecialized build, while trimmed variants coexist in
+  the warm store next to their full twins.
+
+Safety is load-bearing: dropping a capability attaches a `GuardState`
+to the Sim — one cheap device predicate per dropped capability,
+evaluated once per window at the fault boundary (core/engine.py
+step_window). If a provably-dead capability would have fired anyway
+(a checkpoint restored a lossy reliability table into a loss-trimmed
+program; an external path staged a TIMER event into a timer-trimmed
+one), the latch trips a FATAL health fault (faults/health.py) —
+specialization can never silently change results. The trimmed values
+are bit-identical by construction wherever the capabilities hold:
+the loss trim advances the RNG counters by exactly the amount the
+skipped draw would have (rng.uniform returns counters+1,
+data-independently), and an omitted handler family is the identity
+on every micro-step where its kinds cannot appear.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EventKind
+
+I64 = jnp.int64
+
+# The capabilities this pass can trim out of the trace. `tcp` and
+# `faults` are recorded in the vector for the manifest/operators but
+# are already structurally elided by older machinery (cfg.tcp gates
+# the TCP handler families; a None fault_fn skips the table-rewrite
+# plumbing) and already keyed (cfg/tcp in the shape vector, the plan
+# digest in the kind census) — only the trims below change the traced
+# program beyond what the key already sees.
+TRIMMABLE = ("loss", "timers")
+
+
+@dataclasses.dataclass(frozen=True)
+class Capabilities:
+    """Static capability vector of one built scenario. True = the
+    capability is LIVE (traced in full); a False trimmable capability
+    is OMITTED from the trace and watched by the guard latch."""
+
+    loss: bool = True      # any send can be reliability-dropped
+    timers: bool = True    # a TIMER event can ever enter the queue
+    tcp: bool = True       # cfg.tcp (recorded; trimmed by cfg already)
+    faults: bool = True    # a fault plan is installed (recorded)
+    # statically-known optional attachments (None-contributes-no-leaves
+    # contract, net/state.py Sim) — recorded so operators can read a
+    # stored program's full composition off the store sidecar
+    telemetry: bool = False
+    lanes: bool = False
+    inject: bool = False
+    flows: bool = False
+    admission: bool = False
+    causality: bool = False
+
+    def dropped(self) -> tuple:
+        """Names of the capabilities this pass trimmed out of the
+        trace (subset of TRIMMABLE), sorted."""
+        return tuple(sorted(n for n in TRIMMABLE if not getattr(self, n)))
+
+    def key_extra(self) -> str | None:
+        """Program-key contribution: a stable token per dropped
+        capability, None when nothing was dropped — so an untrimmed
+        specialized build keys identically to an unspecialized one."""
+        d = self.dropped()
+        return "-".join("no_" + n for n in d) if d else None
+
+    def as_dict(self) -> dict:
+        """Manifest / store-sidecar block."""
+        return {
+            "capabilities": {f.name: bool(getattr(self, f.name))
+                             for f in dataclasses.fields(self)},
+            "dropped": list(self.dropped()),
+            "key_extra": self.key_extra(),
+        }
+
+
+def _plan_touches_reliability(plan) -> bool:
+    """True when any record of the installed fault plan can rewrite
+    the reliability table (mirror of faults/apply.py rel_kinds)."""
+    if plan is None or not getattr(plan, "n", 0):
+        return False
+    from shadow_tpu.faults.plan import FaultKind
+
+    k = np.asarray(plan.kind)
+    return bool(np.isin(k, (FaultKind.LINK_DOWN, FaultKind.LINK_UP,
+                            FaultKind.LOSS, FaultKind.PARTITION,
+                            FaultKind.HEAL)).any())
+
+
+def _timers_statically_dead(bundle, app_handlers) -> bool:
+    """TIMER events are emitted only by net/timers.timer_set, which on
+    the device side is reached only through handlers that arm host
+    timers. A handler opts into the analysis by declaring
+    `specialize_kinds` (a frozenset of the EventKind ints it can
+    emit); every handler must declare, and none may declare TIMER.
+    Injection staging can stage arbitrary kinds, so an attached
+    inject lane keeps timers live. The guard latch backstops the
+    declaration: a queue-resident TIMER on a timer-trimmed program is
+    a fatal health fault, never a silent no-op."""
+    if getattr(bundle.sim, "inject", None) is not None:
+        return False
+    for h in app_handlers or ():
+        kinds = getattr(h, "specialize_kinds", None)
+        if kinds is None or int(EventKind.TIMER) in kinds:
+            return False
+    return True
+
+
+def derive(bundle, app_handlers=(), app_bulk=None,
+           app_tcp_bulk=None) -> Capabilities:
+    """Derive the capability vector from one built bundle's concrete
+    inputs. Pure analysis — attaches nothing; see apply()."""
+    rel = np.asarray(bundle.sim.net.reliability)
+    plan = getattr(bundle, "fault_plan", None)
+    lossless = bool((rel >= 1.0).all()) and not _plan_touches_reliability(plan)
+    sim = bundle.sim
+    return Capabilities(
+        loss=not lossless,
+        timers=not _timers_statically_dead(bundle, app_handlers),
+        tcp=bool(bundle.cfg.tcp),
+        faults=plan is not None,
+        telemetry=getattr(sim, "telem", None) is not None,
+        lanes=getattr(sim, "lanes", None) is not None,
+        inject=getattr(sim, "inject", None) is not None,
+        flows=getattr(sim, "flows", None) is not None,
+        admission=getattr(sim, "admission", None) is not None,
+        causality=getattr(sim, "causality", None) is not None,
+    )
+
+
+@struct.dataclass
+class GuardState:
+    """Device-side guard latch for a specialized program: one sticky
+    trip counter per dropped capability, bumped once per window at the
+    fault boundary (engine.step_window). The watch flags are static
+    (pytree_node=False) so an unwatched predicate contributes nothing
+    to the trace; the counters are scalar leaves, so shard_map's
+    generic delta-psum aggregates them (parallel/shard.py
+    _replicate_scalars) and lane compaction passes them through
+    untouched (core/compact.py)."""
+
+    watch_loss: bool = struct.field(pytree_node=False, default=False)
+    watch_timers: bool = struct.field(pytree_node=False, default=False)
+    loss_trips: jax.Array = None    # [] i64
+    timer_trips: jax.Array = None   # [] i64
+
+    def watched(self) -> tuple:
+        return tuple(n for n, w in (("loss", self.watch_loss),
+                                    ("timers", self.watch_timers)) if w)
+
+
+def make_guard(caps: Capabilities) -> GuardState | None:
+    """Guard for a capability vector; None when nothing was dropped
+    (no dropped capability -> no guard -> no extra pytree leaves ->
+    byte-identical program to the unspecialized build)."""
+    d = caps.dropped()
+    if not d:
+        return None
+    return GuardState(
+        watch_loss="loss" in d,
+        watch_timers="timers" in d,
+        loss_trips=jnp.zeros((), I64),
+        timer_trips=jnp.zeros((), I64),
+    )
+
+
+def guard_update(sim, wend):
+    """Per-window guard evaluation, called from engine.step_window
+    right after the fault rewrite (the only in-window writer of the
+    watched tables). Each watched predicate asks "could the dropped
+    capability fire?" and bumps its sticky counter; faults/health.py
+    gather() folds a nonzero counter into a FATAL verdict."""
+    g = sim.guard
+    if g.watch_loss:
+        trip = jnp.any(sim.net.reliability < 1.0)
+        g = g.replace(loss_trips=g.loss_trips + trip.astype(I64))
+    if g.watch_timers:
+        q = sim.events
+        pending = ((q.time != simtime.INVALID)
+                   & (q.kind == EventKind.TIMER))
+        g = g.replace(
+            timer_trips=g.timer_trips + jnp.any(pending).astype(I64))
+    return sim.replace(guard=g)
+
+
+def apply(bundle, app_handlers=(), app_bulk=None, app_tcp_bulk=None,
+          mode: str = "auto"):
+    """Specialize a built bundle: derive the capability vector and,
+    when anything is trimmable, return a new bundle carrying the
+    vector (SimBundle.caps — the runner factories read it) with the
+    guard attached to its Sim. mode="off" returns the bundle
+    unchanged with caps=None (the --specialize off escape hatch).
+    Returns the (possibly new) bundle; read `bundle.caps` for the
+    vector (None = unspecialized)."""
+    if mode == "off":
+        return (dataclasses.replace(bundle, caps=None)
+                if getattr(bundle, "caps", None) is not None else bundle)
+    if mode != "auto":
+        raise ValueError(f"--specialize must be auto|off, got {mode!r}")
+    caps = derive(bundle, app_handlers, app_bulk, app_tcp_bulk)
+    sim = bundle.sim
+    guard = make_guard(caps)
+    if guard is not None:
+        sim = sim.replace(guard=guard)
+    return dataclasses.replace(bundle, sim=sim, caps=caps)
+
+
+def loss_trimmed(caps) -> bool:
+    """True when the loss capability was dropped — the send paths use
+    this one predicate so every draw site trims under the same rule."""
+    return caps is not None and not caps.loss
+
+
+def timers_trimmed(caps) -> bool:
+    return caps is not None and not caps.timers
+
+
+def specialization_block(caps, sim=None, *, mode: str = "auto") -> dict | None:
+    """run_manifest.json block for a specialized run (None when the
+    run was not specialized): the capability vector, the dropped list,
+    the key contribution, and — when the final sim is given — the
+    guard-latch counters proving no dead capability fired.
+    tools/telemetry_lint.py validates this block."""
+    if caps is None:
+        return None
+    block = {"mode": mode, **caps.as_dict()}
+    g = guard_report(sim) if sim is not None else None
+    if g is not None:
+        block["guard"] = g
+    return block
+
+
+def guard_report(sim) -> dict | None:
+    """Host-side snapshot of the guard counters (None when the sim
+    carries no guard) — consumed by health.gather and the manifest."""
+    g = getattr(sim, "guard", None)
+    if g is None:
+        return None
+    return {
+        "watched": list(g.watched()),
+        "loss_trips": int(np.asarray(g.loss_trips)),
+        "timer_trips": int(np.asarray(g.timer_trips)),
+    }

@@ -269,6 +269,12 @@ class ProgramStore:
             "total_bytes": sum(int(m.get("nbytes", 0)) for m in entries),
             "code_versions": sorted({m.get("code") for m in entries
                                      if m.get("code")}),
+            # capability-trimmed variants (compile/specialize.py) — a
+            # specialized entry's sidecar carries the vector its
+            # program was trimmed under
+            "specialized": sum(
+                1 for m in entries
+                if (m.get("specialization") or {}).get("dropped")),
         }
 
     def drop(self, key: str) -> None:

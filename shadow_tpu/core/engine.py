@@ -290,6 +290,15 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         inject_deltas = (inj_w, drop_w, def_w)
     if fault_fn is not None:
         sim = fault_fn(sim, wend)
+    # Specialization guard (compile/specialize.py): on a
+    # capability-trimmed program, evaluate one cheap predicate per
+    # dropped capability right after the fault rewrite (the only
+    # in-window writer of the watched tables) — a trip is latched
+    # sticky and becomes a fatal health fault at gather time.
+    # Trace-time no-op when Sim.guard is None (every full program).
+    if getattr(sim, "guard", None) is not None:
+        from shadow_tpu.compile.specialize import guard_update
+        sim = guard_update(sim, wend)
     if bulk_fn is not None:
         sim, n_bulk = bulk_fn(sim, wend)
         stats = stats.replace(

@@ -88,6 +88,21 @@ class RunHealth:
     # read an inactive lane's silence as a stall or incident.
     resident: bool = False
     admission: tuple = ()
+    # --- specialized programs (compile/specialize.py GuardState) -----
+    # guard_watched non-empty means the sim ran as a capability-
+    # trimmed variant: the named capabilities were PROVEN dead at
+    # build time and omitted from the trace. A nonzero trip counter
+    # means a dead capability would have fired anyway (e.g. a
+    # restored snapshot carried a lossy reliability table into a
+    # loss-trimmed program) — the results are INVALID, always fatal:
+    # specialization must never silently change results.
+    guard_watched: tuple = ()
+    guard_loss_trips: int = 0
+    guard_timer_trips: int = 0
+
+    @property
+    def guard_tripped(self) -> bool:
+        return bool(self.guard_loss_trips or self.guard_timer_trips)
 
     @property
     def fatal(self) -> bool:
@@ -99,7 +114,7 @@ class RunHealth:
             # remains, in which case the program serves nobody
             cap_trip = len(self.lanes_quarantined) >= self.lanes_total
         return bool(
-            cap_trip or self.deadline_exceeded
+            cap_trip or self.deadline_exceeded or self.guard_tripped
             or (self.stall_limit and self.stalled_windows >= self.stall_limit))
 
     def diagnostics(self) -> list:
@@ -166,6 +181,22 @@ class RunHealth:
                         f"final snapshot was taken — state is healthy "
                         f"but the time budget is spent; --resume "
                         f"continues it, or raise --max-run-wallclock"))
+        if self.guard_loss_trips:
+            out.append(("fatal",
+                        f"specialization guard tripped x"
+                        f"{self.guard_loss_trips}{where}: the loss "
+                        f"capability was trimmed from this program but "
+                        f"the reliability table went below 1.0 at "
+                        f"runtime — drops were NOT modelled, results "
+                        f"are invalid; rerun with --specialize off"))
+        if self.guard_timer_trips:
+            out.append(("fatal",
+                        f"specialization guard tripped x"
+                        f"{self.guard_timer_trips}{where}: the timer "
+                        f"capability was trimmed from this program but "
+                        f"a TIMER event entered the queue — it would "
+                        f"never be handled, results are invalid; rerun "
+                        f"with --specialize off"))
         if self.narrow_miss:
             out.append(("warning",
                         f"narrow exchange tier missed {self.narrow_miss} "
@@ -225,6 +256,12 @@ class RunHealth:
             **({"admission": {
                 "per_lane": [dict(d) for d in self.admission],
             }} if self.resident else {}),
+            **({"guard": {
+                "watched": list(self.guard_watched),
+                "loss_trips": self.guard_loss_trips,
+                "timer_trips": self.guard_timer_trips,
+                "tripped": self.guard_tripped,
+            }} if self.guard_watched else {}),
         }
 
 
@@ -262,7 +299,17 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
 
         resident = True
         adm_rep = tuple(admission_report(sim))
+    g_watched, g_loss, g_timer = (), 0, 0
+    if getattr(sim, "guard", None) is not None:
+        from shadow_tpu.compile.specialize import guard_report
+
+        g = guard_report(sim)
+        g_watched = tuple(g["watched"])
+        g_loss, g_timer = g["loss_trips"], g["timer_trips"]
     return RunHealth(
+        guard_watched=g_watched,
+        guard_loss_trips=g_loss,
+        guard_timer_trips=g_timer,
         lanes_total=lanes_total,
         lanes=lane_rep,
         lanes_quarantined=quar,

@@ -538,6 +538,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 old_telem = getattr(bundle.sim, "telem", None)
                 old_inject = getattr(bundle.sim, "inject", None)
                 old_lanes = getattr(bundle.sim, "lanes", None)
+                old_caps = getattr(bundle, "caps", None)
                 bundle = rebuild_fn(grow)
                 if old_lanes is not None:
                     # re-attach lane isolation at the grown shapes
@@ -565,6 +566,20 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
 
                     bundle.sim = inject_attach(bundle.sim,
                                                old_inject.lanes)
+                if old_caps is not None:
+                    # re-derive the capability vector at the grown
+                    # shapes (capacity growth cannot change it — the
+                    # reliability table and handler set are capacity-
+                    # independent) so the transplant below finds the
+                    # snapshot's guard leaves in the template and the
+                    # healed program stays trimmed under the same key
+                    # discipline (compile/specialize.py)
+                    from shadow_tpu.compile import specialize as \
+                        specialize_mod
+
+                    bundle = specialize_mod.apply(
+                        bundle, app_handlers,
+                        app_bulk=getattr(bundle, "app_bulk", None))
                 # a caller-supplied fault_fn closes over the OLD
                 # shapes; drop it — run_windows re-resolves from the
                 # rebuilt bundle's installed plan

@@ -148,6 +148,17 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
         b.sim = telemetry.attach_causality(
             b.sim, sample_period=int(spec.causality_sample))
+    # compile-time specialization LAST — the analysis reads the final
+    # sim composition (attachments above) and the installed fault
+    # plan. A lossless no-timer job serves the trimmed variant from
+    # the warm store under its own key; a faulted job serves the full
+    # program; the guard latch makes a violated assumption a fatal
+    # health fault, never silent drift (compile/specialize.py).
+    from shadow_tpu.compile import specialize as specialize_mod
+
+    b = specialize_mod.apply(b, (phold.handler,),
+                             app_bulk=getattr(b, "app_bulk", None),
+                             mode=getattr(spec, "specialize", "auto"))
     return b
 
 
@@ -284,6 +295,7 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                   stop, heartbeat, log) -> dict:
     from shadow_tpu import faults, telemetry
     from shadow_tpu.apps import phold
+    from shadow_tpu.compile import specialize as specialize_mod
     from shadow_tpu.utils import checkpoint as ckpt
 
     caps = {"event_capacity": spec.event_capacity,
@@ -419,7 +431,10 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
             lanes=lanes_manifest_block(res.health, incidents),
             flows=flows_blk,
             causality=caus_blk,
-            compile_info=cinfo or None)
+            compile_info=cinfo or None,
+            specialization=specialize_mod.specialization_block(
+                getattr(bundle, "caps", None), res.sim,
+                mode=getattr(spec, "specialize", "auto")))
         result["manifest"] = telemetry.write_manifest(
             os.path.join(job_dir, "run_manifest.json"), man)
         result["counters"] = man["counters"]

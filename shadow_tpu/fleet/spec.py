@@ -143,6 +143,14 @@ class JobSpec:
     # spec file serves resident and per-process execution.
     tenant_class: str = "best_effort"
     slo_p99_ms: Optional[float] = None
+    # compile-time program specialization (compile/specialize.py):
+    # "auto" trims capabilities the build proves statically dead
+    # (reliability loss draws, the timer handler family) out of the
+    # traced program; the trimmed variant keys separately in the warm
+    # AOT store, so a fleet of lossless jobs serves the lean program
+    # while faulted jobs serve the full one. "off" always runs the
+    # full program.
+    specialize: str = "auto"
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -184,6 +192,10 @@ class JobSpec:
         if int(self.causality_sample) < 0:
             raise ValueError(f"job {self.id}: causality_sample must "
                              f"be >= 0 (0 disables causality tracing)")
+        if self.specialize not in ("auto", "off"):
+            raise ValueError(
+                f"job {self.id}: specialize must be 'auto' or 'off', "
+                f"got {self.specialize!r}")
         if self.tenant_class not in ("protected", "best_effort"):
             raise ValueError(
                 f"job {self.id}: tenant_class must be 'protected' or "

@@ -99,6 +99,13 @@ class SimBundle:
     # needs a fresh Sim AND fresh step/fault closures, because every
     # compiled function shape-specializes on the boot arrays.
     rebuild: Any = None
+    # Optional compile/specialize.Capabilities attached by
+    # specialize.apply(): the runner factories below thread it into
+    # the step/bulk builders (dead subgraphs are omitted from the
+    # trace) and fold it into the program key when anything was
+    # dropped. None = full (unspecialized) program. Escalation regrow
+    # must re-derive it (a rebuilt bundle starts unspecialized).
+    caps: Any = None
 
     def ip_of(self, name: str) -> int:
         return self.dns.resolve_name(name).ip
@@ -180,25 +187,60 @@ def build(cfg: NetConfig, graphml_text: str, hosts: Sequence[HostSpec],
 
 
 def _resolve_bulk_fn(bundle: SimBundle, app_bulk, app_tcp_bulk,
-                     tcp_bulk_lossless: bool = False):
+                     tcp_bulk_lossless: bool = False, caps=None):
     """One bulk-pass selection rule for every runner flavor (the UDP
     bulk wins when both are given; make_bulk_fn's order_impl is a
     separate knob with its own vocabulary, not forwarded).
     tcp_bulk_lossless compiles the narrow loss-free TCP pass — see
     make_tcp_bulk_fn (bit-identical for any workload; faster when the
-    workload is genuinely artifact-free)."""
+    workload is genuinely artifact-free). `caps` is the bundle's
+    capability vector (compile/specialize.py) — the bulk builders trim
+    their reliability-draw subgraphs under it."""
     if app_bulk is not None:
         from shadow_tpu.net.bulk import make_bulk_fn
 
-        fn = make_bulk_fn(bundle.cfg, app_bulk)
+        fn = make_bulk_fn(bundle.cfg, app_bulk, caps=caps)
         if fn is not None:
             return fn
     if app_tcp_bulk is not None:
         from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
 
         return make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
-                                lossless=tcp_bulk_lossless)
+                                lossless=tcp_bulk_lossless, caps=caps)
     return None
+
+
+def _resolve_caps(bundle: SimBundle, caller_fault_fn):
+    """The capability vector a runner may trim under. An explicit
+    caller fault_fn is OPAQUE — its closure could rewrite any table
+    (e.g. re-introduce loss) invisibly to the static analysis — so it
+    disables specialization exactly like it disables warm serving
+    (_whole_run_key_fn). The installed-plan path (bundle.fault_plan)
+    stays trimmable: derive() already folded the plan's record kinds
+    into the vector."""
+    caps = getattr(bundle, "caps", None)
+    if caller_fault_fn is not None:
+        if caps is not None and caps.dropped():
+            # the specialized sim already carries the guard latch —
+            # running it under a full (untrimmed) program would turn
+            # any table rewrite by this opaque fault_fn into a false
+            # fatal. Refuse loudly instead of mis-reporting.
+            raise ValueError(
+                "explicit fault_fn on a specialized bundle: an opaque "
+                "fault rule defeats the static capability analysis — "
+                "rebuild with specialize.apply(mode='off') or install "
+                "the plan via faults.install()")
+        return None
+    return caps
+
+
+def _caps_meta(caps):
+    """Store-sidecar block for a trimmed program (compcache_ctl ls
+    shows it next to the bucket plan); None when nothing was dropped
+    so untrimmed sidecars are unchanged."""
+    if caps is None or not caps.dropped():
+        return None
+    return {"specialization": caps.as_dict()}
 
 
 def _resolve_fault_fn(bundle: SimBundle, fault_fn):
@@ -289,7 +331,7 @@ def _whole_run_key_fn(bundle: SimBundle, app_handlers, *, end, path,
                       chunk_windows, adaptive, fault_fn, app_bulk,
                       app_tcp_bulk, tcp_bulk_lossless=False,
                       route_impl=None, shards=1,
-                      exchange_capacity=None):
+                      exchange_capacity=None, caps=None):
     """Lazy program-key rule for the whole-run factories (compile/):
     the shape vector comes from the FIRST call's sim (telemetry /
     lane / injection attachments change the traced pytree, and the
@@ -309,6 +351,13 @@ def _whole_run_key_fn(bundle: SimBundle, app_handlers, *, end, path,
                  "tcp_bulk_lossless": bool(tcp_bulk_lossless),
                  "tcp_bulk": (type(app_tcp_bulk).__name__
                               if app_tcp_bulk is not None else None)}
+        if caps is not None and caps.key_extra() is not None:
+            # trimmed variants are DIFFERENT executables — key them
+            # apart so they coexist in the store next to their full
+            # twins. Untrimmed specialized builds contribute nothing:
+            # their program is byte-identical to the unspecialized one
+            # and must share its key (and its warm artifacts).
+            extra["caps"] = caps.key_extra()
         census = buckets.kind_census(
             app_handlers, app_bulk,
             fault_plan_digest=(fault_plan_digest(fp)
@@ -358,10 +407,11 @@ def make_runner(bundle: SimBundle, app_handlers=(),
     `compile_info` (a dict) receives the {key, hit, load_s|compile_s}
     block at the first call."""
     caller_fault_fn = fault_fn
-    step = make_step_fn(bundle.cfg, app_handlers)
+    caps = _resolve_caps(bundle, caller_fault_fn)
+    step = make_step_fn(bundle.cfg, app_handlers, caps=caps)
     end = end_time if end_time is not None else bundle.cfg.end_time
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
-                               tcp_bulk_lossless)
+                               tcp_bulk_lossless, caps=caps)
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
     route_fn = _default_route
     if route_impl is not None:
@@ -399,8 +449,9 @@ def make_runner(bundle: SimBundle, app_handlers=(),
                           fault_fn=caller_fault_fn, app_bulk=app_bulk,
                           app_tcp_bulk=app_tcp_bulk,
                           tcp_bulk_lossless=tcp_bulk_lossless,
-                          route_impl=route_impl),
+                          route_impl=route_impl, caps=caps),
         enabled=serve.warm_enabled(default=bool(warm_start)),
+        meta=_caps_meta(caps),
         info=compile_info)
 
 
@@ -445,10 +496,11 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
             "(0 iterations would spin the host loop forever)")
 
     caller_fault_fn = fault_fn
-    step = make_step_fn(bundle.cfg, app_handlers)
+    caps = _resolve_caps(bundle, caller_fault_fn)
+    step = make_step_fn(bundle.cfg, app_handlers, caps=caps)
     end = int(end_time if end_time is not None else bundle.cfg.end_time)
     bulk_fn = _resolve_bulk_fn(bundle, app_bulk, app_tcp_bulk,
-                               tcp_bulk_lossless)
+                               tcp_bulk_lossless, caps=caps)
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
     telem_fn = make_telem_fn()
     wend_fn = resolve_wend_fn(bundle, end, adaptive_jump, fault_fn)
@@ -471,8 +523,10 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
                           adaptive=bool(adaptive_jump),
                           fault_fn=caller_fault_fn, app_bulk=app_bulk,
                           app_tcp_bulk=app_tcp_bulk,
-                          tcp_bulk_lossless=tcp_bulk_lossless),
+                          tcp_bulk_lossless=tcp_bulk_lossless,
+                          caps=caps),
         enabled=serve.warm_enabled(default=bool(warm_start)),
+        meta=_caps_meta(caps),
         info=compile_info)
 
     def go(sim):

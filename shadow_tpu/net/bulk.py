@@ -325,9 +325,17 @@ def _lookup_bulk(net, mask, dst_ip, dst_port, src_ip, src_port):
 
 
 def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
-                 order_impl: str | None = None) -> Callable | None:
+                 order_impl: str | None = None,
+                 caps=None) -> Callable | None:
     """Build the per-window bulk pass, or None when the config cannot
-    support it (static preconditions)."""
+    support it (static preconditions).
+
+    `caps` (compile/specialize.py, None = full program) with a dropped
+    loss capability trims the NIC-egress reliability draw out of the
+    trace: uniform_at is a pure counter query (the app owns every
+    window draw advance — BulkSends.nic_draw_ctr), so skipping it
+    moves no RNG state, and with rel == 1.0 the drop mask it fed is
+    constant-False."""
     if cfg.tcp:
         return None
     if cfg.qdisc != QDisc.FIFO:
@@ -441,18 +449,23 @@ def make_bulk_fn(cfg: NetConfig, app_bulk: AppBulk,
                 sends.dst_host >= 0, sends.dst_host,
                 host_of_ip(net, sends.dst_ip))
         known = smask & (dsth >= 0)
-        u2 = rng.uniform_at(net.rng_keys, sends.nic_draw_ctr)
+        lossless = caps is not None and not caps.loss
         V = net.latency_ns.shape[0]
         if V == 1:
-            rel = net.reliability[0, 0]
             lat = net.latency_ns[0, 0]
         else:
             vsrc = net.vertex_of_host[lane][:, None]
             vdst = net.vertex_of_host[jnp.clip(dsth, 0, GH - 1)]
-            rel = net.reliability[vsrc, vdst]
             lat = net.latency_ns[vsrc, vdst]
-        drop = known & nonboot & (sends.length > 0) & (u2 > rel)
-        emit_ok = known & ~drop
+        if lossless:
+            drop = jnp.zeros_like(known)
+            emit_ok = known
+        else:
+            u2 = rng.uniform_at(net.rng_keys, sends.nic_draw_ctr)
+            rel = (net.reliability[0, 0] if V == 1
+                   else net.reliability[vsrc, vdst])
+            drop = known & nonboot & (sends.length > 0) & (u2 > rel)
+            emit_ok = known & ~drop
 
         # ---- audit parity: last_drop_status (serial order) -----------
         # Per event column at most one drop occurs: a no-socket arrival

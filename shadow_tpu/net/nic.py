@@ -444,7 +444,7 @@ def _qdisc_select(cfg: NetConfig, net: NetState):
     return jnp.where(found, sel, -1)
 
 
-def handle_nic_send(cfg: NetConfig, sim, popped, buf):
+def handle_nic_send(cfg: NetConfig, sim, popped, buf, caps=None):
     """Drain up to cfg.nic_drain packets chosen by the qdisc; chain a
     same-time NIC_SEND event if more remain sendable (ref:
     _networkinterface_sendPackets, network_interface.c:519-579 — the
@@ -471,11 +471,13 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
 
     bootstrap = now < cfg.bootstrap_end
     if cfg.nic_drain <= 1:
-        sim, buf = _drain_one(cfg, sim, buf, mask, now, bootstrap)
+        sim, buf = _drain_one(cfg, sim, buf, mask, now, bootstrap,
+                              caps=caps)
     else:
         sim, buf = jax.lax.fori_loop(
             0, cfg.nic_drain,
-            lambda i, c: _drain_one(cfg, c[0], c[1], mask, now, bootstrap),
+            lambda i, c: _drain_one(cfg, c[0], c[1], mask, now, bootstrap,
+                                    caps=caps),
             (sim, buf))
 
     # continue or re-arm (guard against lanes that already have a
@@ -493,10 +495,19 @@ def handle_nic_send(cfg: NetConfig, sim, popped, buf):
     return sim.replace(net=net), buf
 
 
-def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
+def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap, caps=None):
     """One qdisc selection + wire transmission across all lanes (the
     loop body of the reference's send loop). Lanes with no sendable
-    packet (or no tokens) are masked off and unchanged."""
+    packet (or no tokens) are masked off and unchanged.
+
+    A dropped loss capability (compile/specialize.py — reliability
+    all-ones, no fault plan touching it) trims the Bernoulli draw and
+    the drop bookkeeping out of the trace. Bit-identical: the RNG
+    counter advance is data-independent (rng.uniform returns
+    counters+1), so the trimmed path advances it arithmetically and
+    every later draw lands on the same counter; with rel == 1.0 the
+    drop mask is constant-False and the skipped updates are the
+    identity."""
     net = sim.net
     H = net.rq_head.shape[0]
     lane = jnp.arange(H)
@@ -564,14 +575,23 @@ def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
 
     dsth = host_of_ip(net, dst_ip)
     known = remote & (dsth >= 0)
-    u, ctr = rng.uniform(net.rng_keys, net.rng_ctr)
-    net = net.replace(rng_ctr=jnp.where(remote, ctr, net.rng_ctr))
+    lossless = caps is not None and not caps.loss
+    if lossless:
+        net = net.replace(
+            rng_ctr=net.rng_ctr + remote.astype(net.rng_ctr.dtype))
+    else:
+        u, ctr = rng.uniform(net.rng_keys, net.rng_ctr)
+        net = net.replace(rng_ctr=jnp.where(remote, ctr, net.rng_ctr))
     vsrc = net.vertex_of_host[net.lane_id]
     vdst = net.vertex_of_host[jnp.clip(dsth, 0, GH - 1)]
-    rel = net.reliability[vsrc, vdst]
     lat = net.latency_ns[vsrc, vdst]
-    drop = known & ~bootstrap & (length > 0) & (u > rel)
-    send = known & ~drop
+    if lossless:
+        drop = jnp.zeros_like(known)
+        send = known
+    else:
+        rel = net.reliability[vsrc, vdst]
+        drop = known & ~bootstrap & (length > 0) & (u > rel)
+        send = known & ~drop
     words = words.at[:, pf.W_STATUS].set(jnp.where(
         send, words[:, pf.W_STATUS] | pf.PDS_INET_SENT,
         words[:, pf.W_STATUS]))
@@ -595,10 +615,13 @@ def _drain_one(cfg: NetConfig, sim, buf, mask, now, bootstrap):
     # to reach all three.
     is_retx = (words[:, pf.W_STATUS] & pf.PDS_SND_TCP_RETRANSMITTED) != 0
     net = net.replace(
-        last_drop_status=jnp.where(
-            drop, words[:, pf.W_STATUS] | pf.PDS_INET_DROPPED,
-            net.last_drop_status),
-        ctr_drop_reliability=net.ctr_drop_reliability + drop.astype(I64),
+        **({} if lossless else {
+            "last_drop_status": jnp.where(
+                drop, words[:, pf.W_STATUS] | pf.PDS_INET_DROPPED,
+                net.last_drop_status),
+            "ctr_drop_reliability":
+                net.ctr_drop_reliability + drop.astype(I64),
+        }),
         ctr_drop_nosocket=net.ctr_drop_nosocket + (remote & ~known).astype(I64),
         ctr_tx_packets=net.ctr_tx_packets + active.astype(I64),
         ctr_tx_bytes=net.ctr_tx_bytes + jnp.where(active, wl, 0),

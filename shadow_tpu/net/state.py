@@ -446,6 +446,12 @@ class Sim:
     # None-contributes-no-leaves contract;
     # telemetry.attach_causality() is the opt-in.
     causality: Any = None
+    # GuardState (compile/specialize.py) when the program is a
+    # capability-trimmed specialized variant — one sticky trip counter
+    # per dropped capability, checked once per window — same
+    # None-contributes-no-leaves contract; specialize.apply() is the
+    # opt-in (attached only when something was actually dropped).
+    guard: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

@@ -120,17 +120,31 @@ def _handle_proc_stop(cfg: NetConfig, sim, popped, buf):
         proc_stopped=net.proc_stopped | stop)), buf
 
 
-def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
+def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = (),
+                 caps=None):
     """Build the engine step_fn: netstack receive/timer handlers, then
     app handlers, then the send drain. TCP timer handlers are included
     only when the config carries TCP state (cfg.tcp) — UDP-only device
     programs stay small. A non-negative cfg.cpu_threshold_ns inserts
-    the virtual-CPU admission gate ahead of everything."""
+    the virtual-CPU admission gate ahead of everything.
+
+    `caps` (compile/specialize.py Capabilities, None = full program)
+    statically trims provably-dead subgraphs instead of runtime-gating
+    them: a dropped timers capability OMITS the timer handler family
+    from the trace entirely, and the send drain skips the Bernoulli
+    loss draw (see _drain_one). Bit-identical wherever the
+    capabilities hold; the per-window guard latch (engine.step_window)
+    converts a violation into a fatal health fault."""
     import jax
     import jax.numpy as jnp
 
     pre = _PRE_APP if cfg.tcp else tuple(
         (h, k) for h, k in _PRE_APP if h not in _TCP_HANDLERS)
+    if caps is not None and not caps.timers:
+        # statically-dead family: no handler can ever arm a host timer
+        # (specialize.derive) — omitting it is the identity, and the
+        # guard latch trips fatally if a TIMER appears anyway
+        pre = tuple((h, k) for h, k in pre if h is not timers.handle_timer)
     cpu_on = cfg.cpu_threshold_ns >= 0
 
     def step(sim, popped, buf, census=None):
@@ -167,7 +181,8 @@ def make_step_fn(cfg: NetConfig, app_handlers: Sequence[AppHandler] = ()):
             | jnp.any(sim.net.nic_send_now)
         sim, buf = jax.lax.cond(
             send_pred,
-            lambda op: nic.handle_nic_send(cfg, op[0], popped, op[1]),
+            lambda op: nic.handle_nic_send(cfg, op[0], popped, op[1],
+                                           caps=caps),
             lambda op: op,
             (sim, buf))
         # per-host executed-event accounting (the device analog of the

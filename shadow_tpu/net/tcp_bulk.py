@@ -218,7 +218,8 @@ def _push_local(q, mask, time, kind, words, lane, seq):
 
 def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                      debug: bool = False,
-                     lossless: bool = False) -> Callable | None:
+                     lossless: bool = False,
+                     caps=None) -> Callable | None:
     """Build the TCP bulk window pass, or None when the config cannot
     support it (static preconditions — mirrors bulk.make_bulk_fn).
     debug=True makes bulk_fn return a third value: a dict with the
@@ -261,6 +262,14 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
     R = cfg.router_ring
     BO = cfg.out_ring
     alg = cfg.tcp_cong
+    # Capability trim (compile/specialize.py): a dropped loss
+    # capability removes the per-wire reliability Bernoulli draws from
+    # the trace. Distinct from `lossless` above: that knob narrows the
+    # TCP *artifact* model (SACK/recovery/RTO stop lanes); this one
+    # elides the wire drop draw itself. uniform_at is a pure counter
+    # query — the draw bookkeeping (`drawn`, j_ctr) is kept, so every
+    # surviving draw site sees identical counters.
+    rel_dead = caps is not None and not caps.loss
 
     def _sack_stamps(tcp, at_slot):
         """The SACK advertisement for a departing packet — identically
@@ -1677,10 +1686,13 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                     wire_w = wire_w.at[:, pf.W_STATUS].set(
                         ring_w[:, pf.W_STATUS] | pf.PDS_SND_INTERFACE_SENT)
                     # reliability draw at the exact serial counter
-                    u = rng.uniform_at(net.rng_keys,
-                                       rngc + jnp.asarray(j_ctr,
-                                                          jnp.uint32))
-                    dropj = pj & (lenj > 0) & (u > s_rel)
+                    if rel_dead:
+                        dropj = jnp.zeros_like(pj)
+                    else:
+                        u = rng.uniform_at(net.rng_keys,
+                                           rngc + jnp.asarray(j_ctr,
+                                                              jnp.uint32))
+                        dropj = pj & (lenj > 0) & (u > s_rel)
                     sendj = pj & ~dropj
                     wire_sent = wire_w.at[:, pf.W_STATUS].set(
                         wire_w[:, pf.W_STATUS] | pf.PDS_INET_SENT)
@@ -1969,16 +1981,20 @@ def make_tcp_bulk_fn(cfg: NetConfig, app_bulk: TcpAppBulk,
                         active = active & ~bad
                         known = active & (dsth >= 0)
                         d_nosock = d_nosock + (active & ~known).astype(I32)
-                        u = rng.uniform_at(
-                            net.rng_keys,
-                            rngc + jnp.asarray(drawn, jnp.uint32))
+                        if not rel_dead:
+                            u = rng.uniform_at(
+                                net.rng_keys,
+                                rngc + jnp.asarray(drawn, jnp.uint32))
                         drawn = drawn + active.astype(I32)
                         vdst_k = net.vertex_of_host[
                             jnp.clip(dsth, 0, GH - 1)]
                         vsrc_k = net.vertex_of_host[lane]
-                        relk = net.reliability[vsrc_k, vdst_k]
                         latk = net.latency_ns[vsrc_k, vdst_k]
-                        dropk = known & (lenk > 0) & (u > relk)
+                        if rel_dead:
+                            dropk = jnp.zeros_like(known)
+                        else:
+                            relk = net.reliability[vsrc_k, vdst_k]
+                            dropk = known & (lenk > 0) & (u > relk)
                         sendk = known & ~dropk
                         wire_sent = wds.at[:, pf.W_STATUS].set(
                             wds[:, pf.W_STATUS] | pf.PDS_INET_SENT)

@@ -585,13 +585,21 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
     The input sim may be unsharded; device_put inside applies the
     NamedShardings once per call."""
     from shadow_tpu.net.step import make_step_fn
+    from shadow_tpu.net.build import (_resolve_caps, _resolve_fault_fn,
+                                      _whole_run_key_fn, plan_times)
 
-    step = make_step_fn(bundle.cfg, app_handlers)
+    caller_fault_fn = fault_fn
+    # Capability trims are shard-invariant: the loss trim's counter
+    # arithmetic and the omitted timer family are per-row, and the
+    # guard's scalar trip counters take the generic delta-psum
+    # (_replicate_scalars) like every other sticky latch.
+    caps = _resolve_caps(bundle, caller_fault_fn)
+    step = make_step_fn(bundle.cfg, app_handlers, caps=caps)
     bulk_fn = None
     if app_bulk is not None:
         from shadow_tpu.net.bulk import make_bulk_fn
 
-        bulk_fn = make_bulk_fn(bundle.cfg, app_bulk)
+        bulk_fn = make_bulk_fn(bundle.cfg, app_bulk, caps=caps)
     if bulk_fn is None and app_tcp_bulk is not None:
         # lane-local like the UDP pass (all its reads/writes are
         # per-row or replicated-table gathers), so it drops straight
@@ -599,11 +607,7 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
         from shadow_tpu.net.tcp_bulk import make_tcp_bulk_fn
 
         bulk_fn = make_tcp_bulk_fn(bundle.cfg, app_tcp_bulk,
-                                   lossless=tcp_bulk_lossless)
-    from shadow_tpu.net.build import (_resolve_fault_fn,
-                                      _whole_run_key_fn, plan_times)
-
-    caller_fault_fn = fault_fn
+                                   lossless=tcp_bulk_lossless, caps=caps)
     fault_fn = _resolve_fault_fn(bundle, fault_fn)
     end = end_time if end_time is not None else bundle.cfg.end_time
     return _make_whole_run(
@@ -621,7 +625,7 @@ def make_sharded_runner(bundle, mesh: Mesh, axis: str = "hosts",
             app_bulk=app_bulk, app_tcp_bulk=app_tcp_bulk,
             tcp_bulk_lossless=tcp_bulk_lossless,
             shards=mesh.shape[axis],
-            exchange_capacity=exchange_capacity),
+            exchange_capacity=exchange_capacity, caps=caps),
         warm_start=warm_start, compile_info=compile_info)
 
 

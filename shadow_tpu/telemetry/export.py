@@ -198,6 +198,11 @@ def final_counters(sim, stats=None) -> dict:
     net = sim.net
     out = {
         "drops_total": int(np.asarray(drop_total(net)).sum()),
+        # broken out so the lint can pin a loss-trimmed program's
+        # reliability drops at exactly zero (compile/specialize.py —
+        # the trimmed counter is structurally never written)
+        "drops_reliability_total": int(
+            np.asarray(net.ctr_drop_reliability).sum()),
         "tx_packets_total": int(np.asarray(net.ctr_tx_packets).sum()),
         "rx_packets_total": int(np.asarray(net.ctr_rx_packets).sum()),
         "tx_bytes_total": int(np.asarray(net.ctr_tx_bytes).sum()),
@@ -298,7 +303,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  flows: dict | None = None,
                  admission: dict | None = None,
                  profile: dict | None = None,
-                 causality: dict | None = None) -> dict:
+                 causality: dict | None = None,
+                 specialization: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -399,6 +405,15 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # and the traffic matrix against the flows block;
         # tools/critpath.py derives the speed-of-light report from it
         man["causality"] = causality
+    if specialization is not None:
+        # compile-time capability trimming (compile/specialize.py
+        # specialization_block): the derived capability vector, the
+        # dropped-capability list baked into this program, and the
+        # guard-latch counters proving no dead capability fired.
+        # tools/telemetry_lint.py checks vector/dropped consistency,
+        # that dropped capabilities' drop counters stayed zero, and
+        # that a tripped guard was reported fatal
+        man["specialization"] = specialization
     return man
 
 

@@ -301,18 +301,22 @@ class _LoopPlan:
 
     __slots__ = ("cfg", "step", "end", "min_jump", "fault_fn",
                  "caller_fault_fn", "bulk_fn", "wpd", "adaptive",
-                 "chunked", "shards")
+                 "chunked", "shards", "caps")
 
 
 def _resolve_loop(bundle, app_handlers, *, end_time, fault_fn, mesh,
                   mesh_axis, windows_per_dispatch, adaptive_jump,
                   sim=None):
-    from shadow_tpu.net.build import _resolve_bulk_fn, _resolve_fault_fn
+    from shadow_tpu.net.build import (_resolve_bulk_fn, _resolve_caps,
+                                      _resolve_fault_fn)
     from shadow_tpu.net.step import make_step_fn
 
     p = _LoopPlan()
     cfg = p.cfg = bundle.cfg
-    p.step = make_step_fn(cfg, app_handlers)
+    # capability-trimmed variant (compile/specialize.py): same rule as
+    # the whole-run factories — an opaque caller fault_fn disables it
+    p.caps = _resolve_caps(bundle, fault_fn)
+    p.step = make_step_fn(cfg, app_handlers, caps=p.caps)
     p.end = int(end_time if end_time is not None else cfg.end_time)
     p.min_jump = max(int(bundle.min_jump), 1)
     p.caller_fault_fn = fault_fn
@@ -325,7 +329,7 @@ def _resolve_loop(bundle, app_handlers, *, end_time, fault_fn, mesh,
     # host-driven loop could never close the throughput gap to
     # engine.run no matter how many windows a dispatch amortizes
     p.bulk_fn = _resolve_bulk_fn(bundle, getattr(bundle, "app_bulk", None),
-                                 None)
+                                 None, caps=p.caps)
     wpd = (int(windows_per_dispatch) if windows_per_dispatch is not None
            else max(1, int(getattr(cfg, "windows_per_dispatch", 1) or 1)))
     if wpd < 1:
@@ -363,6 +367,10 @@ def _program_key_for(bundle, plan, sim, app_handlers, *, sharded,
     fp = getattr(bundle, "fault_plan", None)
     extra = {"path": ("sharded_" if sharded else "")
              + ("chunk" if plan.chunked else "window")}
+    if plan.caps is not None and plan.caps.key_extra() is not None:
+        # trimmed variants key apart from their full twins (see
+        # net.build._whole_run_key_fn); untrimmed builds share keys
+        extra["caps"] = plan.caps.key_extra()
     if plan.adaptive:
         # the adaptive wend rule bakes the host->vertex map into the
         # traced pair mask (net.build.adaptive_jump_spec)
@@ -399,6 +407,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
         step_window,
     )
     from shadow_tpu.compile import serve
+    from shadow_tpu.net.build import _caps_meta
     from shadow_tpu.telemetry.flows import make_flow_fn
     from shadow_tpu.telemetry.ring import make_telem_fn
 
@@ -439,6 +448,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
         example = (sim, EngineStats.create(),
                    jnp.asarray(0, simtime.DTYPE))
         chunk_fn = serve.maybe_warm(raw, key, enabled=warm, store=store,
+                                    meta=_caps_meta(plan.caps),
                                     info=compile_info)
         return chunk_fn, None, key, raw, example
     if mesh is not None:
@@ -465,6 +475,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
                                flow_fn=flow_fn)
     example = (sim, 0, plan.min_jump)
     one_window = serve.maybe_warm(raw, key, enabled=warm, store=store,
+                                  meta=_caps_meta(plan.caps),
                                   info=compile_info)
     return None, one_window, key, raw, example
 
@@ -490,7 +501,10 @@ def prewarm_dispatch(bundle, app_handlers=(), *, end_time=None, sim=None,
         exchange_capacity=exchange_capacity, warm=False, store=store,
         compile_info={})
     st = store if store is not None else default_store()
-    _, info = st.get_or_compile(key, raw, example)
+    from shadow_tpu.net.build import _caps_meta
+
+    _, info = st.get_or_compile(key, raw, example,
+                                meta=_caps_meta(plan.caps))
     return info
 
 

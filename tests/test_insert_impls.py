@@ -180,3 +180,43 @@ def test_sweep_matches_scatter_across_random_shapes():
             dst_of=lambda h, c: hot if rng.random() < 0.5
             else int(rng.integers(0, H)))
         _assert_all_equal(q, out, narrows=(0, max(2, M // 2)))
+
+
+def test_no_pallas_env_gate_and_gather_fallback_identity(monkeypatch):
+    """SHADOW_NO_PALLAS=1 must force mailbox_available False (the
+    device-fault-bisection escape hatch) and leave the sort2 insert
+    bit-identical: the select sweep then takes the XLA windowed-gather
+    fallback, which this CPU suite compares plane-for-plane against
+    the sort/count reference impls and the ungated run."""
+    from shadow_tpu.core import insert_pallas
+
+    monkeypatch.setenv("SHADOW_NO_PALLAS", "1")
+    assert insert_pallas.mailbox_available(8) is False
+    assert insert_pallas.mailbox_available(
+        insert_pallas._MAX_SMEM_START_ROWS) is False
+
+    rng = np.random.default_rng(7)
+    H, K, M, W = 31, 8, 6, 6
+    q = _mkqueue(rng, H, K, W, fill=0.3)
+    cnt = rng.integers(0, M + 1, H)
+    cols = {h: sorted(rng.choice(M, size=cnt[h], replace=False))
+            for h in range(H)}
+    dsts = {(h, c): int(rng.integers(0, H))
+            for h in range(H) for c in cols[h]}
+    out = _mkoutbox(rng, H, M, W,
+                    cols_of_row=lambda h: cols[h],
+                    dst_of=lambda h, c: dsts[(h, c)])
+    ref = None
+    for env in ("1", None):
+        if env is None:
+            monkeypatch.delenv("SHADOW_NO_PALLAS", raising=False)
+        else:
+            monkeypatch.setenv("SHADOW_NO_PALLAS", env)
+        for impl in IMPLS:
+            q2, _ = ev.route_outbox(q, out, impl=impl, narrow=0)
+            s = _snap(q2)
+            if ref is None:
+                ref = s
+            else:
+                for i, (a, b) in enumerate(zip(ref, s)):
+                    assert np.array_equal(a, b), (env, impl, i)

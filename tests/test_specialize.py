@@ -1,0 +1,252 @@
+"""Compile-time program specialization (compile/specialize.py):
+bit-identity of capability-trimmed variants across shard/chunk
+splits, structural jaxpr assertions that the dead subgraphs are
+actually gone from the trace, program-key separation in the warm
+store, and the guard latch converting a capability violation into a
+fatal health fault."""
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from shadow_tpu.apps import phold
+from shadow_tpu.compile import specialize
+from shadow_tpu.core import simtime
+from shadow_tpu.core.events import EmitBuffer, EventKind, pop_earliest
+from shadow_tpu.faults import health
+from shadow_tpu.net.build import (HostSpec, _whole_run_key_fn, build,
+                                  make_runner)
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.net.step import make_step_fn
+from shadow_tpu.utils import checkpoint as ckpt
+
+from tests.test_phold import ONE_VERTEX
+
+HANDLERS = (phold.handler,)
+
+
+def _build(num_hosts=16, load=4, seconds=1, seed=1):
+    cfg = NetConfig(num_hosts=num_hosts, tcp=False,
+                    end_time=seconds * simtime.ONE_SECOND, seed=seed)
+    hosts = [HostSpec(name=f"peer{i}", proc_start_time=0)
+             for i in range(num_hosts)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    b.sim = phold.setup(b.sim, load=load)
+    return b
+
+
+def _specialized(**kw):
+    b = specialize.apply(_build(**kw), HANDLERS)
+    assert b.caps is not None and b.caps.dropped()
+    return b
+
+
+def _run(b, shards=1, wpd=1):
+    mesh = None
+    if shards > 1:
+        mesh = Mesh(np.array(jax.devices()[:shards]), ("hosts",))
+    sim, stats, _ = ckpt.run_windows(b, HANDLERS, mesh=mesh,
+                                     windows_per_dispatch=wpd)
+    return jax.device_get((sim, stats))
+
+
+@pytest.fixture(scope="module")
+def full_single():
+    """Unspecialized serial baseline every variant must match."""
+    return _run(_build())
+
+
+# ---------------------------------------------------------------- vector
+
+
+def test_phold_vector_trims_loss_and_timers():
+    b = _specialized()
+    assert b.caps.dropped() == ("loss", "timers")
+    assert b.caps.key_extra() == "no_loss-no_timers"
+    assert b.sim.guard is not None
+    assert b.sim.guard.watched() == ("loss", "timers")
+    blk = specialize.specialization_block(b.caps, b.sim)
+    assert blk["dropped"] == ["loss", "timers"]
+    assert blk["guard"] == {"watched": ["loss", "timers"],
+                            "loss_trips": 0, "timer_trips": 0}
+
+
+def test_mode_off_detaches_vector():
+    b = specialize.apply(_specialized(), HANDLERS, mode="off")
+    assert b.caps is None
+
+
+def test_lossy_or_undeclared_handler_keeps_capabilities_live():
+    # reliability below 1.0 keeps loss live
+    b = _build()
+    b.sim = b.sim.replace(net=b.sim.net.replace(
+        reliability=b.sim.net.reliability * 0.5))
+    b = specialize.apply(b, HANDLERS)
+    assert b.caps.loss and "loss" not in b.caps.dropped()
+    # a handler that never declared its emit kinds keeps timers live
+    def mute(sim, popped, active, buf):  # pragma: no cover - not traced
+        return sim, buf
+    b2 = specialize.apply(_build(), (mute,))
+    assert b2.caps.timers
+
+
+# ---------------------------------------------------------- bit-identity
+
+
+def test_trimmed_final_state_identical_every_leaf(full_single):
+    """Serial trimmed run: every Sim leaf (guard aside) and the run
+    stats must be bit-identical to the unspecialized program."""
+    fsim, fstats = full_single
+    tsim, tstats = _run(_specialized())
+    g = tsim.guard
+    assert int(g.loss_trips) == 0 and int(g.timer_trips) == 0
+    fleaves, fdef = jax.tree_util.tree_flatten(fsim)
+    tleaves, tdef = jax.tree_util.tree_flatten(tsim.replace(guard=None))
+    assert fdef == tdef
+    for a, b in zip(fleaves, tleaves):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert int(fstats.events_processed) == int(tstats.events_processed)
+
+
+@pytest.mark.parametrize("shards,wpd", [(1, 1), (1, 64), (8, 1), (8, 64)])
+def test_bit_identity_across_shards_and_chunks(full_single, shards, wpd):
+    """The ISSUE acceptance matrix: the trimmed variant at every
+    shard x windows-per-dispatch split reproduces the unspecialized
+    serial baseline bit-for-bit (per-host results, RNG counters and
+    the surviving event stream; queue slot order is split-dependent,
+    values are not)."""
+    fsim, fstats = full_single
+    tsim, tstats = _run(_specialized(), shards=shards, wpd=wpd)
+    assert int(tsim.guard.loss_trips) == 0
+    assert int(tsim.guard.timer_trips) == 0
+    np.testing.assert_array_equal(fsim.app.sent, tsim.app.sent)
+    np.testing.assert_array_equal(fsim.app.rcvd, tsim.app.rcvd)
+    np.testing.assert_array_equal(fsim.net.rng_ctr, tsim.net.rng_ctr)
+    np.testing.assert_array_equal(fsim.net.ctr_rx_bytes,
+                                  tsim.net.ctr_rx_bytes)
+    np.testing.assert_array_equal(fsim.net.ctr_tx_packets,
+                                  tsim.net.ctr_tx_packets)
+    np.testing.assert_array_equal(np.sort(np.asarray(fsim.events.time)),
+                                  np.sort(np.asarray(tsim.events.time)))
+    assert int(fstats.events_processed) == int(tstats.events_processed)
+
+
+# ------------------------------------------------------------ jaxpr
+
+
+def test_jaxpr_omits_trimmed_subgraphs():
+    """Structural assertion on CPU: the specialized step fn contains
+    NO Bernoulli draw (the rng uniform of the send drain) and fewer
+    equations overall (the timer handler family is gone), instead of
+    runtime-gated versions of both."""
+    cfg = NetConfig(num_hosts=4, tcp=False,
+                    end_time=simtime.ONE_SECOND, seed=1)
+    hosts = [HostSpec(name=f"peer{i}", proc_start_time=0)
+             for i in range(4)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    caps = specialize.Capabilities(loss=False, timers=False)
+    q, popped = pop_earliest(b.sim.events, b.cfg.end_time)
+    sim = b.sim.replace(events=q)
+    buf = EmitBuffer.create(cfg.num_hosts, cfg.emit_capacity)
+
+    def trace(step):
+        return jax.make_jaxpr(step)(sim, popped, buf)
+
+    full = trace(make_step_fn(cfg, ()))
+    trim = trace(make_step_fn(cfg, (), caps=caps))
+    full_txt, trim_txt = str(full), str(trim)
+    assert "uniform" in full_txt        # the per-send loss draw
+    assert "uniform" not in trim_txt    # statically gone, not gated
+    assert "random" not in trim_txt
+    assert len(trim.jaxpr.eqns) < len(full.jaxpr.eqns)
+
+
+# ------------------------------------------------------- program keys
+
+
+def _key_for(b, caps):
+    fn = _whole_run_key_fn(b, HANDLERS, end=b.cfg.end_time, path="whole",
+                           chunk_windows=0, adaptive=False, fault_fn=None,
+                           app_bulk=None, app_tcp_bulk=None, caps=caps)
+    return fn((b.sim,), {})
+
+
+def test_program_key_separates_trimmed_variant():
+    full_b = _build()
+    spec_b = _specialized()
+    k_full = _key_for(full_b, None)
+    k_spec = _key_for(spec_b, spec_b.caps)
+    assert k_full != k_spec
+
+
+def test_untrimmed_specialized_build_keys_identically():
+    """Nothing dropped => no guard leaves, no key contribution: the
+    specialized build must share the unspecialized program and its
+    warm artifacts."""
+    b = _build()
+    b.sim = b.sim.replace(net=b.sim.net.replace(
+        reliability=b.sim.net.reliability * 0.5))
+    def mute(sim, popped, active, buf):  # pragma: no cover - not traced
+        return sim, buf
+    sb = specialize.apply(dataclasses.replace(b), (mute,))
+    assert sb.caps.dropped() == ()
+    assert sb.caps.key_extra() is None
+    assert sb.sim.guard is None
+    assert _key_for(b, None) == _key_for(sb, sb.caps)
+
+
+def test_opaque_fault_fn_rejected_on_specialized_bundle():
+    b = _specialized()
+    with pytest.raises(ValueError, match="opaque"):
+        make_runner(b, HANDLERS, fault_fn=lambda s, w: s)
+
+
+# ------------------------------------------------------------- guard
+
+
+def test_guard_trips_fatal_on_lossy_table():
+    """A loss-trimmed program fed a sim whose reliability table was
+    mutated under it (the checkpoint-restore hazard) must latch the
+    guard and surface a FATAL health fault, never silently diverge."""
+    b = _specialized()
+    tampered = b.sim.replace(net=b.sim.net.replace(
+        reliability=b.sim.net.reliability * 0.5))
+    runner = make_runner(b, HANDLERS)
+    sim, _ = runner(tampered)
+    rep = specialize.guard_report(sim)
+    assert rep["loss_trips"] > 0 and rep["timer_trips"] == 0
+    h = health.gather(sim)
+    assert h.guard_loss_trips > 0
+    assert h.guard_tripped and h.fatal
+    assert any(sev == "fatal" and "specialization guard" in msg
+               for sev, msg in h.diagnostics())
+
+
+def test_guard_trips_fatal_on_resident_timer():
+    """A TIMER event staged into a timer-trimmed program's queue (an
+    external path the static analysis could not see) trips the timer
+    watch."""
+    b = _specialized()
+    q = b.sim.events
+    assert int(np.asarray(q.time)[0, 0]) != simtime.INVALID
+    tampered = b.sim.replace(events=q.replace(
+        kind=q.kind.at[0, 0].set(int(EventKind.TIMER))))
+    runner = make_runner(b, HANDLERS)
+    sim, _ = runner(tampered)
+    rep = specialize.guard_report(sim)
+    assert rep["timer_trips"] > 0
+    h = health.gather(sim)
+    assert h.guard_timer_trips > 0
+    assert h.guard_tripped and h.fatal
+
+
+def test_jobspec_validates_specialize():
+    from shadow_tpu.fleet.spec import JobSpec
+
+    assert JobSpec(id="j1").specialize == "auto"
+    assert JobSpec(id="j2", specialize="off").specialize == "off"
+    with pytest.raises(ValueError, match="specialize"):
+        JobSpec(id="j3", specialize="bogus")

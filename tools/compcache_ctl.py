@@ -70,9 +70,16 @@ def cmd_ls(args) -> int:
     for m in entries:
         servable = (m.get("code") == code_now
                     and m.get("jax") == jax_now)
+        # capability vector (compile/specialize.py): a trimmed
+        # variant's sidecar records what was dropped — `full` means
+        # the general program
+        spec = m.get("specialization") or {}
+        tag = spec.get("key_extra") or ("full" if not spec.get("dropped")
+                                        else "-".join(spec["dropped"]))
         print(f"{m.get('key', '?'):20s} {int(m.get('nbytes', 0)):>12d}B "
               f"{_age(float(m.get('mtime', 0.0))):>7s} "
               f"code={str(m.get('code'))[:8]} jax={m.get('jax')} "
+              f"spec={tag} "
               f"{'servable' if servable else 'STALE'}")
     return 0
 
@@ -124,6 +131,20 @@ def cmd_prewarm(args) -> int:
         if grown:
             print(f"bucketing capacities: {grown}")
             b = b.rebuild(grown)
+    if args.specialize != "off":
+        # prewarm the variant a fleet run of this config will actually
+        # serve: the capability-trimmed program when the build proves
+        # trims sound, keyed by its own store entry
+        # (compile/specialize.py)
+        from shadow_tpu.compile import specialize
+
+        b = specialize.apply(b, loaded.handlers,
+                             app_bulk=getattr(b, "app_bulk", None),
+                             mode=args.specialize)
+        if b.caps is not None and b.caps.dropped():
+            print(f"specializing: trimmed "
+                  f"{','.join(b.caps.dropped())} "
+                  f"(key extra {b.caps.key_extra()!r})")
     store = _store(args) if args.root else None
     info = serve.prewarm(b, loaded.handlers, store=store,
                          log=lambda m: print(m))
@@ -151,6 +172,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--exact", action="store_true",
                    help="skip capacity bucketing (bespoke shapes)")
+    p.add_argument("--specialize", choices=("auto", "off"),
+                   default="auto",
+                   help="prewarm the capability-trimmed variant the "
+                        "fleet will serve (auto, default) or the full "
+                        "general program (off)")
     args = ap.parse_args(argv)
     return {"ls": cmd_ls, "stats": cmd_stats, "gc": cmd_gc,
             "prewarm": cmd_prewarm}[args.cmd](args)

@@ -161,6 +161,120 @@ def _lint_compile_block(comp, where: str) -> tuple[list, list]:
     return errors, warnings
 
 
+_SPEC_TRIMMABLE = ("loss", "timers")
+
+
+def _lint_specialization(spec, ctr, health) -> tuple[list, list]:
+    """(errors, warnings) for a manifest's "specialization" block
+    (compile/specialize.py specialization_block). The invariants are
+    the safety contract of capability trimming: the dropped list must
+    be the trimmable subset of the capability vector's False flags,
+    the program-key extra must be derived from exactly that list, a
+    dropped loss capability means the reliability drop counter was
+    structurally never written (so it is exactly zero), and a tripped
+    guard latch is a FATAL health verdict — never a silent integer."""
+    errors: list = []
+    warnings: list = []
+    w = "specialization"
+    if not isinstance(spec, dict):
+        return ([f"{w} must be an object"], [])
+    mode = spec.get("mode")
+    if mode != "auto":
+        errors.append(f'{w}.mode must be "auto" (a --specialize off '
+                      f"run writes no block), got {mode!r}")
+    caps = spec.get("capabilities")
+    if not isinstance(caps, dict):
+        errors.append(f"{w}.capabilities must be an object")
+        caps = {}
+    for k, v in sorted(caps.items()):
+        if not isinstance(v, bool):
+            errors.append(f"{w}.capabilities.{k} must be a bool, "
+                          f"got {v!r}")
+    dropped = spec.get("dropped")
+    if not isinstance(dropped, list):
+        errors.append(f"{w}.dropped must be a list")
+        dropped = []
+    for n in dropped:
+        if n not in _SPEC_TRIMMABLE:
+            errors.append(f"{w}.dropped contains {n!r} — only "
+                          f"{list(_SPEC_TRIMMABLE)} are trimmable")
+        elif caps.get(n) is not False:
+            errors.append(
+                f"{w}: {n!r} is dropped but capabilities.{n} is "
+                f"{caps.get(n)!r} — a dropped capability must be "
+                f"recorded dead in the vector")
+    for n in _SPEC_TRIMMABLE:
+        if caps.get(n) is False and n not in dropped:
+            errors.append(
+                f"{w}: capabilities.{n}=false but {n!r} is not in "
+                f"dropped — a dead trimmable capability is always "
+                f"trimmed")
+    want_extra = "-".join(
+        "no_" + n for n in sorted(x for x in dropped
+                                  if x in _SPEC_TRIMMABLE)) or None
+    if spec.get("key_extra") != want_extra:
+        errors.append(
+            f"{w}.key_extra={spec.get('key_extra')!r} does not match "
+            f"the dropped list (expected {want_extra!r}) — the store "
+            f"key and the manifest must name the same variant")
+    # guard latch: one watch per dropped capability, counters are
+    # non-negative, and a nonzero counter MUST coincide with a fatal
+    # health verdict (the whole point of the latch)
+    g = spec.get("guard")
+    tripped = 0
+    if g is not None:
+        if not isinstance(g, dict):
+            errors.append(f"{w}.guard must be an object")
+            g = {}
+        watched = g.get("watched")
+        if isinstance(watched, list) and sorted(watched) != \
+                sorted(x for x in dropped if x in _SPEC_TRIMMABLE):
+            errors.append(
+                f"{w}.guard.watched={watched} must equal the dropped "
+                f"list {sorted(dropped)} — every trimmed capability "
+                f"is watched, nothing else is")
+        for k in ("loss_trips", "timer_trips"):
+            v = g.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{w}.guard.{k} must be a non-negative "
+                              f"integer, got {v!r}")
+            else:
+                tripped += v
+        if tripped:
+            hg = (health or {}).get("guard", {}) \
+                if isinstance(health, dict) else {}
+            surfaced = bool(hg.get("tripped")) or any(
+                "specialization guard tripped" in d
+                for d in (health or {}).get("diagnostics", [])
+                if isinstance(d, str))
+            if not surfaced:
+                errors.append(
+                    f"{w}.guard counters are nonzero "
+                    f"(loss={g.get('loss_trips')}, "
+                    f"timer={g.get('timer_trips')}) but the health "
+                    f"block does not report the trip as fatal — a "
+                    f"violated trim assumption must fail the run, "
+                    f"never degrade it silently")
+            else:
+                warnings.append(
+                    f"{w}: guard latch tripped {tripped} window(s) — "
+                    f"the run was (correctly) reported fatal; rerun "
+                    f"with --specialize off")
+    elif dropped:
+        warnings.append(
+            f"{w}: dropped={dropped} but no guard block — the final "
+            f"sim was not available to the manifest writer")
+    if "loss" in dropped and not tripped:
+        dr = (ctr or {}).get("drops_reliability_total")
+        if dr is not None and dr != 0:
+            errors.append(
+                f"counters.drops_reliability_total={dr} but the loss "
+                f"capability was trimmed — the trimmed program cannot "
+                f"write that counter; the manifest is lying about "
+                f"which program ran")
+    return errors, warnings
+
+
 _FLOW_HIST_KEY = re.compile(r"^lane\d+/\d+->\d+/k-?\d+$")
 
 
@@ -1092,6 +1206,14 @@ def lint_manifest_obj(man) -> tuple[list, list]:
                 warnings.append(
                     f"conformance: {conf['diverge']} workload(s) "
                     f"diverged between backends: {bad}")
+    # compile-time specialization block (optional): vector/dropped
+    # coherence, key derivation, guard-latch fatality
+    spec = man.get("specialization")
+    if spec is not None:
+        e2, w2 = _lint_specialization(spec, man.get("counters"),
+                                      man.get("health"))
+        errors += e2
+        warnings += w2
     # supervisor chain identity (optional): run_id / resume_of are
     # opaque id strings; a resume_of without a run_id is incoherent
     for k in ("run_id", "resume_of"):
