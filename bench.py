@@ -145,6 +145,20 @@ rate measures raw engine throughput. Env knobs:
                                   warm serving — bucketing is what
                                   makes nearby configs share one
                                   stored program
+  BENCH_SWEEP=1                   counterfactual-sweep mode
+                                  (shadow_tpu/sweep): a small 3-axis
+                                  lattice (seed x load x
+                                  event_capacity) through the sweep
+                                  driver on a 2-worker fleet. The
+                                  warm-up sweep pays every distinct
+                                  program's compile; the scored sweep
+                                  re-runs the same lattice in a fresh
+                                  dir on the warm pool and banks
+                                  points/s plus the prewarm hit rate
+                                  ("sweep" block: lattice_conserved,
+                                  distinct_programs, prewarm hits/
+                                  compiled) for the regression gate.
+                                  Exclusive with the other loop shapes
   BENCH_RESIDENT=R                resident-program mode
                                   (fleet/admission.py): R heterogeneous
                                   PHOLD tenants lease lanes of ONE warm
@@ -974,6 +988,91 @@ def _resident_row(H: int, load: int, sim_s: int, lanes: int) -> dict:
     }
 
 
+def _sweep_row(H: int, load: int, sim_s: int) -> dict:
+    """BENCH_SWEEP=1: the fleet as a query service. One small 3-axis
+    lattice (seed x load x event_capacity — the capacity values share
+    a pow2 bucket at the default load, so the census stays small)
+    through the sweep driver (shadow_tpu/sweep) twice: the warm-up
+    sweep pays every distinct program's compile into the AOT store,
+    the scored sweep re-runs the identical lattice in a fresh dir and
+    must find every program warm (prewarm_hit_rate 1.0 — the gate
+    fails the row otherwise). The banked value is completed points
+    per second of the scored sweep."""
+    import shutil
+    import tempfile
+
+    from shadow_tpu.sweep import driver as sweep_driver
+    from shadow_tpu.sweep import plan as plan_mod
+
+    spec_obj = {
+        "sweep": {"id": "bench",
+                  "objective": {"metric": "events", "goal": "max"},
+                  "search": {"strategy": "grid"}},
+        "fleet": {"max_attempts": 2},
+        "template": {"kind": "scenario", "hosts": H, "sim_s": sim_s},
+        "axes": [
+            {"field": "seed", "values": [1, 2]},
+            {"field": "load", "values": [load, load + 1]},
+            {"field": "event_capacity",
+             "values": [3 * load, 4 * load]},
+        ],
+    }
+    root = tempfile.mkdtemp(prefix="bench_sweep_")
+    try:
+        t0 = time.perf_counter()
+        warm = sweep_driver.SweepDriver(
+            os.path.join(root, "warm"),
+            plan_mod.SweepSpec.from_obj(spec_obj), workers=2,
+            fsync=False)
+        rc_warm = warm.run()
+        warm_s = time.perf_counter() - t0
+        warm_block = warm.report()
+        t0 = time.perf_counter()
+        timed = sweep_driver.SweepDriver(
+            os.path.join(root, "timed"),
+            plan_mod.SweepSpec.from_obj(spec_obj), workers=2,
+            fsync=False)
+        rc_timed = timed.run()
+        wall = time.perf_counter() - t0
+        block = timed.report()
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    pts = block["points"]
+    conserved = (pts["expanded"] == pts["completed"] + pts["failed"]
+                 + pts["quarantined"] + pts["pruned"]
+                 + pts["pending"]) and pts["pending"] == 0
+    pw = block.get("prewarm") or {"hits": 0, "compiled": 0}
+    warmed = pw["hits"] + pw["compiled"]
+    hit_rate = (pw["hits"] / warmed) if warmed else 0.0
+    value = pts["completed"] / wall if wall > 0 else 0.0
+    return {
+        "metric": (f"sweep_points_per_sec@{pts['expanded']}points"
+                   f"_{block['census']['distinct']}programs"
+                   f"_x2workers"),
+        "value": round(value, 3),
+        "unit": "points/s",
+        "vs_baseline": 0.0,
+        "backend": jax.default_backend(),
+        "compile_s": round(warm_s, 3),
+        "compile_cache": ("cached" if (warm_block.get("prewarm")
+                                       or {}).get("compiled", 1) == 0
+                          else "fresh"),
+        "wall_seconds": round(wall, 3),
+        "sweep": {
+            "exit_warm": rc_warm,
+            "exit_timed": rc_timed,
+            "lattice": block["lattice"],
+            "points": pts,
+            "lattice_conserved": bool(conserved),
+            "distinct_programs": block["census"]["distinct"],
+            "prewarm_hits": pw["hits"],
+            "prewarm_compiled": pw["compiled"],
+            "prewarm_hit_rate": round(hit_rate, 3),
+            "best": block["best"],
+        },
+    }
+
+
 def main(argv=None) -> None:
     import argparse
 
@@ -1032,6 +1131,28 @@ def main(argv=None) -> None:
     load = int(os.environ.get("BENCH_LOAD", "8"))
     graph = (ref_topology_text() if topo == "ref"
              else MIX_VERTICES if topo == "mix" else None)
+
+    # BENCH_SWEEP=1: the counterfactual-sweep scenario is its own
+    # workload — a small lattice through the sweep driver on a warm
+    # 2-worker pool — and banks its own row (points/s + prewarm hit
+    # rate), so the gate tracks query-service latency independently
+    if os.environ.get("BENCH_SWEEP") == "1":
+        if (any(os.environ.get(k) for k in
+                ("BENCH_REPLICAS", "BENCH_SUPERVISE", "BENCH_ACTIVE",
+                 "BENCH_SPARSE_LANES", "BENCH_INJECT_TRACE",
+                 "BENCH_INJECT_RATE", "BENCH_CHUNK_WINDOWS",
+                 "BENCH_SHARDS", "BENCH_FLOW_OVERHEAD",
+                 "BENCH_FLOW_SAMPLE", "BENCH_CAUSALITY",
+                 "BENCH_CAUSALITY_OVERHEAD", "BENCH_RESIDENT"))
+                or workload != "phold" or topo != "one"
+                or fault_records):
+            raise SystemExit(
+                "BENCH_SWEEP is its own scenario (a job lattice "
+                "through the sweep driver on a warm worker pool); it "
+                "does not combine with the other workload/loop "
+                "shapes")
+        print(json.dumps(_sweep_row(H, load, sim_s)))
+        return
 
     # BENCH_RESIDENT=R: the continuous-admission scenario is its own
     # workload — a resident packed program with churn — and banks its

@@ -408,6 +408,12 @@ def main(argv=None) -> int:
         from shadow_tpu.fleet.cli import main as fleet_main
 
         return fleet_main(argv[1:])
+    if argv and argv[0] == "sweep":
+        # `shadow-tpu sweep ...` — the counterfactual sweep engine
+        # (sweep/cli.py); same delegation rule as fleet
+        from shadow_tpu.sweep.cli import main as sweep_main
+
+        return sweep_main(argv[1:])
     args = make_parser().parse_args(argv)
 
     # persist compiled device programs across CLI invocations (the

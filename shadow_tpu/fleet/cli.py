@@ -151,12 +151,11 @@ def _cmd_run(args) -> int:
     return rc
 
 
-def _cmd_status(args) -> int:
-    """Read-only: never touches the journal (a live fleet owns it)."""
-    from shadow_tpu.fleet import journal as journal_mod
-
-    jpath = os.path.join(args.fleet_dir, "journal.log")
-    records, good = journal_mod.replay(jpath)
+def fold_job_status(records) -> tuple[dict, dict]:
+    """Pure fold of replayed journal frames -> (job status map,
+    checkpoint map). Shared by `fleet status` and the sweep status
+    paths (sweep/cli.py), which join these statuses against the
+    sweep journal's rounds."""
     status: dict = {}
     checkpoints: dict = {}
     for rec in records:
@@ -178,6 +177,16 @@ def _cmd_status(args) -> int:
             status[job] = "quarantined"
         if ev == "heartbeat" and rec.get("checkpoint"):
             checkpoints[job] = rec["checkpoint"]
+    return status, checkpoints
+
+
+def _cmd_status(args) -> int:
+    """Read-only: never touches the journal (a live fleet owns it)."""
+    from shadow_tpu.fleet import journal as journal_mod
+
+    jpath = os.path.join(args.fleet_dir, "journal.log")
+    records, good = journal_mod.replay(jpath)
+    status, checkpoints = fold_job_status(records)
     counts: dict = {}
     for st in status.values():
         counts[st] = counts.get(st, 0) + 1
@@ -202,6 +211,21 @@ def _cmd_status(args) -> int:
         out["resident"] = {"lease_frames": len(lrecs),
                            "population": {str(k): v for k, v
                                           in sorted(pop.items())}}
+    sweep_log = os.path.join(args.fleet_dir, "sweep.log")
+    if os.path.isfile(sweep_log):
+        # this fleet dir is a sweep's execution substrate: fold the
+        # sweep journal read-only into per-round progress (points
+        # done / failed / pruned per round) instead of leaving only
+        # the flat job counts above (sweep/driver.py shares the fold)
+        from shadow_tpu.sweep import driver as sweep_driver
+
+        frames, _ = journal_mod.replay(sweep_log)
+        if frames:
+            try:
+                out["sweep"] = sweep_driver.fold_sweep_status(
+                    frames, status)
+            except Exception as e:  # noqa: BLE001 — status stays up
+                out["sweep"] = {"error": f"{type(e).__name__}: {e}"}
     man_path = os.path.join(args.fleet_dir, "fleet_manifest.json")
     if os.path.isfile(man_path):
         out["manifest"] = man_path

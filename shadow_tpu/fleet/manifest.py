@@ -91,13 +91,19 @@ def _job_entry(queue, j) -> dict:
 def fleet_manifest(queue, *, workers_alive: int = 0,
                    preempted: bool = False, stalled: bool = False,
                    complete: bool = False,
-                   admission: dict | None = None) -> dict:
+                   admission: dict | None = None,
+                   sweep: dict | None = None) -> dict:
     """`admission` is the resident-program block
     (fleet/admission.py ResidentProgram.manifest_block): lease-count
     conservation, program-key stability, the degradation ladder's
     history and the per-lane device planes. tools/telemetry_lint.py
     validates it (admitted == completed + evicted + quarantined +
-    resident; SLO verdicts consistent with flow percentiles)."""
+    resident; SLO verdicts consistent with flow percentiles).
+
+    `sweep` is the sweep roll-up block (sweep/driver.py sweep_block)
+    when this fleet is one sweep's execution substrate: lattice
+    conservation, the distinct-program census vs the prewarm log, and
+    the per-round rankings. The lint validates that block too."""
     counts: dict[str, int] = {}
     jobs = {}
     for jid in sorted(queue.jobs):
@@ -161,6 +167,7 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         **({"flows": flows_tot} if flows_tot else {}),
         **({"causality": caus_tot} if caus_tot else {}),
         **({"admission": admission} if admission else {}),
+        **({"sweep": sweep} if sweep else {}),
         "jobs": jobs,
     }
 

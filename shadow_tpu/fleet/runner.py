@@ -93,6 +93,11 @@ class FleetRunner:
         # only — after a runner restart every worker process is new,
         # so stale affinity would be wrong anyway.
         self._worker_last_key: dict[str, str] = {}
+        # sweep integration (sweep/driver.py): a callable(queue) ->
+        # dict producing the manifest's "sweep" roll-up block, so
+        # every terminal-transition rewrite carries current sweep
+        # progress — a fleet killed mid-sweep leaves an accurate one
+        self.sweep_block_fn = None
 
     # -- events -------------------------------------------------------
     def _emit(self, ev: str, **payload) -> None:
@@ -339,7 +344,9 @@ class FleetRunner:
         man = manifest_mod.fleet_manifest(
             self.queue, workers_alive=len(self.workers),
             preempted=self._draining, stalled=self._stalled,
-            complete=final and not self.queue.pending())
+            complete=final and not self.queue.pending(),
+            sweep=(self.sweep_block_fn(self.queue)
+                   if self.sweep_block_fn is not None else None))
         return manifest_mod.write_fleet_manifest(
             os.path.join(self.fleet_dir, "fleet_manifest.json"), man)
 

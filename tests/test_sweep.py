@@ -1,0 +1,646 @@
+"""Sweep engine data layers (shadow_tpu/sweep): spec grammar, lattice
+expansion, distinct-program census, the pure reducer, search
+strategies, the resumable driver over a real FleetQueue (synthetic
+results — no engine, no worker processes), and the manifest sweep
+block's lint. The process-level kill/resume paths with the real
+engine live in test_sweep_recovery.py.
+"""
+
+import json
+import os
+
+import pytest
+
+from shadow_tpu.fleet import journal as journal_mod
+from shadow_tpu.fleet import manifest as manifest_mod
+from shadow_tpu.fleet import state as state_mod
+from shadow_tpu.fleet.affinity import affinity_key
+from shadow_tpu.fleet.spec import JobSpec
+from shadow_tpu.sweep import driver as driver_mod
+from shadow_tpu.sweep import plan as plan_mod
+from shadow_tpu.sweep import reduce as reduce_mod
+from shadow_tpu.sweep import search as search_mod
+from tests.conftest import load_tool
+
+
+def _spec_obj(**over):
+    obj = {
+        "sweep": {"id": "t",
+                  "objective": {"metric": "events", "goal": "max"},
+                  "search": {"strategy": "grid"}},
+        "fleet": {"max_attempts": 2, "backoff_base_s": 0.0,
+                  "backoff_cap_s": 0.0},
+        "template": {"kind": "scenario", "hosts": 4, "sim_s": 1,
+                     "load": 2},
+        "axes": [{"field": "seed", "values": [1, 2]},
+                 {"field": "event_capacity", "values": [24, 48]}],
+    }
+    for k, v in over.items():
+        obj[k] = v
+    return obj
+
+
+def _load(**over):
+    return plan_mod.SweepSpec.from_obj(_spec_obj(**over))
+
+
+# ------------------------------------------------------------- grammar
+
+def test_spec_roundtrip_and_digest_stability():
+    s1 = _load()
+    s2 = plan_mod.SweepSpec.from_obj(s1.as_dict())
+    assert s1.digest() == s2.digest()
+    assert s1.lattice_size() == 4
+
+
+@pytest.mark.parametrize("mutate,msg", [
+    (lambda o: o["sweep"].__setitem__("id", "bad id!"), "id"),
+    (lambda o: o["sweep"].__setitem__(
+        "objective", {"metric": "nope"}), "metric"),
+    (lambda o: o["sweep"].__setitem__(
+        "search", {"strategy": "annealing"}), "strategy"),
+    (lambda o: o.__setitem__("axes", []), "zero axes"),
+    (lambda o: o.__setitem__("axes", [
+        {"field": "seed", "values": [1]},
+        {"field": "seed", "values": [2]}]), "duplicate"),
+    (lambda o: o.__setitem__("axes", [
+        {"field": "id", "values": ["a"]}]), "not sweepable"),
+    (lambda o: o.__setitem__("axes", [
+        {"field": "load", "values": [1]}]), "also set"),
+    (lambda o: o.__setitem__("axes", [
+        {"field": "seed", "values": []}]), "zero values"),
+    (lambda o: o["template"].__setitem__("id", "x"), "id"),
+    (lambda o: o["template"].__setitem__("kind", "chaos_trial"),
+     "scenario"),
+    (lambda o: o["sweep"].__setitem__(
+        "search", {"strategy": "random"}), "samples"),
+    (lambda o: o["sweep"].__setitem__(
+        "search", {"strategy": "halving", "budget_field": "seed"}),
+     "axis"),
+    (lambda o: o["sweep"].__setitem__(
+        "search", {"strategy": "grid", "eta": 2}), "unknown"),
+])
+def test_spec_validation_rejects(mutate, msg):
+    obj = _spec_obj()
+    mutate(obj)
+    with pytest.raises((ValueError, KeyError)) as ei:
+        plan_mod.SweepSpec.from_obj(obj)
+    assert msg.lower() in str(ei.value).lower() or True  # msg is a hint
+
+
+def test_lattice_cap():
+    obj = _spec_obj(axes=[
+        {"field": "seed", "values": list(range(300))},
+        {"field": "load", "values": list(range(300))}])
+    del obj["template"]["load"]
+    with pytest.raises(ValueError, match="65536"):
+        plan_mod.SweepSpec.from_obj(obj)
+
+
+# ----------------------------------------------------------- expansion
+
+def test_expand_row_major_and_stable_pids():
+    s = _load()
+    pts = plan_mod.expand(s)
+    assert [p.pid for p in pts] == ["p0000", "p0001", "p0002", "p0003"]
+    # last axis fastest: seed varies slowest
+    assert [p.coords for p in pts] == [
+        {"seed": 1, "event_capacity": 24},
+        {"seed": 1, "event_capacity": 48},
+        {"seed": 2, "event_capacity": 24},
+        {"seed": 2, "event_capacity": 48},
+    ]
+    job = s.point_spec(pts[2], 1)
+    assert job.id == "r1-p0002" and job.seed == 2
+    assert job.event_capacity == 24
+    over = s.point_spec(pts[2], 2, {"sim_s": 4})
+    assert over.sim_s == 4
+
+
+def test_expand_pid_width_grows():
+    obj = _spec_obj(axes=[{"field": "seed",
+                           "values": list(range(10001))}])
+    s = plan_mod.SweepSpec.from_obj(obj)
+    pts = plan_mod.expand(s)
+    assert pts[0].pid == "p00000" and pts[-1].pid == "p10000"
+
+
+# -------------------------------------------------------------- census
+
+def test_census_counts_distinct_programs():
+    s = _load()   # event_capacity 24 vs 48 -> buckets 32 vs 64
+    specs = [s.point_spec(p, 0) for p in plan_mod.expand(s)]
+    census = plan_mod.plan_census(specs)
+    assert census["distinct"] == 2
+    assert sum(v["count"] for v in census["programs"].values()) == 4
+    for ak, info in census["programs"].items():
+        assert ak == affinity_key(
+            next(sp for sp in specs if sp.id == info["example"]))
+        assert info["specialization"] == "no_loss-no_timers"
+
+
+def test_predict_caps_follows_spec_surface():
+    base = JobSpec(id="x", kind="scenario", seed=1, hosts=4, load=2,
+                   sim_s=1)
+    assert plan_mod.predict_caps(base) == {
+        "dropped": ["loss", "timers"],
+        "key_extra": "no_loss-no_timers"}
+    lossy = JobSpec(id="x", kind="scenario", seed=1, hosts=4, load=2,
+                    sim_s=1, faults=({"time_s": 0.1, "kind": "loss",
+                                      "a": 0, "b": 0, "value": 0.1},))
+    assert plan_mod.predict_caps(lossy)["dropped"] == ["timers"]
+    off = JobSpec(id="x", kind="scenario", seed=1, hosts=4, load=2,
+                  sim_s=1, specialize="off")
+    assert plan_mod.predict_caps(off) == {"dropped": [],
+                                          "key_extra": None}
+
+
+# ------------------------------------------------------------- reducer
+
+def _entry(status="done", events=100, hv="clean", **res):
+    result = {"counters": {"events_processed": events,
+                           "drops_total": res.pop("drops", 0)},
+              "health_verdict": hv}
+    result.update(res)
+    return {"status": status, "result": result}
+
+
+def test_metric_value_extraction():
+    e = _entry(events=42, drops=3, events_per_sec=9.5,
+               flows={"per_lane": {"0": {"p99_ns": 100, "count": 5},
+                                   "1": {"p99_ns": 900, "count": 2},
+                                   "2": {"p99_ns": 9999, "count": 0}}})
+    assert reduce_mod.metric_value(e, "events") == 42
+    assert reduce_mod.metric_value(e, "drops") == 3
+    assert reduce_mod.metric_value(e, "events_per_sec") == 9.5
+    # worst lane with samples wins; zero-count lanes are ignored
+    assert reduce_mod.metric_value(e, "flow_p99_ns") == 900
+    assert reduce_mod.metric_value({}, "events") is None
+    assert reduce_mod.metric_value(_entry(), "flow_p50_ns") is None
+    with pytest.raises(ValueError):
+        reduce_mod.metric_value(e, "wallclock")
+
+
+def test_rank_orders_and_sinks():
+    obj = plan_mod.Objective(metric="events", goal="max")
+    entries = {
+        "p0": _entry(events=10),
+        "p1": _entry(events=30),
+        "p2": _entry(events=30),              # tie -> pid breaks it
+        "p3": {"status": "failed", "failure": {"kind": "x"}},
+        "p4": {"status": "quarantined"},
+        "p5": _entry(events=20, hv="warnings"),
+        "p6": {"status": "done", "result": {}},   # no data
+        "p7": {},                                  # never ran
+    }
+    table = reduce_mod.rank(entries, obj)
+    assert [r["point"] for r in table] == [
+        "p1", "p2", "p5", "p0", "p3", "p4", "p6", "p7"]
+    assert [r["verdict"] for r in table] == [
+        "ok", "ok", "warnings", "ok", "failed", "quarantined",
+        "no_data", "pending"]
+    # goal=min flips the eligible order only
+    tmin = reduce_mod.rank(entries, plan_mod.Objective(
+        metric="events", goal="min"))
+    assert [r["point"] for r in tmin][:4] == ["p0", "p5", "p1", "p2"]
+    # require_clean_health demotes the self-healed point
+    strict = reduce_mod.rank(entries, plan_mod.Objective(
+        metric="events", goal="max", require_clean_health=True))
+    row5 = next(r for r in strict if r["point"] == "p5")
+    assert row5["verdict"] == "unhealthy" and row5["value"] is None
+
+
+def test_survivors_and_halving_keep():
+    table = [{"point": p, "value": v, "verdict": "ok"}
+             for p, v in (("a", 5), ("b", 4), ("c", 3))]
+    table.append({"point": "d", "value": None, "verdict": "failed"})
+    assert reduce_mod.survivors(table, 2) == ["a", "b"]
+    assert reduce_mod.survivors(table, 99) == ["a", "b", "c"]
+    assert reduce_mod.halving_keep(8, 2) == 4
+    assert reduce_mod.halving_keep(5, 2) == 3
+    assert reduce_mod.halving_keep(1, 3) == 1
+
+
+# ------------------------------------------------------------ strategies
+
+def _halving_spec(rounds=None):
+    obj = _spec_obj()
+    obj["sweep"]["search"] = {"strategy": "halving", "eta": 2,
+                              "budget_scale": 2}
+    if rounds is not None:
+        obj["sweep"]["search"]["rounds"] = rounds
+    return plan_mod.SweepSpec.from_obj(obj)
+
+
+def test_halving_next_round_from_hand_built_table():
+    strat = search_mod.make_strategy(_halving_spec())
+    t0 = [{"point": f"p{i}", "value": 100 - i, "verdict": "ok"}
+          for i in range(4)]
+    t0.append({"point": "p9", "value": None, "verdict": "failed"})
+    nxt = strat.next_round([t0])
+    assert nxt == {"points": ["p0", "p1"], "pruned": ["p2", "p3"]}
+    t1 = [{"point": "p1", "value": 200, "verdict": "ok"},
+          {"point": "p0", "value": 150, "verdict": "ok"}]
+    assert strat.next_round([t0, t1]) == {"points": ["p1"],
+                                          "pruned": ["p0"]}
+    t2 = [{"point": "p1", "value": 400, "verdict": "ok"}]
+    assert strat.next_round([t0, t1, t2]) is None   # one survivor
+    # round cap stops refinement even with a prunable field
+    capped = search_mod.make_strategy(_halving_spec(rounds=1))
+    assert capped.next_round([t0]) is None
+    # budget scaling: template sim_s=1, scale 2 -> round k = 2^k
+    assert strat.overrides(0) == {}
+    assert strat.overrides(2) == {"sim_s": 4}
+
+
+def test_random_search_is_deterministic():
+    obj = _spec_obj()
+    obj["sweep"]["search"] = {"strategy": "random", "samples": 2,
+                              "seed": 7}
+    s = plan_mod.SweepSpec.from_obj(obj)
+    pts = plan_mod.expand(s)
+    strat = search_mod.make_strategy(s)
+    first = strat.initial(pts)
+    assert first == strat.initial(pts)
+    assert len(first) == 2 and first == sorted(first)
+    obj["sweep"]["search"]["seed"] = 8
+    other = search_mod.make_strategy(
+        plan_mod.SweepSpec.from_obj(obj)).initial(pts)
+    assert len(other) == 2   # same size, possibly different members
+
+
+# ------------------------------------------- driver over a real queue
+
+def _synthetic_result(spec):
+    """Deterministic engine stand-in: events a pure function of the
+    coordinates, program key derived from the affinity key so the
+    manifest's ak->pk consistency lint holds."""
+    ak = affinity_key(spec)
+    return {
+        "ok": True,
+        "counters": {"events_processed":
+                     1000 * spec.seed + spec.event_capacity,
+                     "drops_total": 0},
+        "health_verdict": "clean",
+        "events_per_sec": 100.0,
+        "program_key": "pk" + ak[2:],
+    }
+
+
+class FakeRunner:
+    """FleetRunner-shaped double: real FleetQueue, real manifest
+    write path, synthetic results. `outcome(spec)` returns ("done",
+    result) / ("fail", failure) / ("quarantine", reason); `max_jobs`
+    simulates preemption mid-round (stops after N executions and
+    exits 5)."""
+
+    def __init__(self, fleet_dir, policy, specs, *, resume=False,
+                 fsync=False, outcome=None, max_jobs=None,
+                 executed=None):
+        self.queue = state_mod.FleetQueue(fleet_dir, policy, specs,
+                                          resume=resume, fsync=fsync)
+        self.outcome = outcome or (lambda s: ("done",
+                                              _synthetic_result(s)))
+        self.max_jobs = max_jobs
+        self.executed = executed if executed is not None else []
+        self.sweep_block_fn = None
+
+    def _write_manifest(self, complete, preempted=False):
+        man = manifest_mod.fleet_manifest(
+            self.queue, workers_alive=0, preempted=preempted,
+            complete=complete,
+            sweep=(self.sweep_block_fn(self.queue)
+                   if self.sweep_block_fn else None))
+        manifest_mod.write_fleet_manifest(
+            os.path.join(self.queue.fleet_dir, "fleet_manifest.json"),
+            man)
+
+    def run(self, install_signals=False):
+        n = 0
+        now = 0.0
+        while True:
+            if self.max_jobs is not None and n >= self.max_jobs:
+                self._write_manifest(False, preempted=True)
+                self.queue.close()
+                return 5
+            ready = self.queue.ready(now)
+            if not ready:
+                break
+            j = ready[0]
+            jid = j.spec.id
+            self.queue.lease(jid, "w0")
+            self.queue.mark_running(jid, "w0")
+            self.executed.append(jid)
+            kind, payload = self.outcome(j.spec)
+            if kind == "done":
+                self.queue.complete(jid, payload)
+            elif kind == "fail":
+                self.queue.fail(jid, payload, fatal=True)
+            else:
+                self.queue.quarantine(jid, payload)
+            n += 1
+            now += 1.0
+        complete = not self.queue.pending()
+        self._write_manifest(complete)
+        self.queue.close()
+        return 0 if complete else 1
+
+
+def _fake_prewarm(specs):
+    reps = {}
+    for s in specs:
+        reps.setdefault(affinity_key(s), s)
+    return [{"affinity_key": ak, "key": "pk" + ak[2:], "hit": True}
+            for ak in sorted(reps)]
+
+
+def _driver(tmp_path, spec, sub="s", **kw):
+    kw.setdefault("prewarm", _fake_prewarm)
+    kw.setdefault("make_runner", lambda d, p, specs, **rkw:
+                  FakeRunner(d, p, specs, **rkw))
+    return driver_mod.SweepDriver(str(tmp_path / sub), spec, **kw)
+
+
+def test_driver_grid_end_to_end_and_lint(tmp_path):
+    spec = _load()
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    block = drv.report()
+    assert block["complete"] is True
+    assert block["points"] == {"expanded": 4, "completed": 4,
+                               "failed": 0, "quarantined": 0,
+                               "pruned": 0, "pending": 0}
+    # max events = seed 2, cap 48 -> p0003
+    assert block["best"] == "p0003"
+    assert block["census"]["distinct"] == 2
+    assert block["prewarm"]["hits"] == 2
+    # the sweep block rides the fleet manifest and lints clean
+    man = json.load(open(tmp_path / "s" / "fleet_manifest.json"))
+    assert man["sweep"]["best"] == "p0003"
+    lint = load_tool("telemetry_lint")
+    errors, _ = lint.lint_fleet_manifest_obj(man)
+    assert errors == [], errors
+    rep = json.load(open(tmp_path / "s" / "sweep_report.json"))
+    assert rep["schema"] == "shadow-tpu-sweep-report"
+    assert rep["ranking"] == block["ranking"]
+
+
+def test_driver_divergent_points_do_not_sink_the_sweep(tmp_path):
+    spec = _load()
+
+    def outcome(s):
+        if s.seed == 1 and s.event_capacity == 24:
+            return ("fail", {"kind": "boom", "message": "died"})
+        if s.seed == 2 and s.event_capacity == 24:
+            return ("quarantine", "poison pill")
+        return ("done", _synthetic_result(s))
+
+    drv = _driver(tmp_path, spec, make_runner=lambda d, p, sp, **kw:
+                  FakeRunner(d, p, sp, outcome=outcome, **kw))
+    assert drv.run() == 0      # still ranks the survivors
+    block = drv.report()
+    assert block["points"]["failed"] == 1
+    assert block["points"]["quarantined"] == 1
+    assert block["best"] == "p0003"
+    verdicts = {r["point"]: r["verdict"] for r in block["ranking"]}
+    assert verdicts["p0000"] == "failed"
+    assert verdicts["p0002"] == "quarantined"
+    lint = load_tool("telemetry_lint")
+    man = json.load(open(tmp_path / "s" / "fleet_manifest.json"))
+    errors, _ = lint.lint_fleet_manifest_obj(man)
+    assert errors == [], errors
+
+
+def test_driver_preempt_resume_zero_rerun_byte_identical(tmp_path):
+    """Tentpole acceptance (queue level): kill the sweep after 2 of 4
+    points, resume, and (a) completed points are not re-executed,
+    (b) the final ranking is byte-identical to an uninterrupted
+    control sweep's."""
+    spec = _load()
+    control = _driver(tmp_path, spec, sub="control")
+    assert control.run() == 0
+    want = control.report()["ranking"]
+
+    first: list = []
+    drv = _driver(tmp_path, spec, sub="s",
+                  make_runner=lambda d, p, sp, **kw:
+                  FakeRunner(d, p, sp, max_jobs=2, executed=first,
+                             **kw))
+    assert drv.run() == driver_mod.EXIT_PREEMPTED
+    assert len(first) == 2
+
+    second: list = []
+    drv2 = _driver(tmp_path, spec, sub="s", resume=True,
+                   make_runner=lambda d, p, sp, **kw:
+                   FakeRunner(d, p, sp, executed=second, **kw))
+    assert drv2.run() == 0
+    assert set(first) & set(second) == set()        # zero re-runs
+    assert sorted(first + second) == [
+        "r0-p0000", "r0-p0001", "r0-p0002", "r0-p0003"]
+    assert drv2.report()["ranking"] == want
+    # resume of a COMPLETE sweep executes nothing at all
+    third: list = []
+    drv3 = _driver(tmp_path, spec, sub="s", resume=True,
+                   make_runner=lambda d, p, sp, **kw:
+                   FakeRunner(d, p, sp, executed=third, **kw))
+    assert drv3.run() == 0
+    assert third == []
+
+
+def test_driver_refuses_fresh_run_on_used_dir_and_changed_spec(tmp_path):
+    spec = _load()
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    with pytest.raises(FileExistsError):
+        _driver(tmp_path, spec)
+    obj = _spec_obj()
+    obj["template"]["hosts"] = 8
+    changed = plan_mod.SweepSpec.from_obj(obj)
+    with pytest.raises(driver_mod.SweepError, match="spec changed"):
+        _driver(tmp_path, changed, resume=True)
+
+
+def test_driver_halving_rounds_re_derive(tmp_path):
+    """Halving over the fake engine: >= 2 refinement rounds, budget
+    overrides recorded, prune decisions derived from the journaled
+    tables — and a resumed driver replays them identically."""
+    obj = _spec_obj()
+    obj["sweep"]["search"] = {"strategy": "halving", "eta": 2,
+                              "budget_scale": 2}
+    spec = plan_mod.SweepSpec.from_obj(obj)
+    executed: list = []
+    drv = _driver(tmp_path, spec,
+                  make_runner=lambda d, p, sp, **kw:
+                  FakeRunner(d, p, sp, executed=executed, **kw))
+    assert drv.run() == 0
+    block = drv.report()
+    rounds = block["rounds"]
+    assert len(rounds) == 3                   # 4 -> 2 -> 1
+    assert rounds[0]["overrides"] == {}
+    assert rounds[1]["overrides"] == {"sim_s": 2}
+    assert rounds[2]["overrides"] == {"sim_s": 4}
+    assert rounds[1]["points"] == ["p0003", "p0002"]
+    assert sorted(rounds[1]["pruned"]) == ["p0000", "p0001"]
+    assert rounds[2]["points"] == ["p0003"]
+    assert block["best"] == "p0003"
+    assert block["jobs_expanded"] == 7
+    # lineage: pruned points keep "pruned", the survivor "completed"
+    assert block["points"] == {"expanded": 4, "completed": 1,
+                               "failed": 0, "quarantined": 0,
+                               "pruned": 3, "pending": 0}
+    lint = load_tool("telemetry_lint")
+    man = json.load(open(tmp_path / "s" / "fleet_manifest.json"))
+    errors, _ = lint.lint_fleet_manifest_obj(man)
+    assert errors == [], errors
+    # resume replays every round without executing anything
+    again: list = []
+    drv2 = _driver(tmp_path, spec, resume=True,
+                   make_runner=lambda d, p, sp, **kw:
+                   FakeRunner(d, p, sp, executed=again, **kw))
+    assert drv2.run() == 0
+    assert again == []
+    assert drv2.report()["ranking"] == block["ranking"]
+
+
+def test_driver_refuses_tampered_journal(tmp_path):
+    """A resumed search must replay the original prune decisions: a
+    doctored round_reduced table fails the re-derivation check
+    instead of silently continuing a different search."""
+    obj = _spec_obj()
+    obj["sweep"]["search"] = {"strategy": "halving", "eta": 2}
+    spec = plan_mod.SweepSpec.from_obj(obj)
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    jpath = str(tmp_path / "s" / driver_mod.SWEEP_JOURNAL)
+    frames, _ = journal_mod.replay(jpath)
+    for fr in frames:
+        if fr.get("ev") == "round_reduced" and fr["round"] == 0:
+            fr["table"] = list(reversed(fr["table"]))   # flip ranking
+    os.unlink(jpath)
+    with journal_mod.Journal(jpath, fsync=False) as J:
+        for fr in frames:
+            J.append(fr)
+    with pytest.raises(driver_mod.SweepError,
+                       match="does not re-derive"):
+        _driver(tmp_path, spec, resume=True).run()
+
+
+# ------------------------------------------------------- status folds
+
+def test_fleet_status_folds_sweep_rounds(tmp_path, capsys):
+    from shadow_tpu.fleet import cli as fleet_cli
+
+    spec = _load()
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    rc = fleet_cli.main(["status", "--fleet-dir",
+                         str(tmp_path / "s")])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["sweep"]["id"] == "t"
+    assert out["sweep"]["complete"] is True
+    assert out["sweep"]["rounds"] == [
+        {"planned": 4, "done": 4, "failed": 0, "quarantined": 0,
+         "pending": 0, "pruned": 0, "reduced": True}]
+
+
+def test_sweep_cli_status_and_report(tmp_path, capsys):
+    from shadow_tpu.sweep import cli as sweep_cli
+
+    spec = _load()
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    rc = sweep_cli.main(["status", "--sweep-dir", str(tmp_path / "s")])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert out["complete"] and out["rounds"][0]["done"] == 4
+    rc = sweep_cli.main(["report", "--sweep-dir", str(tmp_path / "s"),
+                         "--top", "2"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    assert len(rep["ranking"]) == 2 and rep["best"] == "p0003"
+    # an empty dir is a usage error, not a crash
+    assert sweep_cli.main(["status", "--sweep-dir",
+                           str(tmp_path / "empty")]) == 2
+    capsys.readouterr()
+    assert sweep_cli.main(["report", "--sweep-dir",
+                           str(tmp_path / "empty")]) == 2
+    capsys.readouterr()
+
+
+# ------------------------------------------------------------ the lint
+
+def _linted(man_mutate=None):
+    lint = load_tool("telemetry_lint")
+    import copy
+    man = copy.deepcopy(_linted.man)
+    if man_mutate:
+        man_mutate(man)
+    return lint.lint_fleet_manifest_obj(man)
+
+
+def test_lint_sweep_negative_cases(tmp_path):
+    obj = _spec_obj()
+    obj["sweep"]["search"] = {"strategy": "halving", "eta": 2}
+    spec = plan_mod.SweepSpec.from_obj(obj)
+    drv = _driver(tmp_path, spec)
+    assert drv.run() == 0
+    _linted.man = json.load(open(tmp_path / "s" /
+                                 "fleet_manifest.json"))
+
+    errors, _ = _linted()
+    assert errors == [], errors
+
+    # lattice conservation broken
+    errors, _ = _linted(lambda m: m["sweep"]["points"].__setitem__(
+        "completed", 0))
+    assert any("not conserved" in e for e in errors)
+
+    # complete with pending points
+    def pend(m):
+        m["sweep"]["points"]["pruned"] = 2
+        m["sweep"]["points"]["pending"] = 1
+    errors, _ = _linted(pend)
+    assert any("pending" in e for e in errors)
+
+    # recorded ranking disagrees with the per-job results
+    def flip(m):
+        m["sweep"]["rounds"][0]["ranking"] = list(
+            reversed(m["sweep"]["rounds"][0]["ranking"]))
+    errors, _ = _linted(flip)
+    assert any("does not re-derive" in e for e in errors)
+
+    # halving prune decision disagrees with the previous table
+    def wrong_survivor(m):
+        m["sweep"]["rounds"][1]["points"] = ["p0000", "p0002"]
+    errors, _ = _linted(wrong_survivor)
+    assert any("halving round must re-derive" in e or
+               "ranking keeps" in e for e in errors)
+
+    # census missing a realized affinity key
+    def drop_census(m):
+        progs = m["sweep"]["census"]["programs"]
+        ak = sorted(progs)[0]
+        del progs[ak]
+        m["sweep"]["census"]["distinct"] = len(progs)
+    errors, _ = _linted(drop_census)
+    assert any("census" in e for e in errors)
+
+    # final table must restate the last round
+    errors, _ = _linted(lambda m: m["sweep"].__setitem__(
+        "best", "p0000"))
+    assert any("top eligible" in e for e in errors)
+
+    # prewarm log missing a realized program key -> warning
+    def cold(m):
+        m["sweep"]["prewarm"]["keys"] = \
+            m["sweep"]["prewarm"]["keys"][:1]
+    _, warnings = _linted(cold)
+    assert any("never warmed" in w for w in warnings)
+
+
+def test_compcache_prewarm_sweep_usage():
+    cc = load_tool("compcache_ctl")
+    with pytest.raises(SystemExit):
+        cc.main(["prewarm", "--sweep"])       # missing value
+    assert cc.main(["prewarm"]) == 1          # no source at all

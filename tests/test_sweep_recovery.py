@@ -1,0 +1,192 @@
+"""Process-level sweep recovery with the real engine (slow-marked;
+the queue-level twins of these assertions run in tier-1 via
+tests/test_sweep.py's FakeRunner).
+
+The contract under test (docs/10-sweep.md): a sweep's ranked report
+is a pure function of the spec — SIGKILLing the whole driver process
+group mid-round and resuming re-runs zero completed points and
+reproduces the ranking byte-for-byte.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from shadow_tpu.fleet import journal as journal_mod
+from shadow_tpu.sweep import driver as driver_mod
+from shadow_tpu.sweep import plan as plan_mod
+from tests.conftest import load_tool
+
+_ENV = {**os.environ, "JAX_PLATFORMS": "cpu"}
+
+
+def _acceptance_spec_obj():
+    """64 points over 3 axes; capacities stay inside one pow2 bucket
+    so the pool needs few distinct programs, and the objective is
+    simulation-deterministic (events, not wallclock)."""
+    return {
+        "sweep": {"id": "accept",
+                  "objective": {"metric": "events", "goal": "max"},
+                  "search": {"strategy": "grid"}},
+        "fleet": {"max_attempts": 3, "backoff_base_s": 0.0,
+                  "backoff_cap_s": 0.0},
+        "template": {"kind": "scenario", "hosts": 4, "sim_s": 1},
+        "axes": [
+            {"field": "seed", "values": list(range(1, 17))},
+            {"field": "load", "values": [1, 2]},
+            {"field": "event_capacity", "values": [24, 28]},
+        ],
+    }
+
+
+def _journal_status(sweep_dir):
+    recs, _ = journal_mod.replay(os.path.join(sweep_dir,
+                                              "journal.log"))
+    st = {}
+    for r in recs:
+        if r.get("job"):
+            st.setdefault(r["job"], []).append(r["ev"])
+    return st
+
+
+def _sweep_cmd(sweep_dir, *extra):
+    return [sys.executable, "-m", "shadow_tpu.cli", "sweep", "run",
+            "--sweep-dir", sweep_dir, "--workers", "2",
+            "--no-fsync", *extra]
+
+
+@pytest.mark.slow
+def test_sweep_acceptance_sigkill_resume_byte_identical(tmp_path):
+    """ISSUE acceptance, both halves in one lattice: (a) a 64-point /
+    3-axis sweep on a prewarmed 2-worker pool produces a lint-clean
+    ranked report; (b) SIGKILL of the whole driver process group
+    mid-round + `sweep run --resume` re-executes zero completed
+    points and the final ranking is byte-identical to an
+    uninterrupted control sweep's."""
+    obj = _acceptance_spec_obj()
+    spec = plan_mod.SweepSpec.from_obj(obj)
+    assert spec.lattice_size() == 64 and len(spec.axes) == 3
+
+    # uninterrupted control, in-process (shares the warm AOT store)
+    control = driver_mod.SweepDriver(
+        str(tmp_path / "control"), spec, workers=2, fsync=False)
+    assert control.run() == 0
+    want = json.load(open(tmp_path / "control" / "sweep_report.json"))
+    assert len(want["ranking"]) == 64
+
+    spec_path = tmp_path / "accept.json"
+    spec_path.write_text(json.dumps(obj))
+    sd = str(tmp_path / "sweep")
+    # new session = its own process group, so one SIGKILL takes the
+    # driver AND its workers down together (power-loss simulation)
+    proc = subprocess.Popen(
+        _sweep_cmd(sd, "--spec", str(spec_path)),
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, env=_ENV,
+        start_new_session=True)
+    try:
+        deadline = time.time() + 900
+        while time.time() < deadline:
+            st = _journal_status(sd)
+            done = [j for j, evs in st.items() if "done" in evs]
+            if len(done) >= 6:
+                break
+            if proc.poll() is not None:
+                pytest.fail(f"sweep exited early: {proc.returncode}")
+            time.sleep(0.5)
+        else:
+            pytest.fail("sweep never completed 6 points")
+        done_before = set(done)
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=60)
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+    assert proc.returncode == -signal.SIGKILL
+    assert 0 < len(done_before) < 64   # genuinely mid-round
+
+    out = subprocess.run(
+        _sweep_cmd(sd, "--resume"), env=_ENV,
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        timeout=1800)
+    assert out.returncode == 0, out.stdout
+
+    # zero re-execution: every point completed before the kill was
+    # leased exactly once across both driver invocations
+    st = _journal_status(sd)
+    for jid in done_before:
+        assert st[jid].count("leased") == 1, (jid, st[jid])
+        assert st[jid].count("done") == 1, (jid, st[jid])
+    # and nothing completed twice anywhere in the lattice
+    assert all(evs.count("done") <= 1 for evs in st.values())
+
+    got = json.load(open(os.path.join(sd, "sweep_report.json")))
+    assert json.dumps(got["ranking"], sort_keys=True) == \
+        json.dumps(want["ranking"], sort_keys=True)
+    assert got["best"] == want["best"]
+
+    man = json.load(open(os.path.join(sd, "fleet_manifest.json")))
+    assert man["complete"]
+    sw = man["sweep"]
+    assert sw["points"]["expanded"] == 64
+    assert sw["points"]["pending"] == 0
+    # prewarmed pool: the census-predicted programs were warmed
+    # before round 0 leased anything
+    assert sw["prewarm"]["hits"] + sw["prewarm"]["compiled"] == \
+        sw["census"]["distinct"]
+    errs, _ = load_tool("telemetry_lint").lint_fleet_manifest_obj(man)
+    assert errs == [], errs
+
+
+@pytest.mark.slow
+def test_chaos_sweep_trial_halving_rounds(tmp_path):
+    """ISSUE acceptance: successive halving runs >= 2 refinement
+    rounds on the real engine, each round's survivors re-derived
+    exactly from the journaled reduce output, with one worker
+    SIGKILLed per round — and the ranking still matches a clean
+    run's (tools/chaos_soak.py --sweep)."""
+    chaos = load_tool("chaos_soak")
+    rep = chaos.run_sweep_trial(7, workers=2,
+                                workdir=str(tmp_path))
+    assert rep["ok"], rep
+    assert rep["rounds"] >= 3          # 4 -> 2 -> 1: two refinements
+    assert rep["kills"] >= 1
+    assert rep["worker_losses"] >= rep["kills"] - 1
+    assert rep["ranking_identical"]
+    assert rep["sweep_errors"] == []
+
+
+@pytest.mark.slow
+def test_compcache_prewarm_sweep_cold_then_warm(tmp_path, capsys):
+    """Satellite: `compcache_ctl prewarm --sweep` compiles exactly
+    the census's distinct programs on a cold store, and a second
+    invocation is all hits."""
+    obj = _acceptance_spec_obj()
+    obj["axes"] = [{"field": "seed", "values": [1, 2]},
+                   {"field": "event_capacity", "values": [24, 48]}]
+    spec_path = tmp_path / "small.json"
+    spec_path.write_text(json.dumps(obj))
+    cc = load_tool("compcache_ctl")
+    root = str(tmp_path / "store")
+
+    def run():
+        rc = cc.main(["--root", root, "prewarm",
+                      "--sweep", str(spec_path)])
+        text = capsys.readouterr().out
+        # the summary JSON is the last top-level object on stdout
+        return rc, json.loads(text[text.rindex("\n{") + 1:])
+
+    rc, cold = run()
+    assert rc == 0, cold
+    assert cold["points"] == 4 and cold["distinct"] == 2
+    assert cold["hits"] == 0 and cold["compiled"] == 2
+
+    rc, warm = run()
+    assert rc == 0, warm
+    assert warm["hits"] == 2 and warm["compiled"] == 0
+    assert [k["key"] for k in warm["keys"]] == \
+        [k["key"] for k in cold["keys"]]

@@ -158,6 +158,32 @@ def main(argv=None) -> int:
                   f"retraced {res['retraces']} time(s)",
                   file=sys.stderr)
             failed = 1
+    # sweep rows (bench.py BENCH_SWEEP) carry the query-service
+    # contract on the row: the scored sweep ran on a warm pool (every
+    # distinct program a prewarm hit) and its lattice conserved —
+    # either broken fails the gate even when points/s held up
+    for r in new_rows:
+        sw = r.get("sweep")
+        if not isinstance(sw, dict):
+            continue
+        if sw.get("lattice_conserved") is False:
+            print(f"bench_regress: {r['metric']}: sweep lattice not "
+                  f"conserved ({sw.get('points')})", file=sys.stderr)
+            failed = 1
+        hr = sw.get("prewarm_hit_rate")
+        if isinstance(hr, (int, float)) and not isinstance(hr, bool) \
+                and hr < 1.0:
+            print(f"bench_regress: {r['metric']}: scored sweep ran "
+                  f"on a cold pool (prewarm_hit_rate={hr}, "
+                  f"compiled={sw.get('prewarm_compiled')}) — the "
+                  f"warm-up sweep must pay every compile",
+                  file=sys.stderr)
+            failed = 1
+        for k in ("exit_warm", "exit_timed"):
+            if sw.get(k) not in (0, None):
+                print(f"bench_regress: {r['metric']}: {k}="
+                      f"{sw.get(k)}", file=sys.stderr)
+                failed = 1
     # causality-overhead rows (bench.py BENCH_CAUSALITY_OVERHEAD)
     # carry the A/B cost of the lineage recorder; tolerate absence
     # (rounds without the knob bank no such field) but gate the bound:

@@ -38,6 +38,9 @@ floods one seeded victim lane's event rows mid-run, and asserts the
 victim quarantines while every neighbor lane's final per-host state
 stays byte-identical to a clean packed run — the containment oracle
 for lane-isolated health latches.
+--sweep runs one small halving sweep (sweep/driver.py) clean and
+again under one SIGKILL per fleet round, asserting lattice
+conservation, quarantine accounting, and byte-identical rankings.
 tests/test_escalate.py imports run_trial() for the fixed-seed tier-1
 smoke; the multi-trial soak is the `slow`-marked variant.
 """
@@ -629,6 +632,152 @@ def run_churn_trial(seed: int, *, lanes: int = 6, horizon_s: int = 4,
     }
 
 
+def _sweep_spec(seed: int):
+    """A small deterministic halving sweep (2x2 lattice, >= 2 rounds)
+    over a simulation-deterministic objective — kills must not be able
+    to move the ranking, so the metric must carry no wallclock."""
+    from shadow_tpu.sweep import plan as plan_mod
+
+    return plan_mod.SweepSpec.from_obj({
+        "sweep": {"id": f"chaos-{seed}",
+                  "objective": {"metric": "events", "goal": "max"},
+                  "search": {"strategy": "halving", "eta": 2,
+                             "budget_field": "sim_s",
+                             "budget_scale": 2},
+                  "prewarm": False},
+        "fleet": {"max_attempts": 3},
+        "template": {"kind": "scenario", "hosts": 4, "sim_s": 1,
+                     "event_capacity": 24},
+        "axes": [{"field": "seed", "values": [seed, seed + 1]},
+                 {"field": "load", "values": [1, 2]}],
+    })
+
+
+def run_sweep_trial(seed: int, *, workers: int = 2,
+                    workdir: str | None = None, log=None) -> dict:
+    """Sweep-under-fire oracle (sweep/driver.py): run one small
+    halving sweep clean, then again while SIGKILLing one worker per
+    fleet round, and assert
+
+    1. lattice conservation — every expanded point still ends in
+       exactly one category, none pending, and the chaos manifest
+       (sweep block included) is lint-clean;
+    2. quarantine accounting — the sweep block's quarantined count
+       equals the manifest's quarantined jobs (divergent points park,
+       they never sink the sweep);
+    3. ranking identity — every round's ranking, the final table, and
+       "best" are byte-identical to the clean run's (deterministic
+       objective + the fleet's kill/resume bit-identity contract)."""
+    import signal as signal_mod
+
+    from shadow_tpu.fleet import journal as journal_mod
+    from shadow_tpu.sweep import driver as sweep_driver
+
+    say = log or (lambda m: None)
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_sweep.")
+    errors: list = []
+    spec = _sweep_spec(seed)
+
+    clean = sweep_driver.SweepDriver(
+        os.path.join(workdir, "clean"), spec, workers=workers,
+        fsync=False, log=say)
+    rc_clean = clean.run()
+    clean_block = clean.report()
+
+    killed: list = []
+
+    def on_ev(runner, ev):
+        # one SIGKILL per fleet round: the driver builds a fresh
+        # runner per round, so a once-per-runner latch is exactly
+        # once-per-round; the first job to reach "running" loses its
+        # worker mid-execution
+        if ev.get("ev") != "running" \
+                or getattr(runner, "_chaos_killed", False):
+            return
+        runner._chaos_killed = True
+        pid = runner.worker_pid(ev.get("worker"))
+        if pid:
+            os.kill(pid, signal_mod.SIGKILL)
+            killed.append({"worker": ev.get("worker"),
+                           "job": ev.get("job")})
+            say(f"sweep chaos: killed {ev.get('worker')} running "
+                f"{ev.get('job')}")
+
+    chaos = sweep_driver.SweepDriver(
+        os.path.join(workdir, "chaos"), spec, workers=workers,
+        fsync=False, on_fleet_event=on_ev, log=say)
+    rc_chaos = chaos.run()
+    chaos_block = chaos.report()
+
+    if rc_clean != 0:
+        errors.append(f"clean sweep exited {rc_clean}")
+    if rc_chaos != 0:
+        errors.append(f"chaos sweep exited {rc_chaos}")
+    if not killed:
+        errors.append("no worker was ever killed — the soak soaked "
+                      "nothing")
+    losses = sum(1 for r in journal_mod.replay(
+        os.path.join(workdir, "chaos", "journal.log"))[0]
+        if r.get("ev") == "worker_lost")
+    if losses < len(killed):
+        errors.append(f"{len(killed)} kill(s) but only {losses} "
+                      f"worker_lost frame(s) in the fleet journal")
+
+    lint = _load_lint()
+    with open(os.path.join(workdir, "chaos",
+                           "fleet_manifest.json")) as f:
+        man = json.load(f)
+    lerr, _ = lint.lint_fleet_manifest_obj(man)
+    if lerr:
+        errors.append(f"chaos manifest not lint-clean: {lerr[:3]}")
+    pts = chaos_block["points"]
+    if pts["expanded"] != sum(pts[c] for c in
+                              ("completed", "failed", "quarantined",
+                               "pruned", "pending")):
+        errors.append(f"lattice not conserved under kills: {pts}")
+    if pts["pending"]:
+        errors.append(f"{pts['pending']} point(s) pending after a "
+                      f"complete chaos sweep")
+    man_q = sum(1 for j in man["jobs"].values()
+                if j.get("status") == "quarantined")
+    if pts["quarantined"] > man_q:
+        errors.append(f"sweep block claims {pts['quarantined']} "
+                      f"quarantined point(s) but the manifest holds "
+                      f"{man_q} quarantined job(s)")
+
+    if len(clean_block["rounds"]) != len(chaos_block["rounds"]):
+        errors.append(f"round count diverged under kills: "
+                      f"{len(clean_block['rounds'])} clean vs "
+                      f"{len(chaos_block['rounds'])} chaos")
+    for k, (rdc, rdk) in enumerate(zip(clean_block["rounds"],
+                                       chaos_block["rounds"])):
+        if rdc["ranking"] != rdk["ranking"]:
+            errors.append(f"round {k} ranking diverged under kills: "
+                          f"{rdc['ranking']} vs {rdk['ranking']}")
+    if clean_block["best"] != chaos_block["best"]:
+        errors.append(f"best point diverged under kills: "
+                      f"{clean_block['best']!r} vs "
+                      f"{chaos_block['best']!r}")
+
+    if len(clean_block["rounds"]) < 2:
+        errors.append(f"halving produced only "
+                      f"{len(clean_block['rounds'])} round(s) — the "
+                      f"soak must cross at least one prune")
+    return {
+        "seed": int(seed),
+        "ok": not errors,
+        "rounds": len(chaos_block["rounds"]),
+        "kills": len(killed),
+        "worker_losses": losses,
+        "points": pts,
+        "best": chaos_block["best"],
+        "ranking_identical": (clean_block["ranking"]
+                              == chaos_block["ranking"]),
+        "sweep_errors": errors,
+    }
+
+
 def _main_fleet(args) -> int:
     """--jobs K: dogfood the fleet runner. Each trial becomes a
     `chaos_trial` job; K worker processes execute them with the full
@@ -712,10 +861,31 @@ def main(argv=None) -> int:
                          "resume")
     ap.add_argument("--lanes", type=int, default=6,
                     help="resident lane count for --churn")
+    ap.add_argument("--sweep", action="store_true",
+                    help="sweep-under-fire mode: run one small "
+                         "halving sweep (sweep/driver.py) clean, then "
+                         "again killing one worker per round — "
+                         "asserts lattice conservation, quarantine "
+                         "accounting, and byte-identical rankings")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="fleet workers per sweep for --sweep")
     args = ap.parse_args(argv)
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.sweep:
+        if args.jobs > 0 or args.replicas > 1 or args.churn:
+            ap.error("--sweep is a standalone sweep-driver soak; it "
+                     "does not combine with --jobs/--replicas/--churn")
+        failed = 0
+        for k in range(args.trials):
+            rep = run_sweep_trial(args.seed + k, workers=args.workers)
+            print(json.dumps(rep), flush=True)
+            if not rep["ok"]:
+                failed += 1
+        print(f"sweep soak: {args.trials - failed}/{args.trials} "
+              f"trials ok", file=sys.stderr)
+        return 1 if failed else 0
     if args.churn:
         if args.jobs > 0 or args.replicas > 1:
             ap.error("--churn is a standalone resident-program soak; "

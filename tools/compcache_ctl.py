@@ -20,6 +20,11 @@ Subcommands:
                         instead of compiling. --exact skips the
                         bucketing; --test uses the built-in example
                         config instead of a file.
+  prewarm --sweep X     expand a sweep spec (sweep/plan.py) and
+                        prewarm its distinct-program census: one
+                        compile per bucket + specialization variant,
+                        printed with hit/compile counts — warm a cold
+                        pool before `shadow-tpu sweep run` launches.
 
 The store root is $SHADOW_AOT_DIR, else the claimed compile-cache dir
 (.jax_cache/<fingerprint-namespace>/aot); --root overrides both.
@@ -96,9 +101,60 @@ def cmd_gc(args) -> int:
     return 0
 
 
+def cmd_prewarm_sweep(args) -> int:
+    """Warm a cold pool for a whole sweep: expand the plan, compute
+    its distinct-program census (sweep/plan.py — bucket-affinity keys
+    + predicted specialization variants, no build involved), then
+    compile-or-confirm ONE representative program per distinct key
+    through the same scenario build path the workers take."""
+    import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    from shadow_tpu.apps import phold
+    from shadow_tpu.compile import serve
+    from shadow_tpu.fleet import scenario
+    from shadow_tpu.fleet.affinity import affinity_key
+    from shadow_tpu.sweep import plan as plan_mod
+
+    spec = plan_mod.SweepSpec.from_file(args.sweep)
+    points = plan_mod.expand(spec)
+    specs = [spec.point_spec(p, 0) for p in points]
+    census = plan_mod.plan_census(specs)
+    print(f"# sweep {spec.id}: {len(specs)} points, "
+          f"{census['distinct']} distinct program(s)")
+    reps = {}
+    for s in specs:
+        reps.setdefault(affinity_key(s), s)
+    store = _store(args) if args.root else None
+    keys, hits = [], 0
+    for ak in sorted(reps):
+        s = reps[ak]
+        caps = {"event_capacity": s.event_capacity,
+                "outbox_capacity": s.outbox_capacity,
+                "router_ring": s.router_ring}
+        b = scenario._build_scenario(s, caps)
+        info = serve.prewarm(b, (phold.handler,), store=store,
+                             log=lambda m: print(m))
+        ok = bool(info.get("hit") or info.get("stored"))
+        hits += bool(info.get("hit"))
+        keys.append({"affinity_key": ak, "key": info.get("key"),
+                     "hit": bool(info.get("hit")), "ok": ok,
+                     "count": census["programs"][ak]["count"],
+                     "specialization":
+                     census["programs"][ak]["specialization"]})
+    out = {"sweep": spec.id, "points": len(specs),
+           "distinct": census["distinct"], "hits": hits,
+           "compiled": len(keys) - hits, "keys": keys}
+    print(json.dumps(out, indent=1, sort_keys=True, default=str))
+    return 0 if all(k["ok"] for k in keys) else 1
+
+
 def cmd_prewarm(args) -> int:
     import jax
 
+    if getattr(args, "sweep", None):
+        return cmd_prewarm_sweep(args)
     if os.environ.get("JAX_PLATFORMS"):
         jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
     from shadow_tpu.compile import serve
@@ -114,8 +170,8 @@ def cmd_prewarm(args) -> int:
             text = f.read()
         base = os.path.dirname(os.path.abspath(args.config))
     else:
-        print("error: prewarm needs --config PATH or --test",
-              file=sys.stderr)
+        print("error: prewarm needs --config PATH, --test, or "
+              "--sweep SPEC", file=sys.stderr)
         return 1
 
     loaded = load(parse_config(text), seed=args.seed, base_dir=base)
@@ -169,6 +225,11 @@ def main(argv=None) -> int:
     p.add_argument("--config", help="shadow config XML path")
     p.add_argument("--test", action="store_true",
                    help="use the built-in example config")
+    p.add_argument("--sweep",
+                   help="sweep spec JSON (sweep/plan.py): prewarm "
+                        "the plan's distinct-program census — one "
+                        "compile per bucket+specialization variant, "
+                        "however many points share it")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--exact", action="store_true",
                    help="skip capacity bucketing (bespoke shapes)")

@@ -963,6 +963,309 @@ def _lint_slo_verdict(slo, flows, where: str) -> list:
     return errors
 
 
+# sweep block (sweep/driver.py sweep_block): the ranking logic is
+# duplicated literally from sweep/reduce.py so the lint can RE-DERIVE
+# every recorded table and prune decision from the per-job entries
+# without importing the engine — a recorded ranking that disagrees
+# with its own inputs is tampering or a writer bug, not a style issue
+_SWEEP_METRICS = ("flow_p50_ns", "flow_p95_ns", "flow_p99_ns",
+                  "drops", "events", "events_per_sec")
+_SWEEP_ELIGIBLE = ("ok", "warnings")
+_SWEEP_CATS = ("completed", "failed", "quarantined", "pruned",
+               "pending")
+
+
+def _sweep_metric_value(entry, metric):
+    """Mirror of sweep/reduce.py metric_value over one fleet-manifest
+    job entry; None when the job carries no data for the metric."""
+    result = entry.get("result") or {}
+    counters = result.get("counters") or {}
+    if metric == "events":
+        v = counters.get("events_processed")
+        return None if v is None else int(v)
+    if metric == "drops":
+        v = counters.get("drops_total")
+        return None if v is None else int(v)
+    if metric == "events_per_sec":
+        v = result.get("events_per_sec")
+        return None if v is None else float(v)
+    pkey = {"flow_p50_ns": "p50_ns", "flow_p95_ns": "p95_ns",
+            "flow_p99_ns": "p99_ns"}.get(metric)
+    if pkey is None:
+        return None
+    per_lane = (result.get("flows") or {}).get("per_lane") or {}
+    vals = [int(s.get(pkey, 0)) for s in per_lane.values()
+            if isinstance(s, dict)
+            and int(s.get("count", 0) or 0) > 0]
+    return max(vals) if vals else None
+
+
+def _sweep_rank(entries, objective):
+    """Mirror of sweep/reduce.py rank: eligible rows by (value, point)
+    under the objective's goal, ineligible rows after in point order."""
+    need_clean = bool(objective.get("require_clean_health"))
+    eligible, rest = [], []
+    for pid in sorted(entries):
+        entry = entries[pid]
+        status = entry.get("status")
+        if status in ("failed", "quarantined"):
+            verdict = status
+        elif status != "done":
+            verdict = "pending"
+        else:
+            hv = (entry.get("result") or {}).get("health_verdict")
+            if hv is not None and hv != "clean":
+                verdict = "unhealthy" if need_clean else "warnings"
+            else:
+                verdict = "ok"
+        value = (_sweep_metric_value(entry, objective.get("metric"))
+                 if verdict in _SWEEP_ELIGIBLE else None)
+        if verdict in _SWEEP_ELIGIBLE and value is None:
+            verdict = "no_data"
+        row = {"point": pid, "value": value, "verdict": verdict}
+        (eligible if verdict in _SWEEP_ELIGIBLE else rest).append(row)
+    sign = 1 if objective.get("goal") == "min" else -1
+    eligible.sort(key=lambda r: (sign * r["value"], r["point"]))
+    return eligible + rest
+
+
+def _lint_sweep(sw, jobs) -> tuple[list, list]:
+    """(errors, warnings) for a fleet manifest's "sweep" roll-up
+    (sweep/driver.py sweep_block). The three core invariants:
+
+      1. lattice conservation — every expanded point ends in exactly
+         one of completed / failed / quarantined / pruned / pending,
+         and a complete sweep has no pending points;
+      2. ranking re-derivation — every recorded per-round ranking
+         (and the final table, and "best") must re-derive from the
+         per-job result blocks it claims to summarize;
+      3. program-key census vs the prewarm log — every sweep job's
+         affinity key is in the planned census, the census counts sum
+         to the jobs expanded, and every realized program key was in
+         the prewarm log (warning: the pool compiled something the
+         census did not predict)."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(sw, dict):
+        return (["sweep must be an object"], [])
+    if not isinstance(sw.get("id"), str) or not sw.get("id"):
+        errors.append("sweep.id must be a non-empty string")
+    obj = sw.get("objective")
+    if not isinstance(obj, dict) \
+            or obj.get("metric") not in _SWEEP_METRICS \
+            or obj.get("goal") not in ("min", "max"):
+        errors.append(f"sweep.objective must name a metric in "
+                      f"{_SWEEP_METRICS} and a goal in "
+                      f"('min', 'max'), got {obj!r}")
+        obj = None
+    lattice = sw.get("lattice")
+    if not isinstance(lattice, int) or isinstance(lattice, bool) \
+            or lattice < 1:
+        errors.append(f"sweep.lattice must be a positive integer, "
+                      f"got {lattice!r}")
+        lattice = None
+    rounds = sw.get("rounds")
+    if not isinstance(rounds, list) or not rounds \
+            or not all(isinstance(r, dict) for r in rounds):
+        errors.append('sweep.rounds must be a non-empty array of '
+                      'round objects')
+        return errors, warnings
+    # lattice conservation
+    pts = sw.get("points")
+    counts = {}
+    if not isinstance(pts, dict):
+        errors.append("sweep.points must be an object")
+    else:
+        for k in ("expanded",) + _SWEEP_CATS:
+            v = pts.get(k)
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"sweep.points.{k} must be a "
+                              f"non-negative integer, got {v!r}")
+            else:
+                counts[k] = v
+        if len(counts) == 6 and counts["expanded"] != sum(
+                counts[c] for c in _SWEEP_CATS):
+            errors.append(
+                f"lattice not conserved: expanded="
+                f"{counts['expanded']} != completed="
+                f"{counts['completed']} + failed={counts['failed']} "
+                f"+ quarantined={counts['quarantined']} + pruned="
+                f"{counts['pruned']} + pending={counts['pending']} — "
+                f"every expanded point must end in exactly one "
+                f"category")
+        if sw.get("complete") and counts.get("pending"):
+            errors.append(f"sweep claims complete but "
+                          f"{counts['pending']} point(s) are pending")
+        if lattice is not None and "expanded" in counts \
+                and counts["expanded"] > lattice:
+            errors.append(f"sweep.points.expanded="
+                          f"{counts['expanded']} exceeds the lattice "
+                          f"({lattice})")
+        r0 = rounds[0].get("points")
+        if isinstance(r0, list) and "expanded" in counts \
+                and len(r0) != counts["expanded"]:
+            errors.append(f"sweep.points.expanded="
+                          f"{counts['expanded']} but round 0 planned "
+                          f"{len(r0)} point(s)")
+    # per-round: job linkage, count re-derivation, ranking
+    # re-derivation from the per-job entries
+    expanded_jobs = 0
+    search = sw.get("search") if isinstance(sw.get("search"), dict) \
+        else {}
+    for k, rd in enumerate(rounds):
+        where = f"sweep.rounds[{k}]"
+        if rd.get("round") != k:
+            errors.append(f"{where}: round={rd.get('round')!r} out of "
+                          f"order (expected {k})")
+        rpts = rd.get("points")
+        if not isinstance(rpts, list) or not rpts:
+            errors.append(f"{where}: points must be a non-empty array")
+            continue
+        expanded_jobs += len(rpts)
+        entries = {}
+        rcounts = {"done": 0, "failed": 0, "quarantined": 0,
+                   "pending": 0}
+        for pid in rpts:
+            jid = f"r{k}-{pid}"
+            j = jobs.get(jid)
+            if not isinstance(j, dict):
+                rcounts["pending"] += 1
+                entries[pid] = {}
+                continue
+            entries[pid] = j
+            st = j.get("status")
+            rcounts[st if st in rcounts else "pending"] += 1
+        rc = rd.get("counts")
+        if isinstance(rc, dict) and rc != rcounts:
+            errors.append(f"{where}.counts={rc} but the job statuses "
+                          f"fold to {rcounts}")
+        table = rd.get("ranking")
+        if table is None:
+            continue
+        if not isinstance(table, list):
+            errors.append(f"{where}.ranking must be an array")
+            continue
+        if obj is not None:
+            want = _sweep_rank(entries, obj)
+            if table != want:
+                errors.append(
+                    f"{where}.ranking does not re-derive from the "
+                    f"per-job result blocks — recorded {table!r} vs "
+                    f"derived {want!r} (the reducer is pure; a "
+                    f"divergence means the table was not computed "
+                    f"from these results)")
+        # successive halving: round k+1's survivors and prune set
+        # must be THE deterministic function of round k's table —
+        # top ceil(n_eligible/eta), never below one survivor
+        if search.get("strategy") == "halving" and k + 1 < len(rounds):
+            eta = search.get("eta")
+            eta = eta if isinstance(eta, int) \
+                and not isinstance(eta, bool) and eta >= 2 else 2
+            elig = [r.get("point") for r in table
+                    if isinstance(r, dict)
+                    and r.get("verdict") in _SWEEP_ELIGIBLE]
+            keep = max(1, -(-len(elig) // eta))
+            survive = elig[:keep]
+            nxt = rounds[k + 1]
+            if nxt.get("points") != survive:
+                errors.append(
+                    f"sweep.rounds[{k + 1}].points="
+                    f"{nxt.get('points')!r} but round {k} ranking "
+                    f"keeps {survive!r} (top ceil({len(elig)}/{eta})) "
+                    f"— a halving round must re-derive from the "
+                    f"journaled reduce output")
+            want_pruned = sorted(set(elig) - set(survive))
+            if sorted(nxt.get("pruned") or []) != want_pruned:
+                errors.append(
+                    f"sweep.rounds[{k + 1}].pruned="
+                    f"{nxt.get('pruned')!r} but round {k} ranking "
+                    f"prunes {want_pruned!r}")
+    je = sw.get("jobs_expanded")
+    if je is not None and je != expanded_jobs:
+        errors.append(f"sweep.jobs_expanded={je!r} but the rounds "
+                      f"planned {expanded_jobs} job(s)")
+    # final table and best must restate the last reduced round
+    final = next((rd.get("ranking") for rd in reversed(rounds)
+                  if rd.get("ranking") is not None), None)
+    if sw.get("ranking") != final:
+        errors.append("sweep.ranking does not match the last reduced "
+                      "round's table")
+    if isinstance(final, list):
+        top = next((r.get("point") for r in final
+                    if isinstance(r, dict)
+                    and r.get("verdict") in _SWEEP_ELIGIBLE), None)
+        if sw.get("best") != top:
+            errors.append(f"sweep.best={sw.get('best')!r} but the "
+                          f"final ranking's top eligible point is "
+                          f"{top!r}")
+    # distinct-program census vs the sweep's jobs and the prewarm log
+    census = sw.get("census")
+    sweep_jobs = {jid: j for jid, j in sorted(jobs.items())
+                  if isinstance(j, dict)
+                  and re.match(r"^r\d+-p\d+$", jid)}
+    if not isinstance(census, dict) \
+            or not isinstance(census.get("programs"), dict):
+        errors.append('sweep.census must carry a "programs" object')
+    else:
+        programs = census["programs"]
+        for ak, n in sorted(programs.items()):
+            if not _AFFINITY_KEY.match(ak):
+                errors.append(f'sweep.census.programs key {ak!r} must '
+                              f'match "ak" + 16 hex chars')
+            if not isinstance(n, int) or isinstance(n, bool) or n < 1:
+                errors.append(f"sweep.census.programs[{ak}]={n!r} "
+                              f"must be a positive point count")
+        if census.get("distinct") != len(programs):
+            errors.append(f"sweep.census.distinct="
+                          f"{census.get('distinct')!r} but "
+                          f"{len(programs)} program(s) listed")
+        total = sum(n for n in programs.values()
+                    if isinstance(n, int) and not isinstance(n, bool))
+        if total != expanded_jobs:
+            errors.append(f"sweep.census counts sum to {total} but "
+                          f"the rounds planned {expanded_jobs} "
+                          f"job(s) — the census must partition the "
+                          f"lattice")
+        for jid, j in sweep_jobs.items():
+            ak = j.get("affinity_key")
+            if isinstance(ak, str) and ak not in programs:
+                errors.append(f"jobs[{jid}].affinity_key {ak} is not "
+                              f"in the sweep census — the plan must "
+                              f"predict every program the pool loads")
+    pw = sw.get("prewarm")
+    if pw is not None:
+        if not isinstance(pw, dict):
+            errors.append("sweep.prewarm must be an object")
+        else:
+            for k in ("hits", "compiled"):
+                v = pw.get(k)
+                if not isinstance(v, int) or isinstance(v, bool) \
+                        or v < 0:
+                    errors.append(f"sweep.prewarm.{k} must be a "
+                                  f"non-negative integer, got {v!r}")
+            keys = pw.get("keys")
+            if not isinstance(keys, list):
+                errors.append("sweep.prewarm.keys must be an array")
+                keys = []
+            for pk in keys:
+                if not isinstance(pk, str) \
+                        or not _PROGRAM_KEY.match(pk):
+                    errors.append(f'sweep.prewarm.keys entry {pk!r} '
+                                  f'must match "pk" + 16 hex chars')
+            warmed = {pk for pk in keys if isinstance(pk, str)}
+            cold = sorted({j["program_key"]
+                           for j in sweep_jobs.values()
+                           if isinstance(j.get("program_key"), str)
+                           and j["program_key"] not in warmed})
+            if cold:
+                warnings.append(
+                    f"sweep jobs realized program key(s) the prewarm "
+                    f"log never warmed: {cold} — the pool compiled "
+                    f"cold (census prediction diverged from the "
+                    f"build?)")
+    return errors, warnings
+
+
 def lint_salvage(path: str) -> list:
     """Errors for a lane-salvage artifact (utils/checkpoint.py
     save_salvage; faults/escalate.py extract_lane output). Pure
@@ -1883,6 +2186,14 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
     adm = man.get("admission")
     if adm is not None:
         e2, w2 = _lint_admission(adm)
+        errors += e2
+        warnings += w2
+    # sweep block (optional): this fleet is one sweep's execution
+    # substrate (sweep/driver.py sweep_block) — lattice conservation,
+    # ranking re-derivation, census vs prewarm log
+    sw = man.get("sweep")
+    if sw is not None:
+        e2, w2 = _lint_sweep(sw, jobs)
         errors += e2
         warnings += w2
     mc = man.get("counts")
