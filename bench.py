@@ -61,6 +61,21 @@ rate measures raw engine throughput. Env knobs:
                                   the default 1-in-64 sampling
                                   (requires BENCH_CAUSALITY; gated by
                                   tools/bench_regress.py)
+  BENCH_SENTINEL=1                attach the cross-shard integrity
+                                  sentinel (parallel/elastic.py) to
+                                  the timed program: per-barrier
+                                  replicated-state digest + pmax/pmin
+                                  compare. The row gains a "sentinel"
+                                  block (checks/trips/verified
+                                  frontier) and banks under its own
+                                  _sentinel metric name
+  BENCH_SENTINEL_OVERHEAD=1       A/B the sentinel's cost: rebuild
+                                  the SAME workload with the sentinel
+                                  detached, time it, and record
+                                  sentinel_overhead_pct = (off-on)/off
+                                  — acceptance: <5% (design goal <2%);
+                                  gated by tools/bench_regress.py
+                                  (requires BENCH_SENTINEL=1)
   BENCH_PROFILE_DIR=path          capture a jax.profiler trace of one
                                   EXTRA (unscored) run after the timed
                                   one — tracing costs wall time, so it
@@ -305,6 +320,23 @@ def _attach_causality_ring(sims: list, causality_sample: int) -> list:
             for s in sims]
 
 
+def _bench_sentinel() -> bool:
+    """BENCH_SENTINEL=1: attach the cross-shard integrity sentinel
+    (parallel/elastic.py attach_sentinel) to the timed program — the
+    per-barrier replicated-state digest plus the pmax/pmin compare.
+    Same honesty rule as the rings: the sentinel rides the timed
+    inputs, so on-vs-off is the real cost of the SDC screen."""
+    return os.environ.get("BENCH_SENTINEL", "0") == "1"
+
+
+def _attach_sentinel(sims: list, on: bool) -> list:
+    if not on:
+        return sims
+    from shadow_tpu.parallel import elastic
+
+    return [elastic.attach_sentinel(s) for s in sims]
+
+
 def _bench_bucketed() -> bool:
     """Quantize capacities to power-of-two buckets? Explicit
     BENCH_BUCKETED wins; unset follows warm serving (a warm store
@@ -418,7 +450,8 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
                   min_jump_ns: int | None = None,
                   flow_sample: int | None = None,
                   causality_sample: int | None = None,
-                  specialize: bool | None = None):
+                  specialize: bool | None = None,
+                  sentinel: bool | None = None):
     """Returns a zero-arg callable running the workload through ONE
     reused jitted program (the timed call must hit the jit dispatch
     fast path, not re-trace the netstack). Each call runs a DIFFERENT
@@ -437,6 +470,7 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
           else causality_sample)
     bucketed = _bench_bucketed()
     sp = _bench_specialize() if specialize is None else specialize
+    sn = _bench_sentinel() if sentinel is None else sentinel
 
     def build_at(cap):
         b = _build_phold(H, load, sim_s, seed, cap, graph, replica_size,
@@ -465,6 +499,7 @@ def _phold_runner(H, load, sim_s, seed=1, shards: int = 0,
         # honesty rule
         sims = _attach_flow_ring(sims, fs)
         sims = _attach_causality_ring(sims, cs)
+        sims = _attach_sentinel(sims, sn)
         b.sim = sims[0]
         if sp:
             # specialize AFTER every attachment (the analysis reads
@@ -530,7 +565,8 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
                              min_jump_ns: int | None = None,
                              checkpoint_windows: int | None = None,
                              flow_sample: int | None = None,
-                             causality_sample: int | None = None):
+                             causality_sample: int | None = None,
+                             sentinel: bool | None = None):
     """PHOLD through faults.run_supervised — the host-driven window
     loop with health checks at every dispatch barrier. This is the
     dispatch-amortization A/B subject: at windows_per_dispatch=1 every
@@ -551,6 +587,7 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
     cs = (_bench_causality_sample() if causality_sample is None
           else causality_sample)
     bucketed = _bench_bucketed()
+    sn = _bench_sentinel() if sentinel is None else sentinel
     every = checkpoint_windows or (1 << 30)   # default: never fires
     ckdir = tempfile.mkdtemp(prefix="bench_sup_")
 
@@ -584,6 +621,7 @@ def _phold_supervised_runner(H, load, sim_s, seed=1, shards: int = 0,
             sims = [telemetry.attach(s, capacity=W) for s in sims]
         sims = _attach_flow_ring(sims, fs)
         sims = _attach_causality_ring(sims, cs)
+        sims = _attach_sentinel(sims, sn)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -668,7 +706,8 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
                    min_jump_ns: int | None = None,
                    checkpoint_windows: int | None = None,
                    flow_sample: int | None = None,
-                   causality_sample: int | None = None):
+                   causality_sample: int | None = None,
+                   sentinel: bool | None = None):
     """Open-system injection scenario: the tgen app (every host binds
     a UDP socket; injected KIND_TGEN events fire datagrams) driven by
     a streamed trace through the supervised window loop — the feeder
@@ -700,6 +739,7 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
     cs = (_bench_causality_sample() if causality_sample is None
           else causality_sample)
     bucketed = _bench_bucketed()
+    sn = _bench_sentinel() if sentinel is None else sentinel
     every = checkpoint_windows or (1 << 30)
     ckdir = tempfile.mkdtemp(prefix="bench_inj_")
 
@@ -739,6 +779,7 @@ def _inject_runner(H, sim_s, seed=1, shards: int = 0,
             sims = [telemetry.attach(s, capacity=W) for s in sims]
         sims = _attach_flow_ring(sims, fs)
         sims = _attach_causality_ring(sims, cs)
+        sims = _attach_sentinel(sims, sn)
         b.sim = sims[0]
         mesh = (jax.make_mesh((shards,), ("hosts",))
                 if shards > 1 else None)
@@ -1143,7 +1184,8 @@ def main(argv=None) -> None:
                  "BENCH_INJECT_RATE", "BENCH_CHUNK_WINDOWS",
                  "BENCH_SHARDS", "BENCH_FLOW_OVERHEAD",
                  "BENCH_FLOW_SAMPLE", "BENCH_CAUSALITY",
-                 "BENCH_CAUSALITY_OVERHEAD", "BENCH_RESIDENT"))
+                 "BENCH_CAUSALITY_OVERHEAD", "BENCH_SENTINEL",
+                 "BENCH_SENTINEL_OVERHEAD", "BENCH_RESIDENT"))
                 or workload != "phold" or topo != "one"
                 or fault_records):
             raise SystemExit(
@@ -1166,7 +1208,8 @@ def main(argv=None) -> None:
                  "BENCH_INJECT_RATE", "BENCH_CHUNK_WINDOWS",
                  "BENCH_SHARDS", "BENCH_FLOW_OVERHEAD",
                  "BENCH_FLOW_SAMPLE", "BENCH_CAUSALITY",
-                 "BENCH_CAUSALITY_OVERHEAD"))
+                 "BENCH_CAUSALITY_OVERHEAD", "BENCH_SENTINEL",
+                 "BENCH_SENTINEL_OVERHEAD"))
                 or workload != "phold" or topo != "one"
                 or fault_records):
             raise SystemExit(
@@ -1335,6 +1378,17 @@ def main(argv=None) -> None:
             and caus_sample_n <= 0:
         raise SystemExit("BENCH_CAUSALITY_OVERHEAD=1 needs "
                          "BENCH_CAUSALITY=N (what would it A/B?)")
+    sent_on = _bench_sentinel()
+    if sent_on and workload != "phold" and not inject_on:
+        raise SystemExit("BENCH_SENTINEL=1 is only wired for the "
+                         "phold/injection runners")
+    if sent_on:
+        # the sentinel's digest fold shapes the program — own metric
+        # name so bench_regress compares like with like
+        name += "_sentinel"
+    if os.environ.get("BENCH_SENTINEL_OVERHEAD") == "1" and not sent_on:
+        raise SystemExit("BENCH_SENTINEL_OVERHEAD=1 needs "
+                         "BENCH_SENTINEL=1 (what would it A/B?)")
 
     # compile + warm (may escalate capacity). Timed + cache-diffed:
     # compile_s is the wall cost of the first device call, and the
@@ -1448,6 +1502,47 @@ def main(argv=None) -> None:
                           else rate_off)
         causality_overhead_pct = round(
             (value_caus_off - value) / value_caus_off * 100.0, 2)
+
+    # BENCH_SENTINEL_OVERHEAD=1: same A/B for the integrity sentinel —
+    # rebuild with the sentinel detached (every other knob unchanged,
+    # so the delta IS the per-barrier digest + pmax/pmin compare),
+    # time it, score the cost as (off - on) / off. Acceptance: <5%
+    # (design goal <2%); tools/bench_regress.py gates the bound.
+    sentinel_overhead_pct = None
+    value_sent_off = None
+    if os.environ.get("BENCH_SENTINEL_OVERHEAD") == "1" and sent_on:
+        if inject_on:
+            base = _inject_runner(
+                H, sim_s, shards=_SHARDS, graph=graph,
+                trace_path=inj_trace, rate=inj_rate,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, sentinel=False)
+        elif supervise:
+            base = _phold_supervised_runner(
+                H, load, sim_s, shards=_SHARDS, graph=graph,
+                fault_records=fault_records, chunk_windows=chunk,
+                adaptive_jump=adaptive, min_jump_ns=min_jump_ns,
+                checkpoint_windows=ck_w, sentinel=False)
+        else:
+            base = _phold_runner(
+                H * replicas, load, sim_s, shards=_SHARDS, graph=graph,
+                replica_size=H if replicas > 1 else None,
+                fault_records=fault_records,
+                active_hosts=active, sparse_lanes=sparse,
+                min_jump_ns=min_jump_ns, sentinel=False)
+        base()                     # warm-up (compile, maybe escalate)
+        while True:
+            t0 = time.perf_counter()
+            ev_off = base()
+            wall_off = time.perf_counter() - t0
+            if not getattr(base, "escalated", False):
+                break
+        rate_off = ev_off / wall_off
+        value_sent_off = (rate_off / _SHARDS if _SHARDS > 1
+                          else rate_off)
+        sentinel_overhead_pct = round(
+            (value_sent_off - value) / value_sent_off * 100.0, 2)
 
     # BENCH_SPECIALIZE=1: time the unspecialized twin of the SAME
     # workload (every other knob unchanged, so the delta IS the
@@ -1640,6 +1735,22 @@ def main(argv=None) -> None:
     if causality_overhead_pct is not None:
         out["causality_overhead_pct"] = causality_overhead_pct
         out["events_per_sec_causality_off"] = round(value_caus_off, 1)
+    if sent_on and getattr(runner, "last_sim", None) is not None:
+        # sentinel latch report of the TIMED run (row + manifest): the
+        # lint validates it (trips <= checks, a trip names its shard)
+        from shadow_tpu.parallel import elastic as elastic_mod
+
+        srep = elastic_mod.sentinel_report(runner.last_sim)
+        if srep is not None:
+            out["sentinel"] = dict(srep)
+            if "manifest" in out:
+                out["manifest"]["sentinel"] = dict(srep)
+    if sentinel_overhead_pct is not None:
+        out["sentinel_overhead_pct"] = sentinel_overhead_pct
+        out["events_per_sec_sentinel_off"] = round(value_sent_off, 1)
+        if "manifest" in out and "sentinel" in out["manifest"]:
+            out["manifest"]["sentinel"]["overhead_pct"] = \
+                sentinel_overhead_pct
     if specialize_speedup is not None:
         out["specialize_speedup"] = specialize_speedup
         out["events_per_sec_full_program"] = round(value_spec_off, 1)

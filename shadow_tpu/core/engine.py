@@ -236,7 +236,7 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
                 route_fn=_default_route, min_fn=_identity,
                 bulk_fn=None, fault_fn=None, telem_fn=None, wstart=None,
                 sparse_lanes: int = 0, census_fn=None, flow_fn=None,
-                adv_attr=None):
+                adv_attr=None, sentinel_fn=None):
     """One full round: drain the window, then route cross-host events
     staged in the outbox into destination queues. Returns the new global
     minimum pending time (the master's minNextEventTime,
@@ -388,6 +388,14 @@ def step_window(sim, stats: EngineStats, step_fn: StepFn, wend,
         # lanes stop holding the global advance back.
         from shadow_tpu.core.lanes import window_update
         sim = window_update(sim, wend)
+    if sentinel_fn is not None:
+        # cross-shard integrity sentinel (parallel/elastic.py): digest
+        # the replicated leaves AFTER the route barrier restored the
+        # replication invariant (_replicate_scalars runs inside
+        # route_fn) and the lane barrier settled — any pmax-vs-pmin
+        # digest disagreement here is silent divergence, latched
+        # sticky. Trace-time no-op when Sim.sentinel is None.
+        sim = sentinel_fn(sim, wend)
     stats = stats.replace(windows=stats.windows + 1)
     local_min = jnp.min(sim.events.min_time())
     if getattr(sim, "inject", None) is not None:
@@ -550,7 +558,7 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
                     lane_fn=None, route_fn=_default_route,
                     min_fn=_identity, bulk_fn=None, fault_fn=None,
                     telem_fn=None, sparse_lanes: int = 0,
-                    census_fn=None, flow_fn=None):
+                    census_fn=None, flow_fn=None, sentinel_fn=None):
     """Build ``chunk(sim, stats, wstart) -> (sim, stats, wstart')``:
     up to `chunk_windows` full window rounds as ONE device program (a
     lax.fori_loop over step_window), so host-driven loops pay one
@@ -629,7 +637,7 @@ def make_chunk_body(step_fn: StepFn, *, end_time: int, wend_fn,
                 route_fn=route_fn, min_fn=min_fn, bulk_fn=bulk_fn,
                 fault_fn=fault_fn, telem_fn=telem_fn, wstart=ws,
                 sparse_lanes=sparse_lanes, census_fn=census_fn,
-                flow_fn=flow_fn, adv_attr=adv)
+                flow_fn=flow_fn, adv_attr=adv, sentinel_fn=sentinel_fn)
             return i + 1, sim, stats, next_min
 
         _, sim, stats, wstart = jax.lax.while_loop(
@@ -657,6 +665,7 @@ def run(
     census_fn=None,
     fault_times=None,
     flow_fn=None,
+    sentinel_fn=None,
 ):
     """Run the whole simulation as one device program (fast path for
     on-device application models). Window advance rule is the
@@ -725,7 +734,7 @@ def run(
         sim, stats, next_min = step_window(
             sim, stats, step_fn, wend, emit_capacity, lane_id,
             route_fn, min_fn, bulk_fn, fault_fn, telem_fn, wstart,
-            sparse_lanes, census_fn, flow_fn, adv,
+            sparse_lanes, census_fn, flow_fn, adv, sentinel_fn,
         )
         return sim, stats, next_min
 

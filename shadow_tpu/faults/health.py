@@ -99,10 +99,35 @@ class RunHealth:
     guard_watched: tuple = ()
     guard_loss_trips: int = 0
     guard_timer_trips: int = 0
+    # --- cross-shard integrity sentinel (parallel/elastic.py) --------
+    # sentinel_checks > 0 means the sim carried a SentinelState: every
+    # window barrier compared a digest of the replicated leaves
+    # pmax-vs-pmin across shards. A nonzero trip count is SILENT
+    # DIVERGENCE (an SDC, a bad collective, a flipped replicated bit)
+    # — always FATAL: results after tripped_at cannot be trusted;
+    # resume from a checkpoint whose time <= sentinel_verified_through.
+    sentinel_checks: int = 0
+    shard_divergence_trips: int = 0
+    divergent_shard: int = -1            # offender of the FIRST trip
+    sentinel_tripped_at: int = 0
+    sentinel_verified_through: int = 0
+    # --- device loss (parallel/elastic.py DeviceLossError) -----------
+    # A machine fault, not a sim fault: set host-side by the
+    # supervisor when a dispatch classified as DEVICE_LOST — the
+    # degradation ladder (retry -> shrink -> serial) owns recovery;
+    # fatal only if the ladder is exhausted (the supervisor then
+    # re-raises, so a RunHealth that still carries it IS the verdict).
+    device_lost: int = 0
+    lost_shard: int = -1
+    device_lost_cause: Optional[str] = None
 
     @property
     def guard_tripped(self) -> bool:
         return bool(self.guard_loss_trips or self.guard_timer_trips)
+
+    @property
+    def shard_divergence(self) -> bool:
+        return bool(self.shard_divergence_trips)
 
     @property
     def fatal(self) -> bool:
@@ -115,6 +140,7 @@ class RunHealth:
             cap_trip = len(self.lanes_quarantined) >= self.lanes_total
         return bool(
             cap_trip or self.deadline_exceeded or self.guard_tripped
+            or self.shard_divergence or self.device_lost
             or (self.stall_limit and self.stalled_windows >= self.stall_limit))
 
     def diagnostics(self) -> list:
@@ -197,6 +223,25 @@ class RunHealth:
                         f"a TIMER event entered the queue — it would "
                         f"never be handled, results are invalid; rerun "
                         f"with --specialize off"))
+        if self.shard_divergence:
+            out.append(("fatal",
+                        f"SHARD_DIVERGENCE: replicated-state digest "
+                        f"disagreed across shards x"
+                        f"{self.shard_divergence_trips}, first at "
+                        f"t={self.sentinel_tripped_at} (suspect shard "
+                        f"{self.divergent_shard}) — silent data "
+                        f"corruption; results after the trip are "
+                        f"invalid, resume from a checkpoint at or "
+                        f"before t={self.sentinel_verified_through}"))
+        if self.device_lost:
+            out.append(("fatal",
+                        f"DEVICE_LOST x{self.device_lost}"
+                        f"{where}: a mesh device failed underneath the "
+                        f"run (shard {self.lost_shard}, cause "
+                        f"{self.device_lost_cause}) — the degradation "
+                        f"ladder (same-mesh retry -> shrink to "
+                        f"survivors -> serial) resumes from the last "
+                        f"verified checkpoint"))
         if self.narrow_miss:
             out.append(("warning",
                         f"narrow exchange tier missed {self.narrow_miss} "
@@ -262,6 +307,19 @@ class RunHealth:
                 "timer_trips": self.guard_timer_trips,
                 "tripped": self.guard_tripped,
             }} if self.guard_watched else {}),
+            **({"sentinel": {
+                "checks": self.sentinel_checks,
+                "trips": self.shard_divergence_trips,
+                "shard": self.divergent_shard,
+                "tripped_at_ns": self.sentinel_tripped_at,
+                "verified_through_ns": self.sentinel_verified_through,
+            }} if self.sentinel_checks or self.shard_divergence_trips
+               else {}),
+            **({"device_lost": {
+                "count": self.device_lost,
+                "shard": self.lost_shard,
+                "cause": self.device_lost_cause,
+            }} if self.device_lost else {}),
         }
 
 
@@ -306,7 +364,20 @@ def gather(sim, *, window_start=None, stalled_windows=0, stall_limit=0,
         g = guard_report(sim)
         g_watched = tuple(g["watched"])
         g_loss, g_timer = g["loss_trips"], g["timer_trips"]
+    s_checks, s_trips, s_shard, s_at, s_ver = 0, 0, -1, 0, 0
+    if getattr(sim, "sentinel", None) is not None:
+        from shadow_tpu.parallel.elastic import sentinel_report
+
+        sr = sentinel_report(sim)
+        s_checks, s_trips = sr["checks"], sr["trips"]
+        s_shard, s_at = sr["shard"], sr["tripped_at_ns"]
+        s_ver = sr["verified_through_ns"]
     return RunHealth(
+        sentinel_checks=s_checks,
+        shard_divergence_trips=s_trips,
+        divergent_shard=s_shard,
+        sentinel_tripped_at=s_at,
+        sentinel_verified_through=s_ver,
         guard_watched=g_watched,
         guard_loss_trips=g_loss,
         guard_timer_trips=g_timer,

@@ -53,6 +53,7 @@ import numpy as np
 from shadow_tpu.core import simtime
 from shadow_tpu.faults import escalate as escalate_mod
 from shadow_tpu.faults import health as health_mod
+from shadow_tpu.parallel import elastic as elastic_mod
 from shadow_tpu.utils import checkpoint as ckpt
 
 
@@ -153,6 +154,11 @@ class SupervisorResult:
     # program (compile/serve.py): {key, warm, hit, load_s|compile_s}.
     # None when the loop never dispatched or warm accounting was off.
     compile_info: Optional[dict] = None
+    # Elastic degraded-mesh recovery (parallel/elastic.py): losses,
+    # divergences, the ladder steps taken and the mesh transitions —
+    # the manifest's `elastic` block. None when no ElasticPolicy was
+    # installed and nothing tripped.
+    elastic: Optional[dict] = None
 
     def failure_report(self) -> dict:
         rep = self.health.failure_report() if self.health is not None \
@@ -172,6 +178,8 @@ class SupervisorResult:
         if self.deadline_exceeded:
             rep["verdict"] = "deadline"
             rep["final_checkpoint"] = self.final_checkpoint
+        if self.elastic is not None:
+            rep["elastic"] = dict(self.elastic)
         return rep
 
 
@@ -204,6 +212,9 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                    feeder=None,
                    on_lane_quarantine=None,
                    warm_start: bool | None = None,
+                   elastic: elastic_mod.ElasticPolicy | None = None,
+                   dispatch_wrap=None,
+                   on_mesh_change=None,
                    ) -> SupervisorResult:
     """Run bundle to end_time under supervision (host-driven window
     loop; serial by default, shard_map'd over `mesh` when given — the
@@ -250,7 +261,27 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
     meaning, quantized up to a chunk boundary; a chunk whose windows
     all processed zero events extends the stall streak by the whole
     chunk, but a mixed chunk resets it — pick stall_windows >= a few
-    chunks."""
+    chunks.
+
+    `elastic` (parallel/elastic.ElasticPolicy) arms degraded-mesh
+    recovery: every dispatch is wrapped in guard_dispatch, so a dead
+    chip (XLA device/transfer error, or a dispatch overrunning
+    `dispatch_deadline_s`) surfaces as a typed DeviceLossError and
+    steps the degradation ladder — retry the same mesh
+    (`same_mesh_retries`), then shrink to the next-pow2-down survivor
+    mesh (checkpoints hold global layout, so the snapshot replans with
+    a digest-verified restamp), then fall back to serial — always
+    resuming from the last VERIFIED checkpoint (saved with sentinel
+    trips == 0, or pre-sentinel and therefore health-clean). A
+    SHARD_DIVERGENCE latch (the cross-shard integrity sentinel,
+    attach_sentinel) steps the SAME ladder: a shard whose replica of
+    the replicated state diverged is treated like a failing chip.
+    Ladder steps consume no failure retries (like escalation heals —
+    the sim did nothing wrong) and are bounded by `max_losses`.
+    `dispatch_wrap` composes INSIDE the guard (chaos poison injection
+    sees the dispatch first, the classifier sees its error);
+    `on_mesh_change(old_shards, new_shards, cause)` fires on every
+    shrink/serial transition — the fleet's degraded-requeue hook."""
 
     def say(msg):
         if log is not None:
@@ -260,7 +291,15 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
         else getattr(bundle, "rebuild", None)
     run_id = run_id or uuid.uuid4().hex[:12]
     t_chain0 = _time.monotonic()   # max_run_wallclock origin
-    shards = mesh.shape[mesh_axis] if mesh is not None else 1
+    # Elastic recovery makes the mesh MUTABLE chain state: a ladder
+    # step may shrink it (or drop to serial) between attempts.
+    cur_mesh = mesh
+    cur_shards = mesh.shape[mesh_axis] if mesh is not None else 1
+    shards0 = cur_shards
+    losses: list = []              # DeviceLossError records, chain-wide
+    divergences: list = []         # sentinel trips, chain-wide
+    ladder_steps: list = []        # one per loss/divergence handled
+    same_mesh_used: dict = {}      # mesh width -> same-mesh retries spent
 
     total_saved = []
     attempt = 0
@@ -352,6 +391,130 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             if on_lane_quarantine is not None:
                 on_lane_quarantine(inc)
 
+    def _verified_snapshot(limit_ns: int | None = None):
+        """Newest checkpoint the elastic ladder may resume from:
+        its elastic stamp (utils/checkpoint.elastic_meta) shows zero
+        sentinel trips — or predates the sentinel entirely, in which
+        case the health check that preceded the save is the verifier.
+        `limit_ns` (a divergence's verified_through) additionally caps
+        the resume time. Returns (path, time_ns, meta) or None."""
+        for path, t in reversed(total_saved):
+            if limit_ns is not None and t > limit_ns:
+                continue
+            try:
+                _, meta = ckpt.load_leaves(path)
+            except (OSError, ValueError, KeyError) as e:
+                say(f"supervisor: skipping unreadable snapshot "
+                    f"{path}: {e}")
+                continue
+            el = meta.get("elastic")
+            rep = el.get("sentinel") if isinstance(el, dict) else None
+            if rep and rep.get("trips"):
+                continue
+            return path, t, meta
+        return None
+
+    def _elastic_block():
+        if elastic is None and not losses and not divergences:
+            return None
+        return {
+            "policy": elastic.as_dict() if elastic is not None else None,
+            "initial_shards": shards0,
+            "final_shards": cur_shards,
+            "losses": [dict(d) for d in losses],
+            "divergences": [dict(d) for d in divergences],
+            "ladder_steps": [dict(s) for s in ladder_steps],
+            "mesh_transitions": [dict(s) for s in ladder_steps
+                                 if s["from"] != s["to"]],
+        }
+
+    def _elastic_step(cause: str, shard: int, limit_ns=None):
+        """One rung of the degradation ladder. Decides retry / shrink /
+        serial, finds the verified resume point (replanning its shard
+        stamp when the width changes), and mutates the chain's mesh
+        state. Returns True when the chain should continue, False when
+        the ladder is exhausted."""
+        nonlocal cur_mesh, cur_shards, resume_sim, resume_time
+        nonlocal resumed_from, base_stats
+        if len(losses) + len(divergences) > elastic.max_losses:
+            say(f"supervisor: elastic budget exhausted "
+                f"({elastic.max_losses} losses)")
+            return False
+        # --- decide the rung ---------------------------------------
+        if same_mesh_used.get(cur_shards, 0) < elastic.same_mesh_retries:
+            same_mesh_used[cur_shards] = \
+                same_mesh_used.get(cur_shards, 0) + 1
+            action, new_mesh, new_shards = "retry", cur_mesh, cur_shards
+        elif (elastic.allow_shrink and cur_mesh is not None
+                and cur_shards > max(elastic.min_shards, 1)):
+            new_mesh, new_shards = elastic_mod.survivor_mesh(
+                cur_mesh, mesh_axis, shard)
+            if new_mesh is None or new_shards < elastic.min_shards:
+                if not elastic.allow_serial:
+                    say("supervisor: survivors cannot carry a mesh and "
+                        "serial fallback is disabled")
+                    return False
+                action, new_mesh, new_shards = "serial", None, 1
+            else:
+                action = "shrink"
+        elif elastic.allow_serial and cur_mesh is not None:
+            action, new_mesh, new_shards = "serial", None, 1
+        else:
+            say(f"supervisor: ladder exhausted at {cur_shards} "
+                f"shard(s) ({cause})")
+            return False
+        # --- verified resume point ---------------------------------
+        found = _verified_snapshot(limit_ns)
+        if found is not None:
+            path, t, _meta = found
+            if new_shards != cur_shards:
+                try:
+                    # digest-verified restamp: recomputes the per-shard
+                    # sha256 ledger at the OLD width against the stamp,
+                    # then restamps at the NEW width
+                    path = ckpt.replan_shards(path, new_shards,
+                                              template_sim=bundle.sim)
+                except (ValueError, OSError, KeyError) as e:
+                    say(f"supervisor: replan of {path} failed ({e}); "
+                        f"rebooting at {new_shards} shard(s)")
+                    path = None
+            if path is not None:
+                resume_sim, resume_time, extra = ckpt.load(path,
+                                                           bundle.sim)
+                base_stats = dict(extra.get("stats", {}))
+                resumed_from = path
+            else:
+                resume_sim, resume_time, base_stats = None, 0, {}
+                t = 0
+        else:
+            say("supervisor: no verified snapshot, rebooting from t=0")
+            resume_sim, resume_time, base_stats = None, 0, {}
+            t = 0
+        ladder_steps.append({
+            "action": action, "cause": cause, "shard": int(shard),
+            "from": cur_shards, "to": new_shards,
+            "resume_time_ns": int(t), "attempt": attempt,
+        })
+        say(f"supervisor: elastic {action} ({cause}, shard {shard}): "
+            f"{cur_shards} -> {new_shards} shard(s), resuming at "
+            f"t={int(t)}")
+        if new_shards != cur_shards and on_mesh_change is not None:
+            on_mesh_change(cur_shards, new_shards, cause)
+        cur_mesh, cur_shards = new_mesh, new_shards
+        return True
+
+    def _wrap_dispatch(fn):
+        """Compose the caller's dispatch_wrap (chaos poison — it must
+        see the dispatch first so its injected error reaches the
+        classifier) inside the device-loss guard."""
+        if dispatch_wrap is not None:
+            fn = dispatch_wrap(fn)
+        if elastic is not None:
+            fn = elastic_mod.guard_dispatch(
+                fn, shards=cur_shards,
+                deadline_s=elastic.dispatch_deadline_s)
+        return fn
+
     while True:
         attempt += 1
         # Per-attempt telemetry the chunk closure mutates.
@@ -402,7 +565,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                     and next_min < simtime.INVALID):
                 # Healthy at this barrier: snapshot resumes at next_min.
                 p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
-                              time_ns=next_min, shards=shards,
+                              time_ns=next_min, shards=cur_shards,
                               config_digest=config_digest,
                               extra=_ckpt_extra(tele["acc"]))
                 total_saved.append((p, next_min))
@@ -417,7 +580,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
             # would double- or under-count across the kill boundary.
             if stop is not None and stop() and next_min < simtime.INVALID:
                 p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
-                              time_ns=next_min, shards=shards,
+                              time_ns=next_min, shards=cur_shards,
                               config_digest=config_digest,
                               extra=_ckpt_extra(tele["acc"]))
                 total_saved.append((p, next_min))
@@ -432,7 +595,7 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 el = _time.monotonic() - t_chain0
                 if el >= max_run_wallclock:
                     p = ckpt.save(f"{checkpoint_path}.{next_min}", sim,
-                                  time_ns=next_min, shards=shards,
+                                  time_ns=next_min, shards=cur_shards,
                                   config_digest=config_digest,
                                   extra=_ckpt_extra(tele["acc"]))
                     total_saved.append((p, next_min))
@@ -468,7 +631,8 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 dispatches=len(tele["dispatch_windows"]),
                 dispatch_windows=tuple(tele["dispatch_windows"]),
                 lane_incidents=tuple(lane_incidents),
-                compile_info=(dict(cinfo) if cinfo else None), **kw)
+                compile_info=(dict(cinfo) if cinfo else None),
+                elastic=_elastic_block(), **kw)
 
         from shadow_tpu.core.engine import EngineStats
 
@@ -482,13 +646,16 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 on_chunk=_on_chunk,
                 stats0=(EngineStats.from_dict(base_stats)
                         if base_stats else None),
-                mesh=mesh, mesh_axis=mesh_axis,
+                mesh=cur_mesh, mesh_axis=mesh_axis,
                 exchange_capacity=exchange_capacity,
                 windows_per_dispatch=windows_per_dispatch,
                 adaptive_jump=adaptive_jump,
                 feeder=feeder,
                 warm_start=warm_start,
                 compile_info=cinfo,
+                dispatch_wrap=(_wrap_dispatch
+                               if (dispatch_wrap is not None
+                                   or elastic is not None) else None),
             )
             if harvester is not None:
                 harvester.drain(sim)
@@ -516,8 +683,40 @@ def run_supervised(bundle, app_handlers=(), *, fault_fn=None,
                 stats=EngineStats.from_dict(
                     _ckpt_extra(tele["acc"])["stats"]),
                 preempted=True, final_checkpoint=p.path)
+        except elastic_mod.DeviceLossError as loss:
+            say(f"supervisor: device loss on attempt {attempt}: {loss}")
+            if elastic is None:
+                raise
+            losses.append(dict(loss.as_dict(), attempt=attempt,
+                               mesh=cur_shards))
+            if _elastic_step("device_lost", loss.shard):
+                continue  # a ladder step consumes no retry, no backoff
+            h = health_mod.RunHealth(
+                device_lost=len(losses),
+                lost_shard=loss.shard,
+                device_lost_cause=loss.cause)
+            return _result(False, None, h, stats=None)
         except LatchTrip as trip:
             say(f"supervisor: latch trip on attempt {attempt}: {trip}")
+            if elastic is not None and trip.health.shard_divergence:
+                # the sentinel's SDC screen: a shard whose replica of
+                # the replicated state diverged is a failing chip —
+                # step the SAME ladder, but the resume point must also
+                # predate the trip's verified_through (nothing after it
+                # is trusted)
+                divergences.append({
+                    "fault": "SHARD_DIVERGENCE",
+                    "shard": int(trip.health.divergent_shard),
+                    "tripped_at_ns": int(trip.health.sentinel_tripped_at),
+                    "verified_through_ns":
+                        int(trip.health.sentinel_verified_through),
+                    "attempt": attempt, "mesh": cur_shards,
+                })
+                if _elastic_step(
+                        "shard_divergence", trip.health.divergent_shard,
+                        limit_ns=trip.health.sentinel_verified_through):
+                    continue
+                return _result(False, trip.sim, trip.health, stats=None)
             healed = False
             if escalation is not None and rebuild_fn is not None:
                 try:

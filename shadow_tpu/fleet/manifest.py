@@ -35,6 +35,7 @@ def _job_entry(queue, j) -> dict:
         "attempts": j.attempts,
         "executions": j.execs,
         "worker_losses": j.worker_losses,
+        "device_losses": j.device_losses,
         "attempt_history": list(j.attempt_history),
         "backoff_history": [round(b, 6) for b in j.backoff_history],
         "verdict": _VERDICTS.get(j.status),
@@ -69,6 +70,14 @@ def _job_entry(queue, j) -> dict:
         # causality accounting (telemetry/causality.py): the job-level
         # copy is the roll-up input for the fleet "causality" block
         entry["causality"] = j.result["causality"]
+    if j.result and j.result.get("elastic"):
+        # elastic recovery record (parallel/elastic.py): the job-level
+        # copy is the roll-up input for the fleet "elastic" block
+        entry["elastic"] = j.result["elastic"]
+    if j.result and j.result.get("device_lease"):
+        entry["device_lease"] = j.result["device_lease"]
+    if j.shards_override:
+        entry["shards_override"] = int(j.shards_override)
     run_man = os.path.join(queue.job_dir(jid), "run_manifest.json")
     if os.path.isfile(run_man):
         entry["run_manifest"] = os.path.join(rel, "run_manifest.json")
@@ -150,6 +159,29 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         for cause, n in (cz.get("causes") or {}).items():
             caus_tot["causes"][cause] = (
                 caus_tot["causes"].get(cause, 0) + int(n or 0))
+    # elastic roll-up: sum every elastic job's loss/divergence/shrink
+    # accounting fleet-wide — "how degraded is the FLEET" (the lint
+    # checks these totals against the per-job entries)
+    elastic_tot = None
+    for jid, entry in jobs.items():
+        el = entry.get("elastic")
+        dlosses = int(entry.get("device_losses", 0) or 0)
+        if not el and not dlosses:
+            continue
+        if elastic_tot is None:
+            elastic_tot = {"jobs": 0, "device_lost": 0,
+                           "shard_divergence": 0, "mesh_shrinks": 0,
+                           "ladder_steps": 0, "fleet_requeues": 0}
+        elastic_tot["jobs"] += 1
+        elastic_tot["fleet_requeues"] += dlosses
+        if el:
+            elastic_tot["device_lost"] += len(el.get("losses") or ())
+            elastic_tot["shard_divergence"] += len(
+                el.get("divergences") or ())
+            elastic_tot["mesh_shrinks"] += len(
+                el.get("mesh_transitions") or ())
+            elastic_tot["ladder_steps"] += len(
+                el.get("ladder_steps") or ())
     return {
         "schema": SCHEMA,
         "schema_version": SCHEMA_VERSION,
@@ -166,6 +198,7 @@ def fleet_manifest(queue, *, workers_alive: int = 0,
         "counts": counts,
         **({"flows": flows_tot} if flows_tot else {}),
         **({"causality": caus_tot} if caus_tot else {}),
+        **({"elastic": elastic_tot} if elastic_tot else {}),
         **({"admission": admission} if admission else {}),
         **({"sweep": sweep} if sweep else {}),
         "jobs": jobs,

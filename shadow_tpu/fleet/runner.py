@@ -176,8 +176,14 @@ class FleetRunner:
             w["job"] = j.spec.id
             w["attempt"] = rec["attempt"]
             self._hb_journaled[j.spec.id] = now
+            # a device-loss requeue re-leases at the degraded width:
+            # the dispatched spec carries the shrunk shard count while
+            # the durable spec dir keeps the original ask
+            spec_d = j.spec.as_dict()
+            if j.shards_override:
+                spec_d["shards"] = int(j.shards_override)
             try:
-                w["conn"].send(("job", j.spec.as_dict(),
+                w["conn"].send(("job", spec_d,
                                 self.queue.job_dir(j.spec.id),
                                 j.resume_from, rec["attempt"]))
             except (BrokenPipeError, OSError):
@@ -233,6 +239,20 @@ class FleetRunner:
                            cz.get("windows_attributed")}
                           if cz else {}))
             self._backfill_lanes(job, result)
+        elif result.get("device_lost"):
+            # DEVICE_LOST with headroom left: the in-run elastic ladder
+            # exhausted but the mesh can still shrink — requeue the
+            # SAME attempt at the degraded width (device loss is
+            # environment, not the job's fault; it must not burn the
+            # failure budget). Bounded by the shared requeue budget.
+            dl = result["device_lost"]
+            st = self.queue.device_lost(
+                job, lost_shard=int(dl.get("lost_shard", -1)),
+                new_shards=int(dl.get("new_shards", 1)),
+                cause=str(dl.get("cause", "")))
+            self._emit("device_lost", job=job, status=st,
+                       lost_shard=dl.get("lost_shard"),
+                       new_shards=dl.get("new_shards"))
         elif result.get("preempted") and not result.get("deadline"):
             # graceful drain: the run snapshotted and yielded — park it
             # back in the queue as a continuation of the same attempt

@@ -148,6 +148,14 @@ def _build_scenario(spec: JobSpec, caps: dict):
 
         b.sim = telemetry.attach_causality(
             b.sim, sample_period=int(spec.causality_sample))
+    if getattr(spec, "sentinel", False):
+        # cross-shard integrity sentinel (parallel/elastic.py): the
+        # digest/latch subtree rides the sim pytree like flows and
+        # causality, so checkpoints carry the verified-state ledger
+        # and silent divergence latches instead of corrupting results
+        from shadow_tpu.parallel import elastic as elastic_mod
+
+        b.sim = elastic_mod.attach_sentinel(b.sim)
     # compile-time specialization LAST — the analysis reads the final
     # sim composition (attachments above) and the installed fault
     # plan. A lossless no-timer job serves the trimmed variant from
@@ -349,6 +357,35 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                  or int(getattr(spec, "causality_sample", 0) or 0) > 0
                  else None)
 
+    # Elastic degraded-mesh execution (parallel/elastic.py): the
+    # worker leases an explicit device set of the spec's width (a
+    # degraded requeue arrives with `shards` already shrunk by the
+    # fleet) and arms the in-run degradation ladder — device loss
+    # retries, then shrinks to survivors, then falls serial, resuming
+    # each rung from the last verified checkpoint.
+    mesh = None
+    elastic_policy = None
+    device_lease = None
+    want = max(1, int(getattr(spec, "shards", 1)))
+    if want > 1 or getattr(spec, "sentinel", False):
+        from shadow_tpu.parallel import elastic as elastic_mod
+        elastic_policy = elastic_mod.ElasticPolicy()
+    if want > 1:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        avail = jax.devices()
+        n = min(want, elastic_mod.next_pow2_down(len(avail)))
+        if n > 1:
+            leased = avail[:n]
+            mesh = Mesh(np.array(leased), ("hosts",))
+            device_lease = {"requested": want, "leased": n,
+                            "devices": [str(d) for d in leased]}
+        else:
+            device_lease = {"requested": want, "leased": 1,
+                            "devices": [str(avail[0])] if avail else []}
+
     t0 = time.monotonic()
     res = faults.run_supervised(
         make_bundle(), app_handlers=(phold.handler,),
@@ -361,6 +398,7 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         max_run_wallclock=spec.max_wallclock_s,
         on_round=on_round, log=log, sleep=lambda s: None,
         feeder=feeder, harvester=harvester,
+        mesh=mesh, elastic=elastic_policy,
         # fleets live on repeated shapes: serve dispatch programs from
         # the persistent AOT store by default (compile/serve.py;
         # SHADOW_WARM_PROGRAMS=0 / SHADOW_NO_COMPILE_CACHE opt out)
@@ -382,6 +420,29 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
         "final_capacities": dict(caps),
         "checkpoint": res.final_checkpoint,
     }
+    elastic_blk = getattr(res, "elastic", None)
+    final_shards = (int(elastic_blk["final_shards"])
+                    if elastic_blk else
+                    (mesh.shape["hosts"] if mesh is not None else 1))
+    if device_lease is not None:
+        device_lease["final_shards"] = final_shards
+        result["device_lease"] = device_lease
+    if elastic_blk is not None:
+        result["elastic"] = elastic_blk
+    hl = getattr(res, "health", None)
+    if (not res.ok and hl is not None
+            and int(getattr(hl, "device_lost", 0) or 0) > 0):
+        # the in-run ladder exhausted on device loss: hand the fleet a
+        # degraded-requeue verdict — next-pow2-down width, same attempt
+        # (runner._fold_result routes this through queue.device_lost)
+        if final_shards > 1:
+            nxt = max(1, final_shards // 2)
+            result["device_lost"] = {
+                "lost_shard": int(getattr(hl, "lost_shard", -1) or -1),
+                "new_shards": nxt,
+                "cause": str(getattr(hl, "device_lost_cause", "")
+                             or "device lost"),
+            }
     incidents = tuple(getattr(res, "lane_incidents", ()) or ())
     if incidents:
         # packed job: each quarantined lane becomes a standalone
@@ -427,9 +488,11 @@ def _run_scenario(spec: JobSpec, job_dir: str, *, resume_from,
                 sample_period=int(getattr(spec, "causality_sample", 0)
                                  or 0) or None)
         man = telemetry.run_manifest(
-            cfg=bundle.cfg, seed=spec.seed, shards=1, sim=res.sim,
+            cfg=bundle.cfg, seed=spec.seed, shards=final_shards,
+            sim=res.sim,
             stats=res.stats, health=res.health,
             fault_plan=bundle.fault_plan,
+            elastic=elastic_blk,
             run_id=res.run_id, resume_of=res.resume_of,
             escalations=res.escalations,
             preempted=res.preempted or None,

@@ -151,6 +151,17 @@ class JobSpec:
     # while faulted jobs serve the full one. "off" always runs the
     # full program.
     specialize: str = "auto"
+    # Elastic degraded-mesh execution (parallel/elastic.py): shards > 1
+    # runs the scenario shard_map'd over that many devices — the worker
+    # leases an explicit device set of this width, and a DEVICE_LOST
+    # requeue re-enqueues the job at the next-pow2-down width (a
+    # continuation, not a new attempt: checkpoints hold global layout,
+    # so the shrunk mesh resumes the same run). `sentinel` attaches the
+    # cross-shard integrity sentinel so checkpoints carry the
+    # verified-state ledger and silent divergence latches as
+    # SHARD_DIVERGENCE instead of corrupting results.
+    shards: int = 1
+    sentinel: bool = False
     # chaos_trial knobs (chaos_soak.run_trial)
     kills: int = 2
     verify: bool = False
@@ -203,6 +214,18 @@ class JobSpec:
         if self.slo_p99_ms is not None and float(self.slo_p99_ms) <= 0:
             raise ValueError(f"job {self.id}: slo_p99_ms must be > 0 "
                              f"(None disables the SLO)")
+        n = int(self.shards)
+        if n < 1 or n & (n - 1):
+            raise ValueError(f"job {self.id}: shards must be a "
+                             f"positive power of two, got {self.shards}")
+        if n > 1 and self.kind != "scenario":
+            raise ValueError(f"job {self.id}: shards > 1 only applies "
+                             f"to kind 'scenario'")
+        if n > 1 and (self.hosts * max(int(self.replicas), 1)) % n:
+            raise ValueError(
+                f"job {self.id}: total host rows "
+                f"({self.hosts}x{self.replicas}) must divide by "
+                f"shards ({n})")
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)

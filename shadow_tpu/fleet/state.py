@@ -84,6 +84,8 @@ class JobState:
         self.attempts = 0            # failure attempts started
         self.execs = 0               # executions incl. requeues
         self.worker_losses = 0
+        self.device_losses = 0       # DEVICE_LOST requeues (elastic)
+        self.shards_override: Optional[int] = None  # degraded width
         self.worker: Optional[str] = None
         self.lease_expires: Optional[float] = None
         self.deadline_at: Optional[float] = None
@@ -263,6 +265,10 @@ class FleetQueue:
             j.continuation = True
         elif ev == "worker_lost" and j is not None:
             j.worker_losses += 1
+        elif ev == "device_lost" and j is not None:
+            j.device_losses += 1
+            if rec.get("new_shards"):
+                j.shards_override = int(rec["new_shards"])
         elif ev == "quarantined" and j is not None:
             j.status = QUARANTINED
             j.worker = None
@@ -378,6 +384,42 @@ class FleetQueue:
             os.path.join(self.job_dir(job_id), "ck"))
         self.record({"ev": "requeued", "job": job_id,
                      "resume_from": resume, "cause": reason})
+        return QUEUED
+
+    def device_lost(self, job_id: str, *, lost_shard: int,
+                    new_shards: int, cause: str = "") -> str:
+        """A device in a leased shard set died mid-run (the in-run
+        elastic ladder exhausted its meshes, or the worker surfaced a
+        DEVICE_LOST verdict). Requeue the job as a continuation of the
+        SAME attempt at the degraded width — device loss is
+        environment, not the job's fault, so it must not burn the
+        failure budget — bounded by the shared requeue budget. The
+        degraded width sticks (shards_override) so the next lease
+        dispatches the shrunk spec; checkpoints hold global layout, so
+        the shrunk mesh resumes the same run. Returns the job's
+        resulting status."""
+        self.record({"ev": "device_lost", "job": job_id,
+                     "lost_shard": lost_shard,
+                     "new_shards": int(new_shards), "cause": cause})
+        from shadow_tpu.utils import checkpoint as ckpt
+
+        j = self.jobs[job_id]
+        if j.terminal:              # result raced the loss; keep it
+            return j.status
+        if (j.worker_losses + j.device_losses
+                > self.policy.requeue_budget):
+            self.quarantine(job_id, f"requeue budget exhausted "
+                            f"({j.device_losses} device losses, "
+                            f"{j.worker_losses} worker losses)",
+                            {"fault": "DEVICE_LOST", "cause": cause})
+            return QUARANTINED
+        resume = j.checkpoint or ckpt.latest_checkpoint(
+            os.path.join(self.job_dir(job_id), "ck"))
+        self.record({"ev": "requeued", "job": job_id,
+                     "resume_from": resume,
+                     "cause": f"device lost (shard {lost_shard}): "
+                              f"{cause}" if cause else
+                              f"device lost (shard {lost_shard})"})
         return QUEUED
 
     def quarantine(self, job_id: str, reason: str,

@@ -27,6 +27,7 @@ from shadow_tpu.core.engine import (
 )
 from shadow_tpu.core.engine import run as engine_run
 from shadow_tpu.core.events import EventKind, emit_words, push_rows
+from shadow_tpu.parallel.elastic import make_sentinel_fn
 from shadow_tpu.telemetry.flows import make_flow_fn
 from shadow_tpu.telemetry.ring import make_telem_fn
 from shadow_tpu.net.state import (
@@ -438,6 +439,9 @@ def make_runner(bundle: SimBundle, app_handlers=(),
             flow_fn=flow_fn,
             sparse_lanes=resolve_sparse_lanes(bundle.cfg),
             fault_times=plan_times(bundle),
+            # serial identity sentinel: never trips, but advances the
+            # verified-through ledger (trace-time no-op when off)
+            sentinel_fn=make_sentinel_fn(None),
         )
 
     from shadow_tpu.compile import serve
@@ -512,7 +516,7 @@ def make_chunked_runner(bundle: SimBundle, app_handlers=(),
         lane_fn=lambda s: s.net.lane_id,
         bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
         sparse_lanes=resolve_sparse_lanes(bundle.cfg),
-        flow_fn=make_flow_fn())
+        flow_fn=make_flow_fn(), sentinel_fn=make_sentinel_fn(None))
     from shadow_tpu.compile import serve
 
     k_windows = serve.maybe_warm(

@@ -452,6 +452,13 @@ class Sim:
     # None-contributes-no-leaves contract; specialize.apply() is the
     # opt-in (attached only when something was actually dropped).
     guard: Any = None
+    # SentinelState (parallel/elastic.py) when the cross-shard
+    # integrity sentinel is on — a per-window-barrier digest of the
+    # replicated leaves compared pmax-vs-pmin across shards, latching
+    # a sticky SHARD_DIVERGENCE trip on mismatch — same
+    # None-contributes-no-leaves contract; elastic.attach_sentinel()
+    # is the opt-in.
+    sentinel: Any = None
 
 
 def drop_total(net: NetState) -> jax.Array:

@@ -59,6 +59,7 @@ from shadow_tpu.core.events import (
     segment_ranks,
 )
 from shadow_tpu.net.state import NetState, REPLICATED_FIELDS
+from shadow_tpu.parallel.elastic import make_sentinel_fn
 from shadow_tpu.telemetry.flows import make_flow_fn
 from shadow_tpu.telemetry.ring import make_telem_fn
 
@@ -291,6 +292,11 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     # would multiply it by the shard count. The [H]/[H,F] lineage
     # leaves and [W] adv planes are non-scalar and untouched below.
     caus = getattr(sim, "causality", None)
+    # The integrity sentinel's leaves are all replicated scalars —
+    # every update is a pure function of collectives
+    # (parallel/elastic.py make_sentinel_fn) — so the subtree pins
+    # like the telemetry ring.
+    sentinel = getattr(sim, "sentinel", None)
     # The per-path matrix is declared replicated (REPLICATED_FIELDS)
     # but each shard scatter-adds only its own hosts' sends into its
     # replica — psum the [V,V] delta so the reassembled matrix equals
@@ -320,6 +326,8 @@ def _replicate_scalars(sim, initial_sim, stats: EngineStats, axis: str):
     if caus is not None:
         sim = sim.replace(causality=sim.causality.replace(
             adv_count=caus.adv_count))
+    if sentinel is not None:
+        sim = sim.replace(sentinel=sentinel)
     if path_pinned is not None:
         sim = sim.replace(net=sim.net.replace(
             ctr_path_packets=path_pinned))
@@ -413,6 +421,8 @@ def _make_whole_run(mesh: Mesh, axis: str, sim, step_fn, *,
             # the record-time wend clamp is computed from replicated
             # constants + the lockstep wstart, so it is shard-invariant
             fault_times=fault_times,
+            # trace-time no-op when sim.sentinel is None (sentinel off)
+            sentinel_fn=make_sentinel_fn(axis),
         )
         return _replicate_scalars(out_sim, local_sim, stats, axis)
 
@@ -507,6 +517,7 @@ def make_sharded_window(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
             flow_fn=make_flow_fn(axis),
+            sentinel_fn=make_sentinel_fn(axis),
         )
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)
         return out_sim, stats, next_min
@@ -559,6 +570,7 @@ def make_sharded_chunk(mesh: Mesh, axis: str, sim_template, cfg, step_fn,
             sparse_lanes=resolve_sparse_lanes(cfg),
             census_fn=lambda x: lax.psum(x, axis),
             flow_fn=make_flow_fn(axis),
+            sentinel_fn=make_sentinel_fn(axis),
         )
         out_sim, stats, next_min = chunk(local_sim, stats, wstart)
         out_sim, stats = _replicate_scalars(out_sim, local_sim, stats, axis)

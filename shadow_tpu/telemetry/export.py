@@ -28,7 +28,7 @@ def _us(ns: int) -> float:
 
 def chrome_trace(records, timers=None, num_shards: int = 1,
                  flow_records=None, adv_records=None,
-                 chains=None) -> dict:
+                 chains=None, elastic=None) -> dict:
     """Build a Trace Event Format object (dict; json.dump it).
 
     Sim-time track: pid 0, one "X" event per window record, ts/dur in
@@ -51,6 +51,22 @@ def chrome_trace(records, timers=None, num_shards: int = 1,
     jump-utilization and the window binding cause — so "why can't this
     run go faster" reads directly off the trace."""
     events = []
+    if elastic:
+        # elastic recovery (parallel/elastic.py): one instant event per
+        # mesh transition on the sim-time axis, pinned at the verified
+        # resume point — the trace shows exactly where the run shrank
+        for step in elastic.get("mesh_transitions") or ():
+            events.append({
+                "ph": "i", "pid": 0, "tid": 0, "s": "g",
+                "name": (f"mesh {step.get('from')}->{step.get('to')} "
+                         f"({step.get('cause')})"),
+                "ts": _us(int(step.get("resume_time_ns", 0) or 0)),
+                "args": {"action": step.get("action"),
+                         "cause": step.get("cause"),
+                         "shard": step.get("shard"),
+                         "from_shards": step.get("from"),
+                         "to_shards": step.get("to")},
+            })
     events.append({"ph": "M", "name": "process_name", "pid": 0, "tid": 0,
                    "args": {"name": "sim-time (simulated µs)"}})
     events.append({"ph": "M", "name": "thread_name", "pid": 0, "tid": 0,
@@ -304,7 +320,8 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
                  admission: dict | None = None,
                  profile: dict | None = None,
                  causality: dict | None = None,
-                 specialization: dict | None = None) -> dict:
+                 specialization: dict | None = None,
+                 elastic: dict | None = None) -> dict:
     """The run's identity + outcome (see module docstring).
     `compile_s` is the wall time of the first (compiling) device call;
     `compile_fresh` says whether it actually compiled (True) or was
@@ -414,6 +431,15 @@ def run_manifest(*, cfg, seed: int, shards: int, sim, stats=None,
         # that dropped capabilities' drop counters stayed zero, and
         # that a tripped guard was reported fatal
         man["specialization"] = specialization
+    if elastic is not None:
+        # elastic degraded-mesh recovery (parallel/elastic.py +
+        # faults/supervisor.py _elastic_block): policy, initial/final
+        # shard widths, every device loss and divergence record, the
+        # ladder steps taken and the mesh transitions among them.
+        # tools/telemetry_lint.py checks transition monotonicity
+        # (pow2-down or serial), losses + divergences == ladder steps,
+        # and the verified-window stamps against the checkpoints
+        man["elastic"] = elastic
     return man
 
 
@@ -554,6 +580,26 @@ def metrics_from_manifest(man: dict) -> dict:
                 c.get("length", 0) for c in chains)
             out["critical_chain_span_ns_max"] = max(
                 c.get("span_ns", 0) for c in chains)
+    if "elastic" in man:
+        # elastic recovery counters: how many devices this run lost,
+        # how many integrity trips it took, and how many times the
+        # mesh shrank — the dashboard's "how degraded is this run"
+        el = man["elastic"]
+        out["device_lost_total"] = len(el.get("losses") or ())
+        out["shard_divergence_total"] = len(el.get("divergences") or ())
+        out["mesh_shrink_total"] = len(el.get("mesh_transitions") or ())
+        if el.get("initial_shards") is not None:
+            out["elastic_initial_shards"] = int(el["initial_shards"])
+        if el.get("final_shards") is not None:
+            out["elastic_final_shards"] = int(el["final_shards"])
+    hl = man.get("health") or {}
+    if hl.get("sentinel"):
+        # cross-shard integrity sentinel: barrier checks performed and
+        # the verified-state frontier (0 trips => frontier == end time)
+        st = hl["sentinel"]
+        out["sentinel_checks_total"] = int(st.get("checks", 0) or 0)
+        out["sentinel_verified_through_ns"] = int(
+            st.get("verified_through_ns", 0) or 0)
     return out
 
 

@@ -81,15 +81,38 @@ def capacities_of_sim(sim) -> dict:
     }
 
 
+def elastic_meta(sim, shards: int = 1) -> dict:
+    """The verified-state ledger stamp a snapshot carries for elastic
+    resume (parallel/elastic.py): per-shard sha256 digests over the
+    leaves as sim_specs shards them (replicated leaves fold into every
+    shard's digest, so digest s survives re-partitioning onto any mesh
+    that still owns those rows), plus the sentinel's
+    `last_verified_window` — the last window barrier proven
+    divergence-free (None when no sentinel is attached: the snapshot
+    is then trusted as-saved, verified == time_ns)."""
+    from shadow_tpu.parallel.elastic import sentinel_report, shard_digests
+
+    rep = sentinel_report(sim)
+    return {
+        "shard_digests": shard_digests(sim, shards),
+        "last_verified_window": (None if rep is None
+                                 else rep["verified_through_ns"]),
+        "sentinel": rep,
+    }
+
+
 def save(path: str, sim, *, time_ns: int, extra: dict | None = None,
-         shards: int = 1, config_digest: str | None = None):
+         shards: int = 1, config_digest: str | None = None,
+         elastic: dict | None = None):
     """Snapshot a Sim pytree at a window boundary. `time_ns` is the
     next window start (resume point). Atomic: the snapshot appears at
     `path` complete or not at all. `shards` records the mesh width the
     run used and `config_digest` the config hash — both are diagnostic
     metadata only (state arrays are always saved in global layout, so
     a snapshot resumes under ANY shard count; a digest mismatch is a
-    warning, not a refusal)."""
+    warning, not a refusal). `elastic` (elastic_meta) stamps the
+    verified-state ledger block: per-shard digests +
+    last_verified_window."""
     leaves = _leaf_dict(sim)
     meta = {"time_ns": int(time_ns), "extra": extra or {},
             "layout": LAYOUT_VERSION, "keys": sorted(leaves),
@@ -98,6 +121,8 @@ def save(path: str, sim, *, time_ns: int, extra: dict | None = None,
             "shards": int(shards),
             "config_digest": config_digest,
             "jax_version": jax.__version__}
+    if elastic is not None:
+        meta["elastic"] = elastic
     # np.savez appends ".npz" to *paths* but not to file objects, and
     # the atomic write goes through a file object — normalize here so
     # both spellings land at the same place.
@@ -294,6 +319,82 @@ def load(path: str, template_sim):
     return sim, meta["time_ns"], meta["extra"]
 
 
+def replan_shards(path: str, new_shards: int, *,
+                  template_sim=None, out_path: str | None = None) -> str:
+    """Re-partition a snapshot onto a `new_shards`-wide mesh. State
+    arrays are saved in GLOBAL layout, so the re-partition is a
+    verified metadata restamp, not a data shuffle — exactly why device
+    loss costs a resume, not a run (parallel/elastic.py module doc):
+
+    1. validate: new_shards is a power of two >= 1 that divides the
+       snapshot's host count;
+    2. verify: every leaf's CRC32 (load_leaves), and — when the
+       snapshot carries a verified-state ledger AND the caller
+       supplies the template to rebuild the pytree — the per-shard
+       digests recomputed at the OLD width must match the stamped
+       ones (a corrupt snapshot must not silently become the resume
+       point of a degraded run);
+    3. restamp: meta.shards = new_shards, with the replan recorded in
+       meta.elastic.replans (old -> new), and per-shard digests
+       recomputed at the NEW width when the template is given.
+
+    Returns the written path (out_path, default: in place)."""
+    if not path.endswith(".npz"):
+        path = path + ".npz"
+    new_shards = int(new_shards)
+    if new_shards < 1 or (new_shards & (new_shards - 1)):
+        raise ValueError(
+            f"replan_shards: new_shards={new_shards} must be a power "
+            f"of two >= 1 (the bucket lattice and AOT program keys "
+            f"are pow2)")
+    leaves, meta = load_leaves(path)
+    hosts = int(meta.get("capacities", {}).get("num_hosts", 0))
+    if hosts and hosts % new_shards:
+        raise ValueError(
+            f"replan_shards: num_hosts={hosts} not divisible by "
+            f"{new_shards} shards")
+    old_shards = int(meta.get("shards", 1))
+    el = dict(meta.get("elastic") or {})
+    if template_sim is not None:
+        from shadow_tpu.parallel.elastic import shard_digests
+
+        sim, _, _ = load(path, template_sim)
+        stamped = el.get("shard_digests")
+        if stamped:
+            fresh = shard_digests(sim, old_shards)
+            if fresh != list(stamped):
+                bad = [s for s, (a, b) in
+                       enumerate(zip(fresh, stamped)) if a != b]
+                raise ValueError(
+                    f"replan_shards: per-shard digest mismatch at "
+                    f"shard(s) {bad} — snapshot state disagrees with "
+                    f"its verified-state ledger, refuse to replan")
+        el["shard_digests"] = shard_digests(sim, new_shards)
+    el.setdefault("replans", []).append(
+        {"from": old_shards, "to": new_shards})
+    meta["shards"] = new_shards
+    meta["elastic"] = el
+    out = out_path or path
+    if not out.endswith(".npz"):
+        out = out + ".npz"
+    d = os.path.dirname(os.path.abspath(out))
+    fd, tmp = tempfile.mkstemp(prefix=".replan.", suffix=".tmp", dir=d)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, __meta__=json.dumps(meta), **leaves)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, out)
+        _fsync_dir(d)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    return out
+
+
 class _LoopPlan:
     """Resolved loop parameters shared by run_windows and
     prewarm_dispatch — one resolution rule so the program a prewarm
@@ -408,6 +509,7 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
     )
     from shadow_tpu.compile import serve
     from shadow_tpu.net.build import _caps_meta
+    from shadow_tpu.parallel.elastic import make_sentinel_fn
     from shadow_tpu.telemetry.flows import make_flow_fn
     from shadow_tpu.telemetry.ring import make_telem_fn
 
@@ -443,7 +545,8 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
                 lane_fn=lambda s: s.net.lane_id,
                 bulk_fn=bulk_fn, fault_fn=fault_fn, telem_fn=telem_fn,
                 sparse_lanes=resolve_sparse_lanes(cfg),
-                flow_fn=make_flow_fn())
+                flow_fn=make_flow_fn(),
+                sentinel_fn=make_sentinel_fn())
             raw = jax.jit(body)
         example = (sim, EngineStats.create(),
                    jnp.asarray(0, simtime.DTYPE))
@@ -472,7 +575,8 @@ def _make_dispatch_fns(bundle, plan, sim, app_handlers, *, mesh,
                                bulk_fn=bulk_fn, fault_fn=fault_fn,
                                telem_fn=telem_fn, wstart=wstart,
                                sparse_lanes=resolve_sparse_lanes(cfg),
-                               flow_fn=flow_fn)
+                               flow_fn=flow_fn,
+                               sentinel_fn=make_sentinel_fn())
     example = (sim, 0, plan.min_jump)
     one_window = serve.maybe_warm(raw, key, enabled=warm, store=store,
                                   meta=_caps_meta(plan.caps),
@@ -519,7 +623,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 windows_per_dispatch: int | None = None,
                 adaptive_jump: bool | None = None,
                 feeder=None, warm_start: bool | None = None,
-                compile_info: dict | None = None):
+                compile_info: dict | None = None,
+                dispatch_wrap=None):
     """Host-driven window loop with optional periodic snapshots —
     the checkpointing twin of engine.run (same advance rule,
     master.c:450-480). Returns (sim, stats, checkpoints) where
@@ -633,6 +738,22 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
         bundle, plan, sim, app_handlers, mesh=mesh, mesh_axis=mesh_axis,
         exchange_capacity=exchange_capacity, warm=warm,
         compile_info=compile_info)
+    if dispatch_wrap is not None:
+        # device-loss guard / chaos poison (parallel/elastic.py): the
+        # wrap sees every dispatch the loop issues — XLA device errors
+        # re-raise as typed DeviceLossError for the supervisor's
+        # degradation ladder
+        if chunk_fn is not None:
+            chunk_fn = dispatch_wrap(chunk_fn)
+        if one_window is not None:
+            one_window = dispatch_wrap(one_window)
+
+    def _elastic_stamp(s):
+        # verified-state ledger: stamped only on sentinel-carrying
+        # runs (the opt-in that funds the per-checkpoint digest cost)
+        if getattr(s, "sentinel", None) is None:
+            return None
+        return elastic_meta(s, shards)
 
     total = stats0 if stats0 is not None else EngineStats.create()
     saved = []
@@ -686,7 +807,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
                 if (next_ckpt is not None and checkpoint_path is not None
                         and nm >= next_ckpt and nm <= end):
                     p = save(f"{checkpoint_path}.{nm}.npz", csim,
-                             time_ns=nm, shards=shards)
+                             time_ns=nm, shards=shards,
+                             elastic=_elastic_stamp(csim))
                     saved.append((p, nm))
                     while next_ckpt <= nm:
                         next_ckpt += checkpoint_every_ns
@@ -734,7 +856,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
             if (next_ckpt is not None and checkpoint_path is not None
                     and nm >= next_ckpt and nm <= end):
                 p = save(f"{checkpoint_path}.{nm}.npz", csim,
-                         time_ns=nm, shards=shards)
+                         time_ns=nm, shards=shards,
+                         elastic=_elastic_stamp(csim))
                 saved.append((p, nm))
                 while next_ckpt <= nm:
                     next_ckpt += checkpoint_every_ns
@@ -754,7 +877,8 @@ def run_windows(bundle, app_handlers=(), *, end_time: int | None = None,
         if (next_ckpt is not None and wstart >= next_ckpt
                 and checkpoint_path is not None):
             p = save(f"{checkpoint_path}.{wstart}.npz", sim,
-                     time_ns=wstart, shards=shards)
+                     time_ns=wstart, shards=shards,
+                     elastic=_elastic_stamp(sim))
             saved.append((p, wstart))
             next_ckpt += checkpoint_every_ns
         wend = _clamp_record(wstart, min(wstart + min_jump, end + 1))

@@ -1,0 +1,557 @@
+"""Elastic degraded-mesh recovery (parallel/elastic.py, the
+supervisor's degradation ladder, the verified-state checkpoint
+ledger, and the fleet's device-loss requeue).
+
+The contracts pinned here:
+
+- the cross-shard integrity sentinel latches a FATAL divergence with
+  the offending shard id, and its verified frontier stops strictly
+  before the tripped barrier;
+- a clean run never trips, and attaching the sentinel never changes
+  the simulation results;
+- `replan_shards` re-partitions a snapshot 8 -> 4 -> 1 leaf-exact
+  (global layout: a replan is a restamp, not a shuffle) and refuses
+  a snapshot whose state disagrees with its digest ledger;
+- the full shrink-to-survivors resume is bit-identical to the
+  uninterrupted control (serial retry here; the 8->4 sharded oracle
+  rides tools/chaos_soak.py --device-loss);
+- the fleet requeues a DEVICE_LOST job at the degraded width without
+  burning a failure attempt;
+- the lint accepts the recorded elastic surface and rejects each
+  broken invariant.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from conftest import load_tool
+from jax.sharding import Mesh
+
+from shadow_tpu import faults
+from shadow_tpu.apps import phold, pingpong
+from shadow_tpu.core import simtime
+from shadow_tpu.fleet import spec as fleet_spec
+from shadow_tpu.fleet import state as fleet_state
+from shadow_tpu.net.build import HostSpec, build, make_runner
+from shadow_tpu.net.state import NetConfig
+from shadow_tpu.parallel import elastic
+from shadow_tpu.parallel.shard import make_sharded_runner
+from shadow_tpu.utils import checkpoint
+
+SEC = simtime.ONE_SECOND
+
+ONE_VERTEX = """<graphml xmlns="http://graphml.graphdrawing.org/xmlns">
+  <key attr.name="latency" attr.type="double" for="edge" id="lat" />
+  <key attr.name="bandwidthup" attr.type="int" for="node" id="up" />
+  <key attr.name="bandwidthdown" attr.type="int" for="node" id="dn" />
+  <graph edgedefault="undirected">
+    <node id="v0"><data key="up">10240</data><data key="dn">10240</data></node>
+    <edge source="v0" target="v0"><data key="lat">50.0</data></edge>
+  </graph>
+</graphml>"""
+
+H = 8
+PORT = 7000
+
+
+def _pingpong_bundle(seed=1, sentinel=True):
+    cfg = NetConfig(num_hosts=H, end_time=5 * SEC, seed=seed)
+    hosts = [HostSpec(name=f"client{i}", proc_start_time=SEC)
+             for i in range(H // 2)]
+    hosts += [HostSpec(name=f"server{i}") for i in range(H // 2)]
+    b = build(cfg, ONE_VERTEX, hosts)
+    client = jnp.asarray(np.arange(H) < H // 2)
+    server = jnp.asarray(np.arange(H) >= H // 2)
+    server_ip = np.zeros(H, np.int64)
+    for i in range(H // 2):
+        server_ip[i] = b.ip_of(f"server{i}")
+    b.sim = pingpong.setup(
+        b.sim, client_mask=client, server_mask=server,
+        server_ip=jnp.asarray(server_ip), server_port=PORT,
+        count=5, size=128)
+    if sentinel:
+        b.sim = elastic.attach_sentinel(b.sim)
+    return b
+
+
+def _phold_bundle(hosts=8, load=2, sim_s=1, seed=3, sentinel=True):
+    cap = max(32, 4 * load)
+    cfg = NetConfig(num_hosts=hosts, tcp=False, end_time=sim_s * SEC,
+                    seed=seed, event_capacity=cap, outbox_capacity=cap,
+                    router_ring=cap, in_ring=max(8, 2 * load))
+    b = build(cfg, ONE_VERTEX,
+              [HostSpec(name=f"p{i}", proc_start_time=0)
+               for i in range(hosts)])
+    b.sim = phold.setup(b.sim, load=load)
+    if sentinel:
+        b.sim = elastic.attach_sentinel(b.sim)
+    return b
+
+
+def _leaves(sim):
+    return jax.tree_util.tree_flatten_with_path(sim)[0]
+
+
+# ------------------------------------------------- device-loss classify
+
+def test_classify_maps_loss_markers_to_typed_error():
+    err = elastic.classify(
+        RuntimeError("INTERNAL: DEVICE_LOST: device ordinal 3 halted"),
+        shards=8)
+    assert isinstance(err, elastic.DeviceLossError)
+    assert err.shard == 3
+    d = err.as_dict()
+    assert d["fault"] == "DEVICE_LOST" and d["shard"] == 3
+    # ordinary errors propagate untouched
+    assert elastic.classify(ValueError("bad spec"), shards=8) is None
+    # a blocking dispatch that overran its deadline is a loss too
+    slow = elastic.classify(RuntimeError("sync timeout"), shards=8,
+                            elapsed_s=12.0, deadline_s=5.0)
+    assert isinstance(slow, elastic.DeviceLossError)
+    assert slow.cause == "dispatch_deadline"
+
+
+def test_guard_dispatch_reraises_typed():
+    def boom():
+        raise RuntimeError("transfer to device failed: device 1 gone")
+
+    with pytest.raises(elastic.DeviceLossError):
+        elastic.guard_dispatch(boom, shards=2)()
+
+    def fine():
+        return 7
+
+    assert elastic.guard_dispatch(fine)() == 7
+
+
+# --------------------------------------------- cross-shard sentinel
+
+@pytest.mark.slow
+def test_sentinel_divergence_latches_offending_shard():
+    """One shard's replica of a replicated table silently corrupts
+    mid-run -> the sentinel latches FATAL with that shard's id, and
+    the verified frontier stops strictly before the tripped barrier."""
+    b = _pingpong_bundle()
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("hosts",))
+    victim, at = 3, int(1.3 * SEC)
+    run = make_sharded_runner(
+        b, mesh, "hosts", app_handlers=(pingpong.handler,),
+        fault_fn=elastic.make_divergence_fault_fn(
+            "hosts", shard=victim, at_ns=at))
+    sim, _ = run(b.sim)
+    rep = elastic.sentinel_report(sim)
+    assert rep["trips"] >= 1
+    assert rep["shard"] == victim
+    assert rep["tripped_at_ns"] >= at
+    assert 0 < rep["verified_through_ns"] < rep["tripped_at_ns"]
+    # the latch report itself is lint-clean (the trip is recorded
+    # coherently, not just recorded)
+    tl = load_tool("telemetry_lint")
+    assert tl._lint_health_sentinel(rep) == []
+
+
+@pytest.mark.slow
+def test_sentinel_clean_run_verifies_and_changes_nothing():
+    """No corruption -> zero trips, the verified frontier advances;
+    and the sentinel's presence never perturbs simulation state (its
+    leaves are pure observers)."""
+    devices = np.array(jax.devices()[:2])
+    mesh = Mesh(devices, ("hosts",))
+    b_on = _pingpong_bundle(sentinel=True)
+    sim_on, stats_on = make_sharded_runner(
+        b_on, mesh, "hosts", app_handlers=(pingpong.handler,))(b_on.sim)
+    rep = elastic.sentinel_report(sim_on)
+    assert rep["trips"] == 0 and rep["shard"] == -1
+    assert rep["checks"] > 0
+    assert rep["verified_through_ns"] > 0
+
+    b_off = _pingpong_bundle(sentinel=False)
+    sim_off, stats_off = make_sharded_runner(
+        b_off, mesh, "hosts", app_handlers=(pingpong.handler,))(b_off.sim)
+    assert int(stats_on.events_processed) == int(stats_off.events_processed)
+    off = {jax.tree_util.keystr(p): l for p, l in _leaves(sim_off)}
+    for path, leaf in _leaves(sim_on):
+        key = jax.tree_util.keystr(path)
+        if key.startswith(".sentinel"):
+            continue
+        assert np.array_equal(np.asarray(leaf), np.asarray(off[key])), key
+
+
+def test_sentinel_serial_identity_advances_frontier():
+    """Serial runs get the identity sentinel: no mesh to disagree
+    with, so it can never trip, but verified_through still advances —
+    serial checkpoints carry a meaningful ledger stamp."""
+    b = _phold_bundle()
+    sim, _ = make_runner(b, app_handlers=(phold.handler,),
+                         app_bulk=phold.BULK)(b.sim)
+    rep = elastic.sentinel_report(sim)
+    assert rep["trips"] == 0
+    assert rep["checks"] > 0
+    assert rep["verified_through_ns"] > 0
+
+
+# ------------------------------------------------- replan_shards
+
+def test_replan_shards_is_leaf_exact(tmp_path):
+    b = _phold_bundle()
+    sim = b.sim
+    path = str(tmp_path / "snap")
+    checkpoint.save(path, sim, time_ns=0, shards=8,
+                    elastic=checkpoint.elastic_meta(sim, 8))
+    # 8 -> 4 -> 1, digest-checked at every hop
+    checkpoint.replan_shards(path, 4, template_sim=sim)
+    checkpoint.replan_shards(path, 1, template_sim=sim)
+    got, t, _ = checkpoint.load(path, sim)
+    assert t == 0
+    orig = {jax.tree_util.keystr(p): l for p, l in _leaves(sim)}
+    for p, leaf in _leaves(got):
+        key = jax.tree_util.keystr(p)
+        assert np.array_equal(np.asarray(leaf), np.asarray(orig[key])), key
+    _, meta = checkpoint.load_leaves(path + ".npz")
+    assert meta["shards"] == 1
+    el = meta["elastic"]
+    assert [r["from"] for r in el["replans"]] == [8, 4]
+    assert [r["to"] for r in el["replans"]] == [4, 1]
+    assert len(el["shard_digests"]) == 1
+    # the restamped ledger still lints clean
+    tl = load_tool("telemetry_lint")
+    errs, _ = tl.lint_checkpoint_elastic(path)
+    assert errs == []
+
+
+def test_replan_shards_rejects_bad_widths(tmp_path):
+    b = _phold_bundle()
+    path = str(tmp_path / "snap")
+    checkpoint.save(path, b.sim, time_ns=0, shards=8)
+    with pytest.raises(ValueError, match="power"):
+        checkpoint.replan_shards(path, 3)
+    with pytest.raises(ValueError, match="divisible"):
+        checkpoint.replan_shards(path, 16)
+
+
+def test_replan_shards_rejects_digest_mismatch(tmp_path):
+    """A snapshot whose state disagrees with its own verified-state
+    ledger must not silently become the resume point of a degraded
+    run."""
+    b = _phold_bundle()
+    el = checkpoint.elastic_meta(b.sim, 8)
+    el["shard_digests"][2] = "0" * 64            # forged ledger
+    path = str(tmp_path / "snap")
+    checkpoint.save(path, b.sim, time_ns=0, shards=8, elastic=el)
+    with pytest.raises(ValueError, match="digest mismatch"):
+        checkpoint.replan_shards(path, 4, template_sim=b.sim)
+
+
+def test_survivor_mesh_drops_lost_shard():
+    devices = np.array(jax.devices()[:8])
+    mesh = Mesh(devices, ("hosts",))
+    new_mesh, n = elastic.survivor_mesh(mesh, "hosts", 6)
+    assert n == 4
+    kept = list(np.asarray(new_mesh.devices).reshape(-1))
+    assert devices[6] not in kept and len(kept) == 4
+    # 2 -> losing one leaves only a serial run
+    m2 = Mesh(devices[:2], ("hosts",))
+    none_mesh, n = elastic.survivor_mesh(m2, "hosts", 0)
+    assert none_mesh is None and n == 1
+    assert elastic.next_pow2_down(7) == 4
+    assert elastic.next_pow2_down(8) == 8
+
+
+# ---------------------------------- shrink/retry resume bit-identity
+
+@pytest.mark.slow
+def test_serial_elastic_retry_bit_identical(tmp_path):
+    """A device loss on a serial supervised run (under a live fault
+    plan) walks one same-mesh retry from the last verified checkpoint
+    and finishes byte-identical to the uninterrupted control."""
+    plan = [
+        faults.FaultRecord(t_ns=int(0.3 * SEC),
+                           kind=faults.FaultKind.LINK_DOWN, a=0, b=0),
+        faults.FaultRecord(t_ns=int(0.6 * SEC),
+                           kind=faults.FaultKind.LINK_UP, a=0, b=0),
+    ]
+
+    def make_bundle():
+        b = _phold_bundle()
+        faults.install(b, plan)
+        return b
+
+    common = dict(app_handlers=(phold.handler,),
+                  checkpoint_every_windows=2, max_retries=2,
+                  sleep=lambda s: None)
+    ctrl = faults.run_supervised(
+        make_bundle(), checkpoint_path=str(tmp_path / "ctrl.ck"),
+        run_id="el.ctrl", **common)
+    assert ctrl.ok
+    assert ctrl.dispatches > 2
+
+    res = faults.run_supervised(
+        make_bundle(), checkpoint_path=str(tmp_path / "ck"),
+        elastic=elastic.ElasticPolicy(),
+        dispatch_wrap=elastic.make_poisoned_dispatch(
+            ctrl.dispatches // 2, shard=0),
+        run_id="el.chaos", **common)
+    assert res.ok, res.failure_report()
+    el = res.elastic
+    assert [s["action"] for s in el["ladder_steps"]] == ["retry"]
+    assert el["final_shards"] == 1
+    assert el["mesh_transitions"] == []
+    assert len(el["losses"]) == 1
+    # the ladder step consumed no failure retries
+    assert res.retries_used == 0
+    ctrl_leaves = {jax.tree_util.keystr(p): l
+                   for p, l in _leaves(ctrl.sim)}
+    for p, leaf in _leaves(res.sim):
+        key = jax.tree_util.keystr(p)
+        if key.startswith(".sentinel"):
+            continue   # the resume replays barriers; counts differ
+        assert np.array_equal(np.asarray(leaf),
+                              np.asarray(ctrl_leaves[key])), key
+    rep_a = elastic.sentinel_report(res.sim)
+    rep_b = elastic.sentinel_report(ctrl.sim)
+    assert rep_a["verified_through_ns"] == rep_b["verified_through_ns"]
+
+
+@pytest.mark.slow
+def test_sharded_shrink_to_survivors_oracle(tmp_path):
+    """The full acceptance path (tools/chaos_soak.py --device-loss):
+    kill one shard of an 8-shard mesh on two consecutive dispatches
+    mid-run (retry, then shrink), resume on the 4 pow2-down survivors
+    from the last verified checkpoint, and finish byte-identical to
+    the uninterrupted 8-shard control — with the elastic block and
+    the final checkpoint's ledger stamp lint-clean."""
+    cs = load_tool("chaos_soak")
+    rep = cs.run_device_loss_trial(3, workdir=str(tmp_path))
+    assert rep["device_loss_errors"] == []
+    assert rep["ok"], rep
+    assert rep["ladder"] == ["retry", "shrink"]
+    assert rep["final_shards"] == 4
+    assert rep["losses"] == 2
+
+
+# ------------------------------------------------- fleet integration
+
+def _policy(**kw):
+    kw.setdefault("max_attempts", 2)
+    kw.setdefault("backoff_base_s", 0.0)
+    kw.setdefault("backoff_cap_s", 0.0)
+    return fleet_spec.FleetPolicy(**kw)
+
+
+def test_fleet_device_lost_requeues_same_attempt(tmp_path):
+    t = {"v": 100.0}
+    q = fleet_state.FleetQueue(
+        str(tmp_path), _policy(),
+        [fleet_spec.JobSpec(id="a", seed=1, shards=8)],
+        fsync=False, now=lambda: t["v"])
+    q.lease("a", "w0")
+    q.mark_running("a", "w0")
+    q.heartbeat("a", checkpoint="/ck/400.npz")
+    assert q.device_lost("a", lost_shard=6, new_shards=4,
+                         cause="injected") == fleet_state.QUEUED
+    j = q.jobs["a"]
+    assert j.device_losses == 1
+    assert j.shards_override == 4      # the degraded width sticks
+    assert j.continuation
+    assert j.resume_from == "/ck/400.npz"
+    rec = q.lease("a", "w1")
+    assert rec["attempt"] == 1         # no failure attempt burned
+    assert rec["resume_from"] == "/ck/400.npz"
+    q.close()
+    # the journal fold reconstructs the degraded width after a kill
+    q2 = fleet_state.FleetQueue(str(tmp_path), _policy(), resume=True,
+                                fsync=False, now=lambda: t["v"])
+    j2 = q2.jobs["a"]
+    assert j2.device_losses == 1 and j2.shards_override == 4
+    q2.close()
+
+
+def test_fleet_device_lost_budget_quarantines(tmp_path):
+    q = fleet_state.FleetQueue(
+        str(tmp_path), _policy(requeue_budget=1),
+        [fleet_spec.JobSpec(id="a", seed=1, shards=8)],
+        fsync=False, now=lambda: 100.0)
+    widths = iter((4, 2, 1))
+    for i in range(3):
+        q.lease("a", f"w{i}")
+        st = q.device_lost("a", lost_shard=0, new_shards=next(widths))
+        if st == fleet_state.QUARANTINED:
+            break
+    assert q.jobs["a"].status == fleet_state.QUARANTINED
+    assert "requeue budget" in q.jobs["a"].quarantine_reason
+    q.close()
+
+
+# ------------------------------------------------------------- lint
+
+def _good_block():
+    shrink = {"action": "shrink", "cause": "DEVICE_LOST", "shard": 2,
+              "from": 8, "to": 4, "resume_time_ns": 2000, "attempt": 1}
+    return {
+        "policy": {"same_mesh_retries": 1},
+        "initial_shards": 8,
+        "final_shards": 4,
+        "losses": [
+            {"fault": "DEVICE_LOST", "shard": 2, "attempt": 1,
+             "mesh": 8},
+            {"fault": "DEVICE_LOST", "shard": 2, "attempt": 1,
+             "mesh": 8},
+        ],
+        "divergences": [],
+        "ladder_steps": [
+            {"action": "retry", "cause": "DEVICE_LOST", "shard": 2,
+             "from": 8, "to": 8, "resume_time_ns": 1000, "attempt": 1},
+            shrink,
+        ],
+        "mesh_transitions": [dict(shrink)],
+    }
+
+
+def test_lint_elastic_block_accept_and_reject():
+    tl = load_tool("telemetry_lint")
+    errs, warns = tl._lint_elastic(_good_block(), None)
+    assert errs == [] and warns == []
+
+    grown = _good_block()
+    grown["final_shards"] = 16
+    errs, _ = tl._lint_elastic(grown, None)
+    assert any("never grows" in e for e in errs)
+
+    subset = _good_block()
+    subset["mesh_transitions"] = []
+    errs, _ = tl._lint_elastic(subset, None)
+    assert any("width-changing subset" in e for e in errs)
+
+    orphan = _good_block()
+    orphan["losses"] = orphan["losses"][:1]   # 2 steps, 1 fault
+    errs, _ = tl._lint_elastic(orphan, None)
+    assert any("every step answers" in e for e in errs)
+
+    # one unanswered fault is the ladder-exhausted signature: warning
+    exhausted = _good_block()
+    exhausted["losses"].append(
+        {"fault": "DEVICE_LOST", "shard": 1, "attempt": 1, "mesh": 4})
+    errs, warns = tl._lint_elastic(exhausted, None)
+    assert errs == []
+    assert any("exhausted" in w for w in warns)
+
+    past = _good_block()
+    past["divergences"] = [
+        {"fault": "SHARD_DIVERGENCE", "shard": 1,
+         "tripped_at_ns": 500, "verified_through_ns": 500,
+         "attempt": 1, "mesh": 8}]
+    past["losses"] = past["losses"][:1]       # keep fault accounting
+    errs, _ = tl._lint_elastic(past, {"sentinel": {
+        "checks": 9, "trips": 1, "shard": 1, "tripped_at_ns": 500,
+        "verified_through_ns": 400}})
+    assert any("verified frontier stops strictly before" in e
+               for e in errs)
+
+    # a divergence with no sentinel latch in health is incoherent
+    nosent = _good_block()
+    nosent["divergences"] = [
+        {"fault": "SHARD_DIVERGENCE", "shard": 1, "tripped_at_ns": 500,
+         "verified_through_ns": 400, "attempt": 1, "mesh": 8}]
+    nosent["losses"] = nosent["losses"][:1]
+    errs, _ = tl._lint_elastic(nosent, {})
+    assert any("sentinel" in e for e in errs)
+
+
+def test_lint_health_sentinel_accept_and_reject():
+    tl = load_tool("telemetry_lint")
+    good = {"checks": 11, "trips": 0, "shard": -1, "tripped_at_ns": 0,
+            "verified_through_ns": 1500000000}
+    assert tl._lint_health_sentinel(good) == []
+
+    over = dict(good, trips=12)
+    assert any("exceeds" in e for e in tl._lint_health_sentinel(over))
+
+    unnamed = dict(good, trips=1, tripped_at_ns=2 * 10**9)
+    errs = tl._lint_health_sentinel(unnamed)
+    assert any("name its suspect shard" in e for e in errs)
+
+    past = dict(good, trips=1, shard=2, tripped_at_ns=10**9,
+                verified_through_ns=10**9)
+    errs = tl._lint_health_sentinel(past)
+    assert any("never verified" in e for e in errs)
+
+
+def test_lint_checkpoint_elastic(tmp_path):
+    tl = load_tool("telemetry_lint")
+    b = _phold_bundle()
+    path = str(tmp_path / "good")
+    checkpoint.save(path, b.sim, time_ns=int(0.5 * SEC), shards=8,
+                    elastic=checkpoint.elastic_meta(b.sim, 8))
+    errs, warns = tl.lint_checkpoint_elastic(path)
+    assert errs == []
+
+    # no sentinel attached, no stamp: trusted as-saved, warned
+    b2 = _phold_bundle(sentinel=False)
+    bare = str(tmp_path / "bare")
+    checkpoint.save(bare, b2.sim, time_ns=0)
+    errs, warns = tl.lint_checkpoint_elastic(bare)
+    assert errs == []
+    assert any("trusted as-saved" in w for w in warns)
+
+    # a frontier past the snapshot time is impossible
+    el = checkpoint.elastic_meta(b.sim, 8)
+    el["last_verified_window"] = int(2 * SEC)
+    late = str(tmp_path / "late")
+    checkpoint.save(late, b.sim, time_ns=int(0.5 * SEC), shards=8,
+                    elastic=el)
+    errs, _ = tl.lint_checkpoint_elastic(late)
+    assert any("verified past the moment" in e for e in errs)
+
+    # digest count must match the stamped mesh width
+    el = checkpoint.elastic_meta(b.sim, 8)
+    el["shard_digests"] = el["shard_digests"][:3]
+    short = str(tmp_path / "short")
+    checkpoint.save(short, b.sim, time_ns=0, shards=8, elastic=el)
+    errs, _ = tl.lint_checkpoint_elastic(short)
+    assert any("digest" in e for e in errs)
+
+
+def test_lint_fleet_elastic_rollup(tmp_path):
+    """The fleet manifest's elastic roll-up must fold exactly from
+    the per-job records — a totals/detail mismatch is an error, and
+    jobs with elastic records demand a roll-up at all."""
+    from shadow_tpu.fleet import manifest as manifest_mod
+
+    tl = load_tool("telemetry_lint")
+    t = {"v": 100.0}
+    q = fleet_state.FleetQueue(
+        str(tmp_path), _policy(),
+        [fleet_spec.JobSpec(id="a", seed=1, shards=8),
+         fleet_spec.JobSpec(id="b", seed=2)],
+        fsync=False, now=lambda: t["v"])
+    q.lease("a", "w0")
+    q.mark_running("a", "w0")
+    q.device_lost("a", lost_shard=6, new_shards=4, cause="injected")
+    q.lease("a", "w1")
+    q.complete("a", {"ok": True, "elastic": _good_block()})
+    q.lease("b", "w0")
+    q.complete("b", {"ok": True})
+    man = manifest_mod.fleet_manifest(q, complete=True)
+    q.close()
+
+    errs, _ = tl.lint_fleet_manifest_obj(json.loads(json.dumps(man)))
+    assert errs == []
+    et = man["elastic"]
+    assert et["jobs"] == 1
+    assert et["device_lost"] == 2          # the block's two losses
+    assert et["mesh_shrinks"] == 1
+    assert et["fleet_requeues"] == 1       # the queue-level requeue
+
+    bad = json.loads(json.dumps(man))
+    bad["elastic"]["device_lost"] = 9
+    errs, _ = tl.lint_fleet_manifest_obj(bad)
+    assert any("fold" in e for e in errs)
+
+    gone = json.loads(json.dumps(man))
+    del gone["elastic"]
+    errs, _ = tl.lint_fleet_manifest_obj(gone)
+    assert any('no "elastic" roll-up' in e for e in errs)

@@ -197,6 +197,18 @@ def main(argv=None) -> int:
                   f"costs {ov}% events/s (>5% bound)",
                   file=sys.stderr)
             failed = 1
+    # sentinel-overhead rows (bench.py BENCH_SENTINEL_OVERHEAD) carry
+    # the A/B cost of the cross-shard integrity screen; same rule as
+    # the causality bound — an SDC screen that taxes throughput >5%
+    # is not an always-on-able instrument
+    for r in new_rows:
+        ov = r.get("sentinel_overhead_pct")
+        if isinstance(ov, (int, float)) and not isinstance(ov, bool) \
+                and ov > 5.0:
+            print(f"bench_regress: {r['metric']}: integrity sentinel "
+                  f"costs {ov}% events/s (>5% bound)",
+                  file=sys.stderr)
+            failed = 1
     for c in comparisons:
         tag = "REGRESSION" if c in regressions else "ok"
         print(f"{tag}: {c['metric']} [{c['backend']}] "

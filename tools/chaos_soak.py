@@ -41,6 +41,12 @@ for lane-isolated health latches.
 --sweep runs one small halving sweep (sweep/driver.py) clean and
 again under one SIGKILL per fleet round, asserting lattice
 conservation, quarantine accounting, and byte-identical rankings.
+--device-loss runs sharded trials killing one victim shard on two
+consecutive dispatches (poisoned dispatch_wrap), asserting the
+supervisor walks retry -> shrink-to-survivors, the healed run is
+byte-identical to an uninterrupted full-width control, and the
+elastic block + checkpoint ledger stamps are lint-clean
+(parallel/elastic.py).
 tests/test_escalate.py imports run_trial() for the fixed-seed tier-1
 smoke; the multi-trial soak is the `slow`-marked variant.
 """
@@ -268,6 +274,175 @@ def _verify_final(sim_healed, make_bundle, errors) -> bool:
                           + jax.tree_util.keystr(pa))
             same = False
     return same
+
+
+def _ensure_host_devices(n: int) -> int:
+    """Give this process `n` host-platform devices (the sharded soak
+    needs a mesh to shrink). Must run BEFORE jax initializes — the
+    flag is read once at backend creation; a too-late call just
+    reports whatever device count the live backend has."""
+    if "jax" not in sys.modules:
+        cur = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in cur:
+            os.environ["XLA_FLAGS"] = (
+                cur + f" --xla_force_host_platform_device_count={n}"
+            ).strip()
+    import jax
+
+    return len(jax.devices())
+
+
+def run_device_loss_trial(seed: int, *, shards: int = 8,
+                          hosts: int = 8, load: int = 2,
+                          sim_s: int = 1, checkpoint_every: int = 2,
+                          workdir: str | None = None,
+                          log=None) -> dict:
+    """Shrink-to-survivors oracle (parallel/elastic.py). One trial:
+
+    1. run the sharded scenario uninterrupted at the full mesh width
+       (the control), sentinel attached so every checkpoint carries
+       the verified-state ledger stamp;
+    2. run it again with a poisoned dispatch killing a seeded victim
+       shard on two consecutive dispatches — the first DEVICE_LOST
+       steps the ladder's same-mesh retry, the second forces the
+       shrink to the pow2-down survivor mesh, resuming from the last
+       verified checkpoint via a digest-checked replan;
+    3. assert the healed run finishes ok at the shrunk width, its
+       elastic block and final checkpoint stamp are lint-clean
+       (tools/telemetry_lint.py), the sentinel stayed untripped, and
+       the final state is byte-identical to the control's (modulo the
+       exchange-tier occupancy telemetry, which legitimately tracks
+       mesh width, and the sentinel's barrier counter, which counts
+       the resume replay)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from shadow_tpu import faults
+    from shadow_tpu.apps import phold
+    from shadow_tpu.parallel import elastic as elastic_mod
+
+    rng = np.random.default_rng(seed)
+    devs = jax.devices()
+    if len(devs) < shards:
+        return {"seed": int(seed), "ok": False, "device_loss_errors": [
+            f"need {shards} devices, have {len(devs)} — set XLA_FLAGS="
+            f"--xla_force_host_platform_device_count={shards} before "
+            f"jax initializes"]}
+    if workdir is None:
+        workdir = tempfile.mkdtemp(prefix="chaos_devloss.")
+    roomy = max(32, 4 * load)
+    caps = {"event_capacity": roomy, "outbox_capacity": roomy,
+            "router_ring": roomy}
+
+    def make_bundle():
+        b = _build(hosts, load, sim_s, seed, caps)
+        b.sim = elastic_mod.attach_sentinel(b.sim)
+        return b
+
+    mesh = Mesh(np.array(devs[:shards]), ("hosts",))
+    common = dict(app_handlers=(phold.handler,), mesh=mesh,
+                  max_retries=2, sleep=lambda s: None, log=log)
+    errors: list = []
+
+    ctrl = faults.run_supervised(
+        make_bundle(), checkpoint_path=os.path.join(workdir, "ctrl.ck"),
+        checkpoint_every_windows=checkpoint_every,
+        run_id=f"dl{seed}.ctrl", **common)
+    if not ctrl.ok:
+        errors.append("control run failed: "
+                      + json.dumps(ctrl.failure_report()))
+
+    # two consecutive poisoned dispatches, mid-run by construction
+    # (the counter is global across attempts: the retry's first
+    # dispatch is the next index, so the pair walks retry -> shrink)
+    victim = int(rng.integers(0, shards))
+    kill_at = int(rng.integers(1, max(2, ctrl.dispatches - 1)))
+    poison = elastic_mod.make_poisoned_dispatch(
+        {kill_at, kill_at + 1}, shard=victim)
+    res = faults.run_supervised(
+        make_bundle(), checkpoint_path=os.path.join(workdir, "ck"),
+        checkpoint_every_windows=checkpoint_every,
+        elastic=elastic_mod.ElasticPolicy(),
+        dispatch_wrap=poison,
+        run_id=f"dl{seed}.chaos", **common)
+    el = res.elastic
+    if not res.ok:
+        errors.append("healed run failed: "
+                      + json.dumps(res.failure_report()))
+    if el is None:
+        errors.append("healed run carries no elastic block")
+    else:
+        if len(el["losses"]) != 2:
+            errors.append(f"expected 2 recorded device losses, got "
+                          f"{len(el['losses'])}")
+        acts = [s["action"] for s in el["ladder_steps"]]
+        if acts != ["retry", "shrink"]:
+            errors.append(f"ladder walked {acts}, expected "
+                          f"['retry', 'shrink']")
+        if el["final_shards"] != shards // 2:
+            errors.append(f"final mesh is {el['final_shards']} "
+                          f"shard(s), expected {shards // 2} "
+                          f"(pow2-down survivors of {shards})")
+        lint = _load_lint()
+        sent = elastic_mod.sentinel_report(res.sim)
+        lerr, _ = lint._lint_elastic(el, {"sentinel": sent})
+        if lerr:
+            errors.append(f"elastic block not lint-clean: {lerr[:3]}")
+        if sent and sent["trips"]:
+            errors.append(f"sentinel tripped during a pure device-"
+                          f"loss trial: {sent}")
+        if res.checkpoints:
+            cerr, _ = lint.lint_checkpoint_elastic(
+                res.checkpoints[-1][0])
+            if cerr:
+                errors.append(f"final checkpoint stamp not "
+                              f"lint-clean: {cerr[:3]}")
+        else:
+            errors.append("healed run saved no checkpoints — the "
+                          "shrink resumed from nothing")
+
+    # the digest oracle: healed final state == uninterrupted control
+    diverged = []
+    if ctrl.ok and res.ok:
+        skip = {".outbox.max_occupied", ".outbox.narrow_hit",
+                ".outbox.narrow_miss"}
+        fa = jax.tree_util.tree_flatten_with_path(res.sim)[0]
+        fb = jax.tree_util.tree_flatten_with_path(ctrl.sim)[0]
+        for (pa, la), (_, lb) in zip(fa, fb):
+            key = jax.tree_util.keystr(pa)
+            if key in skip or key.startswith(".sentinel"):
+                continue
+            if not np.array_equal(np.asarray(la), np.asarray(lb)):
+                diverged.append(key)
+        if diverged:
+            errors.append(f"healed state diverges from the "
+                          f"uninterrupted control at {diverged[:5]} — "
+                          f"shrink-resume is not bit-exact")
+        sa = elastic_mod.sentinel_report(res.sim)
+        sb = elastic_mod.sentinel_report(ctrl.sim)
+        if sa and sb and sa["verified_through_ns"] \
+                != sb["verified_through_ns"]:
+            errors.append(
+                f"verified frontier diverged: healed "
+                f"{sa['verified_through_ns']} vs control "
+                f"{sb['verified_through_ns']}")
+
+    return {
+        "seed": int(seed),
+        "ok": not errors,
+        "shards": int(shards),
+        "victim": victim,
+        "kill_at_dispatch": kill_at,
+        "control_dispatches": ctrl.dispatches,
+        "final_shards": (el or {}).get("final_shards"),
+        "ladder": [s["action"] for s in (el or {}).get(
+            "ladder_steps", [])],
+        "losses": len((el or {}).get("losses", [])),
+        "verified_through_ns": (elastic_mod.sentinel_report(res.sim)
+                                or {}).get("verified_through_ns")
+        if res.sim is not None else None,
+        "device_loss_errors": errors,
+    }
 
 
 def _build_packed(replicas, hosts, load, sim_s, seed, caps):
@@ -861,6 +1036,17 @@ def main(argv=None) -> int:
                          "resume")
     ap.add_argument("--lanes", type=int, default=6,
                     help="resident lane count for --churn")
+    ap.add_argument("--device-loss", action="store_true",
+                    help="elastic-recovery mode: sharded trials with "
+                         "a poisoned dispatch killing one shard twice "
+                         "(retry, then shrink to survivors) — asserts "
+                         "the healed run is byte-identical to an "
+                         "uninterrupted full-width control and the "
+                         "elastic block + checkpoint ledger stamp are "
+                         "lint-clean (parallel/elastic.py)")
+    ap.add_argument("--shards", type=int, default=8,
+                    help="mesh width for --device-loss (forces that "
+                         "many host-platform devices)")
     ap.add_argument("--sweep", action="store_true",
                     help="sweep-under-fire mode: run one small "
                          "halving sweep (sweep/driver.py) clean, then "
@@ -873,6 +1059,24 @@ def main(argv=None) -> int:
 
     if args.platform:
         os.environ["JAX_PLATFORMS"] = args.platform
+    if args.device_loss:
+        if args.jobs > 0 or args.replicas > 1 or args.churn \
+                or args.sweep:
+            ap.error("--device-loss is a standalone elastic soak; it "
+                     "does not combine with --jobs/--replicas/--churn/"
+                     "--sweep")
+        have = _ensure_host_devices(args.shards)
+        failed = 0
+        for k in range(args.trials):
+            rep = run_device_loss_trial(
+                args.seed + k, shards=min(args.shards, have),
+                hosts=args.hosts, load=args.load, sim_s=args.sim_s)
+            print(json.dumps(rep), flush=True)
+            if not rep["ok"]:
+                failed += 1
+        print(f"device-loss soak: {args.trials - failed}/"
+              f"{args.trials} trials ok", file=sys.stderr)
+        return 1 if failed else 0
     if args.sweep:
         if args.jobs > 0 or args.replicas > 1 or args.churn:
             ap.error("--sweep is a standalone sweep-driver soak; it "

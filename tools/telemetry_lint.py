@@ -750,6 +750,275 @@ def _lint_causality(cz, tel, flows) -> tuple[list, list]:
     return errors, warnings
 
 
+# elastic degradation-ladder actions (faults/supervisor.py
+# _elastic_step) — duplicated literally so the lint stays importable
+# without the engine
+_ELASTIC_ACTIONS = ("retry", "shrink", "serial")
+
+
+def _is_pow2(n) -> bool:
+    return (isinstance(n, int) and not isinstance(n, bool)
+            and n >= 1 and not (n & (n - 1)))
+
+
+def _lint_elastic(el, health) -> tuple[list, list]:
+    """(errors, warnings) for an "elastic" block (faults/supervisor.py
+    _elastic_block; rides the run manifest and the fleet manifest's
+    per-job entries). The invariants are the degradation ladder's
+    contract: mesh widths are powers of two that only hold or shrink
+    (monotone transitions, contiguous chain), every recorded fault is
+    answered by at most one ladder step (losses + divergences ==
+    ladder steps, short exactly one when the ladder exhausted),
+    mesh_transitions is exactly the width-changing subset of the
+    steps, and a divergence's verified frontier can never pass its own
+    trip point."""
+    errors: list = []
+    warnings: list = []
+    if not isinstance(el, dict):
+        return (["elastic must be an object"], [])
+    w = "elastic"
+    init, fin = el.get("initial_shards"), el.get("final_shards")
+    for k, v in (("initial_shards", init), ("final_shards", fin)):
+        if not _is_pow2(v):
+            errors.append(f"{w}.{k} must be a positive power of two, "
+                          f"got {v!r}")
+    if _is_pow2(init) and _is_pow2(fin) and fin > init:
+        errors.append(f"{w}: final_shards={fin} exceeds initial_"
+                      f"shards={init} — the ladder only holds or "
+                      f"shrinks the mesh, never grows it")
+    lists = {}
+    for k in ("losses", "divergences", "ladder_steps",
+              "mesh_transitions"):
+        v = el.get(k)
+        if not isinstance(v, list):
+            errors.append(f"{w}.{k} must be an array")
+            lists[k] = []
+        else:
+            lists[k] = v
+    for i, ls in enumerate(lists["losses"]):
+        where = f"{w}.losses[{i}]"
+        if not isinstance(ls, dict) \
+                or ls.get("fault") != "DEVICE_LOST":
+            errors.append(f'{where}: must be an object with '
+                          f'fault="DEVICE_LOST"')
+            continue
+        sh = ls.get("shard")
+        if not isinstance(sh, int) or isinstance(sh, bool) or sh < -1:
+            errors.append(f"{where}: shard must be an integer >= -1 "
+                          f"(-1 = unattributed), got {sh!r}")
+    for i, dv in enumerate(lists["divergences"]):
+        where = f"{w}.divergences[{i}]"
+        if not isinstance(dv, dict) \
+                or dv.get("fault") != "SHARD_DIVERGENCE":
+            errors.append(f'{where}: must be an object with '
+                          f'fault="SHARD_DIVERGENCE"')
+            continue
+        sh = dv.get("shard")
+        if not isinstance(sh, int) or isinstance(sh, bool) or sh < 0:
+            errors.append(f"{where}: shard must name the offending "
+                          f"shard (integer >= 0), got {sh!r}")
+        va, ta = dv.get("verified_through_ns"), dv.get("tripped_at_ns")
+        for k, v in (("verified_through_ns", va),
+                     ("tripped_at_ns", ta)):
+            if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+                errors.append(f"{where}: {k} must be a non-negative "
+                              f"integer, got {v!r}")
+        if isinstance(va, int) and isinstance(ta, int) \
+                and not isinstance(va, bool) \
+                and not isinstance(ta, bool) and va >= ta > 0:
+            errors.append(
+                f"{where}: verified_through_ns={va} reaches its own "
+                f"trip point (tripped_at_ns={ta}) — the verified "
+                f"frontier stops strictly before the first tripped "
+                f"barrier")
+    cur = init if _is_pow2(init) else None
+    for i, st in enumerate(lists["ladder_steps"]):
+        where = f"{w}.ladder_steps[{i}]"
+        if not isinstance(st, dict):
+            errors.append(f"{where}: must be an object")
+            cur = None
+            continue
+        action = st.get("action")
+        if action not in _ELASTIC_ACTIONS:
+            errors.append(f"{where}: unknown action {action!r} "
+                          f"(expected one of {_ELASTIC_ACTIONS})")
+        f_, t_ = st.get("from"), st.get("to")
+        if not _is_pow2(f_) or not _is_pow2(t_):
+            errors.append(f"{where}: from/to must be positive powers "
+                          f"of two, got {f_!r} -> {t_!r}")
+            cur = None
+            continue
+        if action == "retry" and t_ != f_:
+            errors.append(f"{where}: a retry holds the mesh, got "
+                          f"{f_} -> {t_}")
+        if action == "shrink" and t_ >= f_:
+            errors.append(f"{where}: a shrink must strictly reduce "
+                          f"the width, got {f_} -> {t_}")
+        if action == "serial" and t_ != 1:
+            errors.append(f"{where}: serial means one shard, got "
+                          f"to={t_}")
+        if cur is not None and f_ != cur:
+            errors.append(f"{where}: from={f_} breaks the chain "
+                          f"(previous width {cur}) — ladder steps "
+                          f"must be contiguous")
+        cur = t_
+        rt = st.get("resume_time_ns")
+        if not isinstance(rt, int) or isinstance(rt, bool) or rt < 0:
+            errors.append(f"{where}: resume_time_ns must be a "
+                          f"non-negative integer, got {rt!r}")
+    if lists["ladder_steps"] and cur is not None \
+            and _is_pow2(fin) and cur != fin:
+        errors.append(f"{w}: final_shards={fin} but the last ladder "
+                      f"step left the mesh at {cur}")
+    want_trans = [s for s in lists["ladder_steps"]
+                  if isinstance(s, dict) and s.get("from") != s.get("to")]
+    if isinstance(el.get("mesh_transitions"), list) \
+            and lists["mesh_transitions"] != want_trans:
+        errors.append(
+            f"{w}.mesh_transitions must be exactly the width-changing "
+            f"subset of ladder_steps ({len(want_trans)} step(s)), got "
+            f"{len(lists['mesh_transitions'])}")
+    n_faults = len(lists["losses"]) + len(lists["divergences"])
+    n_steps = len(lists["ladder_steps"])
+    if n_steps > n_faults:
+        errors.append(
+            f"{w}: {n_steps} ladder step(s) but only {n_faults} "
+            f"recorded fault(s) — every step answers exactly one "
+            f"loss or divergence")
+    elif n_faults - n_steps > 1:
+        errors.append(
+            f"{w}: {n_faults} fault(s) but only {n_steps} ladder "
+            f"step(s) — the ladder answers every fault except, at "
+            f"most, the one that exhausted it")
+    elif n_faults == n_steps + 1:
+        warnings.append(f"{w}: the ladder exhausted on the final "
+                        f"fault (the run ended degraded-and-failed; "
+                        f"the fleet layer owns the next requeue)")
+    sent = (health or {}).get("sentinel") \
+        if isinstance(health, dict) else None
+    if lists["divergences"] and health is not None and not sent:
+        errors.append(
+            f"{w}: divergence records but no sentinel block in "
+            f"health — a SHARD_DIVERGENCE verdict can only come from "
+            f"the integrity sentinel latch")
+    return errors, warnings
+
+
+def _lint_health_sentinel(sent) -> list:
+    """Errors for a health block's "sentinel" latch report
+    (faults/health.py failure_report): trips never exceed checks, a
+    tripped latch names its suspect shard, and the verified frontier
+    stops strictly before the first tripped barrier."""
+    errors: list = []
+    w = "health.sentinel"
+    if not isinstance(sent, dict):
+        return [f"{w} must be an object"]
+    vals = {}
+    for k in ("checks", "trips", "tripped_at_ns",
+              "verified_through_ns"):
+        v = sent.get(k)
+        if not isinstance(v, int) or isinstance(v, bool) or v < 0:
+            errors.append(f"{w}.{k} must be a non-negative integer, "
+                          f"got {v!r}")
+        else:
+            vals[k] = v
+    sh = sent.get("shard")
+    if not isinstance(sh, int) or isinstance(sh, bool) or sh < -1:
+        errors.append(f"{w}.shard must be an integer >= -1, got {sh!r}")
+    if vals.get("trips", 0) > vals.get("checks", 0):
+        errors.append(f"{w}: trips={vals.get('trips')} exceeds "
+                      f"checks={vals.get('checks')} — the latch "
+                      f"counts a subset of the barrier checks")
+    if vals.get("trips"):
+        if isinstance(sh, int) and not isinstance(sh, bool) and sh < 0:
+            errors.append(f"{w}: a tripped sentinel must name its "
+                          f"suspect shard")
+        if "tripped_at_ns" in vals and "verified_through_ns" in vals \
+                and vals["tripped_at_ns"] > 0 \
+                and vals["verified_through_ns"] >= vals["tripped_at_ns"]:
+            errors.append(
+                f"{w}: verified_through_ns="
+                f"{vals['verified_through_ns']} reaches the trip "
+                f"point tripped_at_ns={vals['tripped_at_ns']} — a "
+                f"tripped barrier is never verified")
+    return errors
+
+
+def lint_checkpoint_elastic(path: str) -> tuple[list, list]:
+    """(errors, warnings) for a snapshot's verified-state ledger
+    stamp (utils/checkpoint.py elastic_meta / replan_shards). Pure
+    numpy + json — no engine import. The invariants: the stamped
+    shard_digests list carries exactly one digest per recorded shard,
+    last_verified_window never passes the snapshot's own resume time
+    (a snapshot cannot be verified past the moment it was taken), and
+    every recorded replan is a pow2 -> pow2 restamp."""
+    import numpy as np
+
+    errors: list = []
+    warnings: list = []
+    p = path if path.endswith(".npz") else path + ".npz"
+    try:
+        z = np.load(p, allow_pickle=False)
+    except (OSError, ValueError) as e:
+        return ([f"{path}: unreadable npz: {e}"], [])
+    with z:
+        if "__meta__" not in z.files:
+            return ([f"{path}: missing __meta__ — not a snapshot"], [])
+        try:
+            meta = json.loads(str(z["__meta__"]))
+        except ValueError as e:
+            return ([f"{path}: __meta__ is not JSON: {e}"], [])
+    shards = meta.get("shards")
+    t = meta.get("time_ns")
+    if not _is_pow2(shards):
+        errors.append(f"{path}: __meta__.shards must be a positive "
+                      f"power of two, got {shards!r}")
+    if not isinstance(t, int) or isinstance(t, bool) or t < 0:
+        errors.append(f"{path}: __meta__.time_ns must be a "
+                      f"non-negative integer, got {t!r}")
+    el = meta.get("elastic")
+    if el is None:
+        warnings.append(f"{path}: snapshot carries no elastic stamp "
+                        f"(no sentinel attached — trusted as-saved)")
+        return errors, warnings
+    if not isinstance(el, dict):
+        return (errors + [f"{path}: __meta__.elastic must be an "
+                          f"object"], warnings)
+    digs = el.get("shard_digests")
+    if not isinstance(digs, list) or not all(
+            isinstance(d, str) and d for d in digs):
+        errors.append(f"{path}: elastic.shard_digests must be a list "
+                      f"of digest strings")
+    elif _is_pow2(shards) and len(digs) != shards:
+        errors.append(
+            f"{path}: elastic.shard_digests holds {len(digs)} "
+            f"digest(s) but the snapshot records shards={shards} — "
+            f"one digest per shard, exactly")
+    lvw = el.get("last_verified_window")
+    if lvw is not None:
+        if not isinstance(lvw, int) or isinstance(lvw, bool) or lvw < 0:
+            errors.append(f"{path}: elastic.last_verified_window must "
+                          f"be a non-negative integer or null, got "
+                          f"{lvw!r}")
+        elif isinstance(t, int) and not isinstance(t, bool) and lvw > t:
+            errors.append(
+                f"{path}: elastic.last_verified_window={lvw} passes "
+                f"the snapshot's own resume time time_ns={t} — a "
+                f"snapshot cannot be verified past the moment it was "
+                f"taken")
+    sent = el.get("sentinel")
+    if sent is not None:
+        errors += [f"{path}: {m.replace('health.sentinel', 'elastic.sentinel')}"
+                   for m in _lint_health_sentinel(sent)]
+    for i, rp in enumerate(el.get("replans") or []):
+        where = f"{path}: elastic.replans[{i}]"
+        if not isinstance(rp, dict) or not _is_pow2(rp.get("from")) \
+                or not _is_pow2(rp.get("to")):
+            errors.append(f"{where}: must record a pow2 -> pow2 "
+                          f"restamp, got {rp!r}")
+    return errors, warnings
+
+
 def _lint_admission(adm) -> tuple[list, list]:
     """(errors, warnings) for an "admission" block — either a resident
     program's lease-table block (fleet/admission.py manifest_block,
@@ -1874,6 +2143,19 @@ def lint_manifest_obj(man) -> tuple[list, list]:
         e2, w2 = _lint_admission(adm)
         errors += e2
         warnings += w2
+    # elastic block (optional): degraded-mesh recovery record
+    el = man.get("elastic")
+    if el is not None:
+        e2, w2 = _lint_elastic(el, man.get("health"))
+        errors += e2
+        warnings += w2
+    # sentinel latch report (optional, inside health): validated even
+    # without an elastic block — a sentinel-armed run that never
+    # degraded still stamps its check/trip accounting
+    sent = (man.get("health") or {}).get("sentinel") \
+        if isinstance(man.get("health"), dict) else None
+    if sent is not None:
+        errors += _lint_health_sentinel(sent)
     # profile block (optional): a pointer to a jax.profiler artifact
     prof = man.get("profile")
     if prof is not None:
@@ -2181,6 +2463,67 @@ def lint_fleet_manifest_obj(man) -> tuple[list, list]:
         errors.append(f'{len(job_cz)} job(s) carry causality '
                       f'summaries but the fleet manifest has no '
                       f'"causality" roll-up')
+    # elastic roll-up (optional): same derived-totals rule — the
+    # fleet block must be the exact fold of the per-job elastic
+    # records and device-loss requeue counts
+    et = man.get("elastic")
+    job_el = {jid: j for jid, j in sorted(jobs.items())
+              if isinstance(j, dict)
+              and (isinstance(j.get("elastic"), dict)
+                   or int(j.get("device_losses", 0) or 0) > 0)}
+    for jid, j in sorted(jobs.items()):
+        if not isinstance(j, dict):
+            continue
+        dl = j.get("device_losses", 0)
+        if not isinstance(dl, int) or isinstance(dl, bool) or dl < 0:
+            errors.append(f"jobs[{jid}].device_losses must be a "
+                          f"non-negative integer, got {dl!r}")
+        so = j.get("shards_override")
+        if so is not None and not _is_pow2(so):
+            errors.append(f"jobs[{jid}].shards_override must be a "
+                          f"positive power of two, got {so!r}")
+        jel = j.get("elastic")
+        if jel is not None:
+            # per-job structural checks; health lives in the job's
+            # run_manifest, not here, so sentinel cross-checks are
+            # skipped (health=None)
+            e2, w2 = _lint_elastic(jel, None)
+            errors += [f"jobs[{jid}].{m}" for m in e2]
+            warnings += [f"jobs[{jid}].{m}" for m in w2]
+    if et is not None:
+        if not isinstance(et, dict):
+            errors.append('"elastic" must be an object')
+        elif not job_el:
+            errors.append('fleet "elastic" roll-up with no elastic '
+                          'job entries')
+        else:
+            if et.get("jobs") != len(job_el):
+                errors.append(f"elastic.jobs={et.get('jobs')!r} but "
+                              f"{len(job_el)} job(s) carry an elastic "
+                              f"record or device losses")
+            want = {"device_lost": 0, "shard_divergence": 0,
+                    "mesh_shrinks": 0, "ladder_steps": 0,
+                    "fleet_requeues": 0}
+            for j in job_el.values():
+                want["fleet_requeues"] += int(
+                    j.get("device_losses", 0) or 0)
+                jel = j.get("elastic")
+                if isinstance(jel, dict):
+                    want["device_lost"] += len(jel.get("losses") or ())
+                    want["shard_divergence"] += len(
+                        jel.get("divergences") or ())
+                    want["mesh_shrinks"] += len(
+                        jel.get("mesh_transitions") or ())
+                    want["ladder_steps"] += len(
+                        jel.get("ladder_steps") or ())
+            for k, v in want.items():
+                if et.get(k) != v:
+                    errors.append(f"elastic.{k}={et.get(k)!r} but the "
+                                  f"job records fold to {v}")
+    elif job_el:
+        errors.append(f'{len(job_el)} job(s) carry elastic records '
+                      f'but the fleet manifest has no "elastic" '
+                      f'roll-up')
     # admission block (optional): a resident program's lease-table
     # roll-up (fleet/admission.py manifest_block)
     adm = man.get("admission")
@@ -2226,13 +2569,16 @@ def main(argv=None) -> int:
     ap.add_argument("--salvage", default=None,
                     help="lane-salvage .npz path (lease eviction / "
                          "quarantine artifact)")
+    ap.add_argument("--checkpoint", default=None,
+                    help="snapshot .npz path — validate the "
+                         "verified-state ledger stamp (elastic meta)")
     ap.add_argument("-q", "--quiet", action="store_true",
                     help="suppress warnings, print errors only")
     args = ap.parse_args(argv)
     if not (args.trace or args.manifest or args.fleet_manifest
-            or args.salvage):
-        ap.error("give --trace, --manifest, --fleet-manifest and/or "
-                 "--salvage")
+            or args.salvage or args.checkpoint):
+        ap.error("give --trace, --manifest, --fleet-manifest, "
+                 "--salvage and/or --checkpoint")
 
     errors: list = []
     warnings: list = []
@@ -2252,6 +2598,10 @@ def main(argv=None) -> int:
         warnings += [f"{path}: {m}" for m in w2]
     if args.salvage:
         errors += lint_salvage(args.salvage)
+    if args.checkpoint:
+        e2, w2 = lint_checkpoint_elastic(args.checkpoint)
+        errors += e2
+        warnings += w2
 
     for e in errors:
         print(f"ERROR: {e}", file=sys.stderr)

@@ -142,6 +142,48 @@ def summarize(trace: dict, manifest: dict | None = None,
         cz = manifest.get("causality")
         if cz:
             lines.append(_window_advance_section(cz, top=top))
+        el = manifest.get("elastic")
+        if el:
+            lines.append(_elastic_section(el, manifest))
+    return "\n".join(lines)
+
+
+def _elastic_section(el: dict, manifest: dict) -> str:
+    """The elastic-recovery view of a manifest: initial vs final mesh
+    width, every device loss and divergence, and the ladder the
+    supervisor walked — the one-screen answer to "how degraded was
+    this run, and did it stay verified"."""
+    lines = []
+    losses = el.get("losses") or []
+    divs = el.get("divergences") or []
+    steps = el.get("ladder_steps") or []
+    trans = el.get("mesh_transitions") or []
+    lines.append(
+        f"elastic: mesh {el.get('initial_shards')} -> "
+        f"{el.get('final_shards')} shard(s), "
+        f"{len(losses)} device loss(es), {len(divs)} divergence(s), "
+        f"{len(trans)} shrink(s) over {len(steps)} ladder step(s)")
+    for ls in losses:
+        lines.append(
+            f"  DEVICE_LOST shard {ls.get('shard')} "
+            f"(attempt {ls.get('attempt')}, mesh {ls.get('mesh')}): "
+            f"{ls.get('cause', '?')}")
+    for dv in divs:
+        lines.append(
+            f"  SHARD_DIVERGENCE shard {dv.get('shard')} at "
+            f"t={dv.get('tripped_at_ns')}ns (verified through "
+            f"{dv.get('verified_through_ns')}ns)")
+    for st in steps:
+        lines.append(
+            f"  ladder: {st.get('action')} {st.get('from')} -> "
+            f"{st.get('to')} shard(s) on {st.get('cause')}, resume at "
+            f"t={st.get('resume_time_ns')}ns")
+    sent = (manifest.get("health") or {}).get("sentinel")
+    if sent:
+        lines.append(
+            f"  sentinel: {sent.get('checks', 0)} barrier check(s), "
+            f"{sent.get('trips', 0)} trip(s), verified through "
+            f"t={sent.get('verified_through_ns', 0)}ns")
     return "\n".join(lines)
 
 
